@@ -1,0 +1,28 @@
+"""shockwave-tpu: a TPU-native cluster-scheduling framework.
+
+A brand-new implementation of the capabilities of the Shockwave/Gavel
+scheduler (reference: JitongZ/shockwave-replication): round-based scheduling
+of DL training jobs on an accelerator cluster, a trace-driven discrete-event
+simulator, a library of allocation policies, and the Shockwave
+Volatile-Fisher-Market planner with a Bayesian (Dirichlet) dynamic-adaptation
+predictor.
+
+Where the reference solves the per-round Eisenberg-Gale program as a
+CVXPY+GUROBI MILP on CPU (reference: scheduler/shockwave.py:330-411), this
+framework evaluates it as a batched, jitted projected-gradient program in JAX
+on TPU, registered as policy name ``shockwave_tpu``.
+
+Layout (bottom-up):
+  data/       trace parsing/generation, throughput oracles, epoch profiles
+  core/       jobs, job ids, round-based scheduler + simulator, metrics
+  predictor/  per-job epoch metadata + Dirichlet remaining-runtime predictor
+  solver/     the JAX Eisenberg-Gale solver + integer rounding/packing
+  policies/   allocation-policy library (name -> policy registry)
+  runtime/    physical-cluster control plane (RPC, workers, leases)
+  models/     JAX/Flax example workload models (the payloads)
+  ops/        low-level JAX/Pallas kernels used by the solver
+  parallel/   device-mesh / sharding helpers for multi-chip solves
+  utils/      logging and misc helpers
+"""
+
+__version__ = "0.1.0"

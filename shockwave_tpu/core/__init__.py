@@ -1,0 +1,4 @@
+from shockwave_tpu.core.ids import JobId
+from shockwave_tpu.core.job import Job
+
+__all__ = ["JobId", "Job"]

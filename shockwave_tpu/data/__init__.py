@@ -1,0 +1,13 @@
+from shockwave_tpu.data.trace import parse_trace, write_trace
+from shockwave_tpu.data.throughputs import read_throughputs
+from shockwave_tpu.data.profiles import synthesize_profiles, load_or_synthesize_profiles
+from shockwave_tpu.data import bs_patterns
+
+__all__ = [
+    "parse_trace",
+    "write_trace",
+    "read_throughputs",
+    "synthesize_profiles",
+    "load_or_synthesize_profiles",
+    "bs_patterns",
+]

@@ -1,0 +1,204 @@
+"""Data-layer tests: trace I/O, throughput oracles, batch-size schedules,
+profile synthesis. Mirrors the reference's fast deterministic test style
+(reference: scheduler/tests/policies_tests.py uses tiny hand-built inputs)."""
+
+import glob
+import math
+import os
+
+import pytest
+
+from shockwave_tpu.core.ids import JobId
+from shockwave_tpu.core.job import Job
+from shockwave_tpu.data import bs_patterns, parse_trace, write_trace
+from shockwave_tpu.data.default_oracle import generate_oracle
+from shockwave_tpu.data.profiles import synthesize_profiles
+from shockwave_tpu.data.throughputs import read_throughputs, stringify_throughputs
+from shockwave_tpu.data.workload_info import num_epochs, steps_per_epoch
+
+REFERENCE_TRACES = sorted(
+    glob.glob("/root/reference/scheduler/traces/shockwave/*.trace")
+)
+
+
+def test_job_id_ordering_and_overlap():
+    a, b = JobId(1), JobId(2)
+    pair = JobId(2, 1)
+    assert pair.is_pair and pair.as_tuple() == (1, 2)
+    assert a < b < JobId(2, 3)
+    assert a.overlaps_with(pair) and b.overlaps_with(pair)
+    assert not JobId(3).overlaps_with(pair)
+    assert sorted([pair, b, a]) == [a, b, pair]
+    assert JobId(5) == 5
+    with pytest.raises(ValueError):
+        pair.overlaps_with(a)
+
+
+def test_job_batch_size_update():
+    job = Job(
+        job_type="LM (batch size 10)",
+        command="python3 main.py --cuda --data %s/wikitext2 --batch_size 10",
+    )
+    assert job.model == "LM" and job.batch_size == 10
+    job.update_batch_size(20)
+    assert job.batch_size == 20
+    assert job.command.endswith("--batch_size 20")
+    # Translation commands carry a trailing flag after the batch size.
+    tj = Job(
+        job_type="Transformer (batch size 64)",
+        command=(
+            "python3 train.py -data %s/translation/multi30k.atok.low.pt"
+            " -batch_size 64 -proj_share_weight"
+        ),
+    )
+    tj.update_batch_size(128)
+    assert "-batch_size 128 -proj_share_weight" in tj.command
+    assert tj.job_type == "Transformer (batch size 128)"
+
+
+@pytest.mark.skipif(not REFERENCE_TRACES, reason="reference traces unavailable")
+def test_parse_reference_traces():
+    for trace in REFERENCE_TRACES:
+        jobs, arrivals = parse_trace(trace)
+        assert len(jobs) == len(arrivals) > 0
+        assert arrivals == sorted(arrivals)
+        for job in jobs:
+            assert job.scale_factor >= 1
+            assert job.mode in ("static", "accordion", "gns")
+            assert job.batch_size > 0 and job.model
+
+
+def test_trace_roundtrip(tmp_path):
+    jobs = [
+        Job(
+            job_type="ResNet-18 (batch size 32)",
+            command="python3 main.py --data_dir=%s/cifar10 --batch_size 32",
+            working_directory="image_classification/cifar10",
+            num_steps_arg="--num_steps",
+            total_steps=5000,
+            duration=1234.0,
+            scale_factor=2,
+            mode="accordion",
+        )
+    ]
+    path = str(tmp_path / "t.trace")
+    write_trace(path, jobs, [17.0])
+    jobs2, arrivals2 = parse_trace(path)
+    assert arrivals2 == [17.0]
+    assert jobs2[0].job_type == jobs[0].job_type
+    assert jobs2[0].total_steps == 5000
+    assert jobs2[0].scale_factor == 2
+    assert jobs2[0].mode == "accordion"
+
+
+def test_throughputs_roundtrip(tmp_path):
+    import json
+
+    oracle = generate_oracle()
+    path = str(tmp_path / "oracle.json")
+    with open(path, "w") as f:
+        json.dump(stringify_throughputs(oracle), f)
+    parsed = read_throughputs(path)
+    key = ("ResNet-18 (batch size 32)", 1)
+    assert parsed["v100"][key]["null"] == pytest.approx(oracle["v100"][key]["null"])
+    pair_key = ("LM (batch size 10)", 1)
+    assert parsed["v100"][key][pair_key] == pytest.approx(
+        oracle["v100"][key][pair_key]
+    )
+
+
+@pytest.mark.skipif(
+    not os.path.exists("/root/reference/scheduler/simulation_throughputs.json"),
+    reason="reference oracle unavailable",
+)
+def test_read_reference_oracle():
+    parsed = read_throughputs("/root/reference/scheduler/simulation_throughputs.json")
+    assert "v100" in parsed
+    some_key = next(iter(parsed["v100"]))
+    assert isinstance(some_key, tuple) and isinstance(some_key[1], int)
+    assert "null" in parsed["v100"][some_key]
+
+
+def test_epoch_math():
+    assert steps_per_epoch("ResNet-18", 32) == math.ceil(50000 / 32)
+    assert num_epochs("ResNet-18", 32, steps_per_epoch("ResNet-18", 32) * 3) == 3
+    assert num_epochs("ResNet-18", 32, 1) == 1
+
+
+def test_accordion_pattern_shape():
+    pat = bs_patterns.accordion_pattern("ResNet-18 (batch size 32)", 32, 300)
+    assert len(pat) == 300
+    # Head critical regime keeps the original batch size.
+    assert all(bs == 32 for bs in pat[:10])
+    # First 30% of the job is forced critical.
+    assert all(bs == 32 for bs in pat[: int(300 * 0.3) + 1])
+    # Past 30%, non-critical epochs scale to the model max.
+    assert pat[120] == 256
+    # Mid-training critical windows drop back to the original size.
+    assert all(bs == 32 for bs in pat[150:160])
+    assert all(bs == 32 for bs in pat[250:260])
+    # Transformer is exempt.
+    tpat = bs_patterns.accordion_pattern("Transformer (batch size 64)", 64, 100)
+    assert set(tpat) == {64}
+
+
+def test_gns_pattern_doubling_and_clamp():
+    pat = bs_patterns.gns_pattern("LM (batch size 10)", 10, 100, 1)
+    assert pat[:11] == [10] * 11
+    assert pat[11] == 20 and pat[20] == 20
+    assert pat[21] == 40 and pat[40] == 40
+    # 8x would be 80 == LM max; clamped at 80.
+    assert pat[41] == 80 and pat[98] == 80
+    # Reference quirk: last epoch keeps the base size when it falls outside
+    # the first breakpoint's range.
+    assert pat[99] == 10
+    # Below the activation threshold nothing changes.
+    short = bs_patterns.gns_pattern("LM (batch size 10)", 10, 11, 1)
+    assert set(short) == {10}
+    # Unknown (model, bs, sf) combinations stay static.
+    static = bs_patterns.gns_pattern("LM (batch size 80)", 80, 100, 1)
+    assert set(static) == {80}
+
+
+def test_profile_synthesis():
+    oracle = generate_oracle()
+    jobs = [
+        Job(
+            job_type="ResNet-18 (batch size 32)",
+            total_steps=steps_per_epoch("ResNet-18", 32) * 50,
+            scale_factor=1,
+            mode="gns",
+        ),
+        Job(
+            job_type="LM (batch size 10)",
+            total_steps=steps_per_epoch("LM", 10) * 30,
+            scale_factor=2,
+            mode="accordion",
+        ),
+    ]
+    profiles = synthesize_profiles(jobs, oracle)
+    for i, job in enumerate(jobs):
+        p = profiles[i]
+        assert p["num_epochs"] == num_epochs(job.model, job.batch_size, job.total_steps)
+        assert len(p["bs_every_epoch"]) == p["num_epochs"]
+        assert len(p["duration_every_epoch"]) == p["num_epochs"]
+        assert p["duration"] == pytest.approx(sum(p["duration_every_epoch"]))
+        assert all(d > 0 for d in p["duration_every_epoch"])
+        assert p["scale_factor"] == job.scale_factor
+    # GNS epochs with bigger batches take no longer per sample: fewer steps
+    # but lower steps/s roughly cancel; durations must stay positive/finite.
+    assert profiles[0]["bs_every_epoch"][0] == 32
+
+
+@pytest.mark.skipif(not REFERENCE_TRACES, reason="reference traces unavailable")
+def test_profiles_for_full_reference_trace():
+    oracle = generate_oracle()
+    trace = [t for t in REFERENCE_TRACES if t.startswith(
+        "/root/reference/scheduler/traces/shockwave/120_"
+    )][0]
+    jobs, _ = parse_trace(trace)
+    profiles = synthesize_profiles(jobs, oracle)
+    assert len(profiles) == len(jobs)
+    for p in profiles.values():
+        assert p["num_epochs"] >= 1
+        assert p["duration"] > 0
