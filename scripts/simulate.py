@@ -1,0 +1,156 @@
+#!/usr/bin/env python3
+"""Trace-driven simulation driver.
+
+Equivalent of the reference's primary entry point
+(reference: scheduler/scripts/drivers/simulate_scheduler_with_trace.py).
+Parses a trace, loads or synthesizes the throughput oracle and epoch
+profiles, runs the round-based simulator under the chosen policy, prints
+makespan / average JCT / utilization / finish-time fairness, and writes a
+result pickle with the same keys the reference's plotting consumes.
+"""
+
+import argparse
+import json
+import os
+import pickle
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from shockwave_tpu.core.scheduler import Scheduler
+from shockwave_tpu.data import (
+    load_or_synthesize_profiles,
+    parse_trace,
+    read_throughputs,
+)
+from shockwave_tpu.data.default_oracle import generate_oracle
+from shockwave_tpu.policies import get_available_policies, get_policy
+
+
+def main(args):
+    jobs, arrival_times = parse_trace(args.trace_file)
+
+    if args.throughputs_file:
+        throughputs = read_throughputs(args.throughputs_file)
+    else:
+        throughputs = generate_oracle()
+
+    profiles = load_or_synthesize_profiles(
+        args.trace_file, jobs, throughputs, cache=not args.no_profile_cache
+    )
+    for i, job in enumerate(jobs):
+        job.duration = sum(profiles[i]["duration_every_epoch"])
+
+    counts = [int(x) for x in args.cluster_spec.split(":")]
+    cluster_spec = {"v100": counts[0], "p100": counts[1], "k80": counts[2]}
+    cluster_spec = {wt: n for wt, n in cluster_spec.items() if n > 0}
+    per_server = [int(x) for x in args.num_gpus_per_server.split(":")]
+    num_gpus_per_server = {"v100": per_server[0], "p100": per_server[1], "k80": per_server[2]}
+
+    shockwave_config = None
+    if args.policy in ("shockwave", "shockwave_tpu"):
+        if args.config:
+            with open(args.config) as f:
+                shockwave_config = json.load(f)
+        else:
+            shockwave_config = {}
+        shockwave_config.setdefault("future_rounds", 20)
+        shockwave_config.setdefault("lambda", 5.0)
+        shockwave_config.setdefault("k", 10.0)
+        shockwave_config.setdefault(
+            "log_approximation_bases", [0.0, 0.2, 0.4, 0.6, 0.8, 1.0]
+        )
+        shockwave_config.setdefault("solver_rel_gap", 1e-3)
+        shockwave_config.setdefault("solver_num_threads", 24)
+        shockwave_config.setdefault("solver_timeout", 15)
+        shockwave_config["time_per_iteration"] = args.time_per_iteration
+        # cluster_spec counts GPUs directly (servers = count // per_server).
+        shockwave_config["num_gpus"] = cluster_spec.get("v100", 0)
+
+    policy = get_policy(args.policy, solver=args.solver, seed=args.seed)
+    sched = Scheduler(
+        policy,
+        simulate=True,
+        throughputs=throughputs,
+        seed=args.seed if args.seed is not None else 0,
+        time_per_iteration=args.time_per_iteration,
+        profiles=profiles,
+        shockwave_config=shockwave_config,
+    )
+
+    jobs_to_complete = None
+    if args.window_start is not None and args.window_end is not None:
+        from shockwave_tpu.core.ids import JobId
+
+        jobs_to_complete = {
+            JobId(i) for i in range(args.window_start, args.window_end)
+        }
+
+    start = time.time()
+    makespan = sched.simulate(
+        cluster_spec,
+        arrival_times,
+        jobs,
+        num_gpus_per_server=num_gpus_per_server,
+        jobs_to_complete=jobs_to_complete,
+    )
+    wall = time.time() - start
+
+    avg_jct = sched.get_average_jct(jobs_to_complete)
+    utilization = sched.get_cluster_utilization()
+    ftf_list, unfair_fraction = sched.get_finish_time_fairness()
+
+    print(f"Policy: {args.policy}")
+    print(f"Makespan: {makespan:.3f} s ({makespan / 3600.0:.2f} h)")
+    if avg_jct is not None:
+        print(f"Average JCT: {avg_jct:.3f} s ({avg_jct / 3600.0:.2f} h)")
+    if utilization is not None:
+        print(f"Cluster utilization: {utilization:.3f}")
+    if ftf_list:
+        print(f"Worst FTF: {max(ftf_list):.3f}")
+        print(f"Unfair job fraction: {unfair_fraction:.1f}%")
+    print(f"Rounds: {sched._num_completed_rounds}; sim wall-clock: {wall:.1f} s")
+
+    if args.output_pickle:
+        result = {
+            "trace_file": args.trace_file,
+            "policy": args.policy,
+            "num_gpus": str(counts[0]),
+            "makespan": makespan,
+            "avg_jct": avg_jct,
+            "worst_ftf": max(ftf_list) if ftf_list else None,
+            "unfair_fraction": unfair_fraction,
+        }
+        os.makedirs(os.path.dirname(args.output_pickle) or ".", exist_ok=True)
+        with open(args.output_pickle, "wb") as f:
+            pickle.dump(result, f)
+        print(f"Wrote {args.output_pickle}")
+    return makespan
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="Run the simulator on a trace")
+    parser.add_argument("-t", "--trace_file", type=str, required=True)
+    parser.add_argument(
+        "-p", "--policy", type=str, default="fifo", choices=get_available_policies()
+    )
+    parser.add_argument(
+        "--throughputs_file",
+        type=str,
+        default=None,
+        help="Oracle JSON; defaults to the built-in synthetic oracle",
+    )
+    parser.add_argument("-c", "--cluster_spec", type=str, default="25:0:0")
+    parser.add_argument("--num_gpus_per_server", type=str, default="1:1:1")
+    parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument(
+        "--solver", type=str, choices=["scipy", "jax"], default="scipy"
+    )
+    parser.add_argument("--time_per_iteration", type=int, default=360)
+    parser.add_argument("-s", "--window-start", type=int, default=None)
+    parser.add_argument("-e", "--window-end", type=int, default=None)
+    parser.add_argument("--config", type=str, default=None, help="Shockwave JSON config")
+    parser.add_argument("--output_pickle", type=str, default=None)
+    parser.add_argument("--no_profile_cache", action="store_true")
+    main(parser.parse_args())

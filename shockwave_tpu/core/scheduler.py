@@ -1,0 +1,1207 @@
+"""The round-based scheduler and its discrete-event simulator.
+
+Time is divided into fixed rounds (``time_per_iteration`` seconds). Every
+round the active policy picks which jobs occupy which workers; jobs are
+preempted at round boundaries via checkpoint/restore (physical mode) or by
+construction (simulation). This module reproduces the mechanism semantics of
+the reference scheduler (reference: scheduler/scheduler.py) with a
+simulation-first, lock-free structure; the physical runtime plugs into the
+same callbacks (see shockwave_tpu.runtime).
+
+Key mechanisms and their reference anchors:
+  * round loop / event heap          scheduler.py:1509-1796
+  * priorities & deficits            scheduler.py:2589-2800
+  * strided worker assignment        scheduler.py:838-1129
+  * micro-task completion merging    scheduler.py:3223-3482
+  * batch-size adaptation (sim)      scheduler.py:1308-1363, 3504-3591
+  * Shockwave planner hooks          scheduler.py:991-1014, 3598-3621
+  * metrics                          scheduler.py:2131-2265, 3627-3655
+"""
+
+from __future__ import annotations
+
+import copy
+import heapq
+import math
+import random
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from shockwave_tpu.core.ids import JobId
+from shockwave_tpu.core.job import Job
+from shockwave_tpu.data.workload_info import (
+    DATASET_SIZES,
+    MAX_BATCH_SIZES,
+    num_epochs as epochs_for_steps,
+    steps_per_epoch,
+    total_steps_for_epochs,
+)
+from shockwave_tpu.utils.logging import make_logger
+
+INFINITY = int(1e9)
+DEFAULT_THROUGHPUT = 1
+EMA_ALPHA = 0.5
+MAX_FAILED_ATTEMPTS = 5
+
+# Batch-size scaling directions.
+BS_BIG = 0
+BS_SMALL = 1
+
+
+class Scheduler:
+    def __init__(
+        self,
+        policy,
+        simulate: bool = True,
+        throughputs: Optional[dict] = None,
+        seed: int = 0,
+        time_per_iteration: float = 360.0,
+        profiles: Optional[dict] = None,
+        shockwave_config: Optional[dict] = None,
+        max_rounds: Optional[int] = None,
+        minimum_time_between_allocation_resets: float = 1920.0,
+        enable_global_queue: bool = False,
+        log_level=None,
+    ):
+        self._policy = policy
+        self._simulate = simulate
+        self._oracle_throughputs = throughputs
+        self._time_per_iteration = float(time_per_iteration)
+        self._profiles = profiles or {}
+        self._max_rounds = max_rounds
+        self._min_reset_interval = minimum_time_between_allocation_resets
+        self._enable_global_queue = enable_global_queue
+
+        self._current_timestamp: float = 0.0
+        self._num_completed_rounds = 0
+
+        # RNG fan-out mirrors the reference so seeded runs are comparable
+        # (reference: scheduler.py:378-392).
+        self._job_generator = random.Random(seed + 2)
+        self._interarrival_time_generator = random.Random(seed + 3)
+        self._worker_type_shuffler = random.Random(seed + 5)
+        self._slo_generator = random.Random(seed + 6)
+
+        # Job state.
+        self._job_id_counter = 0
+        self._jobs: "OrderedDict[JobId, Job]" = OrderedDict()
+        self._completed_jobs: set = set()
+        self._running_jobs: set = set()
+        self._steps_run_so_far: Dict[JobId, Dict[str, int]] = {}
+        self._total_steps_run: Dict[JobId, int] = {}
+        self._job_time_so_far: Dict[JobId, Dict[str, float]] = {}
+        self._job_cost_so_far: Dict[JobId, float] = {}
+        self._throughputs: Dict[JobId, dict] = {}
+        self._original_bs: Dict[JobId, int] = {}
+        self._bs_scale: Dict[JobId, Optional[int]] = {}
+        self._job_id_to_job_type: Dict[JobId, Tuple[str, int]] = {}
+        self._job_type_to_job_ids: Dict[Tuple[str, int], set] = {}
+        self._num_failures_per_job: Dict[JobId, int] = {}
+        self._per_job_start_timestamps: Dict[JobId, float] = {}
+        self._per_job_latest_timestamps: Dict[JobId, Optional[float]] = {}
+        self._job_completion_times: "OrderedDict[JobId, Optional[float]]" = OrderedDict()
+        self._job_priority_weights: Dict[JobId, float] = {}
+        self._num_jobs_in_trace = 0
+        self._in_progress_updates: Dict[JobId, list] = {}
+        self._job_timelines: Dict[JobId, list] = {}
+        self._slos: Optional[Dict[JobId, float]] = None
+
+        # Worker state.
+        self._worker_id_counter = 0
+        self._worker_ids: List[int] = []
+        self._worker_types: List[str] = []
+        self._cluster_spec: Dict[str, int] = {}
+        self._worker_id_to_worker_type: Dict[int, str] = {}
+        # worker_type -> list of per-server worker-id lists.
+        self._worker_type_to_worker_ids: Dict[str, List[List[int]]] = {}
+        self._worker_start_times: Dict[int, float] = {}
+        self._cumulative_worker_time_so_far: Dict[int, float] = {}
+        self._worker_time_so_far: Dict[str, float] = {}
+        self._available_worker_ids: set = set()
+
+        # Allocation state.
+        self._allocation: Dict[JobId, Dict[str, float]] = {}
+        self._priorities: Dict[str, Dict[JobId, float]] = {}
+        self._deficits: Dict[str, Dict[JobId, float]] = {}
+        self._need_to_update_allocation = True
+        self._last_reset_time: float = 0.0
+        self._current_worker_assignments: "OrderedDict[JobId, tuple]" = OrderedDict()
+        self._current_round_scheduled_jobs: List[JobId] = []
+        self._num_lease_extensions = 0
+        self._num_lease_extension_opportunities = 0
+
+        self._logger = make_logger(
+            "scheduler", lambda: self._current_timestamp,
+            **({"level": log_level} if log_level is not None else {}),
+        )
+
+        # Shockwave planner (attached when the policy is a Shockwave
+        # variant; see shockwave_tpu.policies.shockwave).
+        self._shockwave = None
+        self._is_shockwave = policy.name.startswith("Shockwave")
+        if self._is_shockwave:
+            if shockwave_config is None:
+                raise ValueError("Shockwave policies require shockwave_config")
+            self._shockwave = policy.make_planner(shockwave_config)
+
+        self._job_packing = "Packing" in policy.name
+
+    # ------------------------------------------------------------------
+    # Worker registration (simulation path; RPC path wraps this).
+    # ------------------------------------------------------------------
+    def register_worker(self, worker_type: str, num_gpus: int = 1) -> List[int]:
+        """Register one server with ``num_gpus`` workers of ``worker_type``
+        (reference: scheduler.py:2854-2940)."""
+        if worker_type not in self._worker_type_to_worker_ids:
+            self._worker_types.append(worker_type)
+            self._worker_types.sort()
+            self._cluster_spec[worker_type] = 0
+            self._worker_type_to_worker_ids[worker_type] = []
+            self._worker_time_so_far[worker_type] = 0.0
+            self._priorities[worker_type] = {}
+            self._deficits[worker_type] = {}
+            for job_id in self._jobs:
+                self._steps_run_so_far[job_id][worker_type] = 0
+                self._set_initial_throughput(job_id, worker_type)
+                self._job_time_so_far[job_id][worker_type] = (
+                    self._time_per_iteration / 2.0
+                )
+        server_ids = []
+        for _ in range(num_gpus):
+            worker_id = self._worker_id_counter
+            self._worker_id_counter += 1
+            server_ids.append(worker_id)
+            self._worker_ids.append(worker_id)
+            self._worker_id_to_worker_type[worker_id] = worker_type
+            self._cluster_spec[worker_type] += 1
+            self._worker_start_times[worker_id] = self._current_timestamp
+            self._cumulative_worker_time_so_far[worker_id] = 0.0
+            self._available_worker_ids.add(worker_id)
+        self._worker_type_to_worker_ids[worker_type].append(server_ids)
+        self._need_to_update_allocation = True
+        return server_ids
+
+    # ------------------------------------------------------------------
+    # Job lifecycle.
+    # ------------------------------------------------------------------
+    def add_job(self, job: Job, timestamp: Optional[float] = None) -> JobId:
+        """(reference: scheduler.py:537-619)"""
+        job_id = JobId(self._job_id_counter)
+        self._job_id_counter += 1
+        job.job_id = job_id.integer
+        self._jobs[job_id] = job
+        self._steps_run_so_far[job_id] = {}
+        self._job_time_so_far[job_id] = {}
+        self._job_cost_so_far[job_id] = 0.0
+        self._job_timelines[job_id] = [[] for _ in range(job.scale_factor)]
+        self._throughputs[job_id] = {}
+        self._original_bs[job_id] = job.batch_size
+        self._num_jobs_in_trace += 1
+        job_type_key = job.job_type_key()
+        self._job_id_to_job_type[job_id] = job_type_key
+        self._job_type_to_job_ids.setdefault(job_type_key, set()).add(job_id)
+        self._num_failures_per_job[job_id] = 0
+        self._total_steps_run[job_id] = 0
+        for worker_type in self._worker_types:
+            self._steps_run_so_far[job_id][worker_type] = 0
+            self._set_initial_throughput(job_id, worker_type)
+            if self._job_packing:
+                self._populate_job_combination_metadata(job_id, worker_type)
+            self._job_time_so_far[job_id][worker_type] = (
+                self._time_per_iteration / 2.0
+            )
+        self._per_job_latest_timestamps[job_id] = None
+        self._add_to_priorities(job_id)
+        self._need_to_update_allocation = True
+        self._bs_scale[job_id] = None
+        if self._shockwave is not None:
+            self._shockwave.add_job(
+                job_id,
+                self._profiles[job_id.integer],
+                self._time_per_iteration,
+                job.scale_factor,
+                submit_time=self.get_current_timestamp(),
+            )
+        if timestamp is None:
+            timestamp = self.get_current_timestamp()
+        self._per_job_start_timestamps[job_id] = timestamp
+        self._logger.info("[Job dispatched]\tJob ID: %s", job_id)
+        return job_id
+
+    def _remove_job(self, job_id: JobId) -> None:
+        """(reference: scheduler.py:627-705)"""
+        if isinstance(job_id, int):
+            job_id = JobId(job_id)
+        self._completed_jobs.add(job_id)
+        duration = (
+            self._per_job_latest_timestamps[job_id]
+            - self._per_job_start_timestamps[job_id]
+        )
+        self._job_priority_weights[job_id] = self._jobs[job_id].priority_weight
+        del self._jobs[job_id]
+        if self._num_failures_per_job[job_id] >= MAX_FAILED_ATTEMPTS:
+            self._job_completion_times[job_id] = None
+        else:
+            self._job_completion_times[job_id] = duration
+        job_type_key = self._job_id_to_job_type[job_id]
+        self._job_type_to_job_ids[job_type_key].discard(job_id)
+        del self._steps_run_so_far[job_id]
+        del self._job_time_so_far[job_id]
+        del self._throughputs[job_id]
+        del self._job_id_to_job_type[job_id]
+        del self._num_failures_per_job[job_id]
+        self._in_progress_updates.pop(job_id, None)
+        if self._job_packing:
+            stale_pairs = [
+                other
+                for other in self._throughputs
+                if other.is_pair and job_id.overlaps_with(other)
+            ]
+            for other in stale_pairs:
+                del self._throughputs[other]
+                self._job_time_so_far.pop(other, None)
+                self._in_progress_updates.pop(other, None)
+            if not self._job_type_to_job_ids[job_type_key]:
+                del self._job_type_to_job_ids[job_type_key]
+        self._remove_from_priorities(job_id)
+        self._need_to_update_allocation = True
+        self._logger.info("Remaining active jobs: %d", len(self._jobs))
+
+    # ------------------------------------------------------------------
+    # Throughputs.
+    # ------------------------------------------------------------------
+    def _set_initial_throughput(self, job_id: JobId, worker_type: str) -> None:
+        assert not job_id.is_pair
+        if self._oracle_throughputs is not None:
+            key = self._jobs[job_id].job_type_key()
+            self._throughputs[job_id][worker_type] = self._oracle_throughputs[
+                worker_type
+            ][key]["null"]
+        else:
+            self._throughputs[job_id][worker_type] = DEFAULT_THROUGHPUT
+
+    def _populate_job_combination_metadata(
+        self, job_id: JobId, worker_type: str
+    ) -> None:
+        """Register colocated throughputs for all same-scale pairs involving
+        ``job_id`` (reference: scheduler.py:2509-2575)."""
+        job = self._jobs[job_id]
+        job_type_key = job.job_type_key()
+        for other_job_id in self._jobs:
+            if other_job_id == job_id:
+                continue
+            other = self._jobs[other_job_id]
+            if job.scale_factor != other.scale_factor:
+                continue
+            merged = JobId(job_id[0], other_job_id[0])
+            if merged not in self._throughputs:
+                self._throughputs[merged] = {}
+                self._job_time_so_far[merged] = {}
+            self._job_time_so_far[merged][worker_type] = 0.0
+            oracle = (
+                self._oracle_throughputs[worker_type]
+                if self._oracle_throughputs is not None
+                else None
+            )
+            other_key = other.job_type_key()
+            if oracle is None:
+                self._throughputs[merged][worker_type] = [0.0, 0.0]
+            else:
+                keys = (
+                    (job_type_key, other_key)
+                    if job_id < other_job_id
+                    else (other_key, job_type_key)
+                )
+                pair_entry = oracle.get(keys[0], {}).get(keys[1])
+                self._throughputs[merged][worker_type] = (
+                    list(pair_entry) if pair_entry is not None else [0.0, 0.0]
+                )
+
+    def _update_throughput(
+        self, job_id, worker_type, all_num_steps, all_execution_times
+    ) -> None:
+        """(reference: scheduler.py:429-498)"""
+        if job_id not in self._throughputs:
+            return
+        if self._shockwave is not None:
+            current_round = self._num_completed_rounds
+            for i, single in enumerate(job_id.singletons()):
+                tput = (
+                    0.0
+                    if all_execution_times[i] <= 0
+                    else all_num_steps[i] / all_execution_times[i]
+                )
+                if single in self._jobs:
+                    self._shockwave.record_round_throughput(
+                        single, current_round, tput, self._jobs[single].batch_size
+                    )
+        if not self._simulate:
+            # EMA update from measured steps (physical mode).
+            singles = job_id.singletons()
+            old = self._throughputs[job_id][worker_type]
+            old_list = list(old) if job_id.is_pair else [old]
+            new_list = []
+            for i in range(len(singles)):
+                measured = (
+                    0.0
+                    if all_execution_times[i] <= 0
+                    else all_num_steps[i] / all_execution_times[i]
+                )
+                if old_list[i] != INFINITY:
+                    measured = EMA_ALPHA * measured + (1 - EMA_ALPHA) * old_list[i]
+                new_list.append(measured)
+            if np.min(all_execution_times) <= 0 and job_id.is_pair:
+                new_list = [0.0, 0.0]
+            self._throughputs[job_id][worker_type] = (
+                new_list if job_id.is_pair else new_list[0]
+            )
+
+    def _get_remaining_steps(self, job_id: JobId) -> int:
+        return self._jobs[job_id].total_steps - self._total_steps_run[job_id]
+
+    # ------------------------------------------------------------------
+    # Priorities / allocation.
+    # ------------------------------------------------------------------
+    def _add_to_priorities(self, job_id: JobId) -> None:
+        for worker_type in self._worker_types:
+            self._priorities[worker_type][job_id] = 0.0
+            self._deficits[worker_type][job_id] = 0.0
+            for other in self._throughputs:
+                if other.is_pair and job_id.overlaps_with(other):
+                    self._priorities[worker_type][other] = 0.0
+                    self._deficits[worker_type][other] = 0.0
+
+    def _remove_from_priorities(self, job_id: JobId) -> None:
+        # Drop the job itself plus any packed pair containing it
+        # (reference: scheduler.py:2667-2682).
+        for worker_type in self._worker_types:
+            stale = [
+                other
+                for other in self._priorities[worker_type]
+                if job_id.overlaps_with(other)
+            ]
+            for other in stale:
+                self._priorities[worker_type].pop(other, None)
+                self._deficits[worker_type].pop(other, None)
+
+    def _get_allocation_state(self) -> dict:
+        throughputs = {}
+        scale_factors = {}
+        priority_weights = {}
+        times_since_start = {}
+        num_steps_remaining = {}
+        for job_id, per_type in self._throughputs.items():
+            singles = job_id.singletons()
+            if not all(s in self._jobs for s in singles):
+                continue
+            throughputs[job_id] = dict(per_type)
+            for s in singles:
+                scale_factors[s] = self._jobs[s].scale_factor
+                priority_weights[s] = self._jobs[s].priority_weight
+                times_since_start[s] = self.get_current_timestamp() - (
+                    self._per_job_start_timestamps.get(s, 0.0)
+                )
+                num_steps_remaining[s] = self._get_remaining_steps(s)
+        return {
+            "throughputs": throughputs,
+            "scale_factors": scale_factors,
+            "priority_weights": priority_weights,
+            "times_since_start": times_since_start,
+            "num_steps_remaining": num_steps_remaining,
+            "cluster_spec": dict(self._cluster_spec),
+        }
+
+    def _compute_allocation(self) -> Dict[JobId, Dict[str, float]]:
+        """Dispatch to the policy with the signature its family expects
+        (reference: scheduler.py:2386-2466)."""
+        state = self._get_allocation_state()
+        name = self._policy.name
+        throughputs = state["throughputs"]
+        scale_factors = state["scale_factors"]
+        cluster_spec = state["cluster_spec"]
+        if not throughputs or not cluster_spec:
+            return {}
+        if name == "AlloX_Perf":
+            allocation = self._policy.get_allocation(
+                throughputs,
+                scale_factors,
+                state["times_since_start"],
+                state["num_steps_remaining"],
+                cluster_spec,
+            )
+        elif name.startswith("FinishTimeFairness"):
+            allocation = self._policy.get_allocation(
+                throughputs,
+                scale_factors,
+                state["priority_weights"],
+                state["times_since_start"],
+                state["num_steps_remaining"],
+                cluster_spec,
+            )
+        elif name == "Isolated":
+            allocation = self._policy.get_allocation(
+                throughputs, scale_factors, cluster_spec
+            )
+        elif name.startswith("MaxMinFairness"):
+            allocation = self._policy.get_allocation(
+                throughputs, scale_factors, state["priority_weights"], cluster_spec
+            )
+        elif name.startswith("MinTotalDuration"):
+            allocation = self._policy.get_allocation(
+                throughputs, scale_factors, state["num_steps_remaining"], cluster_spec
+            )
+        else:
+            allocation = self._policy.get_allocation(
+                throughputs, scale_factors, cluster_spec
+            )
+        return allocation or {}
+
+    def _reset_time_run_so_far(self) -> None:
+        """(reference: scheduler.py:2589-2637)"""
+        current_time = self.get_current_timestamp()
+        elapsed = current_time - self._last_reset_time
+        for worker_type in self._worker_types:
+            self._worker_time_so_far[worker_type] = 0.0
+            for job_id in self._job_time_so_far:
+                time_received = self._job_time_so_far[job_id].get(
+                    worker_type, self._time_per_iteration / 2.0
+                ) - (self._time_per_iteration / 2.0)
+                if job_id in self._allocation:
+                    should_have = self._allocation[job_id][worker_type] * elapsed
+                else:
+                    should_have = 0.0
+                self._deficits[worker_type].setdefault(job_id, 0.0)
+                self._deficits[worker_type][job_id] += should_have - time_received
+                self._job_time_so_far[job_id][worker_type] = (
+                    self._time_per_iteration / 2.0
+                )
+                self._worker_time_so_far[worker_type] += (
+                    self._time_per_iteration / 2.0
+                )
+        self._last_reset_time = current_time
+
+    def _update_priorities(self) -> None:
+        """(reference: scheduler.py:2684-2800, simulation branch)"""
+        current_time = self.get_current_timestamp()
+        interval_ok = (
+            current_time - self._last_reset_time >= self._min_reset_interval
+            or self._last_reset_time == 0
+        )
+        if self._need_to_update_allocation and interval_ok:
+            self._reset_time_run_so_far()
+            self._allocation = self._compute_allocation()
+            self._need_to_update_allocation = False
+
+        fractions: Dict[str, Dict[JobId, float]] = {}
+        for worker_type in self._worker_types:
+            fractions[worker_type] = {}
+            worker_time = self._worker_time_so_far[worker_type]
+            for job_id in self._job_time_so_far:
+                if worker_time == 0.0 or worker_type not in self._job_time_so_far[job_id]:
+                    fractions[worker_type][job_id] = 0.0
+                else:
+                    fractions[worker_type][job_id] = (
+                        self._job_time_so_far[job_id][worker_type] / worker_time
+                    )
+            for job_id in self._priorities[worker_type]:
+                if job_id not in self._allocation:
+                    self._priorities[worker_type][job_id] = 0.0
+                    continue
+                alloc = self._allocation[job_id][worker_type]
+                new_priority = alloc * 1e9
+                tput = self._throughputs[job_id][worker_type]
+                tput_zero = (
+                    (tput[0] == 0 or tput[1] == 0) if job_id.is_pair else tput == 0
+                )
+                if alloc == 0.0:
+                    new_priority = 0.0
+                elif tput_zero:
+                    new_priority = 0.0
+                elif fractions[worker_type][job_id] > 0.0:
+                    new_priority = alloc / fractions[worker_type][job_id]
+                self._priorities[worker_type][job_id] = new_priority
+
+    # ------------------------------------------------------------------
+    # Per-round scheduling.
+    # ------------------------------------------------------------------
+    def _schedule_jobs_on_workers_helper(
+        self, worker_types: List[str]
+    ) -> Dict[str, List[Tuple[JobId, int]]]:
+        """Greedy selection in sorted priority order
+        (reference: scheduler.py:892-989)."""
+        already_scheduled: set = set()
+        scheduled_jobs: Dict[str, List[Tuple[JobId, int]]] = {
+            wt: [] for wt in worker_types
+        }
+        num_workers_left = {wt: self._cluster_spec[wt] for wt in worker_types}
+
+        entries = []
+        for worker_type in worker_types:
+            per_type = []
+            for job_id in self._priorities[worker_type]:
+                allocation = 0.0
+                if self._allocation and job_id in self._allocation:
+                    allocation = self._allocation[job_id][worker_type]
+                per_type.append(
+                    (
+                        job_id,
+                        worker_type,
+                        self._priorities[worker_type][job_id],
+                        self._deficits[worker_type][job_id],
+                        allocation,
+                    )
+                )
+            if not self._enable_global_queue:
+                per_type.sort(key=lambda x: (x[2], x[3], x[4]), reverse=True)
+            entries += per_type
+        if self._enable_global_queue:
+            entries.sort(key=lambda x: (x[2], x[3], x[4]), reverse=True)
+
+        for job_id, worker_type, priority, _, _ in entries:
+            if num_workers_left[worker_type] == 0:
+                continue
+            singles = job_id.singletons()
+            if any(s in already_scheduled for s in singles):
+                continue
+            tput = self._throughputs[job_id][worker_type]
+            if job_id.is_pair:
+                if tput[0] <= 0 or tput[1] <= 0:
+                    continue
+                sf0 = self._jobs[singles[0]].scale_factor
+                sf1 = self._jobs[singles[1]].scale_factor
+                if sf0 != sf1:
+                    continue
+                scale_factor = sf0
+            else:
+                if tput <= 0:
+                    continue
+                scale_factor = self._jobs[job_id].scale_factor
+            if self._policy.name.startswith("FIFO") and priority <= 0.0:
+                continue
+            if scale_factor > num_workers_left[worker_type]:
+                continue
+            num_workers_left[worker_type] -= scale_factor
+            for s in singles:
+                already_scheduled.add(s)
+            scheduled_jobs[worker_type].append((job_id, scale_factor))
+        return scheduled_jobs
+
+    def _shockwave_schedule_helper(self) -> Dict[str, List[Tuple[JobId, int]]]:
+        """Pull this round's job list from the Shockwave planner
+        (reference: scheduler.py:991-1014; v100-only by design)."""
+        worker_type = "v100"
+        scheduled: Dict[str, List[Tuple[JobId, int]]] = {worker_type: []}
+        self._current_round_scheduled_jobs = self._shockwave.current_round_schedule()
+        for job_id in self._current_round_scheduled_jobs:
+            if job_id in self._jobs:
+                scheduled[worker_type].append(
+                    (job_id, self._jobs[job_id].scale_factor)
+                )
+        return scheduled
+
+    def _assign_workers_to_job(
+        self, job_id, scale_factor, worker_state, worker_assignments
+    ) -> None:
+        """Strided server-local placement (reference: scheduler.py:838-889)."""
+        worker_ids = worker_state["worker_ids"]
+        assigned = worker_state["assigned_worker_ids"]
+        ptr = worker_state["server_id_ptr"]
+        ids_for_job = list(worker_assignments.get(job_id, ()))
+        while len(ids_for_job) < scale_factor and ptr < len(worker_ids):
+            if not worker_ids[ptr]:
+                ptr += 1
+                continue
+            candidate = worker_ids[ptr][0]
+            if candidate not in assigned:
+                ids_for_job.append(candidate)
+                assigned.add(candidate)
+            worker_ids[ptr].pop(0)
+        if len(ids_for_job) != scale_factor:
+            raise RuntimeError(f"Could not assign workers to job {job_id}")
+        worker_assignments[job_id] = tuple(ids_for_job)
+        worker_state["server_id_ptr"] = ptr
+        for single in job_id.singletons():
+            if self._simulate:
+                self._per_job_latest_timestamps[single] = self.get_current_timestamp()
+                self._running_jobs.add(single)
+
+    def _schedule_jobs_on_workers(self) -> "OrderedDict[JobId, tuple]":
+        """(reference: scheduler.py:1017-1129)"""
+        if not self._is_shockwave:
+            self._update_priorities()
+
+        worker_types = [
+            wt for wt in ["v100", "p100", "k80"] if wt in self._worker_type_to_worker_ids
+        ]
+        if "Perf" not in self._policy.name and "Packing" not in self._policy.name:
+            self._worker_type_shuffler.shuffle(worker_types)
+
+        if self._is_shockwave:
+            scheduled_jobs = self._shockwave_schedule_helper()
+            worker_types = [wt for wt in worker_types if wt in scheduled_jobs]
+        else:
+            scheduled_jobs = self._schedule_jobs_on_workers_helper(worker_types)
+
+        new_assignments: "OrderedDict[JobId, tuple]" = OrderedDict()
+        worker_state = {}
+        for worker_type in worker_types:
+            scheduled_jobs[worker_type].sort(key=lambda x: x[1], reverse=True)
+            worker_state[worker_type] = {
+                "worker_ids": copy.deepcopy(
+                    self._worker_type_to_worker_ids[worker_type]
+                ),
+                "assigned_worker_ids": set(),
+                "server_id_ptr": 0,
+            }
+
+        prev_worker_types = {
+            job_id: self._worker_id_to_worker_type[ids[0]]
+            for job_id, ids in self._current_worker_assignments.items()
+        }
+
+        for worker_type in worker_types:
+            state = worker_state[worker_type]
+            assigned = state["assigned_worker_ids"]
+            scale_factors = sorted(
+                {sf for _, sf in scheduled_jobs[worker_type]}, reverse=True
+            )
+            for current_sf in scale_factors:
+                # First pass: keep jobs on their previous workers if intact.
+                for job_id, sf in scheduled_jobs[worker_type]:
+                    if sf != current_sf:
+                        continue
+                    if prev_worker_types.get(job_id) != worker_type:
+                        continue
+                    prev_ids = self._current_worker_assignments[job_id]
+                    if any(wid in assigned for wid in prev_ids):
+                        continue
+                    new_assignments[job_id] = prev_ids
+                    assigned.update(prev_ids)
+                # Second pass: everyone else, strided.
+                for job_id, sf in scheduled_jobs[worker_type]:
+                    if sf != current_sf:
+                        continue
+                    if not self._is_shockwave and job_id not in self._allocation:
+                        continue
+                    self._assign_workers_to_job(
+                        job_id, sf, state, new_assignments
+                    )
+
+        counts: Dict[int, int] = {}
+        for ids in new_assignments.values():
+            for wid in ids:
+                counts[wid] = counts.get(wid, 0) + 1
+                if counts[wid] > 1:
+                    raise RuntimeError(f"Worker {wid} assigned twice")
+        return new_assignments
+
+    # ------------------------------------------------------------------
+    # Micro-task accounting.
+    # ------------------------------------------------------------------
+    def _get_num_steps(self, job_id, worker_type, single_job_id=None) -> int:
+        """(reference: scheduler.py:1131-1165)"""
+        if self._simulate and job_id.is_pair:
+            assert single_job_id is not None
+            oracle = self._oracle_throughputs[worker_type]
+            index = job_id.as_tuple().index(single_job_id[0])
+            sf = self._jobs[single_job_id].scale_factor
+            keys = [(self._jobs[s].job_type, sf) for s in job_id.singletons()]
+            tput = oracle[keys[0]][keys[1]][index]
+            num_steps = int(tput * self._time_per_iteration)
+        else:
+            tput = self._throughputs[job_id][worker_type]
+            if job_id.is_pair:
+                index = job_id.as_tuple().index(single_job_id[0])
+                tput = tput[index]
+            num_steps = int(tput * self._time_per_iteration)
+        target = single_job_id if single_job_id is not None else job_id
+        return min(num_steps, self._get_remaining_steps(target))
+
+    def _get_job_steps_and_finish_times(self, job_id, worker_type):
+        """(reference: scheduler.py:1166-1212)"""
+        max_finish_time = self.get_current_timestamp()
+        all_num_steps = []
+        for i, single in enumerate(job_id.singletons()):
+            num_steps = self._get_num_steps(job_id, worker_type, single)
+            all_num_steps.append(num_steps)
+            tput = self._throughputs[job_id][worker_type]
+            if job_id.is_pair:
+                tput = tput[i]
+            if tput <= 0:
+                raise RuntimeError(
+                    f"Throughput for job {single} on {worker_type} is <= 0"
+                )
+            finish_time = self.get_current_timestamp() + num_steps / tput
+            max_finish_time = max(max_finish_time, finish_time)
+            self._running_jobs.add(single)
+        return all_num_steps, max_finish_time
+
+    def _done_callback(
+        self, job_id, worker_id, all_num_steps, all_execution_times
+    ) -> None:
+        """Merge per-worker completions for a micro-task; update steps, time
+        and batch-size adaptation; remove finished jobs
+        (reference: scheduler.py:3223-3482, simulation-relevant paths)."""
+        to_remove: List[JobId] = []
+        worker_type = self._worker_id_to_worker_type[worker_id]
+        self._available_worker_ids.add(worker_id)
+        is_active = {s: s in self._jobs for s in job_id.singletons()}
+        if not any(is_active.values()):
+            return
+
+        scale_factor = len(self._current_worker_assignments[job_id])
+        updates = self._in_progress_updates.setdefault(job_id, [])
+        updates.append((worker_id, all_num_steps, all_execution_times))
+        if len(updates) < scale_factor:
+            return
+        updates.sort(key=lambda x: x[0])
+        micro_task_succeeded = True
+        merged_steps = [0] * len(job_id.singletons())
+        merged_times = [0.0] * len(job_id.singletons())
+        for _, steps_i, times_i in updates:
+            for j, single in enumerate(job_id.singletons()):
+                if (
+                    not self._simulate
+                    and is_active[single]
+                    and (steps_i[j] <= 0 or times_i[j] <= 0)
+                ):
+                    # Physical mode: any worker reporting no progress means
+                    # the micro-task failed (reference: scheduler.py:3326-3328).
+                    micro_task_succeeded = False
+                merged_steps[j] += steps_i[j]
+                merged_times[j] = max(merged_times[j], times_i[j])
+        if self._simulate:
+            # In simulation a gang's steps are split across workers and the
+            # final sliver of a job can be smaller than its gang size, which
+            # leaves some workers with 0 steps; judge success on the merged
+            # totals instead of per-worker shares.
+            for j, single in enumerate(job_id.singletons()):
+                if is_active[single] and (
+                    merged_steps[j] <= 0 or merged_times[j] <= 0
+                ):
+                    micro_task_succeeded = False
+        self._in_progress_updates[job_id] = []
+
+        if not micro_task_succeeded:
+            self._logger.info("[Micro-task failed]\tJob ID: %s", job_id)
+            if not job_id.is_pair and is_active[job_id]:
+                self._num_failures_per_job[job_id] += 1
+                if self._num_failures_per_job[job_id] >= MAX_FAILED_ATTEMPTS:
+                    to_remove.append(job_id)
+            self._need_to_update_allocation = True
+        else:
+            for single, num_steps, execution_time in zip(
+                job_id.singletons(), merged_steps, merged_times
+            ):
+                if not is_active[single]:
+                    continue
+                if single in self._running_jobs:
+                    self._running_jobs.remove(single)
+                    self._steps_run_so_far[single][worker_type] += num_steps
+                    self._total_steps_run[single] += num_steps
+                    if self._get_remaining_steps(single) <= 0:
+                        to_remove.append(single)
+            max_execution_time = max(merged_times)
+            if job_id in self._job_time_so_far:
+                self._job_time_so_far[job_id][worker_type] += max_execution_time
+                self._worker_time_so_far[worker_type] += max_execution_time
+            for wid, _, _ in updates:
+                self._cumulative_worker_time_so_far[wid] += max_execution_time
+
+        self._update_throughput(job_id, worker_type, merged_steps, merged_times)
+
+        for single in job_id.singletons():
+            self._scale_bs_and_iters(single)
+            self._bs_scale[single] = None
+
+        for single in to_remove:
+            self._remove_job(single)
+            if self._shockwave is not None:
+                self._shockwave.remove_job(single)
+
+    # ------------------------------------------------------------------
+    # Batch-size adaptation (simulation).
+    # ------------------------------------------------------------------
+    def _simulate_gns(self, job_id: JobId) -> None:
+        """(reference: scheduler.py:1308-1334)"""
+        from shockwave_tpu.data import bs_patterns
+
+        job = self._jobs[job_id]
+        model = job.model
+        batch_size = job.batch_size
+        current_epoch = epochs_for_steps(
+            model, batch_size, self._total_steps_run[job_id]
+        )
+        pattern = bs_patterns.gns_pattern(
+            job.job_type,
+            self._original_bs[job_id],
+            max(760, current_epoch + 2),
+            job.scale_factor,
+        )
+        if (
+            pattern[current_epoch + 1] > batch_size
+            or pattern[current_epoch] > batch_size
+        ):
+            if MAX_BATCH_SIZES.get(model) != batch_size:
+                self._bs_scale[job_id] = BS_BIG
+
+    def _simulate_accordion(self, job_id: JobId) -> None:
+        """(reference: scheduler.py:1336-1363)"""
+        from shockwave_tpu.data import bs_patterns
+
+        job = self._jobs[job_id]
+        model = job.model
+        if model == "Transformer":
+            return
+        batch_size = job.batch_size
+        original = self._original_bs[job_id]
+        current_epoch = epochs_for_steps(
+            model, batch_size, self._total_steps_run[job_id]
+        )
+        in_critical = bs_patterns.accordion_in_critical_regime(
+            model, original, current_epoch
+        )
+        if batch_size == original and not in_critical:
+            if MAX_BATCH_SIZES.get(model) != batch_size:
+                self._bs_scale[job_id] = BS_BIG
+        elif batch_size != original and in_critical:
+            from shockwave_tpu.data.workload_info import MIN_BATCH_SIZES
+
+            if MIN_BATCH_SIZES.get(model) != batch_size:
+                self._bs_scale[job_id] = BS_SMALL
+
+    def _scale_bs_and_iters(self, job_id: JobId) -> None:
+        """Apply a pending batch-size change: rewrite the job's command and
+        type, refresh throughputs, and rescale total/completed steps so epoch
+        progress is preserved (reference: scheduler.py:3504-3591)."""
+        if job_id is None or self._bs_scale.get(job_id) is None:
+            return
+        assert not job_id.is_pair
+        job = self._jobs[job_id]
+        old_bs = job.batch_size
+        model = job.model
+        original = self._original_bs[job_id]
+        if MAX_BATCH_SIZES.get(model) == original:
+            self._bs_scale[job_id] = None
+            return
+        if job.mode == "gns":
+            assert self._bs_scale[job_id] == BS_BIG
+            new_bs = 2 * old_bs
+        elif job.mode == "accordion":
+            new_bs = (
+                MAX_BATCH_SIZES[model]
+                if self._bs_scale[job_id] == BS_BIG
+                else original
+            )
+        else:
+            new_bs = old_bs
+        job.update_batch_size(new_bs)
+        for worker_type in self._worker_types:
+            key = job.job_type_key()
+            if key not in self._oracle_throughputs[worker_type]:
+                self._logger.error(
+                    "Reverting job %s bs: %s -> %s", job_id, new_bs, old_bs
+                )
+                self._bs_scale[job_id] = None
+                job.update_batch_size(old_bs)
+                return
+            self._throughputs[job_id][worker_type] = self._oracle_throughputs[
+                worker_type
+            ][key]["null"]
+
+        total_steps = job.total_steps
+        total_steps_run = self._total_steps_run[job_id]
+        old_total_epochs = epochs_for_steps(model, old_bs, total_steps)
+        new_total_steps = math.ceil(total_steps * old_bs / new_bs)
+        if epochs_for_steps(model, new_bs, new_total_steps) != old_total_epochs:
+            new_total_steps = total_steps_for_epochs(model, new_bs, old_total_epochs)
+        job.total_steps = new_total_steps
+
+        completed_epochs = epochs_for_steps(model, old_bs, total_steps_run)
+        new_steps_run = total_steps_for_epochs(model, new_bs, completed_epochs)
+        # Rescale each worker type's step history proportionally so per-type
+        # accounting stays consistent (the reference rewrites only "v100",
+        # scheduler.py:3588-3589, which breaks on non-v100 clusters).
+        old_total = self._total_steps_run[job_id]
+        for worker_type in self._worker_types:
+            old_per_type = self._steps_run_so_far[job_id].get(worker_type, 0)
+            if old_total > 0:
+                self._steps_run_so_far[job_id][worker_type] = round(
+                    old_per_type * new_steps_run / old_total
+                )
+            else:
+                self._steps_run_so_far[job_id][worker_type] = 0
+        self._total_steps_run[job_id] = new_steps_run
+
+        self._bs_scale[job_id] = None
+        if self._shockwave is not None:
+            self._shockwave.set_recompute_flag()
+
+    def _shockwave_scheduler_update(self) -> None:
+        """Push epoch progress into the planner and advance its round
+        (reference: scheduler.py:3598-3621)."""
+        for job_id in self._current_round_scheduled_jobs:
+            if job_id in self._completed_jobs:
+                self._shockwave.mark_complete(job_id)
+                continue
+            steps_run = self._steps_run_so_far.get(job_id, {}).get("v100", 0)
+            if job_id in self._jobs:
+                bs = self._jobs[job_id].batch_size
+                model = self._jobs[job_id].model
+                current_epoch = steps_run // steps_per_epoch(model, bs)
+                self._shockwave.set_progress(job_id, current_epoch)
+        self._shockwave.increment_round()
+
+    # ------------------------------------------------------------------
+    # Simulation.
+    # ------------------------------------------------------------------
+    def get_current_timestamp(self, in_seconds: bool = False) -> float:
+        return self._current_timestamp
+
+    def simulate(
+        self,
+        cluster_spec: Dict[str, int],
+        arrival_times: Optional[List[float]] = None,
+        jobs: Optional[List[Job]] = None,
+        num_gpus_per_server: Optional[Dict[str, int]] = None,
+        jobs_to_complete: Optional[set] = None,
+        max_rounds: Optional[int] = None,
+    ) -> float:
+        """Trace-driven simulation; returns the makespan
+        (reference: scheduler.py:1365-1796, from_trace path)."""
+        assert arrival_times is not None and jobs is not None
+        remaining_jobs = len(jobs)
+        queued_jobs = list(zip(arrival_times, jobs))
+        running_jobs: list = []
+        consecutive_idle_rounds = 0
+
+        for worker_type in sorted(cluster_spec):
+            num_gpus = (
+                num_gpus_per_server[worker_type]
+                if num_gpus_per_server is not None
+                else 1
+            )
+            for _ in range(cluster_spec[worker_type] // num_gpus):
+                self.register_worker(worker_type, num_gpus=num_gpus)
+
+        self._current_timestamp = arrival_times[0]
+
+        while True:
+            if jobs_to_complete is not None and jobs_to_complete.issubset(
+                self._completed_jobs
+            ):
+                break
+            if remaining_jobs == 0:
+                break
+            if max_rounds is not None and self._num_completed_rounds >= max_rounds:
+                break
+            next_job_arrival_time = queued_jobs[0][0] if queued_jobs else None
+            if next_job_arrival_time is None and not running_jobs:
+                self._last_reset_time = 0
+
+            # Advance the clock to the end of the round (latest micro-task
+            # finish) or to the next arrival when idle.
+            max_timestamp = 0.0
+            if running_jobs and -running_jobs[0][0] > max_timestamp:
+                max_timestamp = -running_jobs[0][0]
+            if max_timestamp > 0:
+                self._current_timestamp = max_timestamp
+            elif next_job_arrival_time is not None:
+                self._current_timestamp = max(
+                    self._current_timestamp, next_job_arrival_time
+                )
+
+            # Complete every running micro-task (they all end by round end).
+            while running_jobs:
+                (
+                    finish_time,
+                    job_id,
+                    worker_ids,
+                    all_num_steps,
+                    round_start,
+                ) = running_jobs[0]
+                finish_time = -finish_time
+                if finish_time > self._current_timestamp:
+                    break
+                all_execution_times = []
+                for single in job_id.singletons():
+                    # Execution time is measured from when this micro-task was
+                    # dispatched, not from a global round marker, so idle gaps
+                    # between rounds are never billed as work.
+                    all_execution_times.append(finish_time - round_start)
+                    self._per_job_latest_timestamps[single] = finish_time
+                self._in_progress_updates[job_id] = []
+                scale_factor = len(worker_ids)
+                total_steps = [0] * len(job_id.singletons())
+                for i, worker_id in enumerate(worker_ids):
+                    if i == len(worker_ids) - 1:
+                        worker_steps = [
+                            all_num_steps[j] - total_steps[j]
+                            for j in range(len(all_num_steps))
+                        ]
+                    else:
+                        worker_steps = [x // scale_factor for x in all_num_steps]
+                    for j in range(len(worker_steps)):
+                        total_steps[j] += worker_steps[j]
+                    self._done_callback(
+                        job_id, worker_id, worker_steps, all_execution_times
+                    )
+                for single in job_id.singletons():
+                    if single not in self._jobs:
+                        remaining_jobs -= 1
+                heapq.heappop(running_jobs)
+
+            # Batch-size adaptation flags for the next completion.
+            for job_id in self._jobs:
+                if self._jobs[job_id].mode == "accordion":
+                    self._simulate_accordion(job_id)
+                elif self._jobs[job_id].mode == "gns":
+                    self._simulate_gns(job_id)
+
+            if self._shockwave is not None and self._num_completed_rounds >= 1:
+                self._shockwave_scheduler_update()
+
+            # Admit arrivals due by now.
+            while queued_jobs and queued_jobs[0][0] <= self._current_timestamp:
+                arrival_time, job = queued_jobs.pop(0)
+                self.add_job(job, timestamp=arrival_time)
+
+            if len(self._jobs) == 0:
+                if not queued_jobs:
+                    break
+                continue
+
+            scheduled_jobs = self._schedule_jobs_on_workers()
+            if self._is_shockwave and len(scheduled_jobs) == 0:
+                break
+            if not scheduled_jobs and not running_jobs and not queued_jobs:
+                # One idle iteration is recoverable: the reset-time trick at
+                # the top of the loop forces an allocation recompute next
+                # time around. Two in a row is a real deadlock.
+                consecutive_idle_rounds += 1
+                if consecutive_idle_rounds > 1:
+                    raise RuntimeError(
+                        "Scheduling deadlock: %d active jobs but nothing "
+                        "schedulable" % len(self._jobs)
+                    )
+            else:
+                consecutive_idle_rounds = 0
+            for job_id in self._current_worker_assignments:
+                if any(s in self._jobs for s in job_id.singletons()):
+                    self._num_lease_extension_opportunities += 1
+            for job_id in scheduled_jobs:
+                if job_id in self._current_worker_assignments and set(
+                    self._current_worker_assignments[job_id]
+                ) == set(scheduled_jobs[job_id]):
+                    self._num_lease_extensions += 1
+            self._current_worker_assignments = scheduled_jobs
+
+            for job_id, worker_ids in scheduled_jobs.items():
+                worker_type = self._worker_id_to_worker_type[worker_ids[0]]
+                for wid in worker_ids:
+                    self._available_worker_ids.discard(wid)
+                all_num_steps, max_finish_time = self._get_job_steps_and_finish_times(
+                    job_id, worker_type
+                )
+                heapq.heappush(
+                    running_jobs,
+                    (
+                        -max_finish_time,
+                        job_id,
+                        worker_ids,
+                        all_num_steps,
+                        self._current_timestamp,
+                    ),
+                )
+
+            self._num_completed_rounds += 1
+
+        self._logger.info(
+            "Total duration: %.3f seconds (%.2f hours)",
+            self._current_timestamp,
+            self._current_timestamp / 3600.0,
+        )
+        return self._current_timestamp
+
+    # ------------------------------------------------------------------
+    # Metrics.
+    # ------------------------------------------------------------------
+    def get_average_jct(self, job_ids=None, verbose: bool = False):
+        """(reference: scheduler.py:2131-2189)"""
+        if len(self._job_completion_times) == 0:
+            return None
+        if job_ids is None:
+            job_ids = sorted(self._job_completion_times.keys())
+        else:
+            job_ids = sorted(job_ids)
+        times = [
+            self._job_completion_times[j]
+            for j in job_ids
+            if self._job_completion_times.get(j) is not None
+        ]
+        if not times:
+            return None
+        avg = float(np.mean(times))
+        if verbose:
+            print(
+                "Average job completion time: %.3f seconds (%.2f hours)"
+                % (avg, avg / 3600.0)
+            )
+        return avg
+
+    def get_cluster_utilization(self):
+        """(reference: scheduler.py:2202-2220)"""
+        utilizations = []
+        for worker_id, worker_time in self._cumulative_worker_time_so_far.items():
+            total = self._current_timestamp - self._worker_start_times[worker_id]
+            if total <= 0:
+                continue
+            utilization = worker_time / total
+            if utilization > 1.0 and not self._job_packing:
+                return None
+            utilizations.append(utilization)
+        if not utilizations:
+            return None
+        return float(np.mean(utilizations))
+
+    def get_finish_time_fairness(self):
+        """rho = JCT / (isolated duration x contention factor); also the
+        fraction of jobs with rho > 1.1 (reference: scheduler.py:3627-3655)."""
+        num_gpus = len(self._worker_ids)
+        if len(self._job_completion_times) == 0:
+            return [], 0.0
+        ftf_list = []
+        contention = max(1.0, self._num_jobs_in_trace / max(1, num_gpus))
+        for job_id in sorted(self._job_completion_times.keys()):
+            jct = self._job_completion_times[job_id]
+            if jct is None:
+                continue
+            profile = self._profiles.get(job_id.integer)
+            if profile is None:
+                continue
+            isolated = sum(profile["duration_every_epoch"])
+            ftf_list.append(round(jct / (isolated * contention), 3))
+        if not ftf_list:
+            return [], 0.0
+        unfair_fraction = 100.0 * sum(f > 1.1 for f in ftf_list) / len(ftf_list)
+        return ftf_list, unfair_fraction
+
+    def get_completed_steps(self, job_ids=None):
+        if job_ids is None:
+            job_ids = sorted(self._total_steps_run.keys())
+        return {j: self._total_steps_run[j] for j in job_ids if j in self._total_steps_run}
+
+    def get_num_lease_extensions(self):
+        """(reference: scheduler.py:2248-2265)"""
+        if self._num_lease_extension_opportunities > 0:
+            return (
+                100.0
+                * self._num_lease_extensions
+                / self._num_lease_extension_opportunities
+            )
+        return 0.0
+
+    def get_total_cost(self):
+        return float(sum(self._job_cost_so_far.values()))

@@ -1,0 +1,220 @@
+"""Policy registry: name -> policy factory.
+
+Names match the reference's CLI vocabulary (reference:
+scheduler/utils.py:484-551) plus the TPU-native ``shockwave_tpu``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from shockwave_tpu.policies.base import Policy
+from shockwave_tpu.policies.fifo import (
+    FIFOPolicy,
+    FIFOPolicyWithPacking,
+    FIFOPolicyWithPerf,
+)
+from shockwave_tpu.policies.isolated import IsolatedPolicy, ProportionalPolicy
+from shockwave_tpu.policies.max_min_fairness import (
+    MaxMinFairnessPolicy,
+    MaxMinFairnessPolicyWithPerf,
+)
+
+
+def get_policy(
+    policy_name: str,
+    solver: Optional[str] = None,
+    seed: Optional[int] = None,
+    priority_reweighting_policies=None,
+) -> Policy:
+    if policy_name.startswith("allox"):
+        from shockwave_tpu.policies.allox import AlloXPolicy
+
+        alpha = 1.0
+        if policy_name != "allox":
+            alpha = float(policy_name.split("allox_alpha=")[1])
+        return AlloXPolicy(alpha=alpha)
+    if policy_name == "fifo":
+        return FIFOPolicy(seed=seed)
+    if policy_name == "fifo_perf":
+        return FIFOPolicyWithPerf()
+    if policy_name == "fifo_packed":
+        return FIFOPolicyWithPacking()
+    if policy_name == "gandiva":
+        from shockwave_tpu.policies.gandiva import GandivaPolicy
+
+        return GandivaPolicy(seed=seed)
+    if policy_name == "isolated":
+        return IsolatedPolicy()
+    if policy_name == "max_min_fairness":
+        return MaxMinFairnessPolicy(solver=solver)
+    if policy_name == "max_min_fairness_perf":
+        return MaxMinFairnessPolicyWithPerf(solver=solver)
+    if policy_name == "max_min_fairness_packed":
+        from shockwave_tpu.policies.max_min_fairness_packed import (
+            MaxMinFairnessPolicyWithPacking,
+        )
+
+        return MaxMinFairnessPolicyWithPacking(solver=solver)
+    if policy_name.startswith("max_min_fairness_water_filling"):
+        from shockwave_tpu.policies.water_filling import (
+            MaxMinFairnessWaterFillingPolicy,
+            MaxMinFairnessWaterFillingPolicyWithPacking,
+            MaxMinFairnessWaterFillingPolicyWithPerf,
+        )
+
+        cls = {
+            "max_min_fairness_water_filling": MaxMinFairnessWaterFillingPolicy,
+            "max_min_fairness_water_filling_perf": MaxMinFairnessWaterFillingPolicyWithPerf,
+            "max_min_fairness_water_filling_packed": MaxMinFairnessWaterFillingPolicyWithPacking,
+        }[policy_name]
+        return cls(priority_reweighting_policies=priority_reweighting_policies)
+    if policy_name == "max_min_fairness_strategy_proof":
+        from shockwave_tpu.policies.strategy_proof import (
+            MaxMinFairnessStrategyProofPolicyWithPerf,
+        )
+
+        return MaxMinFairnessStrategyProofPolicyWithPerf(solver=solver)
+    if policy_name == "finish_time_fairness":
+        from shockwave_tpu.policies.finish_time_fairness import (
+            FinishTimeFairnessPolicy,
+        )
+
+        return FinishTimeFairnessPolicy(solver=solver)
+    if policy_name == "finish_time_fairness_perf":
+        from shockwave_tpu.policies.finish_time_fairness import (
+            FinishTimeFairnessPolicyWithPerf,
+        )
+
+        return FinishTimeFairnessPolicyWithPerf(solver=solver)
+    if policy_name == "finish_time_fairness_packed":
+        from shockwave_tpu.policies.finish_time_fairness import (
+            FinishTimeFairnessPolicyWithPacking,
+        )
+
+        return FinishTimeFairnessPolicyWithPacking(solver=solver)
+    if policy_name == "max_sum_throughput_perf":
+        from shockwave_tpu.policies.max_sum_throughput import ThroughputSumWithPerf
+
+        return ThroughputSumWithPerf(solver=solver)
+    if policy_name == "max_sum_throughput_normalized_by_cost_perf":
+        from shockwave_tpu.policies.max_sum_throughput import (
+            ThroughputNormalizedByCostSumWithPerf,
+        )
+
+        return ThroughputNormalizedByCostSumWithPerf(solver=solver)
+    if policy_name == "max_sum_throughput_normalized_by_cost_perf_SLOs":
+        from shockwave_tpu.policies.max_sum_throughput import (
+            ThroughputNormalizedByCostSumWithPerfSLOs,
+        )
+
+        return ThroughputNormalizedByCostSumWithPerfSLOs(solver=solver)
+    if policy_name == "max_sum_throughput_normalized_by_cost_packed_SLOs":
+        from shockwave_tpu.policies.max_sum_throughput import (
+            ThroughputNormalizedByCostSumWithPackingSLOs,
+        )
+
+        return ThroughputNormalizedByCostSumWithPackingSLOs(solver=solver)
+    if policy_name == "min_total_duration":
+        from shockwave_tpu.policies.min_total_duration import MinTotalDurationPolicy
+
+        return MinTotalDurationPolicy(solver=solver)
+    if policy_name == "min_total_duration_perf":
+        from shockwave_tpu.policies.min_total_duration import (
+            MinTotalDurationPolicyWithPerf,
+        )
+
+        return MinTotalDurationPolicyWithPerf(solver=solver)
+    if policy_name == "min_total_duration_packed":
+        from shockwave_tpu.policies.min_total_duration import (
+            MinTotalDurationPolicyWithPacking,
+        )
+
+        return MinTotalDurationPolicyWithPacking(solver=solver)
+    if policy_name == "shockwave":
+        from shockwave_tpu.policies.shockwave import ShockwavePolicy
+
+        return ShockwavePolicy(backend="reference")
+    if policy_name == "shockwave_tpu":
+        from shockwave_tpu.policies.shockwave import ShockwavePolicy
+
+        return ShockwavePolicy(backend="tpu")
+    raise ValueError(f"Unknown policy: {policy_name!r}")
+
+
+# Full target vocabulary (parity with reference utils.py:484-551 plus the
+# TPU-native shockwave_tpu). Only names whose modules exist are advertised.
+_ALL_POLICY_NAMES = [
+    "allox",
+    "fifo",
+    "fifo_perf",
+    "fifo_packed",
+    "finish_time_fairness",
+    "finish_time_fairness_perf",
+    "finish_time_fairness_packed",
+    "gandiva",
+    "isolated",
+    "max_min_fairness",
+    "max_min_fairness_perf",
+    "max_min_fairness_packed",
+    "max_min_fairness_water_filling",
+    "max_min_fairness_water_filling_perf",
+    "max_min_fairness_water_filling_packed",
+    "max_min_fairness_strategy_proof",
+    "max_sum_throughput_perf",
+    "max_sum_throughput_normalized_by_cost_perf",
+    "max_sum_throughput_normalized_by_cost_perf_SLOs",
+    "max_sum_throughput_normalized_by_cost_packed_SLOs",
+    "min_total_duration",
+    "min_total_duration_perf",
+    "min_total_duration_packed",
+    "shockwave",
+    "shockwave_tpu",
+]
+
+_POLICY_MODULES = {
+    "allox": "allox",
+    "gandiva": "gandiva",
+    "finish_time_fairness": "finish_time_fairness",
+    "max_min_fairness_packed": "max_min_fairness_packed",
+    "max_min_fairness_water_filling": "water_filling",
+    "max_min_fairness_strategy_proof": "strategy_proof",
+    "max_sum_throughput": "max_sum_throughput",
+    "min_total_duration": "min_total_duration",
+    "shockwave": "shockwave",
+    "shockwave_tpu": "shockwave",
+}
+
+
+def _module_exists(name: str) -> bool:
+    import importlib.util
+
+    spec = importlib.util.find_spec(f"shockwave_tpu.policies.{name}")
+    return spec is not None
+
+
+def get_available_policies():
+    available = []
+    for name in _ALL_POLICY_NAMES:
+        module = None
+        for prefix, mod in _POLICY_MODULES.items():
+            if name.startswith(prefix):
+                module = mod
+                break
+        if module is None or _module_exists(module):
+            available.append(name)
+    return available
+
+
+__all__ = [
+    "Policy",
+    "get_policy",
+    "get_available_policies",
+    "FIFOPolicy",
+    "FIFOPolicyWithPerf",
+    "FIFOPolicyWithPacking",
+    "IsolatedPolicy",
+    "ProportionalPolicy",
+    "MaxMinFairnessPolicy",
+    "MaxMinFairnessPolicyWithPerf",
+]

@@ -1,0 +1,84 @@
+"""Allocation-policy base machinery.
+
+A policy maps a throughput matrix (jobs x worker types) plus per-job scale
+factors to a fractional time-share allocation ``{job_id: {worker_type:
+fraction}}`` subject to the cluster's capacity. Shapes and constraint
+semantics match the reference (reference: scheduler/policies/policy.py:11-63):
+
+  x >= 0
+  sum_j scale_factor_j * x[j, w] <= num_workers[w]   (capacity per type)
+  sum_w x[j, w] <= 1                                 (a job's total share)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from shockwave_tpu.core.ids import JobId
+
+Allocation = Dict[JobId, Dict[str, float]]
+
+
+class Policy:
+    """Base class: flatten/unflatten between dict-of-dicts and arrays."""
+
+    name: str = "Policy"
+
+    def __init__(self, solver: Optional[str] = None):
+        # ``solver`` selects the LP backend ("jax" or "scipy"); policies
+        # with closed forms ignore it.
+        self.solver = solver or "scipy"
+        self._num_workers: Optional[List[int]] = None
+
+    def flatten(self, throughputs: dict, cluster_spec: Dict[str, int]):
+        job_ids = sorted(throughputs.keys())
+        if not job_ids:
+            return None, None
+        worker_types = sorted(throughputs[job_ids[0]].keys())
+        if not worker_types:
+            return None, None
+        self._num_workers = [cluster_spec[wt] for wt in worker_types]
+        matrix = np.array(
+            [[throughputs[j][wt] for wt in worker_types] for j in job_ids],
+            dtype=np.float64,
+        )
+        return matrix, (job_ids, worker_types)
+
+    def unflatten(self, matrix: np.ndarray, index) -> Allocation:
+        job_ids, worker_types = index
+        return {
+            job_id: {wt: float(matrix[i][k]) for k, wt in enumerate(worker_types)}
+            for i, job_id in enumerate(job_ids)
+        }
+
+    def scale_factors_array(
+        self, scale_factors: dict, job_ids: Sequence[JobId], m: int, n: int
+    ) -> np.ndarray:
+        col = np.array([scale_factors[j] for j in job_ids], dtype=np.float64)
+        return np.tile(col[:, None], (1, n))
+
+
+def constraint_matrices(
+    scale_factors_array: np.ndarray, num_workers: Sequence[int]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Dense (A_ub, b_ub) for the base constraints over vec(x), excluding
+    x >= 0 which callers express as variable bounds."""
+    m, n = scale_factors_array.shape
+    rows = []
+    rhs = []
+    # Capacity per worker type.
+    for w in range(n):
+        row = np.zeros(m * n)
+        for j in range(m):
+            row[j * n + w] = scale_factors_array[j, w]
+        rows.append(row)
+        rhs.append(num_workers[w])
+    # Per-job total share <= 1.
+    for j in range(m):
+        row = np.zeros(m * n)
+        row[j * n : (j + 1) * n] = 1.0
+        rows.append(row)
+        rhs.append(1.0)
+    return np.array(rows), np.array(rhs)

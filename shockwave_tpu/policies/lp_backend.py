@@ -1,0 +1,104 @@
+"""LP backends for the LP-shaped Gavel policies.
+
+``scipy`` (HiGHS) is the exact CPU backend — the stand-in for the
+reference's ECOS/GUROBI cvxpy solves. A JAX backend (shared with the
+Shockwave EG solver in :mod:`shockwave_tpu.solver`) can be selected with
+``solver="jax"`` for on-device solves; it returns an eps-feasible point of
+the same program.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+from scipy.optimize import linprog
+
+from shockwave_tpu.policies.base import constraint_matrices
+
+
+def max_min_lp(
+    coeffs: np.ndarray,
+    scale_factors_array: np.ndarray,
+    num_workers: Sequence[int],
+    backend: str = "scipy",
+) -> np.ndarray:
+    """maximize  min_j sum_w coeffs[j,w] * x[j,w]  over the base polytope.
+
+    This is the core of max-min fairness (reference:
+    scheduler/policies/max_min_fairness.py:44-100, where coeffs =
+    throughput * priority * scale_factor).
+    """
+    if backend == "jax":
+        from shockwave_tpu.solver.lp_jax import max_min_lp_jax
+
+        return max_min_lp_jax(coeffs, scale_factors_array, np.asarray(num_workers))
+    m, n = coeffs.shape
+    # Variables: vec(x) followed by t; maximize t.
+    A_base, b_base = constraint_matrices(scale_factors_array, num_workers)
+    A_ub = np.zeros((A_base.shape[0] + m, m * n + 1))
+    A_ub[: A_base.shape[0], : m * n] = A_base
+    b_ub = np.concatenate([b_base, np.zeros(m)])
+    # t - coeffs[j] . x[j] <= 0
+    for j in range(m):
+        A_ub[A_base.shape[0] + j, j * n : (j + 1) * n] = -coeffs[j]
+        A_ub[A_base.shape[0] + j, -1] = 1.0
+    c = np.zeros(m * n + 1)
+    c[-1] = -1.0
+    bounds = [(0, None)] * (m * n) + [(None, None)]
+    res = linprog(c, A_ub=A_ub, b_ub=b_ub, bounds=bounds, method="highs")
+    if not res.success:
+        raise RuntimeError(f"max_min LP failed: {res.message}")
+    return res.x[: m * n].reshape(m, n)
+
+
+def feasibility_lp(
+    rate_requirements: np.ndarray,
+    coeffs: np.ndarray,
+    scale_factors_array: np.ndarray,
+    num_workers: Sequence[int],
+) -> np.ndarray | None:
+    """Find x in the base polytope with coeffs[j].x[j] >= rate_requirements[j]
+    for every job, or None if infeasible. Used by makespan-minimization's
+    binary search (reference: scheduler/policies/min_total_duration.py:46-59).
+    """
+    m, n = coeffs.shape
+    A_base, b_base = constraint_matrices(scale_factors_array, num_workers)
+    A_req = np.zeros((m, m * n))
+    for j in range(m):
+        A_req[j, j * n : (j + 1) * n] = -coeffs[j]
+    A_ub = np.vstack([A_base, A_req])
+    b_ub = np.concatenate([b_base, -rate_requirements])
+    res = linprog(
+        np.zeros(m * n), A_ub=A_ub, b_ub=b_ub, bounds=[(0, None)] * (m * n),
+        method="highs",
+    )
+    if not res.success:
+        return None
+    return res.x.reshape(m, n)
+
+
+def max_sum_lp(
+    objective_coeffs: np.ndarray,
+    scale_factors_array: np.ndarray,
+    num_workers: Sequence[int],
+    extra_A_ub: np.ndarray | None = None,
+    extra_b_ub: np.ndarray | None = None,
+) -> np.ndarray | None:
+    """maximize sum_jw objective_coeffs[j,w] * x[j,w] over the base polytope
+    (plus optional extra rows over vec(x)); None if infeasible."""
+    m, n = objective_coeffs.shape
+    A_ub, b_ub = constraint_matrices(scale_factors_array, num_workers)
+    if extra_A_ub is not None:
+        A_ub = np.vstack([A_ub, extra_A_ub])
+        b_ub = np.concatenate([b_ub, extra_b_ub])
+    res = linprog(
+        -objective_coeffs.reshape(-1),
+        A_ub=A_ub,
+        b_ub=b_ub,
+        bounds=[(0, None)] * (m * n),
+        method="highs",
+    )
+    if not res.success:
+        return None
+    return res.x.reshape(m, n)

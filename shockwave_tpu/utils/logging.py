@@ -1,0 +1,28 @@
+"""Logging with simulated-or-wall-clock timestamps
+(capability of reference: scheduler/custom_logging.py:5-12)."""
+
+from __future__ import annotations
+
+import logging
+
+
+class TimestampAdapter(logging.LoggerAdapter):
+    """Prefixes records with the scheduler's current (possibly simulated)
+    timestamp, fetched lazily from a callable."""
+
+    def __init__(self, logger, clock):
+        super().__init__(logger, {})
+        self._clock = clock
+
+    def process(self, msg, kwargs):
+        return "[%.2f] %s" % (self._clock(), msg), kwargs
+
+
+def make_logger(name: str, clock, level=logging.WARNING) -> TimestampAdapter:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter("%(name)s:%(levelname)s %(message)s"))
+        logger.addHandler(handler)
+    logger.setLevel(level)
+    return TimestampAdapter(logger, clock)

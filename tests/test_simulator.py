@@ -1,0 +1,154 @@
+"""Simulator-core tests: determinism, conservation of work, policy behavior
+on tiny hand-built traces (reference test style: scheduler/tests)."""
+
+import pytest
+
+from shockwave_tpu.core.ids import JobId
+from shockwave_tpu.core.job import Job
+from shockwave_tpu.core.scheduler import Scheduler
+from shockwave_tpu.data.default_oracle import generate_oracle
+from shockwave_tpu.data.profiles import synthesize_profiles
+from shockwave_tpu.data.workload_info import steps_per_epoch
+from shockwave_tpu.policies import get_policy
+
+
+def tiny_trace(num_jobs=4, epochs=3, arrival_gap=0.0, scale_factors=None, mode="static"):
+    jobs = []
+    arrivals = []
+    for i in range(num_jobs):
+        sf = scale_factors[i] if scale_factors else 1
+        jobs.append(
+            Job(
+                job_type="ResNet-18 (batch size 32)",
+                command="python3 main.py --data_dir=%s/cifar10 --batch_size 32",
+                num_steps_arg="--num_steps",
+                total_steps=steps_per_epoch("ResNet-18", 32) * epochs,
+                scale_factor=sf,
+                mode=mode,
+            )
+        )
+        arrivals.append(i * arrival_gap)
+    return jobs, arrivals
+
+
+def run_sim(policy_name, jobs, arrivals, cluster={"v100": 4}, seed=0, **kw):
+    oracle = generate_oracle()
+    profiles = synthesize_profiles(jobs, oracle)
+    sched = Scheduler(
+        get_policy(policy_name, seed=seed),
+        throughputs=oracle,
+        seed=seed,
+        time_per_iteration=kw.pop("time_per_iteration", 120),
+        profiles=profiles,
+    )
+    makespan = sched.simulate(dict(cluster), list(arrivals), list(jobs), **kw)
+    return sched, makespan
+
+
+def test_all_jobs_complete_and_steps_conserved():
+    jobs, arrivals = tiny_trace(num_jobs=6, epochs=2)
+    sched, makespan = run_sim("fifo", jobs, arrivals)
+    assert len(sched._job_completion_times) == 6
+    assert all(t is not None and t > 0 for t in sched._job_completion_times.values())
+    assert makespan > 0
+    # Every job ran exactly its total steps.
+    target = steps_per_epoch("ResNet-18", 32) * 2
+    for job_id, steps in sched.get_completed_steps().items():
+        assert steps == target
+
+
+def test_determinism():
+    jobs1, arrivals = tiny_trace(num_jobs=8, epochs=2, arrival_gap=30.0)
+    jobs2, _ = tiny_trace(num_jobs=8, epochs=2, arrival_gap=30.0)
+    _, mk1 = run_sim("max_min_fairness", jobs1, arrivals, seed=7)
+    _, mk2 = run_sim("max_min_fairness", jobs2, arrivals, seed=7)
+    assert mk1 == mk2
+
+
+def test_gang_scheduling_multi_gpu():
+    # Two 2-GPU jobs on a 4-GPU cluster can run simultaneously; a 4-GPU job
+    # must wait for all workers (gang semantics).
+    jobs, arrivals = tiny_trace(num_jobs=2, epochs=2, scale_factors=[2, 2])
+    sched, _ = run_sim("fifo", jobs, arrivals)
+    assert len(sched._job_completion_times) == 2
+
+    jobs, arrivals = tiny_trace(num_jobs=2, epochs=2, scale_factors=[4, 1])
+    sched, _ = run_sim("fifo", jobs, arrivals)
+    assert len(sched._job_completion_times) == 2
+
+
+def test_fifo_orders_by_arrival():
+    jobs, arrivals = tiny_trace(num_jobs=3, epochs=2, arrival_gap=1.0)
+    sched, _ = run_sim("fifo", jobs, arrivals, cluster={"v100": 1})
+    jct = sched._job_completion_times
+    # With one GPU, earlier-arriving jobs must finish first under FIFO.
+    finish = {
+        j: sched._per_job_start_timestamps[j] + jct[j] for j in jct
+    }
+    assert finish[JobId(0)] < finish[JobId(1)] < finish[JobId(2)]
+
+
+def test_max_min_fairness_shares_cluster():
+    # With more jobs than GPUs, all jobs should still finish, and no single
+    # job should be starved (FTF bounded).
+    jobs, arrivals = tiny_trace(num_jobs=8, epochs=2)
+    sched, _ = run_sim("max_min_fairness", jobs, arrivals, cluster={"v100": 2})
+    assert len(sched._job_completion_times) == 8
+    ftf_list, _ = sched.get_finish_time_fairness()
+    assert len(ftf_list) == 8
+    assert max(ftf_list) < 10.0
+
+
+def test_utilization_bounds():
+    jobs, arrivals = tiny_trace(num_jobs=4, epochs=2)
+    sched, _ = run_sim("fifo", jobs, arrivals, cluster={"v100": 2})
+    util = sched.get_cluster_utilization()
+    assert util is not None and 0.0 < util <= 1.0
+
+
+def test_accordion_scales_batch_size():
+    # A long accordion ResNet-18 job should scale its batch size up past the
+    # critical regime and back down inside later critical windows.
+    epochs = 40
+    job = Job(
+        job_type="ResNet-18 (batch size 32)",
+        command="python3 main.py --data_dir=%s/cifar10 --batch_size 32",
+        total_steps=steps_per_epoch("ResNet-18", 32) * epochs,
+        mode="accordion",
+    )
+    sched, _ = run_sim("fifo", [job], [0.0], cluster={"v100": 1})
+    # Job completed; its final batch size should have been scaled at least
+    # once (command rewritten to max bs at some point => job_type mutated).
+    assert len(sched._job_completion_times) == 1
+
+
+def test_isolated_allocation_matrix():
+    from shockwave_tpu.policies.isolated import IsolatedPolicy
+
+    pol = IsolatedPolicy()
+    throughputs = {JobId(i): {"v100": 10.0, "k80": 2.0} for i in range(4)}
+    sf = {JobId(i): 1 for i in range(4)}
+    alloc = pol.get_allocation(throughputs, sf, {"v100": 4, "k80": 4})
+    for j in alloc:
+        assert sum(alloc[j].values()) <= 1.0 + 1e-9
+        for v in alloc[j].values():
+            assert v >= 0
+
+
+def test_max_min_lp_matches_closed_form():
+    # 2 jobs, 1 worker type, equal throughputs: fair split is 0.5/0.5
+    # effective rate each.
+    from shockwave_tpu.policies.max_min_fairness import MaxMinFairnessPolicyWithPerf
+
+    pol = MaxMinFairnessPolicyWithPerf()
+    throughputs = {JobId(0): {"v100": 4.0}, JobId(1): {"v100": 4.0}}
+    sf = {JobId(0): 1, JobId(1): 1}
+    pw = {JobId(0): 1.0, JobId(1): 1.0}
+    alloc = pol.get_allocation(throughputs, sf, pw, {"v100": 1})
+    assert alloc[JobId(0)]["v100"] == pytest.approx(0.5, abs=1e-6)
+    assert alloc[JobId(1)]["v100"] == pytest.approx(0.5, abs=1e-6)
+
+
+def test_scheduler_rejects_shockwave_without_config():
+    with pytest.raises(Exception):
+        Scheduler(get_policy("shockwave"), throughputs=generate_oracle())
