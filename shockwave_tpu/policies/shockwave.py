@@ -1,0 +1,280 @@
+"""The Shockwave policy: per-round Volatile Fisher Market planning.
+
+``ShockwavePolicy`` is a name-only marker the scheduler dispatches on
+(reference: scheduler/policies/shockwave.py:6-8 plus the scheduler hooks
+gated on the policy name); the planning logic lives in
+:class:`ShockwavePlanner`, the equivalent of the reference's
+``ShockwaveScheduler`` (reference: scheduler/shockwave.py:12-91).
+
+Two interchangeable solver backends:
+  * ``reference`` — the exact boolean program on host CPU via HiGHS
+    (:mod:`shockwave_tpu.solver.eg_milp`), reference-math ground truth.
+  * ``tpu`` — the jitted relaxed solve + greedy recovery
+    (:mod:`shockwave_tpu.solver.eg_jax`), the TPU-native fast path.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from shockwave_tpu.policies.base import Policy
+from shockwave_tpu.predictor import JobMetadata
+from shockwave_tpu.solver.eg_problem import EGProblem
+
+DEFAULT_LOG_BASES = [0.0, 0.2, 0.4, 0.6, 0.8, 1.0]
+
+
+class ShockwavePlanner:
+    """Plans a boolean (job x future-round) schedule each planning window.
+
+    State: per-job predictor metadata, finish-time-estimate history, the
+    schedule cache keyed by absolute round index, and the recompute flag
+    (set on batch-size changes; reference: scheduler/scheduler.py:3590-3591).
+    """
+
+    def __init__(self, config: dict, backend: str = "tpu"):
+        self.config = dict(config)
+        self.backend = backend
+        self.num_gpus = int(config["num_gpus"])
+        self.round_duration = float(config["time_per_iteration"])
+        self.future_rounds = int(config.get("future_rounds", 20))
+        self.priority_power = float(config.get("lambda", 5.0))
+        self.regularizer = float(config.get("k", 10.0))
+        self.log_bases = list(
+            config.get("log_approximation_bases", DEFAULT_LOG_BASES)
+        )
+        self.solver_rel_gap = float(config.get("solver_rel_gap", 1e-3))
+        self.solver_timeout = float(config.get("solver_timeout", 15.0))
+        self.solver_num_steps = int(config.get("solver_num_steps", 256))
+
+        self.round_index = 0
+        self.recompute_flag = False
+        self.schedules: "OrderedDict[int, list]" = OrderedDict()
+        self.job_metadata: "OrderedDict[object, JobMetadata]" = OrderedDict()
+        self.finish_time_estimates: Dict[object, list] = {}
+        # Wall-clock seconds of each plan solve (consumed by bench.py).
+        self.solve_times: List[float] = []
+
+    # -- scheduler-facing interface -------------------------------------
+    def add_job(
+        self, job_id, profile: dict, round_len: float, scale_factor: int,
+        submit_time: Optional[float] = None,
+    ) -> None:
+        md = JobMetadata(profile, round_len, scale_factor)
+        if submit_time is not None:
+            md.submit(submit_time)
+        self.job_metadata[job_id] = md
+
+    def remove_job(self, job_id) -> None:
+        self.job_metadata.pop(job_id, None)
+        self.finish_time_estimates.pop(job_id, None)
+
+    def record_round_throughput(self, job_id, round_id, throughput, bs) -> None:
+        md = self.job_metadata.get(job_id)
+        if md is not None:
+            md.record_round_throughput(round_id, throughput, bs)
+
+    def mark_complete(self, job_id) -> None:
+        md = self.job_metadata.get(job_id)
+        if md is not None:
+            md.complete()
+
+    def set_progress(self, job_id, num_epochs: int) -> None:
+        md = self.job_metadata.get(job_id)
+        if md is not None:
+            md.complete(min(int(num_epochs), md.total_epochs))
+
+    def increment_round(self) -> None:
+        self.round_index += 1
+
+    def set_recompute_flag(self) -> None:
+        self.recompute_flag = True
+
+    @property
+    def num_jobs(self) -> int:
+        return len(self.job_metadata)
+
+    def current_round_schedule(self) -> list:
+        """This round's job list, from the plan cache or a fresh solve
+        (reference: shockwave.py:77-91).
+
+        Beyond the reference's cache semantics, a cached round whose
+        scheduled jobs have all since completed triggers a replan while
+        incomplete jobs remain — the reference returns the stale empty
+        round, which the scheduler interprets as end-of-trace and wedges
+        the remaining jobs (scheduler.py:1731-1732).
+        """
+        if not self.recompute_flag and self.round_index in self.schedules:
+            schedule = self.schedules[self.round_index]
+            live = [
+                j
+                for j in schedule
+                if j in self.job_metadata
+                and self.job_metadata[j].completed_epochs
+                < self.job_metadata[j].total_epochs
+            ]
+            if live or not self._has_incomplete_jobs():
+                return schedule
+        self._replan()
+        self.recompute_flag = False
+        return self.schedules[self.round_index]
+
+    def _has_incomplete_jobs(self) -> bool:
+        return any(
+            md.completed_epochs < md.total_epochs
+            for md in self.job_metadata.values()
+        )
+
+    # -- planning -------------------------------------------------------
+    def _build_problem(self):
+        """Predictor state -> EGProblem arrays + this window's priorities.
+
+        Finish-time fairness per job (reference: shockwave.py:244-279):
+        predicted JCT under contention divided by the window-weighted
+        running average of its isolated finish-time estimates.
+        """
+        job_ids = [
+            j
+            for j, md in self.job_metadata.items()
+            if md.completed_epochs < md.total_epochs
+        ]
+        if not job_ids:
+            return None, []
+        J = len(job_ids)
+        completed = np.zeros(J)
+        total = np.zeros(J)
+        epoch_dur = np.zeros(J)
+        remaining = np.zeros(J)
+        nworkers = np.zeros(J)
+        priorities = np.zeros(J)
+        contention = self.num_jobs / self.num_gpus
+        round_time = (self.round_index + self.future_rounds) * self.round_duration
+        for i, job_id in enumerate(job_ids):
+            md = self.job_metadata[job_id]
+            md.recompute_epoch_durations()
+            completed[i] = md.completed_epochs
+            total[i] = md.total_epochs
+            epoch_dur[i] = md.mean_epoch_duration()
+            rem = md.remaining_runtime()
+            remaining[i] = rem
+            nworkers[i] = md.nworkers
+            predicted_jct = round_time + rem * contention
+            predicted_finish = (
+                float(np.sum(md.epoch_durations[: md.completed_epochs])) + rem
+            )
+            history = self.finish_time_estimates.setdefault(job_id, [])
+            history.append((self.round_index, predicted_finish))
+            ftf = predicted_jct / self._interpolated_finish_time(job_id)
+            priorities[i] = ftf ** self.priority_power
+        problem = EGProblem(
+            priorities=priorities,
+            completed_epochs=completed,
+            total_epochs=total,
+            epoch_duration=epoch_dur,
+            remaining_runtime=remaining,
+            nworkers=nworkers,
+            num_gpus=self.num_gpus,
+            round_duration=self.round_duration,
+            future_rounds=self.future_rounds,
+            regularizer=self.regularizer,
+            log_bases=np.asarray(self.log_bases, dtype=np.float64),
+        )
+        return problem, job_ids
+
+    def _interpolated_finish_time(self, job_id, alpha: float = 0.9) -> float:
+        """Window-weighted running average blended with the latest estimate
+        (reference: shockwave.py:224-242, including the quirk that the
+        weight vector's length truncates the estimate list)."""
+        history = self.finish_time_estimates[job_id]
+        round_ids = np.array([r for r, _ in history], dtype=np.float64)
+        windows = np.diff(round_ids)
+        if windows.size == 0 or np.sum(windows) == 0:
+            weights = np.array([1.0])
+        else:
+            weights = windows / np.sum(windows)
+        finish_times = np.array([ft for _, ft in history[: weights.size]])
+        avg = float(np.dot(weights, finish_times))
+        return alpha * avg + (1 - alpha) * history[-1][1]
+
+    def _solve(self, problem: EGProblem) -> np.ndarray:
+        if self.backend == "reference":
+            from shockwave_tpu.solver.eg_milp import (
+                reorder_unfair_jobs_milp,
+                solve_eg_milp,
+            )
+
+            Y = solve_eg_milp(
+                problem,
+                rel_gap=self.solver_rel_gap,
+                time_limit=self.solver_timeout,
+            )
+            return reorder_unfair_jobs_milp(
+                Y,
+                problem,
+                rel_gap=self.solver_rel_gap,
+                time_limit=self.solver_timeout,
+            )
+        from shockwave_tpu.solver.eg_jax import solve_eg_greedy
+        from shockwave_tpu.solver.rounding import reorder_columns
+
+        Y = solve_eg_greedy(problem)
+        return reorder_columns(Y, problem.priorities)
+
+    def _replan(self) -> None:
+        # Past rounds are never read again; keep the cache bounded.
+        for r in [r for r in self.schedules if r < self.round_index]:
+            del self.schedules[r]
+        problem, job_ids = self._build_problem()
+        if problem is None:
+            for i in range(self.future_rounds):
+                self.schedules[self.round_index + i] = []
+            return
+        start = time.time()
+        Y = self._solve(problem)
+        self.solve_times.append(time.time() - start)
+        Y = self._backfill(Y, problem)
+        for r in range(self.future_rounds):
+            self.schedules[self.round_index + r] = [
+                job_ids[j] for j in range(len(job_ids)) if Y[j, r]
+            ]
+
+    def _backfill(self, Y: np.ndarray, problem: EGProblem) -> np.ndarray:
+        """Fill any round left completely idle while unfinished jobs exist
+        (the scheduler treats an empty round as end-of-trace; the MILP can
+        legitimately leave a round empty when every job's utility is
+        saturated, which would wedge the mechanism)."""
+        J, R = Y.shape
+        order = np.argsort(-problem.priorities, kind="stable")
+        for r in range(R):
+            if Y[:, r].any():
+                continue
+            capacity = float(problem.num_gpus)
+            for j in order:
+                if problem.nworkers[j] <= capacity:
+                    Y[j, r] = 1
+                    capacity -= problem.nworkers[j]
+                if capacity <= 0:
+                    break
+        return Y
+
+
+class ShockwavePolicy(Policy):
+    """Marker policy selecting the Shockwave mechanism path in the
+    scheduler; carries the planner factory."""
+
+    def __init__(self, backend: str = "tpu"):
+        super().__init__()
+        self.backend = backend
+        self.name = "Shockwave" if backend == "reference" else "Shockwave_TPU"
+
+    def make_planner(self, config: dict) -> ShockwavePlanner:
+        return ShockwavePlanner(config, backend=self.backend)
+
+    def get_allocation(self, *args, **kwargs):
+        # The scheduler never requests a fractional allocation for
+        # Shockwave; rounds come from the planner.
+        return {}

@@ -1,0 +1,184 @@
+"""Per-job dynamic-adaptation predictor state.
+
+One :class:`JobMetadata` per job tracks its epoch profile (batch size and
+wall-clock duration of every epoch), the measured per-round throughput
+schedule, and a Dirichlet prior over batch-size "regimes". From these it
+predicts the job's remaining runtime — the quantity the Shockwave planner's
+finish-time-fairness and makespan terms are built on.
+
+Capability parity with reference: scheduler/job_metadata.py:1-202. The
+implementation here is vectorized numpy (cumsum/bincount over epoch arrays
+instead of Python loops) so the same math can be lifted into the batched JAX
+round-prep path (see :func:`batch_remaining_runtimes`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+INFINITY = 1e9
+
+
+class JobMetadata:
+    """Epoch profile + throughput history + Dirichlet regime posterior.
+
+    Profile schema (reference: job_metadata.py:14-23):
+      num_epochs, num_samples_per_epoch, scale_factor, duration,
+      bs_every_epoch, mem_every_epoch, util_every_epoch, duration_every_epoch.
+    """
+
+    def __init__(
+        self,
+        profile: dict,
+        round_duration: float,
+        scale_factor: Optional[int] = None,
+    ):
+        self.total_epochs = int(profile["num_epochs"])
+        self.completed_epochs = 0
+        self.nsamples_per_epoch = profile["num_samples_per_epoch"]
+        self.nworkers = (
+            int(scale_factor)
+            if scale_factor is not None
+            else int(profile["scale_factor"])
+        )
+        self.epoch_batch_sizes = np.asarray(profile["bs_every_epoch"], dtype=np.int64)
+        self.epoch_mem_reqs = list(profile.get("mem_every_epoch", []))
+        self.epoch_gpu_reqs = list(profile.get("util_every_epoch", []))
+
+        # Durations are clamped to whole >=1s values up front
+        # (reference: job_metadata.py:39).
+        durations = np.asarray(profile["duration_every_epoch"], dtype=np.float64)
+        self.epoch_durations = np.maximum(1.0, np.round(durations))
+        # The as-profiled durations stay fixed; ``epoch_durations`` is
+        # re-scaled in place from measured throughput.
+        self.estimated_epoch_durations = self.epoch_durations.copy()
+
+        # Dirichlet prior: uniform over the distinct batch sizes in the
+        # profile, with total concentration = total_epochs
+        # (reference: job_metadata.py:42-45).
+        self.regimes = np.unique(self.epoch_batch_sizes)
+        self.dirichlet: Dict[int, float] = {
+            int(bs): self.total_epochs / len(self.regimes) for bs in self.regimes
+        }
+
+        self.submit_time: Optional[float] = None
+        # round_id -> (throughput, batch size), insertion-ordered.
+        self.throughput_schedule: Dict[int, tuple] = {}
+        self.round_duration = round_duration
+
+    # -- lifecycle ------------------------------------------------------
+    def submit(self, time: float) -> None:
+        if self.submit_time is None:
+            self.submit_time = time
+
+    def complete(self, num_epochs: Optional[int] = None) -> None:
+        """Record epoch progress; with no argument, mark fully finished
+        (reference: job_metadata.py:64-78)."""
+        if num_epochs is None:
+            self.completed_epochs = self.total_epochs
+        else:
+            if num_epochs > self.total_epochs:
+                raise ValueError(f"epoch progress {num_epochs} > {self.total_epochs}")
+            self.completed_epochs = int(num_epochs)
+
+    def record_round_throughput(self, round_id: int, throughput: float, bs: int) -> None:
+        """(reference: job_metadata.py:80-92)"""
+        self.throughput_schedule[int(round_id)] = (float(throughput), int(bs))
+
+    # -- duration model -------------------------------------------------
+    def recompute_epoch_durations(self) -> None:
+        """Rescale the per-epoch duration estimates so that the samples/sec
+        they imply matches what the measured throughput schedule observed
+        (reference: job_metadata.py:94-148).
+
+        measured samples: integrate throughput*bs over the measured rounds
+        (each measurement is extended back to the previous one). estimated
+        samples: walk the original per-epoch durations across the same time
+        window, counting whole epochs plus the in-progress fraction.
+        """
+        if not self.throughput_schedule:
+            return
+        rounds = np.array(sorted(self.throughput_schedule), dtype=np.int64)
+        tputs = np.array(
+            [self.throughput_schedule[r][0] for r in rounds], dtype=np.float64
+        )
+        bss = np.array([self.throughput_schedule[r][1] for r in rounds], dtype=np.float64)
+        spans = np.diff(np.concatenate([[0], rounds])).astype(np.float64)
+        measured_nsamples = float(np.sum(bss * tputs * self.round_duration * spans))
+        measured_time_range = self.round_duration * float(rounds[-1])
+
+        cum = np.cumsum(self.estimated_epoch_durations)
+        # Number of whole estimated epochs that fit in the measured window.
+        whole = int(np.searchsorted(cum, measured_time_range, side="right"))
+        whole = min(whole, len(cum))
+        estimated_nsamples = self.nsamples_per_epoch * whole
+        elapsed = float(cum[whole - 1]) if whole > 0 else 0.0
+        partial = measured_time_range - elapsed
+        if partial > 0:
+            # The fractional epoch is valued against the same as-profiled
+            # durations the whole-epoch count uses, making this recompute
+            # idempotent. (The reference prices the fraction at the
+            # already-rescaled duration, job_metadata.py:131-134, so its
+            # repeated recomputes oscillate with no new measurements — a
+            # consciously fixed quirk, SURVEY §7.)
+            idx = min(whole, len(self.estimated_epoch_durations) - 1)
+            estimated_nsamples += self.nsamples_per_epoch * (
+                partial / self.estimated_epoch_durations[idx]
+            )
+
+        if measured_nsamples <= 0 or estimated_nsamples <= 0:
+            return
+        scale = estimated_nsamples / measured_nsamples
+        self.epoch_durations = self.estimated_epoch_durations * scale
+
+    def bs_epoch_durations(self) -> Dict[int, float]:
+        """Mean epoch duration per batch-size regime, after rescaling
+        (reference: job_metadata.py:150-165)."""
+        self.recompute_epoch_durations()
+        out: Dict[int, float] = {}
+        for bs in self.regimes:
+            mask = self.epoch_batch_sizes == bs
+            out[int(bs)] = float(np.mean(self.epoch_durations[mask]))
+        return out
+
+    def mean_epoch_duration(self) -> float:
+        """Interpolated epoch duration: mean over the completed epochs plus
+        the one in progress (reference: shockwave.py:116-120 footnote of
+        EQ 7)."""
+        return float(np.mean(self.epoch_durations[: self.completed_epochs + 1]))
+
+    # -- remaining-runtime prediction -----------------------------------
+    def remaining_runtime(self) -> float:
+        """Expected remaining runtime under the Dirichlet regime posterior
+        (reference: job_metadata.py:167-202).
+
+        Posterior = prior + one count per observed epoch (including the
+        in-progress one); rebased so the concentrations sum to total_epochs;
+        observed epochs are then subtracted back out (floored at zero); what
+        remains is the expected number of future epochs in each regime,
+        priced at that regime's mean epoch duration.
+        """
+        if len(self.dirichlet) == 0 or self.completed_epochs >= self.total_epochs:
+            return 1.0
+        observed = self.epoch_batch_sizes[: self.completed_epochs + 1]
+        counts = {
+            int(bs): int(np.sum(observed == bs)) for bs in np.unique(observed)
+        }
+        posterior = {
+            bs: conc + counts.get(bs, 0) for bs, conc in self.dirichlet.items()
+        }
+        total_conc = sum(posterior.values())
+        rebased = {
+            bs: self.total_epochs * conc / total_conc for bs, conc in posterior.items()
+        }
+        for bs, n in counts.items():
+            rebased[bs] = max(0.0, rebased[bs] - n)
+        durations = self.bs_epoch_durations()
+        return float(sum(rebased[bs] * durations[bs] for bs in rebased))
+
+
+def batch_remaining_runtimes(metadatas: Sequence[JobMetadata]) -> np.ndarray:
+    """Remaining runtimes for a set of jobs as one array (round-prep path)."""
+    return np.array([m.remaining_runtime() for m in metadatas], dtype=np.float64)

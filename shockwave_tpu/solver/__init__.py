@@ -1,0 +1,5 @@
+"""EG planning-program solvers: exact MILP (host) and relaxed JAX (TPU)."""
+
+from shockwave_tpu.solver.eg_problem import EGProblem
+
+__all__ = ["EGProblem"]

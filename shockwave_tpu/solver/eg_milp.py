@@ -1,0 +1,187 @@
+"""Exact boolean solve of the EG planning program on host CPU.
+
+This is the reference-math backend: the same mixed-integer program the
+reference builds with CVXPY+GUROBI (reference: scheduler/shockwave.py:
+330-411), here formulated directly for scipy's HiGHS ``milp``. It exists
+(a) as the drop-in "shockwave" policy backend, and (b) as the ground truth
+the TPU solver is benchmarked and tested against.
+
+Formulation notes (equivalent to, but smaller than, the reference's):
+  * The piecewise-log utility uses the lambda (convex-combination-of-
+    breakpoints) encoding WITHOUT per-segment booleans: because log is
+    concave and each utility enters the maximized objective with a positive
+    weight, the LP optimum automatically uses adjacent breakpoints, so the
+    SOS2 booleans of the reference encoding (shockwave.py:161-182) are
+    redundant. Only the Y[j, r] schedule variables are integer.
+  * max(0, remaining - planned) per job and the max over jobs collapse into
+    one epigraph variable M with M >= remaining_j - D_j * pe_j, M >= 0.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+from scipy import sparse
+from scipy.optimize import Bounds, LinearConstraint, milp
+
+from shockwave_tpu.solver.eg_problem import EGProblem
+
+
+def solve_eg_milp(
+    problem: EGProblem,
+    rel_gap: float = 1e-3,
+    time_limit: Optional[float] = 15.0,
+) -> np.ndarray:
+    """Solve the EG program; returns Y as a (num_jobs, future_rounds) 0/1
+    array. Variables: [Y (J*R, binary) | pe (J) | w (J*B) | M (1)].
+    """
+    J, R = problem.num_jobs, problem.future_rounds
+    B = len(problem.log_bases)
+    G = problem.num_gpus
+    dur = problem.round_duration
+    D = problem.epoch_duration
+    bases = np.asarray(problem.log_bases, dtype=np.float64)
+    log_vals = problem.log_base_values()
+
+    n_y, n_pe, n_w = J * R, J, J * B
+    n_var = n_y + n_pe + n_w + 1
+    iY = lambda j, r: j * R + r
+    iPE = lambda j: n_y + j
+    iW = lambda j, b: n_y + n_pe + j * B + b
+    iM = n_var - 1
+
+    rows, cols, vals, lo, hi = [], [], [], [], []
+    row = 0
+
+    def add(entries, lb, ub):
+        nonlocal row
+        for c, v in entries:
+            rows.append(row)
+            cols.append(c)
+            vals.append(v)
+        lo.append(lb)
+        hi.append(ub)
+        row += 1
+
+    # Per-round capacity: sum_j g_j Y[j,r] <= G (reference: shockwave.py:64-75).
+    for r in range(R):
+        add(
+            [(iY(j, r), float(problem.nworkers[j])) for j in range(J)],
+            -np.inf,
+            float(G),
+        )
+    for j in range(J):
+        # Planned runtime fits in the granted rounds:
+        # D_j pe_j - dur * sum_r Y[j,r] <= 0 (reference: shockwave.py:125-129).
+        add(
+            [(iPE(j), float(D[j]))] + [(iY(j, r), -dur) for r in range(R)],
+            -np.inf,
+            0.0,
+        )
+        # w_j on the simplex.
+        add([(iW(j, b), 1.0) for b in range(B)], 1.0, 1.0)
+        # sum_b w[j,b] * base_b == (completed_j + pe_j) / total_j.
+        add(
+            [(iW(j, b), float(bases[b])) for b in range(B)]
+            + [(iPE(j), -1.0 / float(problem.total_epochs[j]))],
+            float(problem.completed_epochs[j] / problem.total_epochs[j]),
+            float(problem.completed_epochs[j] / problem.total_epochs[j]),
+        )
+        # Makespan epigraph: M + D_j pe_j >= remaining_j.
+        add(
+            [(iM, 1.0), (iPE(j), float(D[j]))],
+            float(problem.remaining_runtime[j]),
+            np.inf,
+        )
+
+    A = sparse.csr_matrix((vals, (rows, cols)), shape=(row, n_var))
+
+    # Maximize sum_j p_j * u_j / (J*R) - k * M  (reference: shockwave.py:373-379).
+    c = np.zeros(n_var)
+    for j in range(J):
+        for b in range(B):
+            c[iW(j, b)] = -problem.priorities[j] * log_vals[b] / (J * R)
+    c[iM] = problem.regularizer
+
+    integrality = np.zeros(n_var)
+    integrality[:n_y] = 1
+    lb = np.zeros(n_var)
+    ub = np.full(n_var, np.inf)
+    ub[:n_y] = 1.0
+
+    options = {"mip_rel_gap": rel_gap}
+    if time_limit is not None:
+        options["time_limit"] = float(time_limit)
+    res = milp(
+        c,
+        constraints=LinearConstraint(A, np.array(lo), np.array(hi)),
+        integrality=integrality,
+        bounds=Bounds(lb, ub),
+        options=options,
+    )
+    if res.x is None:
+        raise RuntimeError(f"EG MILP failed: {res.message}")
+    Y = np.round(res.x[:n_y]).reshape(J, R).astype(np.int64)
+    return Y
+
+
+def reorder_unfair_jobs_milp(
+    Y: np.ndarray,
+    problem: EGProblem,
+    rel_gap: float = 1e-3,
+    time_limit: Optional[float] = 15.0,
+) -> np.ndarray:
+    """Re-derive which rounds each job occupies, keeping its granted count
+    and the capacity constraint, so that unfair (high-priority) jobs run
+    earliest: minimize sum_j priority_j * mean-round-index_j
+    (reference: shockwave.py:281-328, paper Appendix G.2).
+    """
+    J, R = Y.shape
+    counts = Y.sum(axis=1)
+    if counts.sum() == 0:
+        return Y
+    n_var = J * R
+    iY = lambda j, r: j * R + r
+
+    rows, cols, vals, lo, hi = [], [], [], [], []
+    row = 0
+    for r in range(R):
+        for j in range(J):
+            rows.append(row)
+            cols.append(iY(j, r))
+            vals.append(float(problem.nworkers[j]))
+        lo.append(-np.inf)
+        hi.append(float(problem.num_gpus))
+        row += 1
+    for j in range(J):
+        for r in range(R):
+            rows.append(row)
+            cols.append(iY(j, r))
+            vals.append(1.0)
+        lo.append(float(counts[j]))
+        hi.append(float(counts[j]))
+        row += 1
+    A = sparse.csr_matrix((vals, (rows, cols)), shape=(row, n_var))
+
+    c = np.zeros(n_var)
+    for j in range(J):
+        if counts[j] > 0:
+            for r in range(R):
+                c[iY(j, r)] = problem.priorities[j] * r / counts[j]
+
+    options = {"mip_rel_gap": rel_gap}
+    if time_limit is not None:
+        options["time_limit"] = float(time_limit)
+    res = milp(
+        c,
+        constraints=LinearConstraint(A, np.array(lo), np.array(hi)),
+        integrality=np.ones(n_var),
+        bounds=Bounds(np.zeros(n_var), np.ones(n_var)),
+        options=options,
+    )
+    if res.x is None:
+        # Infeasible/timeout: keep the original schedule
+        # (reference: shockwave.py:325-328).
+        return Y
+    return np.round(res.x).reshape(J, R).astype(np.int64)

@@ -1,0 +1,131 @@
+"""Boolean schedule recovery from the relaxed solve (host side).
+
+Two steps, mirroring the two MILPs of the reference backend:
+  1. ``round_counts``: fractional per-job round counts s -> integers n,
+     respecting the aggregate budget sum_j g_j n_j <= R * G.
+  2. ``order_schedule``: place each job's n_j rounds into the planning
+     window under per-round capacity, earliest-first by unfairness
+     priority — a greedy solution of the reordering program the reference
+     solves as a second MILP (reference: shockwave.py:281-328): minimize
+     sum_j priority_j * mean-round-index_j.
+
+These run once per plan recompute over a few thousand elements; a C++
+implementation of the same loops is available for large windows (see
+shockwave_tpu/native).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def round_counts(
+    s: np.ndarray, nworkers: np.ndarray, num_gpus: int, future_rounds: int
+) -> np.ndarray:
+    """Fractional round counts -> integers under the round-seconds budget.
+
+    Floors are always budget-feasible (the relaxed s was); the leftover
+    budget is granted as round-ups in order of largest fractional part,
+    breaking ties toward higher-priority-independent larger remainders.
+    """
+    s = np.clip(np.asarray(s, dtype=np.float64), 0.0, future_rounds)
+    g = np.asarray(nworkers, dtype=np.float64)
+    budget = float(num_gpus) * future_rounds
+    n = np.floor(s + 1e-9)
+    used = float(np.sum(g * n))
+    # Defensive: a caller may hand in an over-budget s (ours never is);
+    # shed load from the widest gangs first.
+    while used > budget + 1e-9:
+        candidates = np.where(n > 0)[0]
+        if len(candidates) == 0:
+            break
+        j = candidates[np.argmax(g[candidates])]
+        n[j] -= 1
+        used -= g[j]
+    frac = s - n
+    for j in np.argsort(-frac):
+        if frac[j] <= 1e-9 or n[j] >= future_rounds:
+            continue
+        if used + g[j] <= budget + 1e-9:
+            n[j] += 1
+            used += g[j]
+    return n.astype(np.int64)
+
+
+def order_schedule(
+    counts: np.ndarray,
+    priorities: np.ndarray,
+    nworkers: np.ndarray,
+    num_gpus: int,
+    future_rounds: int,
+) -> np.ndarray:
+    """Assign each job its ``counts[j]`` rounds under per-round capacity.
+    Returns Y (J x R) in {0, 1}.
+
+    Best effort: aggregate-budget-feasible counts are not always per-round
+    packable with gang constraints (e.g. g=[2,2], G=3, R=2, counts=[2,1]),
+    so row sums of the result may fall short of ``counts``. The production
+    planner path avoids this entirely by tracking per-round capacity
+    inside the greedy solve (solve_eg_greedy); this placement is only used
+    to recover schedules from the relaxed solver.
+    """
+    counts = np.asarray(counts, dtype=np.int64)
+    J = len(counts)
+    R = int(future_rounds)
+    Y = np.zeros((J, R), dtype=np.int64)
+    need = counts.copy()
+    # Placement completeness trumps ordering: counts drive utility and
+    # makespan, round indices only the (secondary) unfairness objective.
+    # Job-major, widest gangs first (narrow jobs backfill around them —
+    # narrow-first fragments capacity and silently drops wide jobs'
+    # grants), priority-desc within a width, each job earliest-first.
+    order = sorted(
+        range(J), key=lambda j: (-nworkers[j], -priorities[j], j)
+    )
+    free = np.full(R, float(num_gpus))
+    for j in order:
+        if need[j] <= 0:
+            continue
+        # A job occupies each round at most once, so its rounds must be
+        # DISTINCT: taking the most-free rounds (ties -> earliest) is the
+        # exchange-argument-safe choice; earliest-first clustering can
+        # strand later jobs with capacity spread one-per-round.
+        rounds = sorted(range(R), key=lambda r: (-free[r], r))
+        for r in rounds:
+            if need[j] <= 0:
+                break
+            if nworkers[j] <= free[r]:
+                Y[j, r] = 1
+                need[j] -= 1
+                free[r] -= nworkers[j]
+    return Y
+
+
+def reorder_columns(Y: np.ndarray, priorities: np.ndarray) -> np.ndarray:
+    """Permute the window's rounds so unfair jobs run earliest.
+
+    The counterpart of the reference's second MILP (reference:
+    shockwave.py:281-328): minimize sum_j priority_j * mean-round-index_j.
+    Restricted to column permutations — which preserve per-round
+    feasibility and per-job counts by construction — the optimum is exact
+    by the rearrangement inequality: sort columns by their total priority
+    weight, heaviest first.
+    """
+    Y = np.asarray(Y)
+    counts = Y.sum(axis=1)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        weight = np.where(counts > 0, priorities / np.maximum(counts, 1), 0.0)
+    column_weight = weight @ Y
+    perm = np.argsort(-column_weight, kind="stable")
+    return Y[:, perm]
+
+
+def schedule_from_relaxed(
+    s: np.ndarray,
+    priorities: np.ndarray,
+    nworkers: np.ndarray,
+    num_gpus: int,
+    future_rounds: int,
+) -> np.ndarray:
+    counts = round_counts(s, nworkers, num_gpus, future_rounds)
+    return order_schedule(counts, priorities, nworkers, num_gpus, future_rounds)

@@ -1,0 +1,135 @@
+"""Predictor tests: golden values hand-derived from the reference math
+(reference: scheduler/job_metadata.py:94-202)."""
+
+import numpy as np
+import pytest
+
+from shockwave_tpu.predictor import JobMetadata
+
+
+def make_profile(bs_every_epoch, duration_every_epoch, nsamples=1000):
+    n = len(bs_every_epoch)
+    return {
+        "num_epochs": n,
+        "num_samples_per_epoch": nsamples,
+        "scale_factor": 1,
+        "duration": float(sum(duration_every_epoch)),
+        "bs_every_epoch": list(bs_every_epoch),
+        "mem_every_epoch": [0.0] * n,
+        "util_every_epoch": [0.0] * n,
+        "duration_every_epoch": list(duration_every_epoch),
+    }
+
+
+class TestDurations:
+    def test_durations_clamped_to_integral_seconds(self):
+        md = JobMetadata(make_profile([32, 32], [0.3, 10.6]), round_duration=60)
+        assert md.epoch_durations.tolist() == [1.0, 11.0]
+
+    def test_no_measurements_is_noop(self):
+        md = JobMetadata(make_profile([32, 32], [100, 100]), round_duration=60)
+        md.recompute_epoch_durations()
+        assert md.epoch_durations.tolist() == [100.0, 100.0]
+
+    def test_rescale_exact_match_is_identity(self):
+        # One epoch = 100s, 1000 samples => true rate 10 samples/s.
+        # Measure a round schedule consistent with exactly that rate:
+        # round_duration=50, after round 2 (i.e. 100s) with bs=10,
+        # throughput=1 step/s -> measured = 10*1*50*2 = 1000 samples;
+        # estimated over 100s = 1 whole epoch = 1000 samples.
+        md = JobMetadata(
+            make_profile([10, 10], [100, 100], nsamples=1000), round_duration=50
+        )
+        md.record_round_throughput(2, throughput=1.0, bs=10)
+        md.recompute_epoch_durations()
+        np.testing.assert_allclose(md.epoch_durations, [100.0, 100.0])
+
+    def test_rescale_faster_than_profile_shrinks_durations(self):
+        # Measured twice the samples the profile predicts -> durations halve.
+        md = JobMetadata(
+            make_profile([10, 10], [100, 100], nsamples=1000), round_duration=50
+        )
+        md.record_round_throughput(2, throughput=2.0, bs=10)
+        md.recompute_epoch_durations()
+        np.testing.assert_allclose(md.epoch_durations, [50.0, 50.0])
+
+    def test_partial_epoch_counted_fractionally(self):
+        # measured_time_range = 150s covers 1 whole epoch (100s) + half of
+        # the next -> estimated = 1000 + 0.5*1000 = 1500 samples.
+        # measured = 10 * 1 * 50 * 3 = 1500 -> identity.
+        md = JobMetadata(
+            make_profile([10, 10], [100, 100], nsamples=1000), round_duration=50
+        )
+        md.record_round_throughput(3, throughput=1.0, bs=10)
+        md.recompute_epoch_durations()
+        np.testing.assert_allclose(md.epoch_durations, [100.0, 100.0])
+
+    def test_gap_between_measurements_extends_back(self):
+        # Measurements at rounds 1 and 3: second spans rounds 2-3.
+        md = JobMetadata(
+            make_profile([10, 10], [100, 100], nsamples=1000), round_duration=50
+        )
+        md.record_round_throughput(1, throughput=1.0, bs=10)
+        md.record_round_throughput(3, throughput=2.0, bs=10)
+        # measured = 10*1*50*1 + 10*2*50*2 = 500 + 2000 = 2500
+        # window = 150s -> estimated = 1500 -> scale 0.6
+        md.recompute_epoch_durations()
+        np.testing.assert_allclose(md.epoch_durations, [60.0, 60.0])
+
+
+class TestRemainingRuntime:
+    def test_done_job_returns_one(self):
+        md = JobMetadata(make_profile([32, 32], [100, 100]), round_duration=60)
+        md.complete()
+        assert md.remaining_runtime() == 1.0
+
+    def test_static_bs_posterior(self):
+        # 4 epochs, single regime bs=32, durations 100 each, 1 completed.
+        # prior = {32: 4}; observed = epochs[:2] -> +2 => posterior 6;
+        # rebase to total: 4; subtract observed 2 -> 2 remaining epochs
+        # at 100s each.
+        md = JobMetadata(make_profile([32] * 4, [100] * 4), round_duration=60)
+        md.complete(1)
+        assert md.remaining_runtime() == pytest.approx(200.0)
+
+    def test_two_regime_posterior(self):
+        # 4 epochs: [32, 32, 64, 64], durations [100,100,50,50].
+        # prior = {32: 2, 64: 2}. completed_epochs=1 -> observed=[32,32]
+        # posterior = {32: 4, 64: 2}, sum=6; rebased = {32: 8/3, 64: 4/3};
+        # minus observed -> {32: 2/3, 64: 4/3}.
+        # durations per regime: 32->100, 64->50.
+        # remaining = 2/3*100 + 4/3*50 = 133.33
+        md = JobMetadata(
+            make_profile([32, 32, 64, 64], [100, 100, 50, 50]), round_duration=60
+        )
+        md.complete(1)
+        assert md.remaining_runtime() == pytest.approx(400.0 / 3.0)
+
+    def test_subtraction_floors_at_zero(self):
+        # Observed regime count can exceed its rebased mass; floor at 0.
+        md = JobMetadata(
+            make_profile([32, 32, 32, 64], [100, 100, 100, 50]), round_duration=60
+        )
+        md.complete(2)  # observed = [32, 32, 32]
+        # prior {32: 2, 64: 2}; posterior {32: 5, 64: 2}; sum 7
+        # rebased {32: 20/7 ~ 2.857, 64: 8/7 ~ 1.143}
+        # minus: 32 -> 0 (2.857-3 floored), 64 -> 8/7
+        expected = (8.0 / 7.0) * 50.0
+        assert md.remaining_runtime() == pytest.approx(expected)
+
+    def test_progress_beyond_total_rejected(self):
+        md = JobMetadata(make_profile([32, 32], [100, 100]), round_duration=60)
+        with pytest.raises(ValueError):
+            md.complete(3)
+
+
+class TestInterpolatedEpochDuration:
+    def test_mean_over_completed_plus_current(self):
+        md = JobMetadata(
+            make_profile([32] * 3, [100, 200, 600]), round_duration=60
+        )
+        assert md.mean_epoch_duration() == pytest.approx(100.0)
+        md.complete(1)
+        assert md.mean_epoch_duration() == pytest.approx(150.0)
+        md.complete(2)
+        assert md.mean_epoch_duration() == pytest.approx(300.0)

@@ -1,0 +1,164 @@
+"""Solver tests: the exact MILP against brute force on tiny instances, and
+the TPU (relaxed JAX + rounding) backend against the MILP — the
+solver-vs-solver agreement layer the reference lacks (SURVEY §4)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from shockwave_tpu.solver.eg_jax import solve_eg_greedy, solve_eg_jax
+from shockwave_tpu.solver.eg_milp import reorder_unfair_jobs_milp, solve_eg_milp
+from shockwave_tpu.solver.eg_problem import EGProblem
+from shockwave_tpu.solver.rounding import (
+    order_schedule,
+    reorder_columns,
+    round_counts,
+    schedule_from_relaxed,
+)
+
+LOG_BASES = np.array([0.0, 0.2, 0.4, 0.6, 0.8, 1.0])
+
+
+def make_problem(
+    priorities, completed, total, epoch_dur, remaining, nworkers,
+    num_gpus=2, round_duration=100.0, future_rounds=3, regularizer=0.001,
+):
+    return EGProblem(
+        priorities=np.asarray(priorities, dtype=np.float64),
+        completed_epochs=np.asarray(completed, dtype=np.float64),
+        total_epochs=np.asarray(total, dtype=np.float64),
+        epoch_duration=np.asarray(epoch_dur, dtype=np.float64),
+        remaining_runtime=np.asarray(remaining, dtype=np.float64),
+        nworkers=np.asarray(nworkers, dtype=np.float64),
+        num_gpus=num_gpus,
+        round_duration=round_duration,
+        future_rounds=future_rounds,
+        regularizer=regularizer,
+    log_bases=LOG_BASES,
+    )
+
+
+def brute_force_best(problem):
+    J, R = problem.num_jobs, problem.future_rounds
+    best, best_Y = -np.inf, None
+    for bits in itertools.product([0, 1], repeat=J * R):
+        Y = np.array(bits).reshape(J, R)
+        loads = problem.nworkers @ Y
+        if np.any(loads > problem.num_gpus):
+            continue
+        v = problem.objective_value(Y)
+        if v > best:
+            best, best_Y = v, Y
+    return best, best_Y
+
+
+def random_problem(rng, J=4, R=3, num_gpus=3):
+    total = rng.integers(2, 10, J).astype(float)
+    completed = np.floor(total * rng.uniform(0, 0.9, J))
+    epoch_dur = rng.uniform(30, 300, J)
+    remaining = (total - completed) * epoch_dur * rng.uniform(0.8, 1.2, J)
+    return make_problem(
+        priorities=rng.uniform(0.5, 4.0, J),
+        completed=completed,
+        total=total,
+        epoch_dur=epoch_dur,
+        remaining=remaining,
+        nworkers=rng.integers(1, 3, J).astype(float),
+        num_gpus=num_gpus,
+        round_duration=100.0,
+        future_rounds=R,
+        regularizer=1e-4,
+    )
+
+
+class TestMilpBackend:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_milp_matches_brute_force(self, seed):
+        rng = np.random.default_rng(seed)
+        problem = random_problem(rng)
+        best, _ = brute_force_best(problem)
+        Y = solve_eg_milp(problem, rel_gap=1e-9, time_limit=30)
+        loads = problem.nworkers @ Y
+        assert np.all(loads <= problem.num_gpus + 1e-9)
+        assert problem.objective_value(Y) == pytest.approx(best, abs=1e-6)
+
+    def test_reorder_preserves_counts_and_capacity(self):
+        rng = np.random.default_rng(7)
+        problem = random_problem(rng, J=5, R=4)
+        Y = solve_eg_milp(problem)
+        Y2 = reorder_unfair_jobs_milp(Y, problem)
+        np.testing.assert_array_equal(Y.sum(axis=1), Y2.sum(axis=1))
+        assert np.all(problem.nworkers @ Y2 <= problem.num_gpus + 1e-9)
+        # The reorder can only improve its own objective.
+        assert problem.reorder_objective(Y2) <= problem.reorder_objective(Y) + 1e-9
+
+
+class TestRounding:
+    def test_round_counts_respects_budget(self):
+        s = np.array([2.7, 1.6, 0.4, 3.0])
+        g = np.array([1.0, 2.0, 1.0, 1.0])
+        n = round_counts(s, g, num_gpus=2, future_rounds=3)
+        assert np.sum(g * n) <= 2 * 3
+        assert np.all(n <= 3)
+
+    def test_order_schedule_capacity_and_counts(self):
+        counts = np.array([3, 2, 1])
+        p = np.array([5.0, 1.0, 3.0])
+        g = np.array([1.0, 1.0, 2.0])
+        Y = order_schedule(counts, p, g, num_gpus=3, future_rounds=3)
+        np.testing.assert_array_equal(Y.sum(axis=1), counts)
+        assert np.all(g @ Y <= 3)
+
+    def test_high_priority_jobs_scheduled_earliest(self):
+        counts = np.array([1, 1])
+        p = np.array([1.0, 10.0])
+        g = np.array([1.0, 1.0])
+        Y = order_schedule(counts, p, g, num_gpus=1, future_rounds=2)
+        # Job 1 (priority 10) gets round 0; job 0 waits.
+        assert Y[1, 0] == 1 and Y[0, 1] == 1
+
+
+class TestTpuBackend:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_rounded_schedule_near_milp_quality(self, seed):
+        rng = np.random.default_rng(100 + seed)
+        problem = random_problem(rng, J=6, R=4, num_gpus=3)
+        Y_milp = solve_eg_milp(problem, rel_gap=1e-6, time_limit=30)
+        Y_tpu = reorder_columns(solve_eg_greedy(problem), problem.priorities)
+        assert np.all(problem.nworkers @ Y_tpu <= problem.num_gpus + 1e-9)
+        obj_milp = problem.objective_value(Y_milp)
+        obj_tpu = problem.objective_value(Y_tpu)
+        # Accepted approximation band for the greedy vs the exact boolean
+        # optimum (measured: mean gap ~0.01, max ~0.07 over 40 seeds).
+        scale = max(1.0, abs(obj_milp))
+        assert obj_tpu >= obj_milp - 0.08 * scale
+
+    def test_relaxed_solution_feasible(self):
+        rng = np.random.default_rng(3)
+        problem = random_problem(rng, J=8, R=5, num_gpus=4)
+        s = solve_eg_jax(problem)
+        assert np.all(s >= -1e-5)
+        assert np.all(s <= problem.future_rounds + 1e-5)
+        budget = problem.num_gpus * problem.future_rounds
+        assert float(problem.nworkers @ s) <= budget * (1 + 1e-4)
+
+    def test_saturated_jobs_get_no_extra_rounds(self):
+        # A job that can finish in one round's worth of seconds should not
+        # hoard the window when others are starved.
+        problem = make_problem(
+            priorities=[1.0, 1.0],
+            completed=[9.0, 0.0],
+            total=[10.0, 10.0],
+            epoch_dur=[50.0, 100.0],
+            remaining=[50.0, 1000.0],
+            nworkers=[1.0, 1.0],
+            num_gpus=1,
+            round_duration=100.0,
+            future_rounds=4,
+            regularizer=1e-4,
+        )
+        s = solve_eg_jax(problem)
+        # Job 0 needs 0.5 rounds; job 1 needs 10.
+        assert s[0] < 1.5
+        assert s[1] > 2.0
