@@ -6,15 +6,22 @@ reference builds with CVXPY+GUROBI (reference: scheduler/shockwave.py:
 (a) as the drop-in "shockwave" policy backend, and (b) as the ground truth
 the TPU solver is benchmarked and tested against.
 
-Formulation notes (equivalent to, but smaller than, the reference's):
-  * The piecewise-log utility uses the lambda (convex-combination-of-
-    breakpoints) encoding WITHOUT per-segment booleans: because log is
-    concave and each utility enters the maximized objective with a positive
-    weight, the LP optimum automatically uses adjacent breakpoints, so the
-    SOS2 booleans of the reference encoding (shockwave.py:161-182) are
-    redundant. Only the Y[j, r] schedule variables are integer.
-  * max(0, remaining - planned) per job and the max over jobs collapse into
-    one epigraph variable M with M >= remaining_j - D_j * pe_j, M >= 0.
+Two formulations share one constraint builder:
+  * ``solve_eg_milp`` — tightened: the piecewise-log utility uses the
+    lambda (convex-combination-of-breakpoints) encoding WITHOUT per-segment
+    booleans. Because log is concave and each utility enters the maximized
+    objective with a positive weight, the LP optimum automatically uses
+    adjacent breakpoints, so the SOS2 booleans of the reference encoding
+    (shockwave.py:161-182) are redundant; only Y[j, r] is integer.
+  * ``solve_eg_milp_reference_formulation`` — the reference's own
+    "Approach 2" encoding (boolean boundary + adjacency variables), kept
+    for honest baseline timing in bench.py: same optimum, many more
+    integer variables and a weaker LP relaxation, i.e. the workload the
+    reference actually hands GUROBI.
+
+In both, max(0, remaining - planned) per job and the max over jobs
+collapse into one epigraph variable M with M >= remaining_j - D_j * pe_j,
+M >= 0.
 """
 
 from __future__ import annotations
@@ -28,13 +35,17 @@ from scipy.optimize import Bounds, LinearConstraint, milp
 from shockwave_tpu.solver.eg_problem import EGProblem
 
 
-def solve_eg_milp(
+def _solve_eg(
     problem: EGProblem,
-    rel_gap: float = 1e-3,
-    time_limit: Optional[float] = 15.0,
+    sos2_booleans: bool,
+    rel_gap: float,
+    time_limit: Optional[float],
 ) -> np.ndarray:
-    """Solve the EG program; returns Y as a (num_jobs, future_rounds) 0/1
-    array. Variables: [Y (J*R, binary) | pe (J) | w (J*B) | M (1)].
+    """Build and solve the EG program; returns Y (J x R) in {0, 1}.
+
+    Variables: [Y (J*R, bin) | pe (J) | w (J*B)
+                | bnd (J*B, bin) + adj (J*(B-1), bin) if sos2_booleans
+                | M (1)].
     """
     J, R = problem.num_jobs, problem.future_rounds
     B = len(problem.log_bases)
@@ -45,10 +56,14 @@ def solve_eg_milp(
     log_vals = problem.log_base_values()
 
     n_y, n_pe, n_w = J * R, J, J * B
-    n_var = n_y + n_pe + n_w + 1
+    n_b = J * B if sos2_booleans else 0
+    n_a = J * (B - 1) if sos2_booleans else 0
+    n_var = n_y + n_pe + n_w + n_b + n_a + 1
     iY = lambda j, r: j * R + r
     iPE = lambda j: n_y + j
     iW = lambda j, b: n_y + n_pe + j * B + b
+    iB = lambda j, b: n_y + n_pe + n_w + j * B + b
+    iA = lambda j, b: n_y + n_pe + n_w + n_b + j * (B - 1) + b
     iM = n_var - 1
 
     rows, cols, vals, lo, hi = [], [], [], [], []
@@ -79,6 +94,23 @@ def solve_eg_milp(
             -np.inf,
             0.0,
         )
+        if sos2_booleans:
+            # Exactly two active boundaries, one adjacent pair
+            # (reference: shockwave.py:163-172).
+            add([(iB(j, b), 1.0) for b in range(B)], 2.0, 2.0)
+            for b in range(B - 1):
+                add(
+                    [(iA(j, b), 1.0), (iB(j, b), -1.0), (iB(j, b + 1), -1.0)],
+                    -1.0,
+                    np.inf,
+                )
+                add([(iA(j, b), 1.0), (iB(j, b), -1.0)], -np.inf, 0.0)
+                add([(iA(j, b), 1.0), (iB(j, b + 1), -1.0)], -np.inf, 0.0)
+            add([(iA(j, b), 1.0) for b in range(B - 1)], 1.0, 1.0)
+            # Weights supported only on active boundaries
+            # (reference: shockwave.py:173-179).
+            for b in range(B):
+                add([(iW(j, b), 1.0), (iB(j, b), -1.0)], -np.inf, 0.0)
         # w_j on the simplex.
         add([(iW(j, b), 1.0) for b in range(B)], 1.0, 1.0)
         # sum_b w[j,b] * base_b == (completed_j + pe_j) / total_j.
@@ -106,9 +138,11 @@ def solve_eg_milp(
 
     integrality = np.zeros(n_var)
     integrality[:n_y] = 1
+    integrality[n_y + n_pe + n_w : n_y + n_pe + n_w + n_b + n_a] = 1
     lb = np.zeros(n_var)
     ub = np.full(n_var, np.inf)
     ub[:n_y] = 1.0
+    ub[n_y + n_pe + n_w : n_y + n_pe + n_w + n_b + n_a] = 1.0
 
     options = {"mip_rel_gap": rel_gap}
     if time_limit is not None:
@@ -122,8 +156,25 @@ def solve_eg_milp(
     )
     if res.x is None:
         raise RuntimeError(f"EG MILP failed: {res.message}")
-    Y = np.round(res.x[:n_y]).reshape(J, R).astype(np.int64)
-    return Y
+    return np.round(res.x[:n_y]).reshape(J, R).astype(np.int64)
+
+
+def solve_eg_milp(
+    problem: EGProblem,
+    rel_gap: float = 1e-3,
+    time_limit: Optional[float] = 15.0,
+) -> np.ndarray:
+    """Tightened formulation (only Y integer); the production exact backend."""
+    return _solve_eg(problem, False, rel_gap, time_limit)
+
+
+def solve_eg_milp_reference_formulation(
+    problem: EGProblem,
+    rel_gap: float = 1e-3,
+    time_limit: Optional[float] = 15.0,
+) -> np.ndarray:
+    """The reference's boolean-boundary encoding, for baseline timing."""
+    return _solve_eg(problem, True, rel_gap, time_limit)
 
 
 def reorder_unfair_jobs_milp(

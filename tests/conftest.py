@@ -6,7 +6,9 @@ exercised without TPU hardware. Must be set before JAX is imported.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-set: the login profile exports JAX_PLATFORMS=axon (the TPU tunnel),
+# which would silently pin tests to the single real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
