@@ -145,7 +145,7 @@ if __name__ == "__main__":
     parser.add_argument("--num_gpus_per_server", type=str, default="1:1:1")
     parser.add_argument("--seed", type=int, default=None)
     parser.add_argument(
-        "--solver", type=str, choices=["scipy", "jax"], default="scipy"
+        "--solver", type=str, choices=["scipy"], default="scipy"
     )
     parser.add_argument("--time_per_iteration", type=int, default=360)
     parser.add_argument("-s", "--window-start", type=int, default=None)

@@ -106,7 +106,11 @@ class Scheduler:
         self._num_jobs_in_trace = 0
         self._in_progress_updates: Dict[JobId, list] = {}
         self._job_timelines: Dict[JobId, list] = {}
-        self._slos: Optional[Dict[JobId, float]] = None
+        # Absolute per-job deadlines, tracked only for SLO-aware policies
+        # (reference: scheduler.py:583-587).
+        self._slos: Optional[Dict[JobId, float]] = (
+            {} if "SLO" in policy.name else None
+        )
 
         # Worker state.
         self._worker_id_counter = 0
@@ -204,6 +208,11 @@ class Scheduler:
         self._job_type_to_job_ids.setdefault(job_type_key, set()).add(job_id)
         self._num_failures_per_job[job_id] = 0
         self._total_steps_run[job_id] = 0
+        if self._slos is not None and job.SLO is not None and job.duration:
+            # Deadline = SLO factor x isolated duration, from submission.
+            self._slos[job_id] = (
+                job.SLO * job.duration + self.get_current_timestamp()
+            )
         for worker_type in self._worker_types:
             self._steps_run_so_far[job_id][worker_type] = 0
             self._set_initial_throughput(job_id, worker_type)
@@ -253,6 +262,8 @@ class Scheduler:
         del self._job_id_to_job_type[job_id]
         del self._num_failures_per_job[job_id]
         self._in_progress_updates.pop(job_id, None)
+        if self._slos is not None:
+            self._slos.pop(job_id, None)
         if self._job_packing:
             stale_pairs = [
                 other
@@ -451,6 +462,22 @@ class Scheduler:
         elif name.startswith("MinTotalDuration"):
             allocation = self._policy.get_allocation(
                 throughputs, scale_factors, state["num_steps_remaining"], cluster_spec
+            )
+        elif "SLO" in name:
+            # Policies consume time-remaining-to-deadline
+            # (reference: scheduler.py:2373-2377).
+            now = self.get_current_timestamp()
+            slos_remaining = {
+                job_id: max(deadline - now, 1e-3)
+                for job_id, deadline in (self._slos or {}).items()
+                if job_id in self._jobs
+            }
+            allocation = self._policy.get_allocation(
+                throughputs,
+                scale_factors,
+                cluster_spec,
+                SLOs=slos_remaining,
+                num_steps_remaining=state["num_steps_remaining"],
             )
         else:
             allocation = self._policy.get_allocation(

@@ -1,0 +1,115 @@
+"""AlloX: jobs-to-(worker, position) assignment minimizing total completion
+time via the Hungarian method. Each worker processes its queue in position
+order; assigning a job to position p on a worker contributes p * processing
+time to the sum of completion times. Only scale factor 1 supported.
+Reference: scheduler/policies/allox.py:1-141.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+from scipy.optimize import linear_sum_assignment
+
+from shockwave_tpu.policies.base import Policy
+
+
+class AlloXPolicy(Policy):
+    name = "AlloX_Perf"
+
+    def __init__(self, alpha=1.0):
+        super().__init__()
+        self._alpha = alpha
+        self._prev_allocation = {}
+
+    def get_allocation(
+        self,
+        throughputs,
+        scale_factors,
+        times_since_start,
+        num_steps_remaining,
+        cluster_spec,
+    ):
+        matrix, index = self.flatten(throughputs, cluster_spec)
+        if matrix is None:
+            return None
+        job_ids, worker_types = index
+        for job_id in scale_factors:
+            if scale_factors[job_id] != 1:
+                raise ValueError("AlloX supports only scale factor 1")
+
+        # Workers already held by fully-allocated jobs are not reassigned
+        # (reference: allox.py:40-63).
+        unallocated, already_allocated = [], []
+        for job_id in throughputs:
+            prev = self._prev_allocation.get(job_id)
+            if prev is not None and sum(prev.values()) == 1.0:
+                already_allocated.append(job_id)
+            else:
+                unallocated.append(job_id)
+
+        worker_id_to_type = {}
+        n = 0
+        for wt in worker_types:
+            num = cluster_spec[wt]
+            for job_id in already_allocated:
+                if self._prev_allocation[job_id][wt] == 1.0:
+                    num -= 1
+            for _ in range(num):
+                worker_id_to_type[n] = wt
+                n += 1
+
+        # Oldest jobs first; optionally truncate to alpha * m
+        # (reference: allox.py:65-68).
+        unallocated.sort(key=lambda j: -times_since_start[j])
+        m = len(unallocated)
+        unallocated = unallocated[: max(int(self._alpha * m), n)]
+        m = len(unallocated)
+        if m == 0 or n == 0:
+            allocation = {
+                job_id: {wt: 0.0 for wt in cluster_spec} for job_id in job_ids
+            }
+            for job_id in already_allocated:
+                allocation[job_id] = copy.copy(self._prev_allocation[job_id])
+            self._prev_allocation = copy.copy(allocation)
+            return allocation
+
+        # Cost of (job i, worker j, position p): queueing delay so far plus
+        # p * processing time; flattened as [q 2q 3q ...] per the classic
+        # sum-of-completion-times reduction (reference: allox.py:70-95).
+        q_base = np.zeros((m, n))
+        for i, job_id in enumerate(unallocated):
+            for j in range(n):
+                tput = throughputs[job_id][worker_id_to_type[j]]
+                q_base[i, j] = num_steps_remaining[job_id] / max(tput, 1e-10)
+        delays = np.array([times_since_start[j] for j in unallocated])
+        q = np.concatenate(
+            [k * q_base + delays[:, None] for k in range(1, m + 1)], axis=1
+        )
+
+        row_idx, col_idx = linear_sum_assignment(q)
+
+        per_worker_assignment = {j: [] for j in range(n)}
+        for r, c in zip(row_idx, col_idx):
+            per_worker_assignment[c % n].append((unallocated[r], c // n))
+        for j in range(n):
+            entries = per_worker_assignment[j]
+            # Position k in the cost reduction means k-th FROM THE END of
+            # the worker's queue (reference: allox.py:101-107).
+            per_worker_assignment[j] = sorted(
+                [(job_id, len(entries) - 1 - pos) for job_id, pos in entries],
+                key=lambda e: e[1],
+            )
+
+        allocation = {
+            job_id: {wt: 0.0 for wt in cluster_spec} for job_id in job_ids
+        }
+        for job_id in already_allocated:
+            allocation[job_id] = copy.copy(self._prev_allocation[job_id])
+        for j in range(n):
+            if per_worker_assignment[j]:
+                head_job = per_worker_assignment[j][0][0]
+                allocation[head_job][worker_id_to_type[j]] = 1.0
+        self._prev_allocation = copy.copy(allocation)
+        return allocation

@@ -1,10 +1,19 @@
 """LP backends for the LP-shaped Gavel policies.
 
-``scipy`` (HiGHS) is the exact CPU backend — the stand-in for the
-reference's ECOS/GUROBI cvxpy solves. A JAX backend (shared with the
-Shockwave EG solver in :mod:`shockwave_tpu.solver`) can be selected with
-``solver="jax"`` for on-device solves; it returns an eps-feasible point of
-the same program.
+scipy's HiGHS is the solver — the stand-in for the reference's
+ECOS/GUROBI cvxpy solves. These programs are small (jobs x worker types)
+and run once per allocation update on the host; the on-device JAX path is
+reserved for the Shockwave planning solver, where the scale lives
+(:mod:`shockwave_tpu.solver.eg_jax`).
+
+The ``*_general`` forms take arbitrary objective rows over vec(x) plus a
+prebuilt (A_base, b_base) polytope, which is what the packed policies need
+(an objective row spans every (combination, worker) cell a job appears
+in); the simpler wrappers below build the standard per-job rows over the
+base polytope and delegate.
+
+Failure contract: all solvers return None when the program is infeasible
+or the solver fails; callers decide between fallback and raise.
 """
 
 from __future__ import annotations
@@ -17,6 +26,92 @@ from scipy.optimize import linprog
 from shockwave_tpu.policies.base import constraint_matrices
 
 
+def _bounds(n_var: int, zero_mask: np.ndarray | None):
+    if zero_mask is None:
+        return [(0, None)] * n_var
+    return [(0, 0) if zero_mask[i] else (0, None) for i in range(n_var)]
+
+
+def max_min_lp_general(
+    coeff_rows: np.ndarray,
+    A_base: np.ndarray,
+    b_base: np.ndarray,
+    zero_mask: np.ndarray | None = None,
+) -> np.ndarray | None:
+    """maximize min_s coeff_rows[s] . x over {A_base x <= b_base, x >= 0}.
+
+    ``zero_mask`` flags variables pinned to zero (e.g. mixed-scale pairs).
+    """
+    S, n_var = coeff_rows.shape
+    A_ub = np.zeros((A_base.shape[0] + S, n_var + 1))
+    A_ub[: A_base.shape[0], :n_var] = A_base
+    b_ub = np.concatenate([b_base, np.zeros(S)])
+    for s in range(S):
+        A_ub[A_base.shape[0] + s, :n_var] = -coeff_rows[s]
+        A_ub[A_base.shape[0] + s, -1] = 1.0
+    c = np.zeros(n_var + 1)
+    c[-1] = -1.0
+    bounds = _bounds(n_var, zero_mask) + [(None, None)]
+    res = linprog(c, A_ub=A_ub, b_ub=b_ub, bounds=bounds, method="highs")
+    if not res.success:
+        return None
+    return res.x[:n_var]
+
+
+def feasibility_lp_general(
+    coeff_rows: np.ndarray,
+    rates: np.ndarray,
+    A_base: np.ndarray,
+    b_base: np.ndarray,
+    zero_mask: np.ndarray | None = None,
+) -> np.ndarray | None:
+    """Find x >= 0 with A_base x <= b_base and coeff_rows[s] . x >= rates[s]
+    for every s, or None."""
+    S, n_var = coeff_rows.shape
+    A_ub = np.vstack([A_base, -coeff_rows])
+    b_ub = np.concatenate([b_base, -np.asarray(rates, dtype=np.float64)])
+    res = linprog(
+        np.zeros(n_var),
+        A_ub=A_ub,
+        b_ub=b_ub,
+        bounds=_bounds(n_var, zero_mask),
+        method="highs",
+    )
+    if not res.success:
+        return None
+    return res.x
+
+
+def max_sum_lp_general(
+    objective: np.ndarray,
+    A_base: np.ndarray,
+    b_base: np.ndarray,
+    zero_mask: np.ndarray | None = None,
+) -> np.ndarray | None:
+    """maximize objective . x over {A_base x <= b_base, x >= 0}; None if
+    infeasible."""
+    n_var = len(objective)
+    res = linprog(
+        -np.asarray(objective, dtype=np.float64),
+        A_ub=A_base,
+        b_ub=b_base,
+        bounds=_bounds(n_var, zero_mask),
+        method="highs",
+    )
+    if not res.success:
+        return None
+    return res.x
+
+
+def _per_job_rows(coeffs: np.ndarray) -> np.ndarray:
+    """Block-diagonal objective rows: row j covers x[j, :] only."""
+    m, n = coeffs.shape
+    rows = np.zeros((m, m * n))
+    for j in range(m):
+        rows[j, j * n : (j + 1) * n] = coeffs[j]
+    return rows
+
+
 def max_min_lp(
     coeffs: np.ndarray,
     scale_factors_array: np.ndarray,
@@ -27,29 +122,15 @@ def max_min_lp(
 
     This is the core of max-min fairness (reference:
     scheduler/policies/max_min_fairness.py:44-100, where coeffs =
-    throughput * priority * scale_factor).
+    throughput * priority * scale_factor). Raises on solver failure (the
+    base polytope is never empty, so failure is exceptional).
     """
-    if backend == "jax":
-        from shockwave_tpu.solver.lp_jax import max_min_lp_jax
-
-        return max_min_lp_jax(coeffs, scale_factors_array, np.asarray(num_workers))
     m, n = coeffs.shape
-    # Variables: vec(x) followed by t; maximize t.
     A_base, b_base = constraint_matrices(scale_factors_array, num_workers)
-    A_ub = np.zeros((A_base.shape[0] + m, m * n + 1))
-    A_ub[: A_base.shape[0], : m * n] = A_base
-    b_ub = np.concatenate([b_base, np.zeros(m)])
-    # t - coeffs[j] . x[j] <= 0
-    for j in range(m):
-        A_ub[A_base.shape[0] + j, j * n : (j + 1) * n] = -coeffs[j]
-        A_ub[A_base.shape[0] + j, -1] = 1.0
-    c = np.zeros(m * n + 1)
-    c[-1] = -1.0
-    bounds = [(0, None)] * (m * n) + [(None, None)]
-    res = linprog(c, A_ub=A_ub, b_ub=b_ub, bounds=bounds, method="highs")
-    if not res.success:
-        raise RuntimeError(f"max_min LP failed: {res.message}")
-    return res.x[: m * n].reshape(m, n)
+    x = max_min_lp_general(_per_job_rows(coeffs), A_base, b_base)
+    if x is None:
+        raise RuntimeError("max_min LP failed")
+    return x.reshape(m, n)
 
 
 def feasibility_lp(
@@ -64,18 +145,12 @@ def feasibility_lp(
     """
     m, n = coeffs.shape
     A_base, b_base = constraint_matrices(scale_factors_array, num_workers)
-    A_req = np.zeros((m, m * n))
-    for j in range(m):
-        A_req[j, j * n : (j + 1) * n] = -coeffs[j]
-    A_ub = np.vstack([A_base, A_req])
-    b_ub = np.concatenate([b_base, -rate_requirements])
-    res = linprog(
-        np.zeros(m * n), A_ub=A_ub, b_ub=b_ub, bounds=[(0, None)] * (m * n),
-        method="highs",
+    x = feasibility_lp_general(
+        _per_job_rows(coeffs), rate_requirements, A_base, b_base
     )
-    if not res.success:
+    if x is None:
         return None
-    return res.x.reshape(m, n)
+    return x.reshape(m, n)
 
 
 def max_sum_lp(
@@ -92,13 +167,7 @@ def max_sum_lp(
     if extra_A_ub is not None:
         A_ub = np.vstack([A_ub, extra_A_ub])
         b_ub = np.concatenate([b_ub, extra_b_ub])
-    res = linprog(
-        -objective_coeffs.reshape(-1),
-        A_ub=A_ub,
-        b_ub=b_ub,
-        bounds=[(0, None)] * (m * n),
-        method="highs",
-    )
-    if not res.success:
+    x = max_sum_lp_general(objective_coeffs.reshape(-1), A_ub, b_ub)
+    if x is None:
         return None
-    return res.x.reshape(m, n)
+    return x.reshape(m, n)

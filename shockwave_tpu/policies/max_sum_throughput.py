@@ -1,0 +1,144 @@
+"""Throughput-sum maximization, optionally cost-normalized and with SLO
+rate constraints. SLO-infeasible programs are re-solved without SLOs.
+Reference: scheduler/policies/max_sum_throughput.py:1-178.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from shockwave_tpu.policies.base import (
+    Policy,
+    PolicyWithPacking,
+    constraint_matrices,
+    packed_constraint_matrices,
+)
+from shockwave_tpu.policies.lp_backend import max_sum_lp_general
+
+
+class ThroughputNormalizedByCostSumWithPerfSLOs(Policy):
+    name = "ThroughputNormalizedByCostSum_PerfSLOs"
+
+    def get_allocation(
+        self,
+        throughputs,
+        scale_factors,
+        cluster_spec,
+        instance_costs=None,
+        SLOs=None,
+        num_steps_remaining=None,
+    ):
+        SLOs = SLOs or {}
+        num_steps_remaining = num_steps_remaining or {}
+        matrix, index = self.flatten(throughputs, cluster_spec)
+        if matrix is None:
+            return None
+        m, n = matrix.shape
+        job_ids, worker_types = index
+        sf = self.scale_factors_array(scale_factors, job_ids, m, n)
+
+        costs = np.ones(n)
+        if instance_costs is not None:
+            costs = np.array([instance_costs[wt] for wt in worker_types])
+        objective = (matrix / costs[None, :]).reshape(-1)
+
+        A_base, b_base = constraint_matrices(sf, self._num_workers)
+        rows, rhs = [], []
+        for job_id in SLOs:
+            i = job_ids.index(job_id)
+            row = np.zeros(m * n)
+            row[i * n : (i + 1) * n] = -matrix[i]
+            rows.append(row)
+            rhs.append(-num_steps_remaining[job_id] / SLOs[job_id])
+        if rows:
+            A = np.vstack([A_base, np.array(rows)])
+            b = np.concatenate([b_base, np.array(rhs)])
+            x = max_sum_lp_general(objective, A, b)
+            if x is None:
+                # SLOs unsatisfiable: drop them (reference: :91-96).
+                x = max_sum_lp_general(objective, A_base, b_base)
+        else:
+            x = max_sum_lp_general(objective, A_base, b_base)
+        if x is None:
+            return None
+        return self.unflatten(x.reshape(m, n).clip(0.0, 1.0), index)
+
+
+class ThroughputSumWithPerf(Policy):
+    name = "ThroughputSumWithPerf"
+
+    def __init__(self, solver=None):
+        super().__init__(solver)
+        self._policy = ThroughputNormalizedByCostSumWithPerfSLOs(solver)
+
+    def get_allocation(self, throughputs, scale_factors, cluster_spec):
+        return self._policy.get_allocation(throughputs, scale_factors, cluster_spec)
+
+
+class ThroughputNormalizedByCostSumWithPerf(Policy):
+    name = "ThroughputNormalizedByCostSum_Perf"
+
+    def __init__(self, solver=None):
+        super().__init__(solver)
+        self._policy = ThroughputNormalizedByCostSumWithPerfSLOs(solver)
+
+    def get_allocation(
+        self, throughputs, scale_factors, cluster_spec, instance_costs=None
+    ):
+        return self._policy.get_allocation(
+            throughputs, scale_factors, cluster_spec, instance_costs=instance_costs
+        )
+
+
+class ThroughputNormalizedByCostSumWithPackingSLOs(PolicyWithPacking):
+    name = "ThroughputNormalizedByCostSum_PackingSLOs"
+
+    def get_allocation(
+        self,
+        throughputs,
+        scale_factors,
+        cluster_spec,
+        instance_costs=None,
+        SLOs=None,
+        num_steps_remaining=None,
+    ):
+        SLOs = SLOs or {}
+        num_steps_remaining = num_steps_remaining or {}
+        all_m, index = self.flatten(throughputs, cluster_spec)
+        if all_m is None or len(all_m) == 0:
+            return None
+        job_ids, single_job_ids, worker_types, relevant = index
+        C, W = len(job_ids), len(worker_types)
+        S = len(single_job_ids)
+        sf = self.scale_factors_array(scale_factors, job_ids, C, W)
+
+        costs = np.ones(W)
+        if instance_costs is not None:
+            costs = np.array([instance_costs[wt] for wt in worker_types])
+        # Per-single effective throughput summed across the singles gives a
+        # per-cell objective (reference: :131-148).
+        objective = (all_m / costs[None, None, :]).sum(axis=0).reshape(-1)
+
+        A_base, b_base = packed_constraint_matrices(
+            sf, self._num_workers, single_job_ids, relevant
+        )
+        zero_mask = (sf.reshape(-1) == 0).astype(bool)
+        rows, rhs = [], []
+        coeff = all_m.reshape(S, C * W)
+        for job_id in SLOs:
+            i = single_job_ids.index(job_id)
+            rows.append(-coeff[i])
+            rhs.append(-num_steps_remaining[job_id] / SLOs[job_id])
+        if rows:
+            A = np.vstack([A_base, np.array(rows)])
+            b = np.concatenate([b_base, np.array(rhs)])
+            x = max_sum_lp_general(objective, A, b, zero_mask=zero_mask)
+            if x is None:
+                x = max_sum_lp_general(
+                    objective, A_base, b_base, zero_mask=zero_mask
+                )
+        else:
+            x = max_sum_lp_general(objective, A_base, b_base, zero_mask=zero_mask)
+        if x is None:
+            return None
+        return self.unflatten(x.reshape(C, W).clip(0.0, 1.0), index)

@@ -1,0 +1,327 @@
+"""Policy-family unit tests: tiny hand-written throughput dicts, golden
+allocations, and cross-formulation validity checks (reference test style:
+scheduler/tests/policies_tests.py)."""
+
+import numpy as np
+import pytest
+
+from shockwave_tpu.core.ids import JobId
+from shockwave_tpu.policies import get_policy
+
+
+def validity(alloc, throughputs, scale_factors, cluster_spec):
+    """Base-polytope validity: capacity per type; per-single share <= 1."""
+    per_type = {wt: 0.0 for wt in cluster_spec}
+    per_single = {}
+    for job_id, shares in alloc.items():
+        sf = max(scale_factors[s] for s in job_id.singletons())
+        for wt, v in shares.items():
+            assert v >= -1e-6
+            per_type[wt] += v * sf
+        for s in job_id.singletons():
+            per_single[s] = per_single.get(s, 0.0) + sum(shares.values())
+    for wt in per_type:
+        assert per_type[wt] <= cluster_spec[wt] + 1e-4, (wt, per_type[wt])
+    for s, total in per_single.items():
+        assert total <= 1.0 + 1e-4, (s, total)
+
+
+def simple_throughputs(m=3, v=4.0, k=1.0):
+    return {JobId(i): {"v100": v, "k80": k} for i in range(m)}
+
+
+CLUSTER = {"v100": 2, "k80": 2}
+
+
+class TestFinishTimeFairness:
+    def args(self, m=2):
+        tputs = simple_throughputs(m)
+        sf = {JobId(i): 1 for i in range(m)}
+        pw = {JobId(i): 1.0 for i in range(m)}
+        tss = {JobId(i): 100.0 for i in range(m)}
+        steps = {JobId(i): 1000 for i in range(m)}
+        return tputs, sf, pw, tss, steps, CLUSTER
+
+    def test_identical_jobs_get_equal_allocations(self):
+        pol = get_policy("finish_time_fairness_perf")
+        tputs, sf, pw, tss, steps, cluster = self.args()
+        alloc = pol.get_allocation(tputs, sf, pw, tss, steps, cluster)
+        validity(alloc, tputs, sf, cluster)
+        a0 = sum(alloc[JobId(0)].values())
+        a1 = sum(alloc[JobId(1)].values())
+        assert a0 == pytest.approx(a1, abs=0.05)
+
+    def test_base_variant_uses_v100_throughputs(self):
+        pol = get_policy("finish_time_fairness")
+        tputs, sf, pw, tss, steps, cluster = self.args()
+        alloc = pol.get_allocation(tputs, sf, pw, tss, steps, cluster)
+        validity(alloc, tputs, sf, cluster)
+
+    def test_packed_variant(self):
+        pol = get_policy("finish_time_fairness_packed")
+        m = 2
+        tputs = simple_throughputs(m)
+        tputs[JobId(0, 1)] = {"v100": [2.5, 2.5], "k80": [0.6, 0.6]}
+        sf = {JobId(i): 1 for i in range(m)}
+        pw = {JobId(i): 1.0 for i in range(m)}
+        tss = {JobId(i): 100.0 for i in range(m)}
+        steps = {JobId(i): 1000 for i in range(m)}
+        alloc = pol.get_allocation(tputs, sf, pw, tss, steps, CLUSTER)
+        validity(alloc, tputs, sf, CLUSTER)
+
+    def test_state_accumulates_between_rounds(self):
+        pol = get_policy("finish_time_fairness_perf")
+        tputs, sf, pw, tss, steps, cluster = self.args()
+        pol.get_allocation(tputs, sf, pw, tss, steps, cluster)
+        steps2 = {j: s - 100 for j, s in steps.items()}
+        pol.get_allocation(tputs, sf, pw, tss, steps2, cluster)
+        assert all(v > 0 for v in pol._cumulative_isolated_time.values())
+
+
+class TestMinTotalDuration:
+    def test_fast_jobs_finish_within_bound(self):
+        pol = get_policy("min_total_duration_perf")
+        tputs = simple_throughputs(2)
+        sf = {JobId(i): 1 for i in range(2)}
+        steps = {JobId(i): 1000 for i in range(2)}
+        alloc = pol.get_allocation(tputs, sf, steps, CLUSTER)
+        validity(alloc, tputs, sf, CLUSTER)
+        # 2 jobs, 2 v100s at 4 steps/s: both can run flat out on v100;
+        # each job's effective rate should be ~4 steps/s.
+        for i in range(2):
+            rate = sum(
+                tputs[JobId(i)][wt] * alloc[JobId(i)][wt] for wt in CLUSTER
+            )
+            assert rate >= 3.0
+
+    def test_packed_variant_valid(self):
+        pol = get_policy("min_total_duration_packed")
+        tputs = simple_throughputs(2)
+        tputs[JobId(0, 1)] = {"v100": [2.5, 2.5], "k80": [0.6, 0.6]}
+        sf = {JobId(i): 1 for i in range(2)}
+        steps = {JobId(i): 1000 for i in range(2)}
+        alloc = pol.get_allocation(tputs, sf, steps, CLUSTER)
+        validity(alloc, tputs, sf, CLUSTER)
+
+
+class TestMaxSumThroughput:
+    def test_capacity_flows_to_fastest_jobs(self):
+        pol = get_policy("max_sum_throughput_perf")
+        tputs = {
+            JobId(0): {"v100": 10.0, "k80": 1.0},
+            JobId(1): {"v100": 1.0, "k80": 0.5},
+            JobId(2): {"v100": 1.0, "k80": 0.5},
+        }
+        sf = {JobId(i): 1 for i in range(3)}
+        alloc = pol.get_allocation(tputs, sf, CLUSTER)
+        validity(alloc, tputs, sf, CLUSTER)
+        # The throughput-sum objective must saturate job 0 on a v100.
+        assert alloc[JobId(0)]["v100"] == pytest.approx(1.0, abs=1e-4)
+
+    def test_slo_constraint_reserves_rate(self):
+        pol = get_policy("max_sum_throughput_normalized_by_cost_perf_SLOs")
+        tputs = {
+            JobId(0): {"v100": 10.0, "k80": 1.0},
+            JobId(1): {"v100": 1.0, "k80": 0.5},
+        }
+        sf = {JobId(i): 1 for i in range(2)}
+        cluster = {"v100": 1, "k80": 0}
+        alloc = pol.get_allocation(
+            tputs,
+            sf,
+            cluster,
+            SLOs={JobId(1): 2000.0},
+            num_steps_remaining={JobId(0): 1000, JobId(1): 1000},
+        )
+        validity(alloc, tputs, sf, cluster)
+        # Job 1 needs 1000 steps in 2000s => rate 0.5 => half the v100.
+        assert alloc[JobId(1)]["v100"] >= 0.5 - 1e-4
+
+    def test_infeasible_slos_dropped(self):
+        pol = get_policy("max_sum_throughput_normalized_by_cost_perf_SLOs")
+        tputs = {JobId(0): {"v100": 1.0}}
+        sf = {JobId(0): 1}
+        alloc = pol.get_allocation(
+            tputs,
+            sf,
+            {"v100": 1},
+            SLOs={JobId(0): 1.0},  # 1e6 steps in 1s: impossible
+            num_steps_remaining={JobId(0): 10**6},
+        )
+        assert alloc is not None
+
+
+class TestAllox:
+    def test_jobs_assigned_to_best_workers(self):
+        pol = get_policy("allox")
+        tputs = {
+            JobId(0): {"v100": 10.0, "k80": 1.0},
+            JobId(1): {"v100": 10.0, "k80": 5.0},
+        }
+        sf = {JobId(i): 1 for i in range(2)}
+        tss = {JobId(0): 200.0, JobId(1): 100.0}
+        steps = {JobId(i): 1000 for i in range(2)}
+        alloc = pol.get_allocation(
+            tputs, sf, tss, steps, {"v100": 1, "k80": 1}
+        )
+        validity(alloc, tputs, sf, {"v100": 1, "k80": 1})
+        # Two workers, two jobs: both should be running somewhere.
+        placed = sum(1 for j in alloc if sum(alloc[j].values()) > 0.99)
+        assert placed == 2
+        # Job 1 gains 5x on k80 vs job 0's 1x, so job 0 takes the v100.
+        assert alloc[JobId(0)]["v100"] == 1.0
+        assert alloc[JobId(1)]["k80"] == 1.0
+
+    def test_rejects_multi_gpu_jobs(self):
+        pol = get_policy("allox")
+        with pytest.raises(ValueError):
+            pol.get_allocation(
+                {JobId(0): {"v100": 1.0}},
+                {JobId(0): 2},
+                {JobId(0): 0.0},
+                {JobId(0): 100},
+                {"v100": 2},
+            )
+
+
+class TestGandiva:
+    def test_undersubscribed_no_packing(self):
+        pol = get_policy("gandiva")
+        tputs = simple_throughputs(2)
+        tputs[JobId(0, 1)] = {"v100": [2.0, 2.0], "k80": [0.5, 0.5]}
+        sf = {JobId(i): 1 for i in range(2)}
+        alloc = pol.get_allocation(tputs, sf, CLUSTER)
+        validity(alloc, tputs, sf, CLUSTER)
+        assert sum(alloc[JobId(0, 1)].values()) == 0.0
+
+    def test_oversubscribed_packs_jobs(self):
+        pol = get_policy("gandiva")
+        m = 6
+        tputs = simple_throughputs(m)
+        for i in range(m):
+            for j in range(i + 1, m):
+                tputs[JobId(i, j)] = {"v100": [3.0, 3.0], "k80": [0.8, 0.8]}
+        sf = {JobId(i): 1 for i in range(m)}
+        alloc = pol.get_allocation(tputs, sf, CLUSTER)
+        validity(alloc, tputs, sf, CLUSTER)
+        packed_share = sum(
+            sum(alloc[j].values()) for j in alloc if j.is_pair
+        )
+        assert packed_share > 0.0
+
+
+class TestWaterFilling:
+    def test_equal_jobs_equal_levels(self):
+        pol = get_policy("max_min_fairness_water_filling_perf")
+        tputs = simple_throughputs(4)
+        sf = {JobId(i): 1 for i in range(4)}
+        pw = {JobId(i): 1.0 for i in range(4)}
+        alloc = pol.get_allocation(tputs, sf, pw, CLUSTER)
+        validity(alloc, tputs, sf, CLUSTER)
+        shares = [sum(alloc[JobId(i)].values()) for i in range(4)]
+        assert max(shares) - min(shares) < 0.05
+
+    def test_water_filling_improves_unsaturated_jobs(self):
+        # One job is rate-limited by its own share cap (sum_w x <= 1); the
+        # others should rise ABOVE the plain max-min level.
+        pol = get_policy("max_min_fairness_water_filling_perf")
+        tputs = {
+            JobId(0): {"v100": 1.0},
+            JobId(1): {"v100": 10.0},
+            JobId(2): {"v100": 10.0},
+        }
+        sf = {JobId(i): 1 for i in range(3)}
+        pw = {JobId(i): 1.0 for i in range(3)}
+        cluster = {"v100": 3}
+        alloc = pol.get_allocation(tputs, sf, pw, cluster)
+        validity(alloc, tputs, sf, cluster)
+        # Job 0 saturates at share 1. Remaining 2 v100s split between jobs
+        # 1 and 2: they should each get ~1 full v100, not be held at the
+        # bottleneck level.
+        assert sum(alloc[JobId(1)].values()) > 0.9
+        assert sum(alloc[JobId(2)].values()) > 0.9
+
+    def test_entity_fairness_reweighting(self):
+        pol = get_policy("max_min_fairness_water_filling_perf")
+        pol._priority_reweighting_policies = {0: "fairness", 1: "fairness"}
+        tputs = simple_throughputs(3)
+        sf = {JobId(i): 1 for i in range(3)}
+        pw = {JobId(i): 1.0 for i in range(3)}
+        alloc = pol.get_allocation(
+            tputs,
+            sf,
+            pw,
+            CLUSTER,
+            entity_weights={0: 1.0, 1: 1.0},
+            entity_to_job_mapping={0: [JobId(0)], 1: [JobId(1), JobId(2)]},
+        )
+        validity(alloc, tputs, sf, CLUSTER)
+        # Entity 0 (one job) should get at least as much as each of entity
+        # 1's two jobs individually.
+        assert (
+            sum(alloc[JobId(0)].values())
+            >= sum(alloc[JobId(1)].values()) - 0.05
+        )
+
+    def test_packed_variant_valid(self):
+        pol = get_policy("max_min_fairness_water_filling_packed")
+        tputs = simple_throughputs(2)
+        tputs[JobId(0, 1)] = {"v100": [2.5, 2.5], "k80": [0.6, 0.6]}
+        sf = {JobId(i): 1 for i in range(2)}
+        pw = {JobId(i): 1.0 for i in range(2)}
+        alloc = pol.get_allocation(tputs, sf, pw, CLUSTER)
+        validity(alloc, tputs, sf, CLUSTER)
+
+
+class TestStrategyProof:
+    def test_returns_allocation_and_discounts(self):
+        pol = get_policy("max_min_fairness_strategy_proof")
+        tputs = simple_throughputs(3)
+        sf = {JobId(i): 1 for i in range(3)}
+        pw = {JobId(i): 1.0 for i in range(3)}
+        alloc, discounts = pol.get_allocation(tputs, sf, pw, CLUSTER)
+        validity(alloc, tputs, sf, CLUSTER)
+        assert len(discounts) == 3
+        # Identical jobs -> identical discounts; discounts near <= 1.
+        assert np.allclose(discounts, discounts[0], rtol=0.05)
+        assert np.all(discounts <= 1.05)
+
+
+class TestMaxMinFairnessPacked:
+    def test_beneficial_packing_used(self):
+        pol = get_policy("max_min_fairness_packed")
+        m = 4
+        tputs = {JobId(i): {"v100": 4.0} for i in range(m)}
+        for i in range(m):
+            for j in range(i + 1, m):
+                # Packing is nearly free: each gets 90% of isolated.
+                tputs[JobId(i, j)] = {"v100": [3.6, 3.6]}
+        sf = {JobId(i): 1 for i in range(m)}
+        pw = {JobId(i): 1.0 for i in range(m)}
+        cluster = {"v100": 2}
+        alloc = pol.get_allocation(tputs, sf, pw, cluster)
+        validity(alloc, tputs, sf, cluster)
+        packed_share = sum(
+            sum(alloc[j].values()) for j in alloc if j.is_pair
+        )
+        assert packed_share > 0.5
+
+    def test_agrees_with_unpacked_when_packing_useless(self):
+        pol_packed = get_policy("max_min_fairness_packed")
+        pol_plain = get_policy("max_min_fairness_perf")
+        m = 3
+        tputs_plain = {JobId(i): {"v100": 4.0} for i in range(m)}
+        tputs = dict(tputs_plain)
+        for i in range(m):
+            for j in range(i + 1, m):
+                tputs[JobId(i, j)] = {"v100": [0.0, 0.0]}
+        sf = {JobId(i): 1 for i in range(m)}
+        pw = {JobId(i): 1.0 for i in range(m)}
+        cluster = {"v100": 2}
+        alloc_packed = pol_packed.get_allocation(tputs, sf, pw, cluster)
+        alloc_plain = pol_plain.get_allocation(tputs_plain, sf, pw, cluster)
+        validity(alloc_packed, tputs, sf, cluster)
+        for i in range(m):
+            assert sum(alloc_packed[JobId(i)].values()) == pytest.approx(
+                sum(alloc_plain[JobId(i)].values()), abs=0.05
+            )

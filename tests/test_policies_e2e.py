@@ -1,0 +1,79 @@
+"""Simulator integration for the wider policy library: every registered
+policy drives a tiny trace end-to-end."""
+
+import pytest
+
+from tests.test_simulator import run_sim, tiny_trace
+
+# Policies runnable on a plain single-type cluster with multi-GPU jobs.
+GENERAL_POLICIES = [
+    "fifo_perf",
+    "max_min_fairness",
+    "max_min_fairness_perf",
+    "max_min_fairness_water_filling",
+    "max_min_fairness_water_filling_perf",
+    "finish_time_fairness",
+    "finish_time_fairness_perf",
+    "min_total_duration",
+    "min_total_duration_perf",
+    "max_sum_throughput_perf",
+    "isolated",
+]
+
+# Packing policies exercise the pair-throughput bookkeeping.
+PACKING_POLICIES = [
+    "fifo_packed",
+    "max_min_fairness_packed",
+    "gandiva",
+]
+
+
+@pytest.mark.parametrize("policy", GENERAL_POLICIES)
+def test_policy_completes_trace(policy):
+    jobs, arrivals = tiny_trace(num_jobs=5, epochs=2, arrival_gap=30.0)
+    sched, makespan = run_sim(policy, jobs, arrivals, cluster={"v100": 2})
+    assert len(sched._job_completion_times) == 5
+    assert all(
+        t is not None and t > 0 for t in sched._job_completion_times.values()
+    )
+    assert makespan > 0
+
+
+@pytest.mark.parametrize("policy", PACKING_POLICIES)
+def test_packing_policy_completes_trace(policy):
+    jobs, arrivals = tiny_trace(num_jobs=6, epochs=2)
+    sched, makespan = run_sim(policy, jobs, arrivals, cluster={"v100": 2})
+    assert len(sched._job_completion_times) == 6
+    assert all(
+        t is not None and t > 0 for t in sched._job_completion_times.values()
+    )
+
+
+def test_allox_completes_trace_single_gpu_jobs():
+    jobs, arrivals = tiny_trace(num_jobs=4, epochs=2)
+    sched, _ = run_sim("allox", jobs, arrivals, cluster={"v100": 2})
+    assert len(sched._job_completion_times) == 4
+
+
+def test_slo_policy_populates_deadlines():
+    jobs, arrivals = tiny_trace(num_jobs=3, epochs=2)
+    for job in jobs:
+        job.SLO = 2.0
+        job.duration = 1000.0
+    sched, _ = run_sim(
+        "max_sum_throughput_normalized_by_cost_perf_SLOs",
+        jobs,
+        arrivals,
+        cluster={"v100": 2},
+    )
+    assert len(sched._job_completion_times) == 3
+    # Deadlines were tracked while jobs were active and cleaned up after.
+    assert sched._slos == {}
+
+
+def test_heterogeneous_cluster_perf_policy():
+    jobs, arrivals = tiny_trace(num_jobs=4, epochs=2)
+    sched, _ = run_sim(
+        "max_min_fairness_perf", jobs, arrivals, cluster={"v100": 1, "k80": 2}
+    )
+    assert len(sched._job_completion_times) == 4
