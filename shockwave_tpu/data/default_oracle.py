@@ -61,11 +61,28 @@ def isolated_steps_per_sec(
     return _WORKER_SPEED[worker_type] * samples_per_sec * gang / bs
 
 
-def _pair_factors(family_a: str, family_b: str) -> Tuple[float, float]:
-    """Fraction of isolated throughput each job keeps when space-shared."""
-    ua = _FAMILY_MODEL[family_a][2]
-    ub = _FAMILY_MODEL[family_b][2]
-    return 1.0 / (1.0 + ub), 1.0 / (1.0 + ua)
+def _pressure(family: str, bs: int) -> float:
+    """How hard a (family, batch size) leans on the accelerator."""
+    peak, half_sat, util = _FAMILY_MODEL[family]
+    return util * (0.7 + 0.6 * bs / (bs + half_sat))
+
+
+def _sensitivity(family: str, bs: int) -> float:
+    """How much a (family, batch size) suffers from a co-located peer."""
+    peak, half_sat, util = _FAMILY_MODEL[family]
+    return 0.3 + util * (0.6 + 0.8 * bs / (bs + half_sat))
+
+
+def _pair_factors(
+    family_a: str, bs_a: int, family_b: str, bs_b: int
+) -> Tuple[float, float]:
+    """Fraction of isolated throughput each job keeps when space-shared.
+    Depends on BOTH sides (my sensitivity x the peer's pressure) so every
+    (family, batch size) has a distinguishable colocation signature — what
+    the throughput estimator's cosine matching relies on."""
+    fa = 1.0 / (1.0 + _sensitivity(family_a, bs_a) * _pressure(family_b, bs_b))
+    fb = 1.0 / (1.0 + _sensitivity(family_b, bs_b) * _pressure(family_a, bs_a))
+    return fa, fb
 
 
 def generate_oracle(
@@ -92,9 +109,9 @@ def generate_oracle(
         ):
             if sf_a != sf_b or sf_a not in pair_scale_factors:
                 continue
-            fam_a, _ = parse_job_type(jt_a)
-            fam_b, _ = parse_job_type(jt_b)
-            fa, fb = _pair_factors(fam_a, fam_b)
+            fam_a, bs_a = parse_job_type(jt_a)
+            fam_b, bs_b = parse_job_type(jt_b)
+            fa, fb = _pair_factors(fam_a, bs_a, fam_b, bs_b)
             per_type[(jt_a, sf_a)][(jt_b, sf_b)] = [
                 per_type[(jt_a, sf_a)]["null"] * fa,
                 per_type[(jt_b, sf_b)]["null"] * fb,

@@ -1,0 +1,104 @@
+"""Throughput-estimator tests (reference test style:
+scheduler/tests/throughput_estimation_tests.py): identity when fully
+profiled; confined to reference types when sampled; ALS completion
+accuracy on synthetic low-rank data."""
+
+import numpy as np
+import pytest
+
+from shockwave_tpu.core.throughput_estimator import ThroughputEstimator
+from shockwave_tpu.data.default_oracle import generate_oracle
+from shockwave_tpu.ops.matrix_completion import complete, masked_als
+
+
+def oracle_and_types():
+    oracle = generate_oracle()
+    # Single-GPU job types that have colocated entries against each other.
+    job_types = [
+        key
+        for key in sorted(oracle["v100"].keys())
+        if key[1] == 1
+    ][:8]
+    trimmed = {}
+    for wt in ["v100", "p100", "k80"]:
+        trimmed[wt] = {}
+        for jt in job_types:
+            entry = {"null": oracle[wt][jt]["null"]}
+            for other in job_types:
+                entry[other] = oracle[wt][jt][other]
+            trimmed[wt][jt] = entry
+    return trimmed, job_types
+
+
+class TestEstimator:
+    def test_fully_profiled_identity(self):
+        oracle, job_types = oracle_and_types()
+        est = ThroughputEstimator(
+            oracle,
+            ["k80", "p100", "v100"],
+            job_types,
+            num_reference_job_types=len(job_types),
+            profiling_percentage=1.0,
+            seed=0,
+        )
+        for jt in job_types:
+            assert est.match_job_to_reference_job(jt) == jt
+
+    def test_sampled_profiling_returns_reference_type(self):
+        oracle, job_types = oracle_and_types()
+        est = ThroughputEstimator(
+            oracle,
+            ["k80", "p100", "v100"],
+            job_types,
+            num_reference_job_types=4,
+            profiling_percentage=0.5,
+            seed=1,
+        )
+        for jt in job_types:
+            match = est.match_job_to_reference_job(jt)
+            assert match in est._reference_job_types
+
+    def test_reference_throughputs_shape(self):
+        oracle, job_types = oracle_and_types()
+        est = ThroughputEstimator(
+            oracle,
+            ["k80", "p100", "v100"],
+            job_types,
+            num_reference_job_types=4,
+            profiling_percentage=0.5,
+        )
+        ref = est.get_reference_throughputs()
+        assert set(ref.keys()) == {"k80", "p100", "v100"}
+        for wt in ref:
+            assert len(ref[wt]) == 4
+            for jt in ref[wt]:
+                for other in ref[wt][jt]:
+                    assert len(ref[wt][jt][other]) == 2
+
+
+class TestMaskedALS:
+    def test_recovers_low_rank_matrix(self):
+        rng = np.random.default_rng(0)
+        U = rng.uniform(0.2, 1.0, (12, 3))
+        V = rng.uniform(0.2, 1.0, (15, 3))
+        X = (U @ V.T) / 3.0  # keep entries in [0, 1]
+        mask = (rng.uniform(size=X.shape) < 0.7).astype(float)
+        est = complete(X * mask, mask, k=3)
+        err = np.abs(est - X)[mask == 0]
+        assert err.mean() < 0.08
+
+    def test_observed_entries_preserved(self):
+        rng = np.random.default_rng(1)
+        X = rng.uniform(0, 1, (6, 6))
+        mask = np.ones_like(X)
+        mask[2, 3] = 0
+        out = complete(X * mask, mask, k=3)
+        np.testing.assert_array_equal(out[mask == 1], X[mask == 1])
+
+    def test_jit_shape_stability(self):
+        import jax.numpy as jnp
+
+        X = jnp.ones((4, 4))
+        mask = jnp.ones((4, 4))
+        out = masked_als(X, mask, k=2)
+        assert out.shape == (4, 4)
