@@ -1,0 +1,103 @@
+#!/usr/bin/env python3
+"""Physical-cluster driver: run a trace against real workers.
+
+Equivalent of the reference's run_scheduler_with_trace.py: starts the
+scheduler's gRPC server, waits for the expected workers to register,
+replays the trace's arrival times in (scaled) wall-clock, and drives
+rounds to completion. Workers are started separately with
+``python -m shockwave_tpu.runtime.worker``.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from shockwave_tpu.core.physical import PhysicalScheduler
+from shockwave_tpu.data import (
+    load_or_synthesize_profiles,
+    parse_trace,
+    read_throughputs,
+)
+from shockwave_tpu.data.default_oracle import generate_oracle
+from shockwave_tpu.policies import get_available_policies, get_policy
+
+
+def main(args):
+    jobs, arrival_times = parse_trace(args.trace_file)
+    throughputs = (
+        read_throughputs(args.throughputs_file)
+        if args.throughputs_file
+        else generate_oracle()
+    )
+    profiles = load_or_synthesize_profiles(args.trace_file, jobs, throughputs)
+    for i, job in enumerate(jobs):
+        job.duration = sum(profiles[i]["duration_every_epoch"])
+
+    shockwave_config = None
+    if args.policy in ("shockwave", "shockwave_tpu"):
+        with open(args.config) as f:
+            shockwave_config = json.load(f)
+        shockwave_config["time_per_iteration"] = args.time_per_iteration
+        shockwave_config.setdefault("num_gpus", args.expected_workers)
+
+    sched = PhysicalScheduler(
+        get_policy(args.policy, seed=args.seed),
+        port=args.port,
+        throughputs=throughputs,
+        seed=args.seed or 0,
+        time_per_iteration=args.time_per_iteration,
+        profiles=profiles,
+        shockwave_config=shockwave_config,
+    )
+    print(f"Scheduler listening on :{args.port}; waiting for "
+          f"{args.expected_workers} workers...")
+    sched.wait_for_workers(args.expected_workers, timeout=args.worker_timeout)
+
+    # Replay arrivals on their own thread (reference:
+    # run_scheduler_with_trace.py:48-70).
+    def submit():
+        start = time.time()
+        for job, arrival in zip(jobs, arrival_times):
+            delay = arrival * args.time_scale - (time.time() - start)
+            if delay > 0:
+                time.sleep(delay)
+            sched.add_job(job)
+
+    sched.expect_jobs(len(jobs))
+    submitter = threading.Thread(target=submit, daemon=True)
+    submitter.start()
+    sched.run()
+    submitter.join(timeout=1)
+
+    avg_jct = sched.get_average_jct()
+    makespan = sched.get_current_timestamp()
+    print(f"Makespan: {makespan:.1f}s")
+    if avg_jct:
+        print(f"Average JCT: {avg_jct:.1f}s")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("-t", "--trace_file", type=str, required=True)
+    parser.add_argument(
+        "-p", "--policy", type=str, default="fifo", choices=get_available_policies()
+    )
+    parser.add_argument("--throughputs_file", type=str, default=None)
+    parser.add_argument("--port", type=int, default=50060)
+    parser.add_argument("--expected_workers", type=int, default=1)
+    parser.add_argument("--worker_timeout", type=float, default=300.0)
+    parser.add_argument("--time_per_iteration", type=float, default=360.0)
+    parser.add_argument(
+        "--time_scale",
+        type=float,
+        default=1.0,
+        help="Multiplier on trace arrival times (e.g. 0.01 to compress)",
+    )
+    parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument("--config", type=str, default=None)
+    main(parser.parse_args())

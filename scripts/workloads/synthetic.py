@@ -1,0 +1,76 @@
+#!/usr/bin/env python3
+"""Synthetic training workload for runtime integration testing.
+
+Behaves like a real trainer from the scheduler's point of view: wraps a
+data loader in ShockwaveIterator, resumes its step counter from a
+checkpoint, runs ``--num_steps`` more steps at ``--steps_per_sec``, writes
+a checkpoint on preemption or completion. No accelerator needed.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+from shockwave_tpu.runtime.iterator import ShockwaveIterator
+
+
+class SyntheticLoader:
+    def __init__(self, batch_size):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        while True:
+            yield [0] * self.batch_size
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-n", "--num_steps", type=int, required=True)
+    parser.add_argument("--checkpoint_dir", type=str, required=True)
+    parser.add_argument("--enable_shockwave_iterator", action="store_true")
+    parser.add_argument("--steps_per_sec", type=float, default=100.0)
+    parser.add_argument("--batch_size", type=int, default=32)
+    # Gang rendezvous args appended by the scheduler for scale_factor > 1.
+    parser.add_argument("--distributed_addr", type=str, default=None)
+    parser.add_argument("--num_workers", type=int, default=1)
+    parser.add_argument("--worker_rank", type=int, default=0)
+    args = parser.parse_args()
+
+    ckpt_path = os.path.join(args.checkpoint_dir, "state.json")
+
+    def load_checkpoint():
+        if os.path.exists(ckpt_path):
+            with open(ckpt_path) as f:
+                return json.load(f)
+        return {"steps": 0}
+
+    def save_checkpoint(state):
+        with open(ckpt_path, "w") as f:
+            json.dump(state, f)
+
+    state = load_checkpoint()
+    loader = SyntheticLoader(args.batch_size)
+    iterator = ShockwaveIterator(
+        loader, args.checkpoint_dir, load_checkpoint, save_checkpoint
+    )
+
+    steps_this_task = 0
+    for _ in iterator:
+        time.sleep(1.0 / args.steps_per_sec)
+        steps_this_task += 1
+        state["steps"] += 1
+        if steps_this_task >= args.num_steps:
+            iterator.complete()
+            break
+    save_checkpoint(state)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,386 @@
+"""Physical-cluster mode: the round loop over real workers via gRPC.
+
+Subclasses the simulator's Scheduler for all bookkeeping (priorities,
+allocation, completion merging, batch-size adaptation, Shockwave planner
+hooks) and adds what only exists with real machines: worker registration,
+per-round dispatch, the lease state machine (init / refresh / extension),
+straggler kills, and shutdown. Reference: scheduler/scheduler.py
+_schedule_with_rounds :2080-2129, _begin/_mid/_end_round :1804-2078,
+lease callbacks :2942-3096, _kill_job :3098-3170.
+
+Timing shape per round (reference: SCHEDULE_RECOMPUTE_FRACTION=0.5,
+JOB_COMPLETION_BUFFER_TIME=60):
+  t=0        dispatch this round's assignments (skipping gang members whose
+             worker set is unchanged — their leases are extended instead)
+  t=0.5R     compute NEXT round's assignment so lease-update RPCs arriving
+             late in the round learn about extensions
+  t=R..R+B   wait for every dispatched micro-task's Done; kill stragglers
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+from shockwave_tpu.core.ids import JobId
+from shockwave_tpu.core.scheduler import Scheduler
+from shockwave_tpu.data.workload_info import steps_per_epoch
+from shockwave_tpu.runtime.lease import INFINITY
+
+SCHEDULE_RECOMPUTE_FRACTION = 0.5
+LEASE_UPDATE_FRACTION = 0.75
+JOB_COMPLETION_BUFFER_SECONDS = 60.0
+KILL_WAIT_SECONDS = 30.0
+
+
+class PhysicalScheduler(Scheduler):
+    def __init__(
+        self,
+        policy,
+        port: int = 50060,
+        completion_buffer_seconds: float = JOB_COMPLETION_BUFFER_SECONDS,
+        **kwargs,
+    ):
+        # The reference's fixed 1920s reset throttle assumes 360s rounds
+        # (scheduler.py:100); scale it with the round length so short-round
+        # deployments do not starve late arrivals of allocation updates.
+        if "minimum_time_between_allocation_resets" not in kwargs:
+            kwargs["minimum_time_between_allocation_resets"] = (
+                1920.0 / 360.0
+            ) * float(kwargs.get("time_per_iteration", 360.0))
+        super().__init__(policy, simulate=False, **kwargs)
+        self._port = port
+        self._completion_buffer = completion_buffer_seconds
+        self._start_time = time.time()
+
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._worker_connections: Dict[int, object] = {}
+        self._worker_addrs: Dict[int, Tuple[str, int]] = {}
+        self._round_id = 0
+        self._num_expected_jobs: Optional[int] = None
+        self._shutdown_requested = threading.Event()
+
+        # Per-job runtime state.
+        self._dispatch_times: Dict[JobId, float] = {}
+        self._round_end_time: float = 0.0
+        # Jobs whose next-round worker set is identical: lease extensions.
+        self._jobs_with_extended_lease: set = set()
+        self._next_assignments: "OrderedDict[JobId, tuple]" = OrderedDict()
+        # Gang lease agreement: job -> (max_steps, max_duration)
+        # fixed by the first member to request an update
+        # (reference: scheduler.py:3067-3096).
+        self._max_steps_agreement: Dict[JobId, Tuple[int, float]] = {}
+        # Micro-tasks dispatched this round and not yet reported done.
+        self._outstanding: set = set()
+        # Dispatch-time worker sets (assignments rotate before Done arrives).
+        self._dispatched_worker_ids: Dict[JobId, tuple] = {}
+
+        from shockwave_tpu.runtime.rpc import scheduler_server
+
+        self._server = scheduler_server.serve(
+            port,
+            {
+                "register_worker": self._register_worker_rpc,
+                "done": self._done_rpc,
+                "init_job": self._init_job_rpc,
+                "update_lease": self._update_lease_rpc,
+            },
+        )
+
+    # -- wall-clock timestamps (simulator uses virtual time) ------------
+    def get_current_timestamp(self, in_seconds: bool = False) -> float:
+        return time.time() - self._start_time
+
+    # -- RPC callbacks --------------------------------------------------
+    def _register_worker_rpc(self, worker_type, num_accelerators, ip_addr, port):
+        """(reference: scheduler.py:2854-2940)"""
+        from shockwave_tpu.runtime.rpc.scheduler_client import SchedulerRpcClient
+
+        with self._cv:
+            worker_ids = self.register_worker(
+                worker_type, num_gpus=num_accelerators
+            )
+            client = SchedulerRpcClient(ip_addr, port)
+            for worker_id in worker_ids:
+                self._worker_connections[worker_id] = client
+                self._worker_addrs[worker_id] = (ip_addr, port)
+            self._cv.notify_all()
+        return worker_ids, self._time_per_iteration
+
+    def _done_rpc(self, worker_id, job_ids, num_steps, execution_times, logs):
+        """(reference: scheduler_server.py:62-95 -> _done_callback)"""
+        with self._cv:
+            if len(job_ids) == 1:
+                key = JobId(job_ids[0])
+                steps_list = [num_steps[0]]
+                times_list = [execution_times[0]]
+            else:
+                key = JobId(job_ids[0], job_ids[1])
+                steps_list = list(num_steps)
+                times_list = list(execution_times)
+            now = self.get_current_timestamp()
+            for single, log_text in zip(key.singletons(), logs):
+                if single in self._job_timelines:
+                    self._job_timelines[single][0].append(log_text)
+                if single in self._jobs:
+                    self._per_job_latest_timestamps[single] = now
+            self._outstanding.discard((key, worker_id))
+            # The process exited, so any granted extension is moot: the job
+            # must be re-dispatched if scheduled again.
+            if not any(
+                (key, wid) in self._outstanding
+                for wid in self._dispatched_worker_ids.get(key, ())
+            ):
+                self._jobs_with_extended_lease.discard(key)
+            self._done_callback(key, worker_id, steps_list, times_list)
+            self._cv.notify_all()
+
+    def _init_job_rpc(self, job_id):
+        """First lease of a micro-task: run until the round ends
+        (reference: scheduler.py:2942-3029)."""
+        with self._cv:
+            key = JobId(int(job_id))
+            now = self.get_current_timestamp()
+            self._dispatch_times.setdefault(key, now)
+            remaining = max(self._round_end_time - now, 1.0)
+            return INFINITY, remaining, 0.0
+
+    def _update_lease_rpc(
+        self, job_id, worker_id, steps, duration, max_steps, max_duration
+    ):
+        """(reference: scheduler.py:3031-3096)"""
+        with self._cv:
+            key = JobId(int(job_id))
+            if key in self._jobs_with_extended_lease:
+                # The job keeps the same workers next round: extend through
+                # the next round's end (reference: scheduler.py:1868-1891).
+                extra = self._time_per_iteration
+                return max_steps or INFINITY, max_duration, extra
+            if steps == 0 or duration < LEASE_UPDATE_FRACTION * max_duration:
+                return max_steps or INFINITY, max_duration, 0.0
+            # Convert the remaining time budget into a step bound so all
+            # gang members stop on the same step: first updater computes,
+            # the rest adopt (reference: scheduler.py:3067-3096).
+            if key not in self._max_steps_agreement:
+                throughput = steps / max(duration, 1e-9)
+                agreed_steps = max(
+                    int(steps + throughput * max(max_duration - duration, 0.0)),
+                    int(steps) + 1,
+                )
+                self._max_steps_agreement[key] = (agreed_steps, max_duration)
+            agreed_steps, agreed_duration = self._max_steps_agreement[key]
+            return agreed_steps, agreed_duration, 0.0
+
+    # -- dispatch -------------------------------------------------------
+    def _job_description(self, job, num_steps, rank, scale_factor, lead_addr):
+        command = job.command
+        if scale_factor > 1:
+            # Gang rendezvous args, appended the way the reference appends
+            # DDP args (reference: scheduler.py:1943-1950); JAX workloads
+            # map them onto jax.distributed.initialize.
+            command = (
+                f"{command} --distributed_addr {lead_addr}"
+                f" --num_workers {scale_factor} --worker_rank {rank}"
+            )
+        return {
+            "job_id": job.job_id,
+            "job_type": job.job_type,
+            "command": command,
+            "working_directory": job.working_directory,
+            "needs_data_dir": job.needs_data_dir,
+            "num_steps_arg": job.num_steps_arg,
+            "num_steps": num_steps,
+            "has_duration": job.duration is not None,
+            "duration": job.duration or 0,
+        }
+
+    def _dispatch(self, key: JobId, worker_ids) -> None:
+        """Send RunJob for every worker of a (possibly packed) assignment."""
+        lead_ip, lead_port = self._worker_addrs[worker_ids[0]]
+        lead_addr = f"{lead_ip}:{10000 + (key.as_tuple()[0] % 40000)}"
+        scale_factor = len(worker_ids)
+        self._dispatch_times[key] = self.get_current_timestamp()
+        self._dispatched_worker_ids[key] = tuple(worker_ids)
+        for single in key.singletons():
+            # Progress accounting in _done_callback only credits running
+            # jobs (reference marks them at dispatch, scheduler.py:1935).
+            self._running_jobs.add(single)
+            self._per_job_latest_timestamps[single] = self.get_current_timestamp()
+        for rank, worker_id in enumerate(worker_ids):
+            descriptions = []
+            for single in key.singletons():
+                job = self._jobs[single]
+                remaining = self._get_remaining_steps(single)
+                descriptions.append(
+                    self._job_description(
+                        job, max(remaining, 1), rank, scale_factor, lead_addr
+                    )
+                )
+            self._outstanding.add((key, worker_id))
+            self._worker_connections[worker_id].run_job(
+                descriptions, worker_id, self._round_id
+            )
+
+    # -- the round loop -------------------------------------------------
+    def wait_for_workers(self, count: int, timeout: float = 120.0) -> None:
+        deadline = time.time() + timeout
+        with self._cv:
+            while len(self._worker_ids) < count:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"only {len(self._worker_ids)}/{count} workers registered"
+                    )
+                self._cv.wait(timeout=remaining)
+
+    def expect_jobs(self, count: int) -> None:
+        """Tell the round loop how many jobs the full trace will submit, so
+        an empty job table mid-trace (an arrival gap) idles instead of
+        ending the run."""
+        with self._cv:
+            self._num_expected_jobs = count
+
+    def run(self, max_rounds: Optional[int] = None) -> None:
+        """Drive rounds until every added job completes
+        (reference: _schedule_with_rounds scheduler.py:2080-2129)."""
+        while not self._shutdown_requested.is_set():
+            with self._cv:
+                if len(self._jobs) == 0:
+                    expected = self._num_expected_jobs
+                    if expected is None or self._num_jobs_in_trace >= expected:
+                        break
+                    # Arrival gap: wait for the next submission.
+                    self._cv.wait(timeout=1.0)
+                    continue
+                if max_rounds is not None and self._round_id >= max_rounds:
+                    break
+                round_start = self.get_current_timestamp()
+                self._round_end_time = round_start + self._time_per_iteration
+                if self._shockwave is not None and self._round_id >= 1:
+                    self._shockwave_scheduler_update()
+                assignments = (
+                    self._next_assignments or self._schedule_jobs_on_workers()
+                )
+                self._next_assignments = OrderedDict()
+                self._max_steps_agreement = {}
+                # Extensions granted at the last mid-round stay in force
+                # until the next mid-round recompute, so refreshes arriving
+                # early in this round still see them (the Done handler
+                # clears a job's extension the moment its process exits).
+                extended = set(self._jobs_with_extended_lease)
+                # Drop jobs that completed between planning and now.
+                assignments = OrderedDict(
+                    (key, ids)
+                    for key, ids in assignments.items()
+                    if all(s in self._jobs for s in key.singletons())
+                )
+                self._current_worker_assignments = assignments
+                for key, worker_ids in assignments.items():
+                    if key in extended:
+                        continue  # still running under an extended lease
+                    self._dispatch(key, worker_ids)
+
+            # Mid-round: plan the next round so in-flight lease updates can
+            # be extended (reference: _mid_round scheduler.py:1839-1965).
+            time.sleep(self._time_per_iteration * SCHEDULE_RECOMPUTE_FRACTION)
+            with self._cv:
+                if len(self._jobs) > 0:
+                    self._next_assignments = self._schedule_jobs_on_workers()
+                    self._jobs_with_extended_lease = set()
+                    for key, worker_ids in self._next_assignments.items():
+                        prev = self._current_worker_assignments.get(key)
+                        # Extend only if the micro-task is actually still
+                        # running on the same workers — a process that
+                        # already exited must be re-dispatched.
+                        still_running = any(
+                            (key, wid) in self._outstanding
+                            for wid in worker_ids
+                        )
+                        if (
+                            prev is not None
+                            and set(prev) == set(worker_ids)
+                            and still_running
+                        ):
+                            self._jobs_with_extended_lease.add(key)
+                            self._num_lease_extensions += 1
+                        self._num_lease_extension_opportunities += 1
+
+            # End of round: wait for completions, then kill stragglers
+            # (reference: _end_round :1993-2078, kill :3098-3170).
+            remaining = self._round_end_time - self.get_current_timestamp()
+            if remaining > 0:
+                time.sleep(remaining)
+            deadline = time.time() + self._completion_buffer
+            with self._cv:
+                expected = {
+                    item
+                    for item in self._outstanding
+                    if item[0] not in self._jobs_with_extended_lease
+                }
+                while expected & self._outstanding:
+                    wait = deadline - time.time()
+                    if wait <= 0:
+                        break
+                    self._cv.wait(timeout=wait)
+                stragglers = {
+                    key for key, _ in (expected & self._outstanding)
+                }
+            for key in stragglers:
+                self._kill_job(key)
+            self._round_id += 1
+            self._num_completed_rounds += 1
+
+        self.shutdown()
+
+    def _kill_job(self, key: JobId) -> None:
+        """Kill an unresponsive micro-task and synthesize zero-progress
+        completions so bookkeeping converges
+        (reference: scheduler.py:3098-3170)."""
+        with self._cv:
+            worker_ids = list(self._current_worker_assignments.get(key, ()))
+        for worker_id in worker_ids:
+            for job_int in key.as_tuple():
+                try:
+                    self._worker_connections[worker_id].kill_job(job_int)
+                except Exception:
+                    pass
+        deadline = time.time() + KILL_WAIT_SECONDS
+        with self._cv:
+            while any(
+                (key, wid) in self._outstanding for wid in worker_ids
+            ):
+                wait = deadline - time.time()
+                if wait <= 0:
+                    break
+                self._cv.wait(timeout=wait)
+            for worker_id in worker_ids:
+                if (key, worker_id) in self._outstanding:
+                    self._outstanding.discard((key, worker_id))
+                    zeros = [0] * len(key.singletons())
+                    self._done_callback(
+                        key, worker_id, zeros, [0.0] * len(key.singletons())
+                    )
+
+    def _micro_task_scale_factor(self, job_id) -> int:
+        ids = self._dispatched_worker_ids.get(job_id)
+        if ids is not None:
+            return len(ids)
+        return len(self._current_worker_assignments[job_id])
+
+    def shutdown(self) -> None:
+        if self._shutdown_requested.is_set():
+            return
+        self._shutdown_requested.set()
+        seen = set()
+        for worker_id, client in self._worker_connections.items():
+            if id(client) in seen:
+                continue
+            seen.add(id(client))
+            try:
+                client.shutdown()
+            except Exception:
+                pass
+        self._server.stop(grace=2)

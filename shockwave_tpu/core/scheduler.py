@@ -765,6 +765,12 @@ class Scheduler:
             self._running_jobs.add(single)
         return all_num_steps, max_finish_time
 
+    def _micro_task_scale_factor(self, job_id) -> int:
+        """Gang size of the micro-task being merged. Physical mode overrides
+        this with the dispatch-time record, since assignments may have
+        rotated by the time a Done report arrives."""
+        return len(self._current_worker_assignments[job_id])
+
     def _done_callback(
         self, job_id, worker_id, all_num_steps, all_execution_times
     ) -> None:
@@ -778,7 +784,7 @@ class Scheduler:
         if not any(is_active.values()):
             return
 
-        scale_factor = len(self._current_worker_assignments[job_id])
+        scale_factor = self._micro_task_scale_factor(job_id)
         updates = self._in_progress_updates.setdefault(job_id, [])
         updates.append((worker_id, all_num_steps, all_execution_times))
         if len(updates) < scale_factor:

@@ -1,0 +1,7 @@
+"""Physical-cluster runtime: gRPC control plane, worker agent, dispatcher,
+and the lease-aware training iterator (reference: scheduler/runtime/,
+scheduler/worker.py, scheduler/gavel_iterator.py, scheduler/lease.py)."""
+
+from shockwave_tpu.runtime.lease import INFINITY, Lease
+
+__all__ = ["Lease", "INFINITY"]

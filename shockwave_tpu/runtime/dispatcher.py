@@ -1,0 +1,222 @@
+"""Worker-side job dispatcher: accelerator queue, subprocess launch with
+the iterator env contract, progress-log parsing, kill, Done reporting.
+Reference: scheduler/runtime/rpc/dispatcher.py.
+
+TPU notes: one training process per accelerator (no CUDA-MPS analog);
+optional numactl CPU pinning is applied when available, mirroring the
+reference's NUMA handling (dispatcher.py:75-120), but is a no-op
+otherwise.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import re
+import shutil
+import signal
+import subprocess
+import threading
+import time
+from typing import Dict, List
+
+LOG = logging.getLogger("runtime.dispatcher")
+
+_PROGRESS_RE = re.compile(r"steps=(\d+) duration=([0-9.]+)")
+
+
+class Dispatcher:
+    def __init__(
+        self,
+        round_duration: float,
+        accelerator_ids: List[int],
+        worker_rpc_client,
+        sched_addr: str,
+        sched_port: int,
+        run_dir: str,
+        checkpoint_dir: str,
+        use_numactl: bool = False,
+    ):
+        self._round_duration = round_duration
+        self._worker_rpc_client = worker_rpc_client
+        self._sched_addr = sched_addr
+        self._sched_port = sched_port
+        self._run_dir = run_dir
+        self._checkpoint_dir = checkpoint_dir
+        self._use_numactl = use_numactl and shutil.which("numactl") is not None
+
+        self._accelerator_queue: "queue.Queue[int]" = queue.Queue()
+        for accel_id in accelerator_ids:
+            self._accelerator_queue.put(accel_id)
+
+        self._lock = threading.Lock()
+        # (job_id, worker_id) -> subprocess.Popen: one gang job can have
+        # several ranks on one multi-accelerator host.
+        self._procs: Dict[tuple, subprocess.Popen] = {}
+        self._kill_requested: set = set()
+        os.makedirs(self._run_dir, exist_ok=True)
+        os.makedirs(self._checkpoint_dir, exist_ok=True)
+
+    # -- command construction ------------------------------------------
+    def _job_dirs(self, job_id: int, worker_id: int, round_id: int):
+        ckpt = os.path.join(self._checkpoint_dir, f"job_id={job_id}")
+        os.makedirs(ckpt, exist_ok=True)
+        log = os.path.join(
+            self._run_dir,
+            f"job={job_id}_worker={worker_id}_round={round_id}.log",
+        )
+        return ckpt, log
+
+    def _construct_command(self, job, ckpt_dir: str) -> str:
+        """(reference: dispatcher.py:163-186)"""
+        command = job["command"]
+        if job.get("needs_data_dir") and "%s" in command:
+            command = command % self._run_dir
+        command = (
+            f"{command} {job['num_steps_arg']} {job['num_steps']}"
+            f" --checkpoint_dir {ckpt_dir}"
+            " --enable_shockwave_iterator"
+        )
+        if self._use_numactl:
+            command = f"numactl --interleave=all {command}"
+        return command
+
+    # -- dispatch -------------------------------------------------------
+    def dispatch_jobs(self, job_descriptions, worker_id: int, round_id: int):
+        """Asynchronously run a (possibly packed) set of jobs on one free
+        accelerator (reference: dispatcher.py:447-553)."""
+        threading.Thread(
+            target=self._dispatch_jobs_helper,
+            args=(job_descriptions, worker_id, round_id),
+            daemon=True,
+        ).start()
+
+    def _dispatch_jobs_helper(self, job_descriptions, worker_id, round_id):
+        accel_id = self._accelerator_queue.get()
+        job_ids, steps, durations, logs = [], [], [], []
+        try:
+            # A packed pair space-shares the accelerator: both processes
+            # run CONCURRENTLY (reference: dispatcher.py:447-525, where
+            # MPS provides the sharing; here the accelerator runtime's own
+            # time-slicing does).
+            results = [None] * len(job_descriptions)
+
+            def launch(i, job):
+                results[i] = self._launch_job(job, accel_id, worker_id, round_id)
+
+            launchers = [
+                threading.Thread(target=launch, args=(i, job), daemon=True)
+                for i, job in enumerate(job_descriptions)
+            ]
+            for t in launchers:
+                t.start()
+            for t in launchers:
+                t.join()
+            for job, (n, d, log_text) in zip(job_descriptions, results):
+                job_ids.append(job["job_id"])
+                steps.append(n)
+                durations.append(d)
+                logs.append(log_text)
+        finally:
+            self._accelerator_queue.put(accel_id)
+        try:
+            self._worker_rpc_client.notify_scheduler(
+                worker_id, job_ids, steps, durations, logs
+            )
+        except Exception:
+            # Scheduler may already be gone during shutdown.
+            LOG.warning("Done notification failed", exc_info=True)
+
+    def _launch_job(self, job, accel_id, worker_id, round_id):
+        """Run one training subprocess to completion; returns
+        (steps, duration, iterator_log_text)
+        (reference: dispatcher.py:309-445)."""
+        job_id = int(job["job_id"])
+        ckpt_dir, log_file = self._job_dirs(job_id, worker_id, round_id)
+        command = self._construct_command(job, ckpt_dir)
+        env = dict(os.environ)
+        env.update(
+            {
+                "SHOCKWAVE_JOB_ID": str(job_id),
+                "SHOCKWAVE_WORKER_ID": str(worker_id),
+                "SHOCKWAVE_ROUND_ID": str(round_id),
+                "SHOCKWAVE_SCHED_ADDR": self._sched_addr,
+                "SHOCKWAVE_SCHED_PORT": str(self._sched_port),
+                "SHOCKWAVE_LOG_FILE": log_file,
+                "SHOCKWAVE_ACCELERATOR_ID": str(accel_id),
+                # CUDA-style selector for GPU hosts; harmless on TPU.
+                "CUDA_VISIBLE_DEVICES": str(accel_id),
+            }
+        )
+        stdout_path = log_file + ".stdout"
+        start = time.time()
+        with open(stdout_path, "w") as out:
+            proc = subprocess.Popen(
+                command,
+                shell=True,
+                cwd=job.get("working_directory") or None,
+                env=env,
+                stdout=out,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+            with self._lock:
+                self._procs[(job_id, worker_id)] = proc
+            proc.wait()
+        with self._lock:
+            self._procs.pop((job_id, worker_id), None)
+            killed = job_id in self._kill_requested
+            if not any(jid == job_id for jid, _ in self._procs):
+                self._kill_requested.discard(job_id)
+        elapsed = time.time() - start
+        n, d, log_text = self._get_steps_and_execution_time(log_file)
+        if n is None:
+            if killed:
+                # A preempted process that never reported progress still
+                # consumed its wall-clock.
+                n, d = 0, elapsed
+            else:
+                LOG.error(
+                    "Job %d reported no progress (see %s)", job_id, stdout_path
+                )
+                n, d = 0, 0.0
+        return n, d, log_text
+
+    def _get_steps_and_execution_time(self, log_file: str):
+        """Parse the iterator's structured log
+        (reference: dispatcher.py:188-213)."""
+        if not os.path.exists(log_file):
+            return None, None, ""
+        with open(log_file) as f:
+            text = f.read()
+        matches = _PROGRESS_RE.findall(text)
+        if not matches:
+            return None, None, text
+        steps, duration = matches[-1]
+        return int(steps), float(duration), text
+
+    # -- kill / lifecycle ----------------------------------------------
+    def kill_job(self, job_id: int):
+        """Kill every rank of ``job_id`` on this host
+        (reference: dispatcher.py:215-262)."""
+        job_id = int(job_id)
+        with self._lock:
+            procs = [p for (jid, _), p in self._procs.items() if jid == job_id]
+            if procs:
+                self._kill_requested.add(job_id)
+        for proc in procs:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+
+    def reset(self):
+        """(reference: dispatcher.py:537-545)"""
+        with self._lock:
+            job_ids = {jid for jid, _ in self._procs}
+        for job_id in job_ids:
+            self.kill_job(job_id)
+
+    def shutdown(self):
+        self.reset()

@@ -1,0 +1,1 @@
+"""Generated protobuf message classes (see Makefile to regenerate)."""

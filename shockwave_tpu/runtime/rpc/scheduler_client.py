@@ -1,0 +1,54 @@
+"""Scheduler -> worker RPC client (reference:
+scheduler/runtime/rpc/scheduler_client.py; like the reference, a fresh
+channel per call keeps the client stateless against worker restarts)."""
+
+from __future__ import annotations
+
+import grpc
+
+from shockwave_tpu.runtime.protobuf import common_pb2, scheduler_to_worker_pb2 as s2w_pb2
+from shockwave_tpu.runtime.rpc.wiring import make_stubs
+
+
+class SchedulerRpcClient:
+    def __init__(self, server_ip_addr: str, port: int):
+        self._addr = f"{server_ip_addr}:{port}"
+
+    def _stubs(self, channel):
+        return make_stubs(channel, "SchedulerToWorker")
+
+    def run_job(self, job_descriptions, worker_id: int, round_id: int) -> None:
+        descriptions = [
+            s2w_pb2.JobDescription(
+                job_id=d["job_id"],
+                job_type=d["job_type"],
+                command=d["command"],
+                working_directory=d.get("working_directory", ""),
+                needs_data_dir=d.get("needs_data_dir", False),
+                num_steps_arg=d.get("num_steps_arg", "-n"),
+                num_steps=d["num_steps"],
+                has_duration=d.get("has_duration", False),
+                duration=int(d.get("duration", 0)),
+            )
+            for d in job_descriptions
+        ]
+        with grpc.insecure_channel(self._addr) as channel:
+            self._stubs(channel).RunJob(
+                s2w_pb2.RunJobRequest(
+                    job_descriptions=descriptions,
+                    worker_id=worker_id,
+                    round_id=round_id,
+                )
+            )
+
+    def kill_job(self, job_id: int) -> None:
+        with grpc.insecure_channel(self._addr) as channel:
+            self._stubs(channel).KillJob(s2w_pb2.KillJobRequest(job_id=job_id))
+
+    def reset(self) -> None:
+        with grpc.insecure_channel(self._addr) as channel:
+            self._stubs(channel).Reset(common_pb2.Empty())
+
+    def shutdown(self) -> None:
+        with grpc.insecure_channel(self._addr) as channel:
+            self._stubs(channel).Shutdown(common_pb2.Empty())

@@ -1,0 +1,115 @@
+"""Scheduler-side gRPC server: hosts WorkerToScheduler and
+IteratorToScheduler (reference: scheduler/runtime/rpc/scheduler_server.py).
+
+Callbacks supplied by the scheduler:
+  register_worker(worker_type, num_accelerators, ip_addr, port)
+      -> (worker_ids, round_duration)     (raises on rejection)
+  done(worker_id, job_ids, num_steps, execution_times, iterator_logs)
+  init_job(job_id) -> (max_steps, max_duration, extra_time)
+  update_lease(job_id, worker_id, steps, duration, max_steps, max_duration)
+      -> (max_steps, max_duration, extra_time)
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent import futures
+
+import grpc
+
+from shockwave_tpu.runtime.protobuf import (
+    common_pb2,
+    iterator_to_scheduler_pb2 as it_pb2,
+    worker_to_scheduler_pb2 as w2s_pb2,
+)
+from shockwave_tpu.runtime.rpc.wiring import add_servicer
+
+LOG = logging.getLogger("runtime.scheduler_server")
+
+
+def _worker_to_scheduler_handlers(callbacks):
+    def RegisterWorker(request, context):
+        try:
+            worker_ids, round_duration = callbacks["register_worker"](
+                request.worker_type,
+                request.num_accelerators,
+                request.ip_addr,
+                request.port,
+            )
+            return w2s_pb2.RegisterWorkerResponse(
+                success=True,
+                worker_ids=worker_ids,
+                round_duration=int(round_duration),
+            )
+        except Exception as e:  # noqa: BLE001 - reported to the caller
+            LOG.exception("RegisterWorker failed")
+            return w2s_pb2.RegisterWorkerResponse(
+                success=False, error_message=str(e)
+            )
+
+    def SendHeartbeat(request, context):
+        cb = callbacks.get("heartbeat")
+        if cb is not None:
+            cb(request.worker_id)
+        return common_pb2.Empty()
+
+    def Done(request, context):
+        callbacks["done"](
+            request.worker_id,
+            list(request.job_id),
+            list(request.num_steps),
+            list(request.execution_time),
+            list(request.iterator_log),
+        )
+        return common_pb2.Empty()
+
+    return {
+        "RegisterWorker": RegisterWorker,
+        "SendHeartbeat": SendHeartbeat,
+        "Done": Done,
+    }
+
+
+def _iterator_to_scheduler_handlers(callbacks):
+    def InitJob(request, context):
+        max_steps, max_duration, extra_time = callbacks["init_job"](
+            request.job_id
+        )
+        return it_pb2.UpdateLeaseResponse(
+            max_steps=int(max_steps),
+            max_duration=float(max_duration),
+            extra_time=float(extra_time),
+        )
+
+    def UpdateLease(request, context):
+        max_steps, max_duration, extra_time = callbacks["update_lease"](
+            request.job_id,
+            request.worker_id,
+            request.steps,
+            request.duration,
+            request.max_steps,
+            request.max_duration,
+        )
+        return it_pb2.UpdateLeaseResponse(
+            max_steps=int(max_steps),
+            max_duration=float(max_duration),
+            extra_time=float(extra_time),
+        )
+
+    return {"InitJob": InitJob, "UpdateLease": UpdateLease}
+
+
+def serve(port: int, callbacks: dict, max_workers: int = 32) -> grpc.Server:
+    """Start (and return) the scheduler's gRPC server; non-blocking."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    add_servicer(
+        server, "WorkerToScheduler", _worker_to_scheduler_handlers(callbacks)
+    )
+    add_servicer(
+        server,
+        "IteratorToScheduler",
+        _iterator_to_scheduler_handlers(callbacks),
+    )
+    server.add_insecure_port(f"[::]:{port}")
+    server.start()
+    return server

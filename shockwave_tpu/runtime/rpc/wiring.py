@@ -1,0 +1,77 @@
+"""Hand-rolled gRPC service wiring.
+
+grpc_tools (the Python codegen plugin) is not a dependency of this build;
+messages come from plain ``protoc --python_out`` and the service surface —
+three small unary-unary services — is declared here once and turned into
+client stubs / server handlers with grpc's generic APIs. Service and
+method names match the reference's wire contract
+(reference: scheduler/runtime/protobuf/*.proto, scheduler/Makefile:1-6).
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+import grpc
+
+from shockwave_tpu.runtime.protobuf import (
+    common_pb2,
+    iterator_to_scheduler_pb2 as it_pb2,
+    scheduler_to_worker_pb2 as s2w_pb2,
+    worker_to_scheduler_pb2 as w2s_pb2,
+)
+
+PACKAGE = "shockwave_tpu"
+
+SERVICES = {
+    "WorkerToScheduler": {
+        "RegisterWorker": (
+            w2s_pb2.RegisterWorkerRequest,
+            w2s_pb2.RegisterWorkerResponse,
+        ),
+        "SendHeartbeat": (w2s_pb2.Heartbeat, common_pb2.Empty),
+        "Done": (w2s_pb2.DoneRequest, common_pb2.Empty),
+    },
+    "SchedulerToWorker": {
+        "RunJob": (s2w_pb2.RunJobRequest, common_pb2.Empty),
+        "KillJob": (s2w_pb2.KillJobRequest, common_pb2.Empty),
+        "Reset": (common_pb2.Empty, common_pb2.Empty),
+        "Shutdown": (common_pb2.Empty, common_pb2.Empty),
+    },
+    "IteratorToScheduler": {
+        "InitJob": (it_pb2.InitJobRequest, it_pb2.UpdateLeaseResponse),
+        "UpdateLease": (it_pb2.UpdateLeaseRequest, it_pb2.UpdateLeaseResponse),
+    },
+}
+
+
+def make_stubs(channel: grpc.Channel, service: str) -> SimpleNamespace:
+    """Client stubs for every method of ``service`` on ``channel``."""
+    stubs = {}
+    for method, (req_cls, resp_cls) in SERVICES[service].items():
+        stubs[method] = channel.unary_unary(
+            f"/{PACKAGE}.{service}/{method}",
+            request_serializer=req_cls.SerializeToString,
+            response_deserializer=resp_cls.FromString,
+        )
+    return SimpleNamespace(**stubs)
+
+
+def add_servicer(server: grpc.Server, service: str, handlers: dict) -> None:
+    """Register ``handlers`` ({method: fn(request, context) -> response})
+    for ``service`` on a grpc server."""
+    method_handlers = {}
+    for method, fn in handlers.items():
+        req_cls, resp_cls = SERVICES[service][method]
+        method_handlers[method] = grpc.unary_unary_rpc_method_handler(
+            fn,
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (
+            grpc.method_handlers_generic_handler(
+                f"{PACKAGE}.{service}", method_handlers
+            ),
+        )
+    )

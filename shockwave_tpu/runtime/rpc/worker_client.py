@@ -1,0 +1,55 @@
+"""Worker -> scheduler RPC client (reference:
+scheduler/runtime/rpc/worker_client.py)."""
+
+from __future__ import annotations
+
+import grpc
+
+from shockwave_tpu.runtime.protobuf import worker_to_scheduler_pb2 as w2s_pb2
+from shockwave_tpu.runtime.rpc.wiring import make_stubs
+
+
+class WorkerRpcClient:
+    def __init__(self, sched_ip_addr: str, sched_port: int):
+        self._addr = f"{sched_ip_addr}:{sched_port}"
+
+    def _stubs(self, channel):
+        return make_stubs(channel, "WorkerToScheduler")
+
+    def register_worker(
+        self, worker_type: str, num_accelerators: int, ip_addr: str, port: int
+    ):
+        """Returns (worker_ids, round_duration, error_message)."""
+        with grpc.insecure_channel(self._addr) as channel:
+            response = self._stubs(channel).RegisterWorker(
+                w2s_pb2.RegisterWorkerRequest(
+                    worker_type=worker_type,
+                    num_accelerators=num_accelerators,
+                    ip_addr=ip_addr,
+                    port=port,
+                )
+            )
+        if not response.success:
+            return None, None, response.error_message
+        return list(response.worker_ids), response.round_duration, None
+
+    def send_heartbeat(self, worker_id: int) -> None:
+        with grpc.insecure_channel(self._addr) as channel:
+            self._stubs(channel).SendHeartbeat(
+                w2s_pb2.Heartbeat(worker_id=worker_id)
+            )
+
+    def notify_scheduler(
+        self, worker_id, job_ids, num_steps, execution_times, iterator_logs
+    ) -> None:
+        """Report completed micro-tasks (reference: worker_client.py:62-86)."""
+        with grpc.insecure_channel(self._addr) as channel:
+            self._stubs(channel).Done(
+                w2s_pb2.DoneRequest(
+                    worker_id=worker_id,
+                    job_id=[int(j) for j in job_ids],
+                    num_steps=[int(s) for s in num_steps],
+                    execution_time=[float(t) for t in execution_times],
+                    iterator_log=[str(x) for x in iterator_logs],
+                )
+            )

@@ -1,0 +1,61 @@
+"""Worker-side gRPC server: hosts SchedulerToWorker (reference:
+scheduler/runtime/rpc/worker_server.py).
+
+Callbacks: run_job(job_descriptions, worker_id, round_id),
+kill_job(job_id), reset(), shutdown().
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+
+import grpc
+
+from shockwave_tpu.runtime.protobuf import common_pb2
+from shockwave_tpu.runtime.rpc.wiring import add_servicer
+
+
+def _handlers(callbacks):
+    def RunJob(request, context):
+        jobs = [
+            {
+                "job_id": d.job_id,
+                "job_type": d.job_type,
+                "command": d.command,
+                "working_directory": d.working_directory,
+                "needs_data_dir": d.needs_data_dir,
+                "num_steps_arg": d.num_steps_arg,
+                "num_steps": d.num_steps,
+                "duration": d.duration if d.has_duration else None,
+            }
+            for d in request.job_descriptions
+        ]
+        callbacks["run_job"](jobs, request.worker_id, request.round_id)
+        return common_pb2.Empty()
+
+    def KillJob(request, context):
+        callbacks["kill_job"](request.job_id)
+        return common_pb2.Empty()
+
+    def Reset(request, context):
+        callbacks["reset"]()
+        return common_pb2.Empty()
+
+    def Shutdown(request, context):
+        callbacks["shutdown"]()
+        return common_pb2.Empty()
+
+    return {
+        "RunJob": RunJob,
+        "KillJob": KillJob,
+        "Reset": Reset,
+        "Shutdown": Shutdown,
+    }
+
+
+def serve(port: int, callbacks: dict, max_workers: int = 16) -> grpc.Server:
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    add_servicer(server, "SchedulerToWorker", _handlers(callbacks))
+    server.add_insecure_port(f"[::]:{port}")
+    server.start()
+    return server

@@ -1,0 +1,127 @@
+"""The worker agent: registers with the scheduler, serves SchedulerToWorker,
+and owns the dispatcher. Reference: scheduler/worker.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import shutil
+import socket
+import threading
+
+LOG = logging.getLogger("runtime.worker")
+
+
+class Worker:
+    def __init__(
+        self,
+        worker_type: str,
+        num_accelerators: int,
+        sched_addr: str,
+        sched_port: int,
+        port: int,
+        run_dir: str,
+        checkpoint_dir: str,
+        use_numactl: bool = False,
+    ):
+        from shockwave_tpu.runtime.dispatcher import Dispatcher
+        from shockwave_tpu.runtime.rpc import worker_server
+        from shockwave_tpu.runtime.rpc.worker_client import WorkerRpcClient
+
+        self._worker_type = worker_type
+        self._port = port
+        self._rpc_client = WorkerRpcClient(sched_addr, sched_port)
+
+        # Clear stale checkpoints from a previous incarnation
+        # (reference: worker.py:86-93).
+        if os.path.isdir(checkpoint_dir):
+            for entry in os.listdir(checkpoint_dir):
+                if entry.startswith("job_id="):
+                    shutil.rmtree(
+                        os.path.join(checkpoint_dir, entry), ignore_errors=True
+                    )
+
+        self._server = worker_server.serve(
+            port,
+            {
+                "run_job": self._run_job_callback,
+                "kill_job": self._kill_job_callback,
+                "reset": self._reset_callback,
+                "shutdown": self._shutdown_callback,
+            },
+        )
+
+        ip_addr = socket.gethostbyname(socket.gethostname())
+        worker_ids, round_duration, error = self._rpc_client.register_worker(
+            worker_type, num_accelerators, ip_addr, port
+        )
+        if error:
+            raise RuntimeError(f"Worker registration failed: {error}")
+        self._worker_ids = worker_ids
+        self._round_duration = round_duration
+        self._dispatcher = Dispatcher(
+            round_duration,
+            list(range(num_accelerators)),
+            self._rpc_client,
+            sched_addr,
+            sched_port,
+            run_dir,
+            checkpoint_dir,
+            use_numactl=use_numactl,
+        )
+        self._shutdown_event = threading.Event()
+        LOG.info(
+            "Worker registered: ids=%s round_duration=%s",
+            worker_ids,
+            round_duration,
+        )
+
+    # -- RPC callbacks --------------------------------------------------
+    def _run_job_callback(self, job_descriptions, worker_id, round_id):
+        self._dispatcher.dispatch_jobs(job_descriptions, worker_id, round_id)
+
+    def _kill_job_callback(self, job_id):
+        self._dispatcher.kill_job(job_id)
+
+    def _reset_callback(self):
+        self._dispatcher.reset()
+
+    def _shutdown_callback(self):
+        self._dispatcher.shutdown()
+        self._shutdown_event.set()
+
+    def join(self):
+        self._shutdown_event.wait()
+        self._server.stop(grace=2)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="shockwave_tpu worker agent")
+    parser.add_argument("-t", "--worker_type", type=str, required=True)
+    parser.add_argument("-n", "--num_accelerators", type=int, default=1)
+    parser.add_argument("-a", "--sched_addr", type=str, required=True)
+    parser.add_argument("-s", "--sched_port", type=int, default=50060)
+    parser.add_argument("-p", "--port", type=int, default=50061)
+    parser.add_argument("--run_dir", type=str, default="/tmp/shockwave_run")
+    parser.add_argument(
+        "--checkpoint_dir", type=str, default="/tmp/shockwave_ckpt"
+    )
+    parser.add_argument("--use_numactl", action="store_true")
+    args = parser.parse_args()
+    worker = Worker(
+        args.worker_type,
+        args.num_accelerators,
+        args.sched_addr,
+        args.sched_port,
+        args.port,
+        args.run_dir,
+        args.checkpoint_dir,
+        use_numactl=args.use_numactl,
+    )
+    worker.join()
+
+
+if __name__ == "__main__":
+    main()
