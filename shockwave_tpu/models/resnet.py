@@ -1,0 +1,83 @@
+"""ResNet-18/50 image classifiers (flax), CIFAR-10 shapes.
+
+Capability parity with the reference's image-classification workloads
+(reference: workloads/pytorch/image_classification/cifar10/main.py). Convs
+map directly onto the MXU; batch is sharded over "data".
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        residual = x
+        y = nn.Conv(self.features, (3, 3), (self.strides, self.strides),
+                    use_bias=False)(x)
+        y = nn.BatchNorm(use_running_average=not train)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), use_bias=False)(y)
+        y = nn.BatchNorm(use_running_average=not train)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.features, (1, 1), (self.strides, self.strides),
+                use_bias=False,
+            )(residual)
+            residual = nn.BatchNorm(use_running_average=not train)(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        residual = x
+        y = nn.Conv(self.features, (1, 1), use_bias=False)(x)
+        y = nn.BatchNorm(use_running_average=not train)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), (self.strides, self.strides),
+                    use_bias=False)(y)
+        y = nn.BatchNorm(use_running_average=not train)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features * 4, (1, 1), use_bias=False)(y)
+        y = nn.BatchNorm(use_running_average=not train)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.features * 4, (1, 1), (self.strides, self.strides),
+                use_bias=False,
+            )(residual)
+            residual = nn.BatchNorm(use_running_average=not train)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block: type
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        y = nn.Conv(64, (3, 3), use_bias=False)(x)
+        y = nn.BatchNorm(use_running_average=not train)(y)
+        y = nn.relu(y)
+        for i, size in enumerate(self.stage_sizes):
+            for j in range(size):
+                strides = 2 if i > 0 and j == 0 else 1
+                y = self.block(64 * 2**i, strides)(y, train=train)
+        y = jnp.mean(y, axis=(1, 2))
+        return nn.Dense(self.num_classes)(y)
+
+
+ResNet18 = partial(ResNet, stage_sizes=[2, 2, 2, 2], block=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block=Bottleneck)

@@ -1,0 +1,117 @@
+"""The remaining reference workload families, TPU-native and compact:
+
+  LM             — LSTM language model (reference: workloads/pytorch/
+                   language_modeling/main.py; wikitext-2 scale)
+  Recommendation — neural collaborative filtering MLP (reference:
+                   workloads/pytorch/recommendation/)
+  A3C            — actor-critic policy/value net (reference:
+                   workloads/pytorch/rl/)
+  CycleGAN       — resnet generator + patch discriminator (reference:
+                   workloads/pytorch/cyclegan/)
+
+Recurrence runs under nn.scan (compiler-friendly lax.scan, static
+shapes); losses are defined next to the models so the unified trainer
+(shockwave_tpu/models/train.py) treats every family identically.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class LSTMLanguageModel(nn.Module):
+    vocab_size: int = 10000
+    d_embed: int = 128
+    d_hidden: int = 256
+
+    @nn.compact
+    def __call__(self, tokens):
+        x = nn.Embed(self.vocab_size, self.d_embed)(tokens)
+        lstm = nn.RNN(nn.OptimizedLSTMCell(self.d_hidden), name="lstm")
+        y = lstm(x)
+        return nn.Dense(self.vocab_size)(y)
+
+
+class NeuMF(nn.Module):
+    """Neural collaborative filtering (GMF + MLP fusion)."""
+
+    num_users: int = 2048
+    num_items: int = 2048
+    d_factor: int = 32
+
+    @nn.compact
+    def __call__(self, user_item):
+        users, items = user_item[:, 0], user_item[:, 1]
+        gmf_u = nn.Embed(self.num_users, self.d_factor, name="gmf_user")(users)
+        gmf_i = nn.Embed(self.num_items, self.d_factor, name="gmf_item")(items)
+        mlp_u = nn.Embed(self.num_users, self.d_factor, name="mlp_user")(users)
+        mlp_i = nn.Embed(self.num_items, self.d_factor, name="mlp_item")(items)
+        mlp = jnp.concatenate([mlp_u, mlp_i], axis=-1)
+        for width in (64, 32, 16):
+            mlp = nn.relu(nn.Dense(width)(mlp))
+        fused = jnp.concatenate([gmf_u * gmf_i, mlp], axis=-1)
+        return nn.Dense(1)(fused)[:, 0]
+
+
+class ActorCritic(nn.Module):
+    """A3C network over image observations."""
+
+    num_actions: int = 6
+
+    @nn.compact
+    def __call__(self, obs):
+        y = nn.relu(nn.Conv(16, (8, 8), (4, 4))(obs))
+        y = nn.relu(nn.Conv(32, (4, 4), (2, 2))(y))
+        y = y.reshape((y.shape[0], -1))
+        y = nn.relu(nn.Dense(256)(y))
+        return nn.Dense(self.num_actions)(y), nn.Dense(1)(y)[:, 0]
+
+
+class CycleGANGenerator(nn.Module):
+    features: int = 32
+    num_res_blocks: int = 3
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.relu(nn.Conv(self.features, (7, 7))(x))
+        y = nn.relu(nn.Conv(self.features * 2, (3, 3), (2, 2))(y))
+        for _ in range(self.num_res_blocks):
+            r = nn.relu(nn.Conv(self.features * 2, (3, 3))(y))
+            r = nn.Conv(self.features * 2, (3, 3))(r)
+            y = y + r
+        y = nn.relu(nn.ConvTranspose(self.features, (3, 3), (2, 2))(y))
+        return nn.tanh(nn.Conv(x.shape[-1], (7, 7))(y))
+
+
+class CycleGANDiscriminator(nn.Module):
+    features: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.leaky_relu(nn.Conv(self.features, (4, 4), (2, 2))(x))
+        y = nn.leaky_relu(nn.Conv(self.features * 2, (4, 4), (2, 2))(y))
+        return nn.Conv(1, (4, 4))(y)
+
+
+# -- losses -------------------------------------------------------------
+def token_xent(logits, targets):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def a3c_loss(policy_logits, values, actions, returns):
+    """Policy-gradient surrogate + value loss + entropy bonus."""
+    advantages = returns - values
+    logp = jax.nn.log_softmax(policy_logits, axis=-1)
+    chosen = jnp.take_along_axis(logp, actions[:, None], axis=-1)[:, 0]
+    policy_loss = -jnp.mean(chosen * jax.lax.stop_gradient(advantages))
+    value_loss = jnp.mean(advantages**2)
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp) * logp, axis=-1))
+    return policy_loss + 0.5 * value_loss - 0.01 * entropy
+
+
+def lsgan_loss(real_scores, fake_scores):
+    return jnp.mean((real_scores - 1.0) ** 2) + jnp.mean(fake_scores**2)

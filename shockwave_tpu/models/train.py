@@ -1,0 +1,309 @@
+"""Unified training program for every workload family.
+
+This is the process the dispatcher launches:
+
+  python -m shockwave_tpu.models.train --model ResNet-18 --batch_size 32 \
+      -n <steps> --checkpoint_dir <dir> --enable_shockwave_iterator
+
+One code path serves all seven families (reference ships a separate
+PyTorch/TF program per family under workloads/). Synthetic data by
+default — the scheduler's concern is steps/second, not accuracy — with
+static shapes so each family compiles exactly once. Gang jobs receive
+``--distributed_addr/--num_workers/--worker_rank`` from the scheduler and
+initialize jax.distributed; the mesh factorizes the gang into
+(data, model, seq) per the transformer flags.
+
+Checkpoint/restore: full train state via flax.serialization, written on
+preemption (lease expiry) and completion.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_family(name, args, mesh):
+    """Returns (params, step_fn(params, opt_state, batch), opt_state,
+    batch_fn(rng) -> batch)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from shockwave_tpu.models import small_models as sm
+    from shockwave_tpu.models.resnet import ResNet18, ResNet50
+    from shockwave_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+        lm_loss,
+    )
+
+    rng = jax.random.PRNGKey(args.seed)
+    bs = args.batch_size
+    tx = optax.adamw(args.learning_rate)
+
+    if name in ("ResNet-18", "ResNet-50"):
+        model = (ResNet18 if name == "ResNet-18" else ResNet50)()
+        example = jnp.zeros((bs, 32, 32, 3), jnp.float32)
+        variables = model.init(rng, example, train=True)
+
+        def loss_fn(variables, batch):
+            images, labels = batch
+            logits, updates = model.apply(
+                variables, images, train=True, mutable=["batch_stats"]
+            )
+            loss = sm.token_xent(logits, labels)
+            return loss, updates
+
+        def batch_fn(np_rng):
+            return (
+                jnp.asarray(np_rng.normal(size=(bs, 32, 32, 3)), jnp.float32),
+                jnp.asarray(np_rng.integers(0, 10, bs)),
+            )
+
+        def step_fn(variables, opt_state, batch):
+            (loss, updates), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(variables, batch)
+            params_grads = grads["params"]
+            update, opt_state = tx.update(
+                params_grads, opt_state, variables["params"]
+            )
+            params = optax.apply_updates(variables["params"], update)
+            variables = {
+                "params": params,
+                "batch_stats": updates["batch_stats"],
+            }
+            return variables, opt_state, loss
+
+        opt_state = tx.init(variables["params"])
+        return variables, step_fn, opt_state, batch_fn
+
+    if name == "Transformer":
+        cfg = TransformerConfig(
+            vocab_size=args.vocab_size,
+            d_model=args.d_model,
+            num_heads=args.num_heads,
+            num_layers=args.num_layers,
+            d_ff=4 * args.d_model,
+            max_len=args.seq_len,
+            attention=args.attention,
+            num_experts=args.num_experts,
+        )
+        model = TransformerLM(cfg, mesh=mesh)
+        example = jnp.zeros((bs, args.seq_len), jnp.int32)
+        variables = model.init(rng, example)
+
+        def loss_fn(variables, batch):
+            return lm_loss(model, variables, batch)
+
+        def batch_fn(np_rng):
+            return jnp.asarray(
+                np_rng.integers(0, cfg.vocab_size, (bs, args.seq_len + 1))
+            )
+
+    elif name == "LM":
+        model = sm.LSTMLanguageModel()
+        example = jnp.zeros((bs, args.seq_len), jnp.int32)
+        variables = model.init(rng, example)
+
+        def loss_fn(variables, batch):
+            logits = model.apply(variables, batch[:, :-1])
+            return sm.token_xent(logits, batch[:, 1:])
+
+        def batch_fn(np_rng):
+            return jnp.asarray(
+                np_rng.integers(0, 10000, (bs, args.seq_len + 1))
+            )
+
+    elif name == "Recommendation":
+        model = sm.NeuMF()
+        example = jnp.zeros((bs, 2), jnp.int32)
+        variables = model.init(rng, example)
+
+        def loss_fn(variables, batch):
+            pairs, labels = batch
+            scores = model.apply(variables, pairs)
+            return jnp.mean(optax.sigmoid_binary_cross_entropy(scores, labels))
+
+        def batch_fn(np_rng):
+            return (
+                jnp.asarray(np_rng.integers(0, 2048, (bs, 2))),
+                jnp.asarray(np_rng.integers(0, 2, bs), jnp.float32),
+            )
+
+    elif name == "A3C":
+        model = sm.ActorCritic()
+        example = jnp.zeros((bs, 84, 84, 4), jnp.float32)
+        variables = model.init(rng, example)
+
+        def loss_fn(variables, batch):
+            obs, actions, returns = batch
+            logits, values = model.apply(variables, obs)
+            return sm.a3c_loss(logits, values, actions, returns)
+
+        def batch_fn(np_rng):
+            return (
+                jnp.asarray(np_rng.normal(size=(bs, 84, 84, 4)), jnp.float32),
+                jnp.asarray(np_rng.integers(0, 6, bs)),
+                jnp.asarray(np_rng.normal(size=bs), jnp.float32),
+            )
+
+    elif name == "CycleGAN":
+        gen = sm.CycleGANGenerator()
+        disc = sm.CycleGANDiscriminator()
+        rng_g, rng_d = jax.random.split(rng)
+        example = jnp.zeros((bs, 64, 64, 3), jnp.float32)
+        variables = {
+            "gen": gen.init(rng_g, example),
+            "disc": disc.init(rng_d, example),
+        }
+
+        def loss_fn(variables, batch):
+            real_a, real_b = batch
+            fake_b = gen.apply(variables["gen"], real_a)
+            # Generator: fool the discriminator + cycle-style identity.
+            fake_scores = disc.apply(variables["disc"], fake_b)
+            gen_loss = jnp.mean((fake_scores - 1.0) ** 2) + jnp.mean(
+                jnp.abs(fake_b - real_b)
+            )
+            # Discriminator: reject fakes (gradient must NOT flow back
+            # into the generator, so stop on the IMAGE, not the score).
+            real_scores = disc.apply(variables["disc"], real_b)
+            fake_scores_d = disc.apply(
+                variables["disc"], jax.lax.stop_gradient(fake_b)
+            )
+            disc_loss = sm.lsgan_loss(real_scores, fake_scores_d)
+            return gen_loss + disc_loss
+
+        def batch_fn(np_rng):
+            return (
+                jnp.asarray(np_rng.normal(size=(bs, 64, 64, 3)), jnp.float32),
+                jnp.asarray(np_rng.normal(size=(bs, 64, 64, 3)), jnp.float32),
+            )
+
+    else:
+        raise ValueError(f"Unknown model family {name!r}")
+
+    def step_fn(variables, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(variables, batch)
+        update, opt_state = tx.update(grads, opt_state, variables)
+        variables = optax.apply_updates(variables, update)
+        return variables, opt_state, loss
+
+    opt_state = tx.init(variables)
+    return variables, step_fn, opt_state, batch_fn
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model", type=str, required=True)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("-n", "--num_steps", type=int, required=True)
+    parser.add_argument("--checkpoint_dir", type=str, default=None)
+    parser.add_argument("--enable_shockwave_iterator", action="store_true")
+    parser.add_argument("--learning_rate", type=float, default=1e-3)
+    parser.add_argument("--seed", type=int, default=0)
+    # Transformer knobs.
+    parser.add_argument("--vocab_size", type=int, default=1024)
+    parser.add_argument("--d_model", type=int, default=128)
+    parser.add_argument("--num_heads", type=int, default=4)
+    parser.add_argument("--num_layers", type=int, default=2)
+    parser.add_argument("--seq_len", type=int, default=128)
+    parser.add_argument("--attention", type=str, default="dense",
+                        choices=["dense", "ring"])
+    parser.add_argument("--num_experts", type=int, default=0)
+    parser.add_argument("--model_parallel", type=int, default=1)
+    parser.add_argument("--seq_parallel", type=int, default=1)
+    # Gang rendezvous (appended by the scheduler).
+    parser.add_argument("--distributed_addr", type=str, default=None)
+    parser.add_argument("--num_workers", type=int, default=1)
+    parser.add_argument("--worker_rank", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    import jax
+
+    if args.distributed_addr and args.num_workers > 1:
+        jax.distributed.initialize(
+            coordinator_address=args.distributed_addr,
+            num_processes=args.num_workers,
+            process_id=args.worker_rank,
+        )
+
+    from shockwave_tpu.parallel.mesh import factorize_gang, make_mesh
+
+    shape = factorize_gang(
+        len(jax.devices()), args.seq_parallel, args.model_parallel
+    )
+    mesh = make_mesh(shape)
+
+    variables, step_fn, opt_state, batch_fn = build_family(
+        args.model, args, mesh
+    )
+
+    # Restore from a previous round's checkpoint.
+    from flax import serialization
+
+    ckpt_path = (
+        os.path.join(args.checkpoint_dir, "train_state.msgpack")
+        if args.checkpoint_dir
+        else None
+    )
+    if ckpt_path and os.path.exists(ckpt_path):
+        with open(ckpt_path, "rb") as f:
+            variables, opt_state = serialization.from_bytes(
+                (variables, opt_state), f.read()
+            )
+
+    def save_checkpoint():
+        if not ckpt_path:
+            return
+        with open(ckpt_path, "wb") as f:
+            f.write(serialization.to_bytes((variables, opt_state)))
+
+    jit_step = jax.jit(step_fn, donate_argnums=(0, 1))
+    np_rng = np.random.default_rng(args.seed)
+
+    class Batches:
+        def __iter__(self):
+            while True:
+                yield batch_fn(np_rng)
+
+    use_iterator = args.enable_shockwave_iterator and "SHOCKWAVE_JOB_ID" in os.environ
+    if use_iterator:
+        from shockwave_tpu.runtime.iterator import ShockwaveIterator
+
+        loader = ShockwaveIterator(
+            Batches(), args.checkpoint_dir or "/tmp",
+            save_checkpoint_func=save_checkpoint,
+        )
+    else:
+        loader = Batches()
+
+    steps = 0
+    start = time.time()
+    loss = None
+    for batch in loader:
+        variables, opt_state, loss = jit_step(variables, opt_state, batch)
+        steps += 1
+        if steps >= args.num_steps:
+            if use_iterator:
+                loader.complete()
+            break
+    if loss is not None:
+        loss.block_until_ready()
+    elapsed = time.time() - start
+    save_checkpoint()
+    loss_str = f"{float(loss):.4f}" if loss is not None else "n/a"
+    print(
+        f"[{args.model}] steps={steps} loss={loss_str} "
+        f"throughput={steps / max(elapsed, 1e-9):.2f} steps/s"
+    )
+
+
+if __name__ == "__main__":
+    main()

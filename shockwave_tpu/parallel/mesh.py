@@ -1,0 +1,60 @@
+"""Device-mesh construction and sharding helpers.
+
+The framework's standard mesh axes:
+  "data"  — batch (data parallelism; gradients psum over it)
+  "model" — tensor parallelism (attention heads / MLP hidden / experts)
+  "seq"   — sequence/context parallelism (ring attention shards)
+
+Jobs pick a (data, model, seq) factorization of their gang; single-chip
+jobs use a trivial 1x1x1 mesh. All collectives are emitted by XLA from
+sharding annotations — nothing here issues them by hand except ring
+attention's ppermute (shockwave_tpu/parallel/ring_attention.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXES = ("data", "model", "seq")
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, int, int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Mesh over (data, model, seq). Default: all devices on "data"."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if shape is None:
+        shape = (n, 1, 1)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    return Mesh(np.asarray(devices).reshape(shape), AXES)
+
+
+def spec(*names) -> PartitionSpec:
+    return PartitionSpec(*names)
+
+
+def shard(mesh: Mesh, *names) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*names))
+
+
+def batch_spec() -> PartitionSpec:
+    """Activations: batch over data, sequence over seq."""
+    return PartitionSpec("data", "seq")
+
+
+def factorize_gang(num_devices: int, seq_parallel: int = 1, model_parallel: int = 1):
+    """(data, model, seq) shape for a gang of ``num_devices``."""
+    if num_devices % (seq_parallel * model_parallel) != 0:
+        raise ValueError(
+            f"{num_devices} devices not divisible by model={model_parallel} "
+            f"x seq={seq_parallel}"
+        )
+    return (num_devices // (seq_parallel * model_parallel), model_parallel, seq_parallel)

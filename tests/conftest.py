@@ -6,11 +6,24 @@ exercised without TPU hardware. Must be set before JAX is imported.
 
 import os
 
-# Force-set: the login profile exports JAX_PLATFORMS=axon (the TPU tunnel),
-# which would silently pin tests to the single real chip.
+# The login profile exports JAX_PLATFORMS=axon (the TPU tunnel) and the
+# axon plugin overrides the env var during jax init, so the only reliable
+# override is jax.config BEFORE the backend initializes. XLA_FLAGS must be
+# in the environment before the import.
+import re
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Force exactly 8 virtual devices, replacing any pre-set count (tests
+# assume the 2x2x2 mesh fits).
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()}"
+)

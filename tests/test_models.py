@@ -1,0 +1,129 @@
+"""Workload-family tests: every family trains a few steps with finite,
+decreasing loss on CPU, and checkpoints roundtrip."""
+
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shockwave_tpu.models.train import build_family, main as train_main
+from shockwave_tpu.parallel.mesh import make_mesh
+
+FAMILIES = [
+    "ResNet-18",
+    "Transformer",
+    "LM",
+    "Recommendation",
+    "A3C",
+    "CycleGAN",
+]
+
+
+def tiny_args(model, **overrides):
+    import argparse
+
+    defaults = dict(
+        model=model,
+        batch_size=4,
+        num_steps=3,
+        checkpoint_dir=None,
+        enable_shockwave_iterator=False,
+        learning_rate=1e-3,
+        seed=0,
+        vocab_size=64,
+        d_model=32,
+        num_heads=2,
+        num_layers=1,
+        seq_len=16,
+        attention="dense",
+        num_experts=0,
+        model_parallel=1,
+        seq_parallel=1,
+        distributed_addr=None,
+        num_workers=1,
+        worker_rank=0,
+    )
+    defaults.update(overrides)
+    return argparse.Namespace(**defaults)
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_family_train_steps_reduce_loss(family):
+    mesh = make_mesh((1, 1, 1), devices=jax.devices()[:1])
+    args = tiny_args(family)
+    variables, step_fn, opt_state, batch_fn = build_family(family, args, mesh)
+    rng = np.random.default_rng(0)
+    step = jax.jit(step_fn)
+    losses = []
+    batch = batch_fn(rng)  # same batch: loss must drop when overfitting it
+    for _ in range(8):
+        variables, opt_state, loss = step(variables, opt_state, batch)
+        losses.append(float(loss))
+    assert np.all(np.isfinite(losses))
+    if family != "A3C":
+        # The A3C surrogate (policy gradient + entropy bonus) is not a
+        # monotone-descent objective; finiteness is the contract there.
+        assert losses[-1] < losses[0]
+
+
+def test_transformer_ring_attention_tp_mesh():
+    # dp=2 x tp=2 x sp=2 mesh with ring attention + MoE experts.
+    mesh = make_mesh((2, 2, 2))
+    args = tiny_args(
+        "Transformer", attention="ring", num_experts=2, seq_len=16
+    )
+    variables, step_fn, opt_state, batch_fn = build_family(
+        "Transformer", args, mesh
+    )
+    rng = np.random.default_rng(0)
+    with mesh:
+        step = jax.jit(step_fn)
+        batch = batch_fn(rng)
+        variables, opt_state, loss = step(variables, opt_state, batch)
+    assert np.isfinite(float(loss))
+
+
+def test_train_cli_end_to_end(tmp_path):
+    # The exact process shape the dispatcher launches.
+    result = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "shockwave_tpu.models.train",
+            "--model",
+            "Recommendation",
+            "--batch_size",
+            "8",
+            "-n",
+            "3",
+            "--checkpoint_dir",
+            str(tmp_path),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=180,
+        env={
+            **__import__("os").environ,
+            "JAX_PLATFORMS": "cpu",
+        },
+    )
+    assert result.returncode == 0, result.stderr
+    assert "steps=3" in result.stdout
+    assert (tmp_path / "train_state.msgpack").exists()
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    mesh = make_mesh((1, 1, 1), devices=jax.devices()[:1])
+    args = tiny_args("LM", checkpoint_dir=str(tmp_path))
+    variables, step_fn, opt_state, batch_fn = build_family("LM", args, mesh)
+    from flax import serialization
+
+    blob = serialization.to_bytes((variables, opt_state))
+    variables2, opt_state2 = serialization.from_bytes(
+        (variables, opt_state), blob
+    )
+    chex = pytest.importorskip("chex")
+    chex.assert_trees_all_close(variables, variables2)
