@@ -63,6 +63,7 @@ class Scheduler:
         max_rounds: Optional[int] = None,
         minimum_time_between_allocation_resets: float = 1920.0,
         enable_global_queue: bool = False,
+        per_worker_type_prices: Optional[Dict[str, float]] = None,
         log_level=None,
     ):
         self._policy = policy
@@ -73,6 +74,9 @@ class Scheduler:
         self._max_rounds = max_rounds
         self._min_reset_interval = minimum_time_between_allocation_resets
         self._enable_global_queue = enable_global_queue
+        # $/accelerator-hour per worker type; None disables cost accounting
+        # (reference: scheduler.py:294-308, 3399-3411).
+        self._per_worker_type_prices = per_worker_type_prices
 
         self._current_timestamp: float = 0.0
         self._num_completed_rounds = 0
@@ -830,6 +834,13 @@ class Scheduler:
             ):
                 if not is_active[single]:
                     continue
+                if self._per_worker_type_prices is not None:
+                    self._job_cost_so_far[single] += (
+                        self._per_worker_type_prices.get(worker_type, 0.0)
+                        * execution_time
+                        / 3600.0
+                        * scale_factor
+                    )
                 if single in self._running_jobs:
                     self._running_jobs.remove(single)
                     self._steps_run_so_far[single][worker_type] += num_steps
@@ -1157,6 +1168,70 @@ class Scheduler:
             self._current_timestamp / 3600.0,
         )
         return self._current_timestamp
+
+    # ------------------------------------------------------------------
+    # Simulator checkpointing (fast-forward for long continuous sweeps;
+    # reference: scheduler.py:1214-1294, trigger :1759-1775).
+    # ------------------------------------------------------------------
+    _CHECKPOINT_FIELDS = [
+        "_current_timestamp",
+        "_num_completed_rounds",
+        "_job_id_counter",
+        "_jobs",
+        "_completed_jobs",
+        "_steps_run_so_far",
+        "_total_steps_run",
+        "_job_time_so_far",
+        "_job_cost_so_far",
+        "_throughputs",
+        "_original_bs",
+        "_bs_scale",
+        "_job_id_to_job_type",
+        "_job_type_to_job_ids",
+        "_num_failures_per_job",
+        "_per_job_start_timestamps",
+        "_per_job_latest_timestamps",
+        "_job_completion_times",
+        "_job_priority_weights",
+        "_num_jobs_in_trace",
+        "_allocation",
+        "_priorities",
+        "_deficits",
+        "_last_reset_time",
+        "_worker_time_so_far",
+        "_cumulative_worker_time_so_far",
+        "_num_lease_extensions",
+        "_num_lease_extension_opportunities",
+    ]
+
+    def save_checkpoint(self, path: str) -> None:
+        import pickle
+
+        state = {f: getattr(self, f) for f in self._CHECKPOINT_FIELDS}
+        with open(path, "wb") as f:
+            pickle.dump(state, f)
+
+    def load_checkpoint(self, path: str) -> None:
+        import pickle
+
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        for field, value in state.items():
+            setattr(self, field, value)
+
+    def save_job_timelines(self, directory: str) -> None:
+        """One per-job file of structured iterator log excerpts
+        (reference: scheduler.py:2267-2284)."""
+        import os
+
+        os.makedirs(directory, exist_ok=True)
+        for job_id, timelines in self._job_timelines.items():
+            with open(
+                os.path.join(directory, f"job_{job_id.integer}.log"), "w"
+            ) as f:
+                for rank, lines in enumerate(timelines):
+                    for line in lines:
+                        f.write(f"[rank {rank}] {line}\n")
 
     # ------------------------------------------------------------------
     # Metrics.

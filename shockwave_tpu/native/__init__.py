@@ -1,0 +1,133 @@
+"""Native (C++) host-side solver kernels, bound via ctypes.
+
+Built lazily with the system compiler on first use and cached next to the
+sources; no build-time dependency beyond g++ (cc fallback). If no
+compiler is available, callers fall back to the JAX/numpy paths —
+``available()`` reports which.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "eg_greedy.cpp")
+_LIB_PATH = os.path.join(_HERE, "_eg_greedy.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _build_failed
+    for compiler in ("g++", "c++"):
+        try:
+            subprocess.run(
+                [
+                    compiler,
+                    "-O2",
+                    "-shared",
+                    "-fPIC",
+                    "-std=c++17",
+                    _SRC,
+                    "-o",
+                    _LIB_PATH,
+                ],
+                check=True,
+                capture_output=True,
+            )
+            return ctypes.CDLL(_LIB_PATH)
+        except (subprocess.CalledProcessError, FileNotFoundError, OSError):
+            continue
+    _build_failed = True
+    return None
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if os.path.exists(_LIB_PATH) and os.path.getmtime(
+            _LIB_PATH
+        ) >= os.path.getmtime(_SRC):
+            try:
+                _lib = ctypes.CDLL(_LIB_PATH)
+            except OSError:
+                _lib = None
+        if _lib is None:
+            _lib = _build()
+        if _lib is not None:
+            _configure(_lib)
+    return _lib
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    d = ctypes.POINTER(ctypes.c_double)
+    lib.eg_greedy_solve.restype = None
+    lib.eg_greedy_solve.argtypes = [
+        ctypes.c_int,  # num_jobs
+        ctypes.c_int,  # future_rounds
+        d, d, d, d, d, d,  # priorities..nworkers
+        ctypes.c_double,  # num_gpus
+        d, d,  # log_bases, log_vals
+        ctypes.c_int,  # num_bases
+        ctypes.c_double,  # round_duration
+        ctypes.c_double,  # regularizer
+        ctypes.POINTER(ctypes.c_int8),  # Y out
+    ]
+
+
+def available() -> bool:
+    return _get_lib() is not None
+
+
+def solve_eg_greedy_native(problem) -> np.ndarray:
+    """Boolean schedule Y ([J, R]) via the C++ greedy; same semantics as
+    shockwave_tpu.solver.eg_jax.solve_eg_greedy."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("no C++ compiler available for the native solver")
+    J, R = problem.num_jobs, int(problem.future_rounds)
+
+    def arr(x):
+        a = np.ascontiguousarray(np.asarray(x, dtype=np.float64))
+        return a, a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+    keep = []  # keep numpy buffers alive through the call
+    ptrs = []
+    for field in (
+        problem.priorities,
+        problem.completed_epochs,
+        problem.total_epochs,
+        problem.epoch_duration,
+        problem.remaining_runtime,
+        problem.nworkers,
+    ):
+        a, p = arr(field)
+        keep.append(a)
+        ptrs.append(p)
+    bases, bases_p = arr(problem.log_bases)
+    vals, vals_p = arr(problem.log_base_values())
+    Y = np.zeros((J, R), dtype=np.int8)
+    lib.eg_greedy_solve(
+        J,
+        R,
+        *ptrs,
+        float(problem.num_gpus),
+        bases_p,
+        vals_p,
+        len(bases),
+        float(problem.round_duration),
+        float(problem.regularizer),
+        Y.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+    )
+    return Y.astype(np.int64)

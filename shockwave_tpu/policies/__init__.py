@@ -139,6 +139,10 @@ def get_policy(
         from shockwave_tpu.policies.shockwave import ShockwavePolicy
 
         return ShockwavePolicy(backend="tpu")
+    if policy_name == "shockwave_native":
+        from shockwave_tpu.policies.shockwave import ShockwavePolicy
+
+        return ShockwavePolicy(backend="native")
     raise ValueError(f"Unknown policy: {policy_name!r}")
 
 
@@ -170,6 +174,7 @@ _ALL_POLICY_NAMES = [
     "min_total_duration_packed",
     "shockwave",
     "shockwave_tpu",
+    "shockwave_native",
 ]
 
 _POLICY_MODULES = {

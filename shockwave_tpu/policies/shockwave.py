@@ -218,10 +218,16 @@ class ShockwavePlanner:
                 rel_gap=self.solver_rel_gap,
                 time_limit=self.solver_timeout,
             )
-        from shockwave_tpu.solver.eg_jax import solve_eg_greedy
         from shockwave_tpu.solver.rounding import reorder_columns
 
-        Y = solve_eg_greedy(problem)
+        if self.backend == "native":
+            from shockwave_tpu.native import solve_eg_greedy_native
+
+            Y = solve_eg_greedy_native(problem)
+        else:
+            from shockwave_tpu.solver.eg_jax import solve_eg_greedy
+
+            Y = solve_eg_greedy(problem)
         return reorder_columns(Y, problem.priorities)
 
     def _replan(self) -> None:
@@ -269,7 +275,10 @@ class ShockwavePolicy(Policy):
     def __init__(self, backend: str = "tpu"):
         super().__init__()
         self.backend = backend
-        self.name = "Shockwave" if backend == "reference" else "Shockwave_TPU"
+        self.name = {
+            "reference": "Shockwave",
+            "native": "Shockwave_Native",
+        }.get(backend, "Shockwave_TPU")
 
     def make_planner(self, config: dict) -> ShockwavePlanner:
         return ShockwavePlanner(config, backend=self.backend)

@@ -1,0 +1,49 @@
+"""The C++ greedy must agree with the JAX greedy (same semantics, host
+build via ctypes) on random instances, and plug into the planner."""
+
+import numpy as np
+import pytest
+
+from shockwave_tpu import native
+from tests.test_shockwave_solver import random_problem
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ compiler"
+)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_matches_jax_greedy_quality(seed):
+    from shockwave_tpu.solver.eg_jax import solve_eg_greedy
+
+    rng = np.random.default_rng(seed)
+    problem = random_problem(rng, J=8, R=5, num_gpus=4)
+    Y_native = native.solve_eg_greedy_native(problem)
+    Y_jax = solve_eg_greedy(problem)
+    # Feasibility is identical by construction; objectives must agree up
+    # to float32-vs-double tie-breaks.
+    assert np.all(problem.nworkers @ Y_native <= problem.num_gpus + 1e-9)
+    obj_native = problem.objective_value(Y_native)
+    obj_jax = problem.objective_value(Y_jax)
+    assert obj_native >= obj_jax - 0.02 * max(1.0, abs(obj_jax))
+
+
+def test_large_instance_runs_fast():
+    import time
+
+    rng = np.random.default_rng(0)
+    problem = random_problem(rng, J=200, R=20, num_gpus=64)
+    start = time.time()
+    Y = native.solve_eg_greedy_native(problem)
+    elapsed = time.time() - start
+    assert np.all(problem.nworkers @ Y <= problem.num_gpus + 1e-9)
+    assert elapsed < 5.0
+
+
+def test_planner_native_backend_end_to_end():
+    from tests.test_shockwave_e2e import make_jobs, run_shockwave
+
+    jobs, arrivals = make_jobs(num_jobs=4, epochs=2)
+    sched, makespan = run_shockwave("native", jobs, arrivals)
+    assert len(sched._job_completion_times) == len(jobs)
+    assert makespan > 0

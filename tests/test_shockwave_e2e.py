@@ -36,7 +36,11 @@ def make_jobs(num_jobs=5, epochs=2, arrival_gap=60.0):
 def run_shockwave(backend, jobs, arrivals, num_gpus=2, future_rounds=6):
     oracle = generate_oracle()
     profiles = synthesize_profiles(jobs, oracle)
-    policy = get_policy("shockwave" if backend == "reference" else "shockwave_tpu")
+    policy_name = {
+        "reference": "shockwave",
+        "native": "shockwave_native",
+    }.get(backend, "shockwave_tpu")
+    policy = get_policy(policy_name)
     config = {
         "num_gpus": num_gpus,
         "time_per_iteration": 120,
