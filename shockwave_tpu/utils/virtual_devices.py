@@ -1,0 +1,37 @@
+"""Force a virtual multi-device CPU backend for sharding tests/dry runs.
+
+The bench/test hosts expose a single TPU chip (platform "axon", whose
+plugin overrides JAX_PLATFORMS during init), so multi-chip sharding logic
+is exercised on N virtual CPU devices instead. The only reliable recipe:
+set XLA_FLAGS and JAX_PLATFORMS in the environment BEFORE the JAX backend
+initializes, then additionally pin jax.config to "cpu" after import.
+
+This module must stay import-safe without jax (it is imported before jax
+in tests/conftest.py).
+"""
+
+import os
+import re
+
+
+def force_cpu_device_env(n_devices: int, env=None) -> dict:
+    """Mutate ``env`` (default os.environ) to request n virtual CPU devices.
+
+    Replaces any pre-set --xla_force_host_platform_device_count. Callers
+    must do this before the first jax import in the target process, and
+    should also run ``jax.config.update("jax_platforms", "cpu")`` right
+    after importing jax (the axon plugin can override the env var alone).
+    Returns the env mapping for chaining.
+    """
+    if env is None:
+        env = os.environ
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        env.get("XLA_FLAGS", ""),
+    )
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    return env

@@ -1,25 +1,14 @@
 """Test configuration.
 
 Tests run on CPU with 8 virtual devices so multi-chip sharding logic is
-exercised without TPU hardware. Must be set before JAX is imported.
+exercised without TPU hardware. Must be set before JAX is imported; the
+shared recipe lives in shockwave_tpu.utils.virtual_devices (also used by
+__graft_entry__.dryrun_multichip's self-provisioning re-exec).
 """
 
-import os
+from shockwave_tpu.utils.virtual_devices import force_cpu_device_env
 
-# The login profile exports JAX_PLATFORMS=axon (the TPU tunnel) and the
-# axon plugin overrides the env var during jax init, so the only reliable
-# override is jax.config BEFORE the backend initializes. XLA_FLAGS must be
-# in the environment before the import.
-import re
-
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-# Force exactly 8 virtual devices, replacing any pre-set count (tests
-# assume the 2x2x2 mesh fits).
-flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
-os.environ["XLA_FLAGS"] = (
-    flags + " --xla_force_host_platform_device_count=8"
-).strip()
+force_cpu_device_env(8)
 
 import jax  # noqa: E402
 
