@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""Synthetic-trace generator CLI.
+
+Equivalent of the reference's scripts/utils/generate_trace.py, extended with
+the Shockwave dynamic-trace style (accordion/gns modes, 60/30/9/1 scale
+factors, log-uniform durations). Examples:
+
+  # Gavel-style static trace, Poisson arrivals with mean 600 s:
+  python scripts/generate_trace.py -n 50 --lam 600 --style gavel -o out.trace
+
+  # Shockwave-style dynamic multi-GPU trace (the 120-job class):
+  python scripts/generate_trace.py -n 120 --lam 55 --style shockwave -o out.trace
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from shockwave_tpu.data import read_throughputs
+from shockwave_tpu.data.default_oracle import generate_oracle
+from shockwave_tpu.data.generate import (
+    DYNAMIC_MODE_DIST,
+    GAVEL_SCALE_FACTOR_DIST,
+    SHOCKWAVE_SCALE_FACTOR_DIST,
+    STATIC_MODE_DIST,
+    generate_trace_file,
+)
+
+
+def main(args):
+    if args.throughputs_file:
+        throughputs = read_throughputs(args.throughputs_file)
+    else:
+        throughputs = generate_oracle()
+
+    if args.style == "gavel":
+        kwargs = dict(
+            scale_factor_dist=GAVEL_SCALE_FACTOR_DIST,
+            mode_dist=STATIC_MODE_DIST,
+            duration_hours=list(
+                np.linspace(
+                    args.min_duration_hours,
+                    args.max_duration_hours,
+                    args.num_durations,
+                )
+            ),
+        )
+    else:
+        kwargs = dict(
+            scale_factor_dist=SHOCKWAVE_SCALE_FACTOR_DIST,
+            mode_dist=DYNAMIC_MODE_DIST,
+            min_duration_s=args.min_duration_s,
+            max_duration_s=args.max_duration_s,
+        )
+
+    jobs, arrivals = generate_trace_file(
+        args.output_file,
+        args.num_jobs,
+        throughputs,
+        seed=args.seed,
+        lam=args.lam,
+        **kwargs,
+    )
+    print(
+        f"Wrote {args.output_file}: {len(jobs)} jobs, "
+        f"last arrival {arrivals[-1]:.0f} s, "
+        f"scale factors {sorted({j.scale_factor for j in jobs})}, "
+        f"modes {sorted({j.mode for j in jobs})}"
+    )
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="Generate a synthetic trace")
+    parser.add_argument("-n", "--num_jobs", type=int, required=True)
+    parser.add_argument("-o", "--output_file", type=str, required=True)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument(
+        "--lam",
+        type=float,
+        default=0.0,
+        help="Mean interarrival time in seconds (0 = all jobs at t=0)",
+    )
+    parser.add_argument(
+        "--style", choices=["gavel", "shockwave"], default="shockwave"
+    )
+    parser.add_argument("--throughputs_file", type=str, default=None)
+    # gavel style: durations in whole hours from a linspace grid
+    parser.add_argument("--min_duration_hours", type=float, default=1.0)
+    parser.add_argument("--max_duration_hours", type=float, default=10.0)
+    parser.add_argument("--num_durations", type=int, default=10)
+    # shockwave style: log-uniform seconds
+    parser.add_argument("--min_duration_s", type=float, default=1200.0)
+    parser.add_argument("--max_duration_s", type=float, default=14400.0)
+    main(parser.parse_args())

@@ -202,3 +202,54 @@ def test_profiles_for_full_reference_trace():
     for p in profiles.values():
         assert p["num_epochs"] >= 1
         assert p["duration"] > 0
+
+
+def test_generate_trace_jobs_deterministic_and_parseable(tmp_path):
+    from shockwave_tpu.data.generate import (
+        DYNAMIC_MODE_DIST,
+        SHOCKWAVE_SCALE_FACTOR_DIST,
+        generate_trace_file,
+        generate_trace_jobs,
+    )
+
+    oracle = generate_oracle()
+    kwargs = dict(
+        scale_factor_dist=SHOCKWAVE_SCALE_FACTOR_DIST,
+        mode_dist=DYNAMIC_MODE_DIST,
+    )
+    jobs_a, arr_a = generate_trace_jobs(40, oracle, seed=3, lam=100, **kwargs)
+    jobs_b, arr_b = generate_trace_jobs(40, oracle, seed=3, lam=100, **kwargs)
+    assert arr_a == arr_b
+    assert [j.job_type for j in jobs_a] == [j.job_type for j in jobs_b]
+    assert [j.total_steps for j in jobs_a] == [j.total_steps for j in jobs_b]
+
+    # Poisson arrivals: start at zero, nondecreasing.
+    assert arr_a[0] == 0
+    assert all(b >= a for a, b in zip(arr_a, arr_a[1:]))
+    # Dynamic style: no static jobs, scale factors from the 60/30/9/1 support.
+    assert all(j.mode in ("accordion", "gns") for j in jobs_a)
+    assert all(j.scale_factor in (1, 2, 4, 8) for j in jobs_a)
+    # Steps follow duration x oracle throughput.
+    for job in jobs_a:
+        tput = oracle["v100"][(job.job_type, job.scale_factor)]["null"]
+        assert job.total_steps == max(1, int(job.duration * tput))
+
+    # Round-trips through the 12-field trace format.
+    path = str(tmp_path / "gen.trace")
+    generate_trace_file(path, 15, oracle, seed=9, lam=50, **kwargs)
+    parsed, arrivals = parse_trace(path)
+    assert len(parsed) == 15 and len(arrivals) == 15
+    profiles = synthesize_profiles(parsed, oracle)
+    assert all(p["num_epochs"] >= 1 for p in profiles.values())
+
+
+def test_committed_traces_parse_standalone():
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    committed = sorted(glob.glob(os.path.join(repo_root, "traces", "*.trace")))
+    assert len(committed) >= 2, "repo must ship standalone traces"
+    oracle = generate_oracle()
+    for trace in committed:
+        jobs, arrivals = parse_trace(trace)
+        assert len(jobs) == len(arrivals) > 0
+        profiles = synthesize_profiles(jobs, oracle)
+        assert len(profiles) == len(jobs)
