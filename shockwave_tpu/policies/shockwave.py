@@ -218,7 +218,7 @@ class ShockwavePlanner:
                 rel_gap=self.solver_rel_gap,
                 time_limit=self.solver_timeout,
             )
-        from shockwave_tpu.solver.rounding import reorder_columns
+        from shockwave_tpu.solver.rounding import reorder_rounds
 
         if self.backend == "native":
             from shockwave_tpu.native import solve_eg_greedy_native
@@ -228,7 +228,9 @@ class ShockwavePlanner:
             from shockwave_tpu.solver.eg_jax import solve_eg_greedy
 
             Y = solve_eg_greedy(problem)
-        return reorder_columns(Y, problem.priorities)
+        return reorder_rounds(
+            Y, problem.priorities, problem.nworkers, problem.num_gpus
+        )
 
     def _replan(self) -> None:
         # Past rounds are never read again; keep the cache bounded.

@@ -104,12 +104,11 @@ def order_schedule(
 def reorder_columns(Y: np.ndarray, priorities: np.ndarray) -> np.ndarray:
     """Permute the window's rounds so unfair jobs run earliest.
 
-    The counterpart of the reference's second MILP (reference:
-    shockwave.py:281-328): minimize sum_j priority_j * mean-round-index_j.
-    Restricted to column permutations — which preserve per-round
-    feasibility and per-job counts by construction — the optimum is exact
-    by the rearrangement inequality: sort columns by their total priority
-    weight, heaviest first.
+    Weak form of the reordering program, kept as the fallback when the
+    re-placement in :func:`reorder_rounds` can't fit a job: column
+    permutations preserve per-round feasibility and per-job counts by
+    construction, and among them sorting columns by total priority weight
+    (heaviest first) is exact by the rearrangement inequality.
     """
     Y = np.asarray(Y)
     counts = Y.sum(axis=1)
@@ -118,6 +117,72 @@ def reorder_columns(Y: np.ndarray, priorities: np.ndarray) -> np.ndarray:
     column_weight = weight @ Y
     perm = np.argsort(-column_weight, kind="stable")
     return Y[:, perm]
+
+
+def reorder_rounds(
+    Y: np.ndarray,
+    priorities: np.ndarray,
+    nworkers: np.ndarray,
+    num_gpus: int,
+) -> np.ndarray:
+    """Re-place each job's planned rounds so unfair jobs run earliest.
+
+    The counterpart of the reference's second MILP (reference:
+    shockwave.py:281-328): minimize sum_j priority_j * mean-round-index_j
+    subject to unchanged per-job round counts and per-round gang capacity.
+    Round-major greedy (a naive job-major placement deadlocks at full
+    budget utilization): fill rounds earliest-first, within each round
+    first placing *urgent* jobs — those whose remaining count is within
+    ``margin`` of the rounds left, which must therefore run in (nearly)
+    every remaining round — then the highest priority-per-round jobs that
+    fit. A pure rate-greedy fill (margin 0) can strand several
+    almost-critical jobs on the same late round, so on failure the
+    placement retries with growing margins, converging to
+    fully-slack-driven (earliest-deadline-first) placement; if even that
+    fails (gang-packing corner), fall back to the (always-feasible)
+    column-permutation reordering of the original Y.
+    """
+    Y = np.asarray(Y)
+    J, R = Y.shape
+    counts = Y.sum(axis=1)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        rate = np.where(counts > 0, priorities / np.maximum(counts, 1), 0.0)
+    order = sorted(range(J), key=lambda j: (-rate[j], j))
+
+    def attempt(margin: int):
+        new_Y = np.zeros_like(Y)
+        need = counts.astype(np.int64).copy()
+        for r in range(R):
+            free = float(num_gpus)
+            rounds_left = R - r
+            in_round = np.zeros(J, dtype=bool)
+            # Urgent jobs, most-constrained (least slack) first.
+            urgent = [
+                j
+                for j in order
+                if 0 < need[j] and need[j] + margin >= rounds_left
+            ]
+            for j in sorted(urgent, key=lambda j: (-need[j], -rate[j])):
+                if need[j] >= rounds_left and nworkers[j] > free:
+                    return None  # a truly critical job no longer fits
+                if nworkers[j] <= free:
+                    in_round[j] = True
+                    free -= nworkers[j]
+            for j in order:
+                if need[j] > 0 and not in_round[j] and nworkers[j] <= free:
+                    in_round[j] = True
+                    free -= nworkers[j]
+                    if free <= 0:
+                        break
+            new_Y[in_round, r] = 1
+            need[in_round] -= 1
+        return new_Y if not need.any() else None
+
+    for margin in (0, 1, 2, 4, R):
+        new_Y = attempt(margin)
+        if new_Y is not None:
+            return new_Y
+    return reorder_columns(Y, priorities)
 
 
 def schedule_from_relaxed(
