@@ -1,0 +1,182 @@
+#!/usr/bin/env python3
+"""Figure-9 replication harness.
+
+Runs the scale experiment of the reference
+(reference: scheduler/shockwave_replicate/scale_experiments.sh:10-27):
+the 220-job dynamic trace on {64, 128, 256}-GPU clusters with 120 s
+rounds, under {max_min_fairness, shockwave (exact MILP), shockwave_tpu}.
+Each cell writes the reference's result-pickle schema
+(reference: scripts/drivers/simulate_scheduler_with_trace.py:113-133)
+plus one merged ``summary.json`` for the whole sweep.
+
+The default trace is the reference's 220-job shockwave trace when the
+read-only reference checkout is present, else the repo's committed
+generated 220-job trace (traces/generated_220_dynamic.trace).
+
+Example:
+  python scripts/replicate/scale_experiments.py --out results/scale
+  python scripts/replicate/scale_experiments.py --policies shockwave_tpu --num_gpus 64
+"""
+
+import argparse
+import json
+import os
+import pickle
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+from shockwave_tpu.core.scheduler import Scheduler
+from shockwave_tpu.data import load_or_synthesize_profiles, parse_trace
+from shockwave_tpu.data.default_oracle import generate_oracle
+from shockwave_tpu.policies import get_policy
+
+REFERENCE_TRACE = (
+    "/root/reference/scheduler/traces/shockwave/"
+    "220_0.2_5_100_25_4_0,0.5,0.5_0.6,0.3,0.09,0.01_multigpu_dynamic.trace"
+)
+FALLBACK_TRACE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "traces",
+    "generated_220_dynamic.trace",
+)
+
+DEFAULT_POLICIES = ["max_min_fairness", "shockwave", "shockwave_tpu"]
+DEFAULT_SIZES = [64, 128, 256]
+
+# Solver hyperparameters of the replication configs
+# (reference: shockwave_replicate/scale_64gpus.json).
+SHOCKWAVE_CONFIG = {
+    "future_rounds": 20,
+    "lambda": 5.0,
+    "k": 10.0,
+    "log_approximation_bases": [0.0, 0.2, 0.4, 0.6, 0.8, 1.0],
+    "solver_rel_gap": 1e-3,
+    "solver_num_threads": 24,
+    "solver_timeout": 15,
+}
+
+
+def run_cell(trace_file, policy_name, num_gpus, round_duration, seed=0):
+    jobs, arrival_times = parse_trace(trace_file)
+    throughputs = generate_oracle()
+    profiles = load_or_synthesize_profiles(
+        trace_file, jobs, throughputs, cache=False
+    )
+    for i, job in enumerate(jobs):
+        job.duration = sum(profiles[i]["duration_every_epoch"])
+
+    shockwave_config = None
+    if policy_name.startswith("shockwave"):
+        shockwave_config = dict(SHOCKWAVE_CONFIG)
+        shockwave_config["time_per_iteration"] = round_duration
+        shockwave_config["num_gpus"] = num_gpus
+
+    policy = get_policy(policy_name, seed=seed)
+    sched = Scheduler(
+        policy,
+        simulate=True,
+        throughputs=throughputs,
+        seed=seed,
+        time_per_iteration=round_duration,
+        profiles=profiles,
+        shockwave_config=shockwave_config,
+    )
+    start = time.time()
+    makespan = sched.simulate(
+        {"v100": num_gpus},
+        arrival_times,
+        jobs,
+        num_gpus_per_server={"v100": 4},
+    )
+    wall = time.time() - start
+    ftf_list, unfair_fraction = sched.get_finish_time_fairness()
+    return {
+        "trace_file": trace_file,
+        "policy": policy_name,
+        "num_gpus": str(num_gpus),
+        "makespan": makespan,
+        "avg_jct": sched.get_average_jct(),
+        "worst_ftf": max(ftf_list) if ftf_list else None,
+        "unfair_fraction": unfair_fraction,
+        "utilization": sched.get_cluster_utilization(),
+        "rounds": sched._num_completed_rounds,
+        "sim_wall_clock_s": wall,
+    }
+
+
+def main(args):
+    trace = args.trace_file
+    if trace is None:
+        trace = REFERENCE_TRACE if os.path.exists(REFERENCE_TRACE) else FALLBACK_TRACE
+    os.makedirs(args.out, exist_ok=True)
+
+    for policy_name in args.policies:
+        for num_gpus in args.num_gpus:
+            name = f"{policy_name}_{num_gpus}gpus"
+            out_pickle = os.path.join(args.out, name + ".pickle")
+            if os.path.exists(out_pickle) and not args.force:
+                print(f"[skip] {name} (exists)")
+                continue
+            print(f"[run ] {name} on {os.path.basename(trace)}")
+            result = run_cell(
+                trace, policy_name, num_gpus, args.time_per_iteration, args.seed
+            )
+            with open(out_pickle, "wb") as f:
+                pickle.dump(result, f)
+            print(
+                f"[done] {name}: makespan={result['makespan']:.0f}s "
+                f"avg_jct={result['avg_jct']:.0f}s "
+                f"worst_ftf={result['worst_ftf']:.2f} "
+                f"unfair={result['unfair_fraction']:.1f}% "
+                f"(sim {result['sim_wall_clock_s']:.1f}s)"
+            )
+
+    # Merge every cell present into the committed summary.
+    summary = {}
+    for fn in sorted(os.listdir(args.out)):
+        if fn.endswith(".pickle"):
+            with open(os.path.join(args.out, fn), "rb") as f:
+                r = pickle.load(f)
+            summary[fn[: -len(".pickle")]] = {
+                k: r[k]
+                for k in (
+                    "policy",
+                    "num_gpus",
+                    "makespan",
+                    "avg_jct",
+                    "worst_ftf",
+                    "unfair_fraction",
+                    "utilization",
+                    "rounds",
+                    "sim_wall_clock_s",
+                )
+            }
+    summary_path = os.path.join(args.out, "summary.json")
+    with open(summary_path, "w") as f:
+        json.dump(
+            {"trace": os.path.basename(trace), "results": summary}, f, indent=2
+        )
+    print(f"Wrote {summary_path} ({len(summary)} cells)")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="Figure-9 scale experiments")
+    parser.add_argument("--trace_file", type=str, default=None)
+    parser.add_argument("--out", type=str, default="results/scale")
+    parser.add_argument(
+        "--policies", type=str, nargs="+", default=DEFAULT_POLICIES
+    )
+    parser.add_argument(
+        "--num_gpus", type=int, nargs="+", default=DEFAULT_SIZES
+    )
+    parser.add_argument("--time_per_iteration", type=int, default=120)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--force", action="store_true")
+    main(parser.parse_args())

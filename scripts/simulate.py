@@ -94,6 +94,8 @@ def main(args):
         jobs,
         num_gpus_per_server=num_gpus_per_server,
         jobs_to_complete=jobs_to_complete,
+        checkpoint_threshold=args.checkpoint_threshold,
+        checkpoint_file=args.checkpoint_file,
     )
     wall = time.time() - start
 
@@ -153,4 +155,16 @@ if __name__ == "__main__":
     parser.add_argument("--config", type=str, default=None, help="Shockwave JSON config")
     parser.add_argument("--output_pickle", type=str, default=None)
     parser.add_argument("--no_profile_cache", action="store_true")
+    parser.add_argument(
+        "--checkpoint_threshold",
+        type=int,
+        default=None,
+        help="Save a simulator checkpoint once this many jobs were admitted",
+    )
+    parser.add_argument(
+        "--checkpoint_file",
+        type=str,
+        default=None,
+        help="Checkpoint path; resumes from it if it already exists",
+    )
     main(parser.parse_args())

@@ -266,8 +266,8 @@ class Scheduler:
         del self._job_id_to_job_type[job_id]
         del self._num_failures_per_job[job_id]
         self._in_progress_updates.pop(job_id, None)
-        if self._slos is not None:
-            self._slos.pop(job_id, None)
+        # Deadlines are kept after completion for get_num_SLO_violations
+        # (the active-jobs policy path filters on ``job_id in self._jobs``).
         if self._job_packing:
             stale_pairs = [
                 other
@@ -1012,14 +1012,29 @@ class Scheduler:
         num_gpus_per_server: Optional[Dict[str, int]] = None,
         jobs_to_complete: Optional[set] = None,
         max_rounds: Optional[int] = None,
+        checkpoint_threshold: Optional[int] = None,
+        checkpoint_file: Optional[str] = None,
     ) -> float:
         """Trace-driven simulation; returns the makespan
-        (reference: scheduler.py:1365-1796, from_trace path)."""
+        (reference: scheduler.py:1365-1796, from_trace path).
+
+        Checkpointing (reference: scheduler.py:1759-1775): with
+        ``checkpoint_threshold`` set, the full scheduler + loop state is
+        pickled to ``checkpoint_file`` once that many jobs have been
+        admitted; a later ``simulate`` call on a fresh Scheduler with an
+        existing ``checkpoint_file`` resumes from that point instead of
+        replaying the prefix (used to fast-forward long continuous-trace
+        sweeps). Not supported for the Shockwave policies, whose planner
+        state lives outside the checkpointed fields.
+        """
+        import os as _os
+
         assert arrival_times is not None and jobs is not None
         remaining_jobs = len(jobs)
         queued_jobs = list(zip(arrival_times, jobs))
         running_jobs: list = []
         consecutive_idle_rounds = 0
+        checkpoint_saved = False
 
         for worker_type in sorted(cluster_spec):
             num_gpus = (
@@ -1030,7 +1045,22 @@ class Scheduler:
             for _ in range(cluster_spec[worker_type] // num_gpus):
                 self.register_worker(worker_type, num_gpus=num_gpus)
 
-        self._current_timestamp = arrival_times[0]
+        if checkpoint_file is not None and _os.path.exists(checkpoint_file):
+            assert self._shockwave is None, (
+                "simulator checkpointing does not cover Shockwave planner state"
+            )
+            extra = self.load_checkpoint(checkpoint_file)
+            queued_jobs = extra["queued_jobs"]
+            running_jobs = extra["running_jobs"]
+            remaining_jobs = extra["remaining_jobs"]
+            consecutive_idle_rounds = extra["consecutive_idle_rounds"]
+            checkpoint_saved = True
+            self._logger.info(
+                "Resumed from checkpoint %s at t=%.1f (%d jobs queued)",
+                checkpoint_file, self._current_timestamp, len(queued_jobs),
+            )
+        else:
+            self._current_timestamp = arrival_times[0]
 
         while True:
             if jobs_to_complete is not None and jobs_to_complete.issubset(
@@ -1111,6 +1141,31 @@ class Scheduler:
             while queued_jobs and queued_jobs[0][0] <= self._current_timestamp:
                 arrival_time, job = queued_jobs.pop(0)
                 self.add_job(job, timestamp=arrival_time)
+
+            if (
+                checkpoint_threshold is not None
+                and checkpoint_file is not None
+                and not checkpoint_saved
+                and self._job_id_counter >= checkpoint_threshold
+            ):
+                assert self._shockwave is None, (
+                    "simulator checkpointing does not cover Shockwave "
+                    "planner state"
+                )
+                self.save_checkpoint(
+                    checkpoint_file,
+                    extra=dict(
+                        queued_jobs=queued_jobs,
+                        running_jobs=running_jobs,
+                        remaining_jobs=remaining_jobs,
+                        consecutive_idle_rounds=consecutive_idle_rounds,
+                    ),
+                )
+                checkpoint_saved = True
+                self._logger.info(
+                    "Saved checkpoint to %s after job %d",
+                    checkpoint_file, self._job_id_counter - 1,
+                )
 
             if len(self._jobs) == 0:
                 if not queued_jobs:
@@ -1202,22 +1257,33 @@ class Scheduler:
         "_cumulative_worker_time_so_far",
         "_num_lease_extensions",
         "_num_lease_extension_opportunities",
+        "_completed_jobs",
+        "_slos",
+        "_in_progress_updates",
+        "_job_timelines",
+        "_current_worker_assignments",
+        "_available_worker_ids",
     ]
 
-    def save_checkpoint(self, path: str) -> None:
+    def save_checkpoint(self, path: str, extra: Optional[dict] = None) -> None:
+        """Pickle scheduler state plus ``extra`` (the simulate-loop locals
+        — queued/running jobs — mirroring reference scheduler.py:1214-1245
+        which checkpoints those alongside the 24 scheduler fields)."""
         import pickle
 
         state = {f: getattr(self, f) for f in self._CHECKPOINT_FIELDS}
         with open(path, "wb") as f:
-            pickle.dump(state, f)
+            pickle.dump({"fields": state, "extra": extra or {}}, f)
 
-    def load_checkpoint(self, path: str) -> None:
+    def load_checkpoint(self, path: str) -> dict:
+        """Restore scheduler fields; returns the ``extra`` dict."""
         import pickle
 
         with open(path, "rb") as f:
             state = pickle.load(f)
-        for field, value in state.items():
+        for field, value in state["fields"].items():
             setattr(self, field, value)
+        return state["extra"]
 
     def save_job_timelines(self, directory: str) -> None:
         """One per-job file of structured iterator log excerpts
@@ -1313,3 +1379,24 @@ class Scheduler:
 
     def get_total_cost(self):
         return float(sum(self._job_cost_so_far.values()))
+
+    def get_num_SLO_violations(self, verbose: bool = False):
+        """Jobs that finished past their absolute deadline, or never
+        finished at all (reference: scheduler.py:2230-2246 — note the
+        reference compares the completion *duration* against the absolute
+        deadline, a latent bug once arrivals are nonzero; here the job's
+        absolute finish timestamp is compared)."""
+        violations = 0
+        for job_id, deadline in (self._slos or {}).items():
+            if job_id in self._jobs:
+                continue  # still running: not yet decided
+            finished_at = self._per_job_latest_timestamps.get(job_id)
+            completed = self._job_completion_times.get(job_id) is not None
+            violated = (not completed) or finished_at > deadline
+            if verbose:
+                self._logger.info(
+                    "%s: finished_at=%s, deadline=%f, violated=%s",
+                    job_id, finished_at, deadline, violated,
+                )
+            violations += int(violated)
+        return violations

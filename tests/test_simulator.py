@@ -1,6 +1,8 @@
 """Simulator-core tests: determinism, conservation of work, policy behavior
 on tiny hand-built traces (reference test style: scheduler/tests)."""
 
+import os
+
 import pytest
 
 from shockwave_tpu.core.ids import JobId
@@ -152,3 +154,40 @@ def test_max_min_lp_matches_closed_form():
 def test_scheduler_rejects_shockwave_without_config():
     with pytest.raises(Exception):
         Scheduler(get_policy("shockwave"), throughputs=generate_oracle())
+
+
+def test_checkpoint_save_load_continue_determinism(tmp_path):
+    """Simulator checkpointing (reference: scheduler.py:1214-1294,
+    1759-1775): a run that saves at a job threshold, then a fresh
+    scheduler resuming from that checkpoint, must reproduce the
+    uncheckpointed run exactly."""
+    ckpt = str(tmp_path / "sim.ckpt")
+
+    def fresh_inputs():
+        return tiny_trace(num_jobs=8, epochs=2, arrival_gap=200.0)
+
+    # Ground truth: no checkpointing.
+    jobs, arrivals = fresh_inputs()
+    ref, ref_makespan = run_sim("max_min_fairness", jobs, arrivals, seed=3)
+
+    # Run A: saves at the 5th admitted job, then keeps going to the end.
+    jobs, arrivals = fresh_inputs()
+    a, a_makespan = run_sim(
+        "max_min_fairness", jobs, arrivals, seed=3,
+        checkpoint_threshold=5, checkpoint_file=ckpt,
+    )
+    assert os.path.exists(ckpt)
+    assert a_makespan == pytest.approx(ref_makespan)
+
+    # Run B: fresh scheduler, resumes from the checkpoint mid-trace.
+    jobs, arrivals = fresh_inputs()
+    b, b_makespan = run_sim(
+        "max_min_fairness", jobs, arrivals, seed=3, checkpoint_file=ckpt,
+    )
+    assert b_makespan == pytest.approx(ref_makespan)
+    assert b.get_average_jct() == pytest.approx(ref.get_average_jct())
+    assert set(b._job_completion_times) == set(ref._job_completion_times)
+    for job_id, jct in ref._job_completion_times.items():
+        assert b._job_completion_times[job_id] == pytest.approx(jct)
+    # The resumed run replays only the suffix.
+    assert b._num_completed_rounds < ref._num_completed_rounds
