@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""Figure-9 replication plot.
+
+Reads the result pickles written by scale_experiments.py and renders the
+reference's four-panel comparison (makespan / avg JCT / worst FTF /
+unfair job fraction, one bar group per cluster size; reference:
+scheduler/shockwave_replicate/plot_scale_experiment.py:17-143).
+
+Usage: python scripts/replicate/plot_scale_experiment.py --dir results/scale
+"""
+
+import argparse
+import os
+import pickle
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+
+METRICS = [
+    ("makespan", "Makespan (s)"),
+    ("avg_jct", "Average JCT (s)"),
+    ("worst_ftf", "Worst finish-time fairness"),
+    ("unfair_fraction", "Unfair job fraction (%)"),
+]
+
+# Fixed policy order and categorical hues (identity follows the policy,
+# never its rank within a panel).
+POLICY_ORDER = ["max_min_fairness", "shockwave", "shockwave_tpu"]
+POLICY_LABEL = {
+    "max_min_fairness": "max-min fairness (Gavel)",
+    "shockwave": "shockwave (exact MILP)",
+    "shockwave_tpu": "shockwave_tpu (ours)",
+}
+POLICY_COLOR = {
+    "max_min_fairness": "#2a78d6",
+    "shockwave": "#eb6834",
+    "shockwave_tpu": "#1baf7a",
+}
+
+
+def load_results(pickle_dir):
+    data = {}
+    for fn in sorted(os.listdir(pickle_dir)):
+        if not fn.endswith(".pickle"):
+            continue
+        with open(os.path.join(pickle_dir, fn), "rb") as f:
+            r = pickle.load(f)
+        data.setdefault(int(r["num_gpus"]), {})[r["policy"]] = r
+    return data
+
+
+def plot(data, out_path):
+    sizes = sorted(data)
+    policies = [
+        p for p in POLICY_ORDER if any(p in data[s] for s in sizes)
+    ]
+    fig, axes = plt.subplots(1, len(METRICS), figsize=(16, 4.2))
+    x = np.arange(len(sizes))
+    width = 0.8 / max(1, len(policies))
+    for ax, (metric, title) in zip(axes, METRICS):
+        for i, policy in enumerate(policies):
+            values = [data[s].get(policy, {}).get(metric) for s in sizes]
+            values = [v if v is not None else np.nan for v in values]
+            ax.bar(
+                x + (i - (len(policies) - 1) / 2) * width,
+                values,
+                width * 0.92,  # surface gap between adjacent bars
+                label=POLICY_LABEL.get(policy, policy),
+                color=POLICY_COLOR.get(policy, "#777777"),
+                edgecolor="white",
+                linewidth=0.8,
+                zorder=3,
+            )
+        ax.set_title(title, fontsize=11)
+        ax.set_xticks(x)
+        ax.set_xticklabels([f"{s} GPUs" for s in sizes])
+        ax.grid(axis="y", color="#dddddd", linewidth=0.6, zorder=0)
+        for spine in ("top", "right"):
+            ax.spines[spine].set_visible(False)
+    handles, labels = axes[0].get_legend_handles_labels()
+    fig.legend(
+        handles,
+        labels,
+        loc="upper center",
+        bbox_to_anchor=(0.5, 0.93),
+        ncol=len(labels),
+        fontsize=9,
+        frameon=False,
+    )
+    fig.suptitle(
+        "Shockwave scale replication: 220-job dynamic trace, 120 s rounds",
+        fontsize=12,
+    )
+    fig.tight_layout(rect=(0, 0, 1, 0.88))
+    fig.savefig(out_path, dpi=150)
+    print(f"Wrote {out_path}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dir", type=str, default="results/scale")
+    parser.add_argument("--out", type=str, default=None)
+    args = parser.parse_args()
+    out = args.out or os.path.join(args.dir, "replicated_fig9.png")
+    plot(load_results(args.dir), out)
