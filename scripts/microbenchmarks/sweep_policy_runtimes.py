@@ -1,0 +1,214 @@
+#!/usr/bin/env python3
+"""Policy-runtime microbenchmark.
+
+Times ``policy.get_allocation`` wall-clock against the number of active
+jobs for the whole policy library, the way the reference benchmarks its
+cvxpy stack (reference:
+scheduler/scripts/microbenchmarks/sweep_policy_runtimes.py:63-140):
+n generated jobs on a 3-type cluster sized n//4 per type, multi-GPU and
+multi-priority jobs enabled.
+
+The reference's own numbers (GAVEL.md / the improved-scalability
+notebook) put cvxpy+ECOS max_min_fairness at ~10 s per solve at 512
+jobs and the water-filling MILD path far beyond that; cvxpy is
+deliberately absent from this build, so the committed artifact records
+this framework's HiGHS/closed-form runtimes alone.
+
+Writes one JSON artifact (default results/policy_runtimes.json):
+  {policy: {num_jobs: seconds_mean}}.
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+from shockwave_tpu.core.ids import JobId
+from shockwave_tpu.data.default_oracle import generate_oracle
+from shockwave_tpu.data.generate import GAVEL_SCALE_FACTOR_DIST, generate_job
+from shockwave_tpu.policies import get_policy
+
+DEFAULT_POLICIES = [
+    "fifo",
+    "fifo_perf",
+    "isolated",
+    "gandiva",
+    "allox",
+    "max_min_fairness",
+    "max_min_fairness_perf",
+    "max_min_fairness_water_filling_perf",
+    "finish_time_fairness_perf",
+    "min_total_duration_perf",
+    "max_sum_throughput_perf",
+    "max_min_fairness_packed",
+]
+
+DEFAULT_NUM_JOBS = [32, 64, 128, 256, 512]
+
+
+def generate_input(num_jobs, policy_name, oracle, seed):
+    """Active-jobs state shaped like the scheduler hands policies."""
+    rng = random.Random(seed)
+    multi_gpu = "allox" not in policy_name  # AlloX requires scale factor 1
+    jobs = {}
+    throughputs = {}
+    for i in range(num_jobs):
+        job = generate_job(
+            oracle,
+            rng,
+            duration_rng=rng,
+            scale_factor_rng=rng,
+            mode_rng=rng,
+            scale_factor_dist=GAVEL_SCALE_FACTOR_DIST if multi_gpu else {1: 1.0},
+            priority_rng=rng,
+        )
+        jobs[JobId(i)] = job
+        key = job.job_type_key()
+        throughputs[JobId(i)] = {
+            wt: oracle[wt][key]["null"] for wt in oracle
+        }
+    if "packed" in policy_name or policy_name == "gandiva":
+        for i in range(num_jobs):
+            for j in range(i + 1, num_jobs):
+                a, b = jobs[JobId(i)], jobs[JobId(j)]
+                if a.scale_factor != b.scale_factor:
+                    continue
+                pair_key = b.job_type_key()
+                entry = {}
+                for wt in oracle:
+                    pair = oracle[wt][a.job_type_key()].get(pair_key)
+                    if pair is None:
+                        break
+                    entry[wt] = list(pair)
+                if len(entry) == len(oracle):
+                    throughputs[JobId(i, j)] = entry
+    scale_factors = {JobId(i): jobs[JobId(i)].scale_factor for i in range(num_jobs)}
+    priority_weights = {
+        JobId(i): jobs[JobId(i)].priority_weight for i in range(num_jobs)
+    }
+    times_since_start = {
+        JobId(i): rng.uniform(0, 3600 * 5) for i in range(num_jobs)
+    }
+    num_steps_remaining = {
+        JobId(i): max(1, int(jobs[JobId(i)].total_steps * rng.uniform(0.1, 1.0)))
+        for i in range(num_jobs)
+    }
+    return dict(
+        throughputs=throughputs,
+        scale_factors=scale_factors,
+        priority_weights=priority_weights,
+        times_since_start=times_since_start,
+        num_steps_remaining=num_steps_remaining,
+    )
+
+
+def call_policy(policy, state, cluster_spec):
+    """The scheduler's dispatch switch (core/scheduler.py:436-490)."""
+    name = policy.name
+    if name == "AlloX_Perf":
+        return policy.get_allocation(
+            state["throughputs"],
+            state["scale_factors"],
+            state["times_since_start"],
+            state["num_steps_remaining"],
+            cluster_spec,
+        )
+    if name.startswith("FinishTimeFairness"):
+        return policy.get_allocation(
+            state["throughputs"],
+            state["scale_factors"],
+            state["priority_weights"],
+            state["times_since_start"],
+            state["num_steps_remaining"],
+            cluster_spec,
+        )
+    if name == "Isolated":
+        return policy.get_allocation(
+            state["throughputs"], state["scale_factors"], cluster_spec
+        )
+    if name.startswith("MaxMinFairness"):
+        return policy.get_allocation(
+            state["throughputs"],
+            state["scale_factors"],
+            state["priority_weights"],
+            cluster_spec,
+        )
+    if name.startswith("MinTotalDuration"):
+        return policy.get_allocation(
+            state["throughputs"],
+            state["scale_factors"],
+            state["num_steps_remaining"],
+            cluster_spec,
+        )
+    return policy.get_allocation(
+        state["throughputs"], state["scale_factors"], cluster_spec
+    )
+
+
+def measure(policy_name, num_jobs, oracle, num_trials):
+    cluster_spec = {
+        "v100": max(1, num_jobs // 4),
+        "p100": max(1, num_jobs // 4),
+        "k80": max(1, num_jobs // 4),
+    }
+    runtimes = []
+    for trial in range(num_trials):
+        state = generate_input(num_jobs, policy_name, oracle, seed=trial + 2)
+        policy = get_policy(policy_name, seed=trial)
+        start = time.time()
+        allocation = call_policy(policy, state, cluster_spec)
+        runtimes.append(time.time() - start)
+        assert allocation is not None
+    return float(sum(runtimes) / len(runtimes))
+
+
+def main(args):
+    oracle = generate_oracle()
+    results = {}
+    for policy_name in args.policies:
+        results[policy_name] = {}
+        for num_jobs in args.num_jobs:
+            if policy_name in ("max_min_fairness_packed", "gandiva") and (
+                num_jobs > args.max_packed_jobs
+            ):
+                continue  # O(n^2) pair tensors; bound the sweep
+            seconds = measure(policy_name, num_jobs, oracle, args.num_trials)
+            results[policy_name][str(num_jobs)] = round(seconds, 4)
+            print(f"{policy_name:>40} n={num_jobs:>4}: {seconds:.4f} s")
+    os.makedirs(os.path.dirname(args.output) or ".", exist_ok=True)
+    with open(args.output, "w") as f:
+        json.dump(
+            {
+                "config": "3 worker types, n//4 workers each, "
+                f"{args.num_trials} trials, mean seconds per get_allocation",
+                "results": results,
+            },
+            f,
+            indent=2,
+        )
+    print(f"Wrote {args.output}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="Policy runtime sweep")
+    parser.add_argument(
+        "--policies", type=str, nargs="+", default=DEFAULT_POLICIES
+    )
+    parser.add_argument(
+        "--num_jobs", type=int, nargs="+", default=DEFAULT_NUM_JOBS
+    )
+    parser.add_argument("--num_trials", type=int, default=3)
+    parser.add_argument("--max_packed_jobs", type=int, default=256)
+    parser.add_argument(
+        "--output", type=str, default="results/policy_runtimes.json"
+    )
+    main(parser.parse_args())
