@@ -41,9 +41,41 @@ def main():
     parser.add_argument("--distributed_addr", type=str, default=None)
     parser.add_argument("--num_workers", type=int, default=1)
     parser.add_argument("--worker_rank", type=int, default=0)
+    # Failure injection (runtime fault-tolerance tests).
+    parser.add_argument(
+        "--crash_attempts",
+        type=int,
+        default=0,
+        help="Die before making progress on the first N launches "
+        "(-1 = every launch); tracked via a counter file in checkpoint_dir",
+    )
+    parser.add_argument(
+        "--hang",
+        action="store_true",
+        help="Never step and never exit (exercises the straggler kill)",
+    )
     args = parser.parse_args()
 
     ckpt_path = os.path.join(args.checkpoint_dir, "state.json")
+
+    if args.crash_attempts:
+        attempt_path = os.path.join(args.checkpoint_dir, "attempts.txt")
+        attempts = 0
+        if os.path.exists(attempt_path):
+            with open(attempt_path) as f:
+                attempts = int(f.read().strip() or 0)
+        attempts += 1
+        with open(attempt_path, "w") as f:
+            f.write(str(attempts))
+        if args.crash_attempts < 0 or attempts <= args.crash_attempts:
+            # Hard exit: no checkpoint, no iterator progress line -> the
+            # dispatcher reports zero progress and the scheduler counts a
+            # micro-task failure.
+            os._exit(13)
+
+    if args.hang:
+        while True:
+            time.sleep(3600)
 
     def load_checkpoint():
         if os.path.exists(ckpt_path):

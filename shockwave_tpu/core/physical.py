@@ -73,6 +73,11 @@ class PhysicalScheduler(Scheduler):
         # fixed by the first member to request an update
         # (reference: scheduler.py:3067-3096).
         self._max_steps_agreement: Dict[JobId, Tuple[int, float]] = {}
+        # Last lease-protocol contact per job, for unresponsiveness
+        # detection of extended-lease jobs (reference: scheduler.py:
+        # 3196-3202,3220-3221 — an extended job that stops requesting
+        # lease updates is declared unresponsive and killed).
+        self._last_lease_contact: Dict[JobId, float] = {}
         # Micro-tasks dispatched this round and not yet reported done.
         self._outstanding: set = set()
         # Dispatch-time worker sets (assignments rotate before Done arrives).
@@ -145,6 +150,7 @@ class PhysicalScheduler(Scheduler):
             key = JobId(int(job_id))
             now = self.get_current_timestamp()
             self._dispatch_times.setdefault(key, now)
+            self._last_lease_contact[key] = now
             remaining = max(self._round_end_time - now, 1.0)
             return INFINITY, remaining, 0.0
 
@@ -154,6 +160,7 @@ class PhysicalScheduler(Scheduler):
         """(reference: scheduler.py:3031-3096)"""
         with self._cv:
             key = JobId(int(job_id))
+            self._last_lease_contact[key] = self.get_current_timestamp()
             if key in self._jobs_with_extended_lease:
                 # The job keeps the same workers next round: extend through
                 # the next round's end (reference: scheduler.py:1868-1891).
@@ -328,6 +335,24 @@ class PhysicalScheduler(Scheduler):
                 stragglers = {
                     key for key, _ in (expected & self._outstanding)
                 }
+                # Extended-lease jobs that stopped speaking the lease
+                # protocol are unresponsive: a healthy extended job
+                # refreshes every round (75% consumption), so >1.5 rounds
+                # of silence means the process is wedged (reference:
+                # scheduler.py:3196-3202,3220-3221).
+                now = self.get_current_timestamp()
+                silence = 1.5 * self._time_per_iteration
+                for key in list(self._jobs_with_extended_lease):
+                    still_running = any(
+                        (key, wid) in self._outstanding
+                        for wid in self._dispatched_worker_ids.get(key, ())
+                    )
+                    last = self._last_lease_contact.get(
+                        key, self._dispatch_times.get(key, now)
+                    )
+                    if still_running and now - last > silence:
+                        stragglers.add(key)
+                        self._jobs_with_extended_lease.discard(key)
             for key in stragglers:
                 self._kill_job(key)
             self._round_id += 1
@@ -340,7 +365,10 @@ class PhysicalScheduler(Scheduler):
         completions so bookkeeping converges
         (reference: scheduler.py:3098-3170)."""
         with self._cv:
-            worker_ids = list(self._current_worker_assignments.get(key, ()))
+            worker_ids = list(
+                self._dispatched_worker_ids.get(key)
+                or self._current_worker_assignments.get(key, ())
+            )
         for worker_id in worker_ids:
             for job_int in key.as_tuple():
                 try:

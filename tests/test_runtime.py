@@ -117,3 +117,85 @@ def test_preemption_resumes_across_rounds(cluster):
     assert len(sched._job_completion_times) == 3
     for job_id in job_ids:
         assert sched._total_steps_run[job_id] >= 700
+
+
+def make_failing_job(total_steps, crash_attempts, steps_per_sec=200):
+    job = make_job(total_steps, steps_per_sec=steps_per_sec)
+    job.command += f" --crash_attempts {crash_attempts}"
+    return job
+
+
+def test_failed_attempts_drop_job_and_spare_healthy_one(cluster):
+    """A micro-task that reports zero progress counts as a failure; after
+    MAX_FAILED_ATTEMPTS the job is dropped with completion_time=None
+    (reference: scheduler.py:3359-3376, 649-651) while healthy jobs
+    continue unharmed."""
+    sched, worker, tmp_path = cluster
+    crasher = sched.add_job(make_failing_job(400, crash_attempts=-1))
+    healthy = sched.add_job(make_job(400))
+    runner = threading.Thread(target=sched.run, kwargs={"max_rounds": 25})
+    runner.start()
+    runner.join(timeout=150)
+    assert not runner.is_alive(), "round loop wedged on the failing job"
+    assert sched._job_completion_times[crasher] is None
+    assert sched._job_completion_times[healthy] is not None
+    assert sched._total_steps_run[healthy] >= 400
+
+
+def test_transient_failures_are_retried_to_completion(cluster):
+    """Two crash-on-launch attempts, then normal training: the scheduler
+    must re-dispatch after each failure and the job must still finish."""
+    sched, worker, tmp_path = cluster
+    job_id = sched.add_job(make_failing_job(400, crash_attempts=2))
+    runner = threading.Thread(target=sched.run, kwargs={"max_rounds": 25})
+    runner.start()
+    runner.join(timeout=150)
+    assert not runner.is_alive()
+    assert sched._job_completion_times[job_id] is not None
+    assert sched._total_steps_run[job_id] >= 400
+    attempts_file = tmp_path / "ckpt" / f"job_id={job_id.integer}" / "attempts.txt"
+    assert int(attempts_file.read_text()) >= 3  # 2 crashes + >=1 real run
+
+
+def test_straggler_is_killed_and_eventually_dropped(cluster):
+    """A hung workload never reports Done: the round loop must kill it at
+    round end + buffer (reference: scheduler.py:3098-3170), count the
+    failure, and after MAX_FAILED_ATTEMPTS drop the job."""
+    sched, worker, tmp_path = cluster
+    hung = sched.add_job(
+        Job(
+            job_type="ResNet-18 (batch size 32)",
+            command=f"{os.sys.executable} {WORKLOAD} --hang --batch_size 32",
+            num_steps_arg="-n",
+            total_steps=400,
+            scale_factor=1,
+            mode="static",
+        )
+    )
+    healthy = sched.add_job(make_job(400))
+    runner = threading.Thread(target=sched.run, kwargs={"max_rounds": 25})
+    runner.start()
+    runner.join(timeout=300)
+    assert not runner.is_alive(), "round loop wedged on the hung job"
+    assert sched._job_completion_times[hung] is None
+    assert sched._job_completion_times[healthy] is not None
+
+
+def test_worker_reset_kills_running_jobs_and_job_recovers(cluster):
+    """The Reset RPC wipes worker-side processes (reference:
+    dispatcher.py:537-545); the preempted job is retried and completes."""
+    sched, worker, tmp_path = cluster
+    job_id = sched.add_job(make_job(900, steps_per_sec=100))
+    runner = threading.Thread(target=sched.run, kwargs={"max_rounds": 30})
+    runner.start()
+    # Let the first dispatch land, then reset the worker out from under it.
+    deadline = time.time() + 30
+    while time.time() < deadline and not sched._dispatched_worker_ids:
+        time.sleep(0.2)
+    assert sched._dispatched_worker_ids, "job was never dispatched"
+    client = next(iter(sched._worker_connections.values()))
+    client.reset()
+    runner.join(timeout=180)
+    assert not runner.is_alive()
+    assert sched._job_completion_times.get(job_id) is not None
+    assert sched._total_steps_run[job_id] >= 900
