@@ -77,6 +77,8 @@ def main(args):
         time_per_iteration=args.time_per_iteration,
         profiles=profiles,
         shockwave_config=shockwave_config,
+        profiling_percentage=args.profiling_percentage,
+        num_reference_models=args.num_reference_models,
     )
 
     jobs_to_complete = None
@@ -155,6 +157,19 @@ if __name__ == "__main__":
     parser.add_argument("--config", type=str, default=None, help="Shockwave JSON config")
     parser.add_argument("--output_pickle", type=str, default=None)
     parser.add_argument("--no_profile_cache", action="store_true")
+    parser.add_argument(
+        "--profiling_percentage",
+        type=float,
+        default=1.0,
+        help="Fraction of colocations profiled for new jobs; <1 turns on "
+        "online throughput estimation (packing policies only)",
+    )
+    parser.add_argument(
+        "--num_reference_models",
+        type=int,
+        default=None,
+        help="Size of the reference-model set for throughput estimation",
+    )
     parser.add_argument(
         "--checkpoint_threshold",
         type=int,

@@ -65,6 +65,8 @@ class Scheduler:
         enable_global_queue: bool = False,
         per_worker_type_prices: Optional[Dict[str, float]] = None,
         log_level=None,
+        profiling_percentage: float = 1.0,
+        num_reference_models: Optional[int] = None,
     ):
         self._policy = policy
         self._simulate = simulate
@@ -156,6 +158,42 @@ class Scheduler:
 
         self._job_packing = "Packing" in policy.name
 
+        # Online throughput estimation (reference: scheduler.py:282-292,
+        # 394-403): with packing policies, when colocation profiling is
+        # partial or the reference-model set is a subset of the job table,
+        # the allocator sees ESTIMATED pair throughputs (matrix completion
+        # + cosine matching against reference types) while simulated
+        # execution keeps using the oracle truth.
+        self._estimate_throughputs = self._job_packing and (
+            profiling_percentage < 1.0 or num_reference_models is not None
+        )
+        if self._estimate_throughputs:
+            from shockwave_tpu.core.throughput_estimator import (
+                ThroughputEstimator,
+            )
+            from shockwave_tpu.data.job_table import build_job_table
+
+            if throughputs is None:
+                raise ValueError(
+                    "throughput estimation requires an oracle to profile "
+                    "against"
+                )
+            job_types = [(t.model, 1) for t in build_job_table()]
+            if num_reference_models is None:
+                num_reference_models = len(job_types)
+            self._throughput_estimator = ThroughputEstimator(
+                throughputs,
+                sorted(throughputs.keys()),
+                job_types,
+                num_reference_models,
+                profiling_percentage,
+                seed=seed + 4,
+            )
+            self._reference_throughputs = (
+                self._throughput_estimator.get_reference_throughputs()
+            )
+            self._reference_job_map: Dict[JobId, Tuple[str, int]] = {}
+
     # ------------------------------------------------------------------
     # Worker registration (simulation path; RPC path wraps this).
     # ------------------------------------------------------------------
@@ -210,6 +248,14 @@ class Scheduler:
         job_type_key = job.job_type_key()
         self._job_id_to_job_type[job_id] = job_type_key
         self._job_type_to_job_ids.setdefault(job_type_key, set()).add(job_id)
+        if self._estimate_throughputs and job.scale_factor == 1:
+            # Profile the unseen job against the reference types and match
+            # it (reference: scheduler.py:573-575).
+            self._reference_job_map[job_id] = (
+                self._throughput_estimator.match_job_to_reference_job(
+                    job_type_key
+                )
+            )
         self._num_failures_per_job[job_id] = 0
         self._total_steps_run[job_id] = 0
         if self._slos is not None and job.SLO is not None and job.duration:
@@ -321,7 +367,32 @@ class Scheduler:
                 else None
             )
             other_key = other.job_type_key()
-            if oracle is None:
+            if (
+                self._estimate_throughputs
+                and job_id in self._reference_job_map
+                and other_job_id in self._reference_job_map
+            ):
+                # Estimated pair throughput: the matched reference types'
+                # normalized colocation fractions scaled by the jobs' own
+                # isolated throughputs (reference: scheduler.py:2531-2555).
+                refs = [
+                    self._reference_job_map[job_id],
+                    self._reference_job_map[other_job_id],
+                ]
+                isolated = [
+                    oracle[job_type_key]["null"],
+                    oracle[other_key]["null"],
+                ]
+                ref_oracle = self._reference_throughputs[worker_type]
+                if job_id < other_job_id:
+                    fractions = ref_oracle[refs[0]][refs[1]]
+                else:
+                    fractions = ref_oracle[refs[1]][refs[0]]
+                    isolated = isolated[::-1]
+                self._throughputs[merged][worker_type] = [
+                    f * t for f, t in zip(fractions, isolated)
+                ]
+            elif oracle is None:
                 self._throughputs[merged][worker_type] = [0.0, 0.0]
             else:
                 keys = (
@@ -352,6 +423,19 @@ class Scheduler:
                     self._shockwave.record_round_throughput(
                         single, current_round, tput, self._jobs[single].batch_size
                     )
+        if self._simulate and self._estimate_throughputs and job_id.is_pair:
+            # Once a pair actually runs, the simulator has "measured" it:
+            # replace the estimate with the oracle truth
+            # (reference: scheduler.py:450-462).
+            if all(s in self._jobs for s in job_id.singletons()):
+                oracle = self._oracle_throughputs[worker_type]
+                keys = [
+                    self._jobs[s].job_type_key() for s in job_id.singletons()
+                ]
+                self._throughputs[job_id][worker_type] = list(
+                    oracle[keys[0]][keys[1]]
+                )
+            return
         if not self._simulate:
             # EMA update from measured steps (physical mode).
             singles = job_id.singletons()
@@ -754,12 +838,22 @@ class Scheduler:
         """(reference: scheduler.py:1166-1212)"""
         max_finish_time = self.get_current_timestamp()
         all_num_steps = []
+        true_pair_tputs = None
+        if self._simulate and self._estimate_throughputs and job_id.is_pair:
+            # Execution runs at the ORACLE rate even when the allocator
+            # only saw estimates (reference: scheduler.py:1173-1184).
+            oracle = self._oracle_throughputs[worker_type]
+            keys = [self._jobs[s].job_type_key() for s in job_id.singletons()]
+            true_pair_tputs = oracle[keys[0]][keys[1]]
         for i, single in enumerate(job_id.singletons()):
             num_steps = self._get_num_steps(job_id, worker_type, single)
             all_num_steps.append(num_steps)
-            tput = self._throughputs[job_id][worker_type]
-            if job_id.is_pair:
-                tput = tput[i]
+            if true_pair_tputs is not None:
+                tput = true_pair_tputs[i]
+            else:
+                tput = self._throughputs[job_id][worker_type]
+                if job_id.is_pair:
+                    tput = tput[i]
             if tput <= 0:
                 raise RuntimeError(
                     f"Throughput for job {single} on {worker_type} is <= 0"

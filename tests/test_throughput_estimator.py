@@ -102,3 +102,77 @@ class TestMaskedALS:
         mask = jnp.ones((4, 4))
         out = masked_als(X, mask, k=2)
         assert out.shape == (4, 4)
+
+
+class TestSchedulerIntegration:
+    """The estimator wired into the scheduler (reference:
+    scheduler.py:286-292,573-575,2531-2555): packing policies see
+    estimated pair throughputs while execution uses the oracle truth."""
+
+    def _run(self, **sched_kwargs):
+        from shockwave_tpu.core.scheduler import Scheduler
+        from shockwave_tpu.data.default_oracle import generate_oracle
+        from shockwave_tpu.data.profiles import synthesize_profiles
+        from shockwave_tpu.data.workload_info import steps_per_epoch
+        from shockwave_tpu.core.job import Job
+        from shockwave_tpu.policies import get_policy
+
+        oracle = generate_oracle()
+        types = [
+            ("ResNet-18", 32), ("LM", 10), ("Transformer", 16),
+            ("ResNet-50", 16), ("Recommendation", 1024), ("ResNet-18", 128),
+        ]
+        jobs = [
+            Job(
+                job_type=f"{fam} (batch size {bs})",
+                total_steps=steps_per_epoch(fam, bs) * 2,
+                scale_factor=1,
+                mode="static",
+            )
+            for fam, bs in types
+        ]
+        sched = Scheduler(
+            get_policy("max_min_fairness_packed"),
+            throughputs=oracle,
+            seed=0,
+            time_per_iteration=120,
+            profiles=synthesize_profiles(jobs, oracle),
+            **sched_kwargs,
+        )
+        makespan = sched.simulate({"v100": 2}, [0.0] * len(jobs), jobs)
+        return sched, makespan, oracle
+
+    def test_estimation_mode_completes_and_matches(self):
+        sched, makespan, oracle = self._run(
+            profiling_percentage=0.5, num_reference_models=12
+        )
+        assert sched._estimate_throughputs
+        # Every (scale-factor-1) job was matched to a reference type.
+        assert len(sched._reference_job_map) == 6
+        for ref in sched._reference_job_map.values():
+            assert ref in {
+                t for wt in sched._reference_throughputs.values() for t in wt
+            }
+        # The trace still completes with the oracle hidden from the policy.
+        assert len(sched._job_completion_times) == 6
+        assert all(
+            t is not None for t in sched._job_completion_times.values()
+        )
+        assert makespan > 0
+
+    def test_estimates_converge_to_truth_once_pairs_run(self):
+        sched, _, oracle = self._run(
+            profiling_percentage=0.5, num_reference_models=12
+        )
+        # _update_throughput replaced estimates of executed pairs with the
+        # oracle truth; any remaining pair entries are estimates (positive,
+        # bounded by isolated throughput).
+        pair_ids = [j for j in sched._throughputs if j.is_pair]
+        for pair in pair_ids:
+            for wt, tputs in sched._throughputs[pair].items():
+                assert len(tputs) == 2
+                assert all(t >= 0 for t in tputs)
+
+    def test_full_profiling_is_off_by_default(self):
+        sched, _, _ = self._run()
+        assert not sched._estimate_throughputs
