@@ -224,6 +224,21 @@ class ShockwavePlanner:
             from shockwave_tpu.native import solve_eg_greedy_native
 
             Y = solve_eg_greedy_native(problem)
+        elif self.backend == "relaxed":
+            # Projected-gradient ascent on the exact continuous relaxation,
+            # then integer rounding + per-round placement on host.
+            from shockwave_tpu.solver.eg_jax import solve_eg_jax
+            from shockwave_tpu.solver.rounding import schedule_from_relaxed
+
+            s = solve_eg_jax(problem, num_steps=self.solver_num_steps)
+            Y = schedule_from_relaxed(
+                s,
+                problem.priorities,
+                problem.nworkers,
+                problem.num_gpus,
+                problem.future_rounds,
+                problem=problem,
+            )
         else:
             from shockwave_tpu.solver.eg_jax import solve_eg_greedy
 
@@ -280,6 +295,7 @@ class ShockwavePolicy(Policy):
         self.name = {
             "reference": "Shockwave",
             "native": "Shockwave_Native",
+            "relaxed": "Shockwave_TPU_Relaxed",
         }.get(backend, "Shockwave_TPU")
 
     def make_planner(self, config: dict) -> ShockwavePlanner:

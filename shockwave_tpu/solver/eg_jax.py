@@ -30,7 +30,7 @@ unfair-jobs ordering pass — see :mod:`shockwave_tpu.solver.rounding`.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -82,6 +82,7 @@ def _objective(
     round_duration: float,
     future_rounds: int,
     regularizer: float,
+    tau: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     granted_sec = s * round_duration
     planned_epochs = jnp.minimum(
@@ -96,7 +97,14 @@ def _objective(
     lateness = active * jnp.maximum(
         0.0, remaining - epoch_dur * planned_epochs
     )
-    return welfare - regularizer * jnp.max(lateness)
+    if tau is None:
+        makespan = jnp.max(lateness)
+    else:
+        # Smoothed max for gradient flow: the hard max only back-props to
+        # the single argmax job, which strands every other late job; the
+        # temperature is annealed toward the hard max over the run.
+        makespan = tau * jax.scipy.special.logsumexp(lateness / tau)
+    return welfare - regularizer * makespan
 
 
 @functools.partial(jax.jit, static_argnames=("future_rounds", "num_steps"))
@@ -141,7 +149,13 @@ def solve_relaxed(
         future_rounds=R,
         regularizer=regularizer,
     )
-    grad = jax.grad(lambda s: obj(s))
+    grad = jax.grad(lambda s, tau: obj(s, tau=tau), argnums=0)
+    # Annealed smoothing temperature for the makespan term: starts at a
+    # fraction of the lateness scale, decays geometrically to (near) the
+    # hard max by the final iterations.
+    lateness_scale = jnp.maximum(jnp.max(remaining * active), 1.0)
+    tau0 = 0.05 * lateness_scale
+    tau1 = jnp.asarray(1.0, jnp.float32)
 
     # Adam-style per-coordinate adaptivity: gradient magnitudes span ~6
     # orders (log slope near zero progress vs. saturated jobs), so a global
@@ -152,7 +166,8 @@ def solve_relaxed(
 
     def step(carry, i):
         s, m, v, best_s, best_obj = carry
-        g = grad(s)
+        tau = tau0 * (tau1 / tau0) ** (i / num_steps)
+        g = grad(s, tau)
         m = 0.9 * m + 0.1 * g
         v = 0.999 * v + 0.001 * g * g
         m_hat = m / (1.0 - 0.9 ** (i + 1.0))

@@ -185,12 +185,95 @@ def reorder_rounds(
     return reorder_columns(Y, priorities)
 
 
+def refine_counts(counts: np.ndarray, problem, max_moves: int = 2000) -> np.ndarray:
+    """Exact-marginal exchange repair of per-job round counts.
+
+    The relaxed solve's projected gradients are scale-normalized, so the
+    makespan term's huge-but-narrow gradient (one argmax job) can be
+    underserved. This local search evaluates the TRUE objective deltas:
+    each move either grants one spare round or shifts a round from the
+    donor with the cheapest loss to the receiver with the largest gain,
+    applying the best strictly-improving move until none exists. The
+    objective is concave and separable plus a max term, so exchange-local
+    optimality lands within rounding distance of the global optimum.
+    """
+    p = problem
+    counts = counts.astype(np.float64).copy()
+    R = float(p.future_rounds)
+    budget = float(p.num_gpus) * R
+    need_sec = np.maximum(p.total_epochs - p.completed_epochs, 0.0) * p.epoch_duration
+    log_vals = p.log_base_values()
+
+    def welfare(n):
+        planned_sec = np.minimum(n * p.round_duration, need_sec)
+        progress = (p.completed_epochs + planned_sec / p.epoch_duration) / p.total_epochs
+        util = np.interp(np.clip(progress, 0, 1), p.log_bases, log_vals)
+        return p.priorities * util / (p.num_jobs * p.future_rounds)
+
+    def lateness(n):
+        planned_sec = np.minimum(n * p.round_duration, need_sec)
+        return np.maximum(0.0, p.remaining_runtime - planned_sec)
+
+    for _ in range(max_moves):
+        used = float(np.sum(counts * p.nworkers))
+        w = welfare(counts)
+        ell = lateness(counts)
+        m1 = ell.max() if len(ell) else 0.0
+        # max excluding each job (top-2 trick).
+        is_max = ell >= m1
+        m2 = np.max(np.where(is_max, -np.inf, ell)) if is_max.sum() < len(ell) else m1
+        if is_max.sum() > 1:
+            m2 = m1
+        m_excl = np.where(is_max, m2, m1)
+
+        gain_plus = (
+            welfare(counts + 1)
+            - w
+            + p.regularizer * (m1 - np.maximum(m_excl, lateness(counts + 1)))
+        )
+        gain_plus[counts >= R] = -np.inf
+        loss_minus = (
+            w
+            - welfare(counts - 1)
+            + p.regularizer * (np.maximum(m_excl, lateness(counts - 1)) - m1)
+        )
+        loss_minus[counts <= 0] = np.inf
+
+        best_delta, best_move = 1e-9, None
+        # Pure grant into spare budget.
+        feasible_add = p.nworkers <= budget - used
+        if feasible_add.any():
+            b = int(np.argmax(np.where(feasible_add, gain_plus, -np.inf)))
+            if feasible_add[b] and gain_plus[b] > best_delta:
+                best_delta, best_move = gain_plus[b], (None, b)
+        # Swap: cheapest donor -> best receiver (argmax over the two
+        # one-dimensional margins is exchange-optimal for a single move).
+        a = int(np.argmin(loss_minus))
+        if np.isfinite(loss_minus[a]):
+            swap_ok = p.nworkers <= budget - used + p.nworkers[a]
+            swap_gain = np.where(swap_ok, gain_plus, -np.inf) - loss_minus[a]
+            swap_gain[a] = -np.inf
+            b = int(np.argmax(swap_gain))
+            if swap_gain[b] > best_delta:
+                best_delta, best_move = swap_gain[b], (a, b)
+        if best_move is None:
+            break
+        donor, receiver = best_move
+        if donor is not None:
+            counts[donor] -= 1
+        counts[receiver] += 1
+    return counts.astype(np.int64)
+
+
 def schedule_from_relaxed(
     s: np.ndarray,
     priorities: np.ndarray,
     nworkers: np.ndarray,
     num_gpus: int,
     future_rounds: int,
+    problem=None,
 ) -> np.ndarray:
     counts = round_counts(s, nworkers, num_gpus, future_rounds)
+    if problem is not None:
+        counts = refine_counts(counts, problem)
     return order_schedule(counts, priorities, nworkers, num_gpus, future_rounds)
