@@ -1,0 +1,159 @@
+#!/usr/bin/env python3
+"""Poisson generated-jobs simulation driver.
+
+Equivalent of the reference's
+scripts/drivers/simulate_scheduler_with_generated_jobs.py:1-346: generate
+``--num_jobs`` jobs with exponential interarrivals of mean ``--lam``
+seconds, simulate under a policy, and report metrics over an optional
+measurement window (jobs [window_start, window_end)) so warmup/drain
+effects can be excluded, the way the reference's capacity-planning sweeps
+measure steady state.
+
+Example:
+  python scripts/drivers/simulate_with_generated_jobs.py \\
+      -p max_min_fairness -n 200 --lam 600 -c 36:36:36 -s 50 -e 150
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+from shockwave_tpu.core.ids import JobId
+from shockwave_tpu.core.scheduler import Scheduler
+from shockwave_tpu.data import write_trace
+from shockwave_tpu.data.default_oracle import generate_oracle
+from shockwave_tpu.data.generate import (
+    DYNAMIC_MODE_DIST,
+    GAVEL_SCALE_FACTOR_DIST,
+    SHOCKWAVE_SCALE_FACTOR_DIST,
+    STATIC_MODE_DIST,
+    generate_trace_jobs,
+)
+from shockwave_tpu.data.profiles import synthesize_profiles
+from shockwave_tpu.data.throughputs import read_throughputs
+from shockwave_tpu.policies import get_available_policies, get_policy
+
+
+def main(args):
+    if args.throughputs_file:
+        throughputs = read_throughputs(args.throughputs_file)
+    else:
+        throughputs = generate_oracle()
+
+    style_kwargs = (
+        dict(
+            scale_factor_dist=SHOCKWAVE_SCALE_FACTOR_DIST,
+            mode_dist=DYNAMIC_MODE_DIST,
+        )
+        if args.style == "shockwave"
+        else dict(
+            scale_factor_dist=(
+                GAVEL_SCALE_FACTOR_DIST
+                if args.generate_multi_gpu_jobs
+                else {1: 1.0}
+            ),
+            mode_dist=STATIC_MODE_DIST,
+            duration_hours=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10],
+        )
+    )
+    jobs, arrivals = generate_trace_jobs(
+        args.num_jobs,
+        throughputs,
+        seed=args.seed,
+        lam=args.lam,
+        **style_kwargs,
+    )
+    if args.output_trace_file:
+        write_trace(args.output_trace_file, jobs, arrivals)
+        print(f"Wrote generated trace to {args.output_trace_file}")
+
+    profiles = synthesize_profiles(jobs, throughputs)
+    for i, job in enumerate(jobs):
+        job.duration = sum(profiles[i]["duration_every_epoch"])
+
+    counts = [int(x) for x in args.cluster_spec.split(":")]
+    cluster_spec = {
+        wt: n
+        for wt, n in zip(("v100", "p100", "k80"), counts)
+        if n > 0
+    }
+
+    shockwave_config = None
+    if args.policy.startswith("shockwave"):
+        shockwave_config = {
+            "time_per_iteration": args.time_per_iteration,
+            "num_gpus": cluster_spec.get("v100", 0),
+        }
+
+    policy = get_policy(args.policy, seed=args.seed)
+    sched = Scheduler(
+        policy,
+        simulate=True,
+        throughputs=throughputs,
+        seed=args.seed,
+        time_per_iteration=args.time_per_iteration,
+        profiles=profiles,
+        shockwave_config=shockwave_config,
+        profiling_percentage=args.profiling_percentage,
+    )
+
+    jobs_to_complete = None
+    if args.window_start is not None and args.window_end is not None:
+        jobs_to_complete = {
+            JobId(i) for i in range(args.window_start, args.window_end)
+        }
+
+    makespan = sched.simulate(
+        cluster_spec,
+        arrivals,
+        jobs,
+        jobs_to_complete=jobs_to_complete,
+        checkpoint_threshold=args.checkpoint_threshold,
+        checkpoint_file=args.checkpoint_file,
+    )
+    avg_jct = sched.get_average_jct(jobs_to_complete)
+    utilization = sched.get_cluster_utilization()
+    print(f"Policy: {args.policy}  lam={args.lam}s  jobs={args.num_jobs}")
+    print(f"Makespan: {makespan:.3f} s")
+    if avg_jct is not None:
+        print(f"Average JCT: {avg_jct:.3f} s ({avg_jct / 3600.0:.2f} h)")
+    if utilization is not None:
+        print(f"Cluster utilization: {utilization:.3f}")
+    print(f"SLO violations: {sched.get_num_SLO_violations()}")
+    print(f"Lease extension rate: {sched.get_num_lease_extensions():.1f}%")
+    return makespan
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="Simulate with Poisson-generated jobs"
+    )
+    parser.add_argument(
+        "-p", "--policy", type=str, default="max_min_fairness",
+        choices=get_available_policies(),
+    )
+    parser.add_argument("-n", "--num_jobs", type=int, default=100)
+    parser.add_argument(
+        "--lam", type=float, default=600.0,
+        help="Mean interarrival time in seconds (0 = all jobs at t=0)",
+    )
+    parser.add_argument("-c", "--cluster_spec", type=str, default="25:0:0")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--time_per_iteration", type=int, default=360)
+    parser.add_argument("--style", choices=["gavel", "shockwave"], default="gavel")
+    parser.add_argument("--generate_multi_gpu_jobs", action="store_true")
+    parser.add_argument("--throughputs_file", type=str, default=None)
+    parser.add_argument("--profiling_percentage", type=float, default=1.0)
+    parser.add_argument("-s", "--window-start", type=int, default=None)
+    parser.add_argument("-e", "--window-end", type=int, default=None)
+    parser.add_argument("--output_trace_file", type=str, default=None)
+    parser.add_argument("--checkpoint_threshold", type=int, default=None)
+    parser.add_argument("--checkpoint_file", type=str, default=None)
+    main(parser.parse_args())

@@ -1,0 +1,176 @@
+#!/usr/bin/env python3
+"""Policy sweep runner (continuous + static).
+
+Equivalent of the reference's scripts/sweeps/run_sweep_continuous.py and
+run_sweep_static.py (documented GAVEL.md:56-137): a multiprocess sweep
+over policy x load x seed.
+
+  continuous: Poisson arrivals with mean interarrival --lams seconds;
+              metrics measured over the jobs_to_complete window
+              [--window_start, --window_end).
+  static:     --num_jobs all submitted at t=0; metrics over all jobs.
+
+Each cell appends one JSON line to <out>/results.jsonl, so partially
+completed sweeps are usable and repeated runs skip finished cells.
+
+Example:
+  python scripts/sweeps/run_sweep.py --mode static \\
+      --policies fifo max_min_fairness --num_jobs 60 --seeds 0 1 \\
+      --cluster_spec 16:0:0 --out results/sweep_static
+"""
+
+import argparse
+import json
+import multiprocessing
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+
+def run_cell(cell):
+    """One (policy, load, seed) simulation; returns a result record."""
+    from shockwave_tpu.core.ids import JobId
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.data.generate import (
+        GAVEL_SCALE_FACTOR_DIST,
+        STATIC_MODE_DIST,
+        generate_trace_jobs,
+    )
+    from shockwave_tpu.data.profiles import synthesize_profiles
+    from shockwave_tpu.policies import get_policy
+
+    throughputs = generate_oracle()
+    jobs, arrivals = generate_trace_jobs(
+        cell["num_jobs"],
+        throughputs,
+        seed=cell["seed"],
+        lam=cell["lam"],
+        scale_factor_dist=(
+            GAVEL_SCALE_FACTOR_DIST if cell["multi_gpu"] else {1: 1.0}
+        ),
+        mode_dist=STATIC_MODE_DIST,
+        duration_hours=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10],
+    )
+    profiles = synthesize_profiles(jobs, throughputs)
+    for i, job in enumerate(jobs):
+        job.duration = sum(profiles[i]["duration_every_epoch"])
+
+    shockwave_config = None
+    if cell["policy"].startswith("shockwave"):
+        shockwave_config = {
+            "time_per_iteration": cell["time_per_iteration"],
+            "num_gpus": cell["cluster_spec"].get("v100", 0),
+        }
+    sched = Scheduler(
+        get_policy(cell["policy"], seed=cell["seed"]),
+        simulate=True,
+        throughputs=throughputs,
+        seed=cell["seed"],
+        time_per_iteration=cell["time_per_iteration"],
+        profiles=profiles,
+        shockwave_config=shockwave_config,
+    )
+    jobs_to_complete = None
+    if cell["window"] is not None:
+        jobs_to_complete = {
+            JobId(i) for i in range(cell["window"][0], cell["window"][1])
+        }
+    makespan = sched.simulate(
+        cell["cluster_spec"], arrivals, jobs, jobs_to_complete=jobs_to_complete
+    )
+    ftf_list, unfair_fraction = sched.get_finish_time_fairness()
+    return {
+        **{k: cell[k] for k in ("policy", "lam", "seed", "num_jobs", "mode")},
+        "makespan": makespan,
+        "avg_jct": sched.get_average_jct(jobs_to_complete),
+        "utilization": sched.get_cluster_utilization(),
+        "worst_ftf": max(ftf_list) if ftf_list else None,
+        "unfair_fraction": unfair_fraction,
+    }
+
+
+def main(args):
+    counts = [int(x) for x in args.cluster_spec.split(":")]
+    cluster_spec = {
+        wt: n for wt, n in zip(("v100", "p100", "k80"), counts) if n > 0
+    }
+    os.makedirs(args.out, exist_ok=True)
+    results_path = os.path.join(args.out, "results.jsonl")
+
+    done = set()
+    if os.path.exists(results_path):
+        with open(results_path) as f:
+            for line in f:
+                r = json.loads(line)
+                done.add((r["policy"], r["lam"], r["seed"]))
+
+    window = None
+    if args.window_start is not None and args.window_end is not None:
+        window = (args.window_start, args.window_end)
+
+    cells = []
+    lams = args.lams if args.mode == "continuous" else [0.0]
+    for policy in args.policies:
+        for lam in lams:
+            for seed in args.seeds:
+                if (policy, lam, seed) in done:
+                    print(f"[skip] {policy} lam={lam} seed={seed}")
+                    continue
+                cells.append(
+                    dict(
+                        policy=policy,
+                        lam=lam,
+                        seed=seed,
+                        num_jobs=args.num_jobs,
+                        cluster_spec=cluster_spec,
+                        time_per_iteration=args.time_per_iteration,
+                        multi_gpu=args.generate_multi_gpu_jobs,
+                        window=window,
+                        mode=args.mode,
+                    )
+                )
+
+    if not cells:
+        print("Nothing to do.")
+        return
+    with multiprocessing.Pool(args.processes) as pool:
+        for result in pool.imap_unordered(run_cell, cells):
+            with open(results_path, "a") as f:
+                f.write(json.dumps(result) + "\n")
+            print(
+                f"[done] {result['policy']} lam={result['lam']} "
+                f"seed={result['seed']}: avg_jct={result['avg_jct']:.0f}s"
+            )
+    print(f"Results in {results_path}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="Policy sweep runner")
+    parser.add_argument(
+        "--mode", choices=["continuous", "static"], default="continuous"
+    )
+    parser.add_argument(
+        "--policies", type=str, nargs="+",
+        default=["fifo", "max_min_fairness"],
+    )
+    parser.add_argument(
+        "--lams", type=float, nargs="+", default=[1200.0, 600.0, 300.0],
+        help="Mean interarrival seconds (continuous mode)",
+    )
+    parser.add_argument("--seeds", type=int, nargs="+", default=[0, 1])
+    parser.add_argument("--num_jobs", type=int, default=150)
+    parser.add_argument("-c", "--cluster_spec", type=str, default="36:0:0")
+    parser.add_argument("--time_per_iteration", type=int, default=360)
+    parser.add_argument("--generate_multi_gpu_jobs", action="store_true")
+    parser.add_argument("--window_start", type=int, default=None)
+    parser.add_argument("--window_end", type=int, default=None)
+    parser.add_argument("--processes", type=int, default=4)
+    parser.add_argument("--out", type=str, default="results/sweep")
+    main(parser.parse_args())
