@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""Plot a continuous-sweep results.jsonl: average JCT (and utilization)
+vs offered load, one line per policy — the Gavel-style capacity-planning
+figure (reference: notebooks/figures/evaluation).
+
+  python scripts/analysis/plot_sweep.py results/sweep/results.jsonl -o sweep.png
+"""
+
+import argparse
+import json
+from collections import defaultdict
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+
+# Fixed categorical assignment (identity follows the policy).
+PALETTE = ["#2a78d6", "#eb6834", "#1baf7a", "#eda100", "#e87ba4", "#008300"]
+
+
+def main(args):
+    with open(args.results) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    if not records:
+        raise SystemExit("No records")
+
+    policies = sorted({r["policy"] for r in records})
+    colors = {p: PALETTE[i % len(PALETTE)] for i, p in enumerate(policies)}
+
+    fig, axes = plt.subplots(1, 2, figsize=(11, 4.2))
+    for metric, ax, label in (
+        ("avg_jct", axes[0], "Average JCT (s)"),
+        ("utilization", axes[1], "Cluster utilization"),
+    ):
+        for policy in policies:
+            by_load = defaultdict(list)
+            for r in records:
+                if r["policy"] == policy and r.get(metric) is not None:
+                    # Offered load grows as interarrival time shrinks.
+                    by_load[r["lam"]].append(r[metric])
+            lams = sorted(by_load, reverse=True)
+            if not lams:
+                continue
+            values = [float(np.mean(by_load[lam])) for lam in lams]
+            ax.plot(
+                range(len(lams)),
+                values,
+                label=policy,
+                color=colors[policy],
+                linewidth=2,
+                marker="o",
+                markersize=5,
+            )
+            ax.set_xticks(range(len(lams)))
+            ax.set_xticklabels([f"{lam:g}" for lam in lams])
+        ax.set_xlabel("Mean interarrival time (s) — load increases →")
+        ax.set_title(label, fontsize=11)
+        ax.grid(color="#dddddd", linewidth=0.6)
+        for spine in ("top", "right"):
+            ax.spines[spine].set_visible(False)
+    axes[0].legend(fontsize=9, frameon=False)
+    fig.tight_layout()
+    fig.savefig(args.output, dpi=150)
+    print(f"Wrote {args.output}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="Plot a sweep")
+    parser.add_argument("results", type=str)
+    parser.add_argument("-o", "--output", type=str, default="sweep.png")
+    main(parser.parse_args())

@@ -1,0 +1,93 @@
+#!/usr/bin/env python3
+"""Result postprocessing: turn result pickles / JSON-lines sweeps into
+tables on stdout.
+
+The L8 analysis layer's text half (reference: scheduler/notebooks +
+scripts/utils/postprocess_simulator_log.py); the plotting half lives in
+plot_sweep.py and scripts/replicate/plot_scale_experiment.py.
+
+  python scripts/analysis/summarize.py results/scale
+  python scripts/analysis/summarize.py results/sweep/results.jsonl
+"""
+
+import argparse
+import json
+import os
+import pickle
+import sys
+
+METRIC_COLUMNS = [
+    ("makespan", "makespan(s)"),
+    ("avg_jct", "avg_jct(s)"),
+    ("worst_ftf", "worst_ftf"),
+    ("unfair_fraction", "unfair(%)"),
+    ("utilization", "util"),
+]
+
+
+def load_records(path):
+    records = []
+    if os.path.isdir(path):
+        for fn in sorted(os.listdir(path)):
+            full = os.path.join(path, fn)
+            if fn.endswith(".pickle"):
+                with open(full, "rb") as f:
+                    records.append(pickle.load(f))
+            elif fn.endswith(".jsonl"):
+                records.extend(load_records(full))
+            elif fn == "summary.json":
+                continue
+    elif path.endswith(".jsonl"):
+        with open(path) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+    elif path.endswith(".pickle"):
+        with open(path, "rb") as f:
+            records = [pickle.load(f)]
+    else:
+        raise SystemExit(f"Don't know how to read {path}")
+    return records
+
+
+def fmt(value):
+    if value is None:
+        return "-"
+    if isinstance(value, float):
+        return f"{value:.2f}" if abs(value) < 100 else f"{value:.0f}"
+    return str(value)
+
+
+def main(args):
+    records = load_records(args.path)
+    if not records:
+        raise SystemExit("No records found")
+    key_cols = [
+        c
+        for c in ("policy", "num_gpus", "lam", "seed", "num_jobs", "mode")
+        if any(c in r for r in records)
+    ]
+    header = key_cols + [label for m, label in METRIC_COLUMNS
+                         if any(m in r for r in records)]
+    rows = []
+    for r in sorted(
+        records, key=lambda r: tuple(str(r.get(c, "")) for c in key_cols)
+    ):
+        row = [fmt(r.get(c)) for c in key_cols]
+        row += [
+            fmt(r.get(m))
+            for m, _ in METRIC_COLUMNS
+            if any(m in rec for rec in records)
+        ]
+        rows.append(row)
+    widths = [
+        max(len(h), *(len(row[i]) for row in rows))
+        for i, h in enumerate(header)
+    ]
+    print("  ".join(h.rjust(w) for h, w in zip(header, widths)))
+    for row in rows:
+        print("  ".join(v.rjust(w) for v, w in zip(row, widths)))
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="Summarize result files")
+    parser.add_argument("path", type=str)
+    main(parser.parse_args())

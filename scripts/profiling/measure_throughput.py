@@ -1,0 +1,188 @@
+#!/usr/bin/env python3
+"""Throughput-profiling harness: measure a real oracle on this accelerator.
+
+Equivalent of the reference's scripts/profiling/measure_throughput.py:
+runs each (model family, batch size) workload's jitted train step on the
+JAX default device, measures isolated steps/s, optionally measures
+colocated pairs, and writes an oracle JSON in the reference's
+throughputs-file format (readable by --throughputs_file everywhere).
+
+Colocation on a single accelerator is measured as strict time-slicing
+(steps of the two jobs alternate; each job's effective rate is
+steps / total wall-clock), which is what round-level packing on a
+one-process-per-accelerator runtime produces. Scale factors > 1 are
+extrapolated with the same per-doubling gang efficiency the synthetic
+oracle uses (no multi-chip gang hardware is assumed present); pass
+--measured_scale_factors_only to write only what was measured.
+
+Example:
+  python scripts/profiling/measure_throughput.py \\
+      --families ResNet-18 LM --warmup 5 --steps 30 -o measured_oracle.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+import types
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+from shockwave_tpu.data.default_oracle import (
+    _FAMILY_BATCH_SIZES,
+    _GANG_EFFICIENCY,
+)
+from shockwave_tpu.data.throughputs import stringify_throughputs
+
+SCALE_FACTORS = [1, 2, 4, 8]
+
+
+def model_args(family, batch_size):
+    return types.SimpleNamespace(
+        seed=0,
+        batch_size=batch_size,
+        learning_rate=1e-3,
+        vocab_size=1024,
+        d_model=128,
+        num_heads=4,
+        num_layers=2,
+        seq_len=128,
+        attention="dense",
+        num_experts=0,
+    )
+
+
+def build_step(family, batch_size):
+    import jax
+    import numpy as np
+
+    from shockwave_tpu.models.train import build_family
+
+    variables, step_fn, opt_state, batch_fn = build_family(
+        family, model_args(family, batch_size), mesh=None
+    )
+    step = jax.jit(step_fn)
+    np_rng = np.random.default_rng(0)
+    batch = batch_fn(np_rng)
+    state = {"variables": variables, "opt": opt_state}
+
+    def one_step():
+        state["variables"], state["opt"], loss = step(
+            state["variables"], state["opt"], batch
+        )
+        return loss
+
+    return one_step
+
+
+def measure_isolated(one_step, warmup, steps):
+    for _ in range(warmup):
+        loss = one_step()
+    loss.block_until_ready()
+    start = time.time()
+    for _ in range(steps):
+        loss = one_step()
+    loss.block_until_ready()
+    return steps / (time.time() - start)
+
+
+def measure_pair(step_a, step_b, warmup, steps):
+    """Strict time-slicing: alternate steps; each side's effective rate is
+    steps / total elapsed."""
+    for _ in range(warmup):
+        la = step_a()
+        lb = step_b()
+    lb.block_until_ready()
+    start = time.time()
+    for _ in range(steps):
+        la = step_a()
+        lb = step_b()
+    la.block_until_ready()
+    lb.block_until_ready()
+    elapsed = time.time() - start
+    return steps / elapsed, steps / elapsed
+
+
+def main(args):
+    import jax
+
+    worker_type = args.worker_type
+    device = jax.devices()[0]
+    print(f"Profiling on {device.platform}:{device.device_kind}")
+
+    jobs = []
+    for family in args.families:
+        for bs in _FAMILY_BATCH_SIZES[family]:
+            if args.batch_sizes and bs not in args.batch_sizes:
+                continue
+            jobs.append((family, bs))
+
+    per_type = {}
+    isolated = {}
+    for family, bs in jobs:
+        one_step = build_step(family, bs)
+        tput = measure_isolated(one_step, args.warmup, args.steps)
+        isolated[(family, bs)] = tput
+        job_type = f"{family} (batch size {bs})"
+        print(f"  {job_type}: {tput:.2f} steps/s")
+        per_type[(job_type, 1)] = {"null": tput}
+        # sf > 1: extrapolated with the synthetic oracle's per-doubling
+        # gang efficiency (data-parallel speedup, same convention as
+        # default_oracle.isolated_steps_per_sec).
+        if not args.measured_scale_factors_only:
+            for sf in SCALE_FACTORS[1:]:
+                gang = sf * (_GANG_EFFICIENCY ** (sf - 1).bit_length())
+                per_type[(job_type, sf)] = {"null": tput * gang}
+
+    if args.pairs:
+        for i, (fam_a, bs_a) in enumerate(jobs):
+            for fam_b, bs_b in jobs[i:]:
+                step_a = build_step(fam_a, bs_a)
+                step_b = build_step(fam_b, bs_b)
+                ta, tb = measure_pair(step_a, step_b, args.warmup, args.steps)
+                # Async dispatch lets the two steps overlap on-device, so
+                # the interleaved rate can exceed the isolated rate (which
+                # pays per-step dispatch latency). Clamp to the isolated
+                # ceiling: consumers (the throughput estimator) require
+                # colocation fractions in [0, 1].
+                ta = min(ta, isolated[(fam_a, bs_a)])
+                tb = min(tb, isolated[(fam_b, bs_b)])
+                key_a = (f"{fam_a} (batch size {bs_a})", 1)
+                key_b = (f"{fam_b} (batch size {bs_b})", 1)
+                per_type[key_a][key_b] = [ta, tb]
+                if key_a != key_b:
+                    per_type[key_b][key_a] = [tb, ta]
+                print(
+                    f"  {key_a[0]} || {key_b[0]}: {ta:.2f} / {tb:.2f} steps/s"
+                )
+
+    oracle = {worker_type: per_type}
+    with open(args.output, "w") as f:
+        json.dump(stringify_throughputs(oracle), f, indent=2)
+    print(f"Wrote {args.output}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="Measure a throughput oracle")
+    parser.add_argument(
+        "--families", type=str, nargs="+",
+        default=["ResNet-18", "LM", "Recommendation"],
+        choices=sorted(_FAMILY_BATCH_SIZES),
+    )
+    parser.add_argument(
+        "--batch_sizes", type=int, nargs="*", default=None,
+        help="Restrict to these batch sizes (default: the family's table)",
+    )
+    parser.add_argument("--warmup", type=int, default=5)
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--pairs", action="store_true")
+    parser.add_argument("--worker_type", type=str, default="v100")
+    parser.add_argument("--measured_scale_factors_only", action="store_true")
+    parser.add_argument("-o", "--output", type=str, default="measured_oracle.json")
+    main(parser.parse_args())
