@@ -198,7 +198,7 @@ class ShockwavePlanner:
             weights = windows / np.sum(windows)
         finish_times = np.array([ft for _, ft in history[: weights.size]])
         avg = float(np.dot(weights, finish_times))
-        return alpha * avg + (1 - alpha) * history[-1][1]
+        return max(1e-6, alpha * avg + (1 - alpha) * history[-1][1])
 
     def _solve(self, problem: EGProblem) -> np.ndarray:
         if self.backend == "reference":

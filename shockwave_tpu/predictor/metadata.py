@@ -176,7 +176,13 @@ class JobMetadata:
         for bs, n in counts.items():
             rebased[bs] = max(0.0, rebased[bs] - n)
         durations = self.bs_epoch_durations()
-        return float(sum(rebased[bs] * durations[bs] for bs in rebased))
+        expected = float(sum(rebased[bs] * durations[bs] for bs in rebased))
+        # Floor at 1 s: an incomplete job always has work left. A
+        # single-epoch job would otherwise predict exactly 0 (its
+        # in-progress epoch is counted as observed and subtracted back
+        # out), which zeroes the planner's finish-time estimate — latent
+        # in the reference, whose traces have no 1-epoch jobs.
+        return max(1.0, expected)
 
 
 def batch_remaining_runtimes(metadatas: Sequence[JobMetadata]) -> np.ndarray:

@@ -133,3 +133,27 @@ class TestInterpolatedEpochDuration:
         assert md.mean_epoch_duration() == pytest.approx(150.0)
         md.complete(2)
         assert md.mean_epoch_duration() == pytest.approx(300.0)
+
+
+def test_single_epoch_job_remaining_runtime_floored():
+    """A 1-epoch job's in-progress epoch is counted as observed and
+    subtracted out of the rebased posterior; the prediction must floor at
+    1 s rather than reach exactly 0 (which zeroes the planner's finish
+    time and divides by zero in the FTF priorities)."""
+    from shockwave_tpu.predictor import JobMetadata
+
+    md = JobMetadata(
+        {
+            "num_epochs": 1,
+            "num_samples_per_epoch": 50000,
+            "scale_factor": 1,
+            "duration": 19.0,
+            "bs_every_epoch": [32],
+            "mem_every_epoch": [0.0],
+            "util_every_epoch": [0.0],
+            "duration_every_epoch": [19.0],
+        },
+        round_duration=3.0,
+    )
+    md.submit(0.0)
+    assert md.remaining_runtime() >= 1.0
