@@ -76,8 +76,11 @@ class Scheduler:
         self._max_rounds = max_rounds
         self._min_reset_interval = minimum_time_between_allocation_resets
         self._enable_global_queue = enable_global_queue
-        # $/accelerator-hour per worker type; None disables cost accounting
-        # (reference: scheduler.py:294-308, 3399-3411).
+        # $/accelerator-hour per worker type; None disables cost
+        # accounting. Each value is either a constant or a time-varying
+        # [[time_s, price], ...] schedule resolved at charge time
+        # (reference: scheduler.py:294-308, 3399-3411 with the spot-price
+        # lookups of utils.py:300-420; see data/spot_prices.py).
         self._per_worker_type_prices = per_worker_type_prices
 
         self._current_timestamp: float = 0.0
@@ -929,8 +932,14 @@ class Scheduler:
                 if not is_active[single]:
                     continue
                 if self._per_worker_type_prices is not None:
+                    from shockwave_tpu.data.spot_prices import latest_price
+
                     self._job_cost_so_far[single] += (
-                        self._per_worker_type_prices.get(worker_type, 0.0)
+                        latest_price(
+                            self._per_worker_type_prices,
+                            worker_type,
+                            self.get_current_timestamp(),
+                        )
                         * execution_time
                         / 3600.0
                         * scale_factor

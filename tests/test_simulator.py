@@ -191,3 +191,47 @@ def test_checkpoint_save_load_continue_determinism(tmp_path):
         assert b._job_completion_times[job_id] == pytest.approx(jct)
     # The resumed run replays only the suffix.
     assert b._num_completed_rounds < ref._num_completed_rounds
+
+
+def test_cost_accounting_constant_and_spot_schedule():
+    """Per-worker-type prices may be constants or time-varying
+    [[time, price], ...] schedules (the reference's spot-price capability,
+    utils.py:300-420) resolved at charge time."""
+    from shockwave_tpu.data.spot_prices import latest_price
+
+    schedules = {"v100": [[0.0, 3.0], [100.0, 1.0]]}
+    assert latest_price(schedules, "v100", 0.0) == 3.0
+    assert latest_price(schedules, "v100", 99.9) == 3.0
+    assert latest_price(schedules, "v100", 100.0) == 1.0
+    assert latest_price(schedules, "v100", 1e9) == 1.0
+    assert latest_price({"v100": 0.5}, "v100", 50.0) == 0.5
+    assert latest_price({}, "k80", 0.0) == 0.0
+
+    jobs, arrivals = tiny_trace(num_jobs=2, epochs=2)
+    oracle = generate_oracle()
+    profiles = synthesize_profiles(jobs, oracle)
+    flat = Scheduler(
+        get_policy("fifo"),
+        throughputs=oracle,
+        time_per_iteration=120,
+        profiles=profiles,
+        per_worker_type_prices={"v100": 3.6},
+    )
+    flat.simulate({"v100": 2}, arrivals, jobs)
+    # Each job ran ~duration seconds at $3.6/hr.
+    expected = sum(
+        sum(p["duration_every_epoch"]) for p in profiles.values()
+    ) * 3.6 / 3600.0
+    assert flat.get_total_cost() == pytest.approx(expected, rel=0.05)
+
+    jobs2, arrivals2 = tiny_trace(num_jobs=2, epochs=2)
+    spot = Scheduler(
+        get_policy("fifo"),
+        throughputs=oracle,
+        time_per_iteration=120,
+        profiles=synthesize_profiles(jobs2, oracle),
+        per_worker_type_prices={"v100": [[0.0, 3.6], [1e9, 999.0]]},
+    )
+    spot.simulate({"v100": 2}, arrivals2, jobs2)
+    # The second breakpoint never activates: same cost as the constant.
+    assert spot.get_total_cost() == pytest.approx(flat.get_total_cost())
