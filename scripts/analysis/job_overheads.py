@@ -1,0 +1,92 @@
+#!/usr/bin/env python3
+"""Per-micro-task overhead analysis for physical runs.
+
+Equivalent of the reference's scripts/utils/get_job_overheads.py: compares
+each micro-task's wall-clock (subprocess lifetime, from the dispatcher's
+stdout log mtimes and the iterator timestamps) against the useful training
+time the iterator reported, yielding the per-round dispatch + compile +
+checkpoint overhead.
+
+Reads a worker's --run_dir: each round leaves
+``job=J_worker=W_round=R.log`` (iterator structured log with PROGRESS
+lines) next to ``.stdout`` files.
+
+  python scripts/analysis/job_overheads.py /tmp/run
+"""
+
+import argparse
+import os
+import re
+from collections import defaultdict
+
+PROGRESS_RE = re.compile(r"steps=(\d+) duration=([0-9.]+)")
+NAME_RE = re.compile(r"job=(\d+)_worker=(\d+)_round=(\d+)\.log$")
+TS_RE = re.compile(r"^\[([0-9T:.\-]+)\]")
+
+
+def parse_log(path):
+    """Returns (useful_seconds, wall_seconds) for one micro-task log."""
+    import datetime
+
+    with open(path) as f:
+        lines = f.readlines()
+    if not lines:
+        return None
+    progress = None
+    for line in lines:
+        m = PROGRESS_RE.search(line)
+        if m:
+            progress = float(m.group(2))
+    timestamps = []
+    for line in lines:
+        m = TS_RE.match(line)
+        if m:
+            timestamps.append(datetime.datetime.fromisoformat(m.group(1)))
+    if progress is None or len(timestamps) < 2:
+        return None
+    wall = (timestamps[-1] - timestamps[0]).total_seconds()
+    return progress, wall
+
+
+def main(args):
+    per_job = defaultdict(list)
+    for fn in sorted(os.listdir(args.run_dir)):
+        m = NAME_RE.search(fn)
+        if not m:
+            continue
+        parsed = parse_log(os.path.join(args.run_dir, fn))
+        if parsed is None:
+            continue
+        useful, wall = parsed
+        per_job[int(m.group(1))].append((int(m.group(3)), useful, wall))
+
+    if not per_job:
+        raise SystemExit(f"No parsable micro-task logs in {args.run_dir}")
+
+    print(f"{'job':>5} {'tasks':>6} {'useful(s)':>10} {'wall(s)':>9} "
+          f"{'overhead(s)':>12} {'overhead%':>10}")
+    total_useful = total_wall = 0.0
+    for job_id in sorted(per_job):
+        useful = sum(u for _, u, _ in per_job[job_id])
+        wall = sum(w for _, _, w in per_job[job_id])
+        total_useful += useful
+        total_wall += wall
+        overhead = wall - useful
+        pct = 100.0 * overhead / wall if wall > 0 else 0.0
+        print(
+            f"{job_id:>5} {len(per_job[job_id]):>6} {useful:>10.2f} "
+            f"{wall:>9.2f} {overhead:>12.2f} {pct:>9.1f}%"
+        )
+    overhead = total_wall - total_useful
+    pct = 100.0 * overhead / total_wall if total_wall > 0 else 0.0
+    print(
+        f"{'all':>5} {sum(len(v) for v in per_job.values()):>6} "
+        f"{total_useful:>10.2f} {total_wall:>9.2f} {overhead:>12.2f} "
+        f"{pct:>9.1f}%"
+    )
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="Micro-task overheads")
+    parser.add_argument("run_dir", type=str)
+    main(parser.parse_args())
