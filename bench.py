@@ -8,8 +8,13 @@ solver at >= 20x lower wall-clock.
 
 Baseline here: the SAME formulation the reference hands GUROBI (boolean
 breakpoint-boundary encoding) solved by HiGHS on the host
-(solve_eg_milp_reference_formulation). Ours: the jitted placement-aware
-greedy (solve_eg_greedy), warm-cache, on whatever accelerator JAX sees.
+(solve_eg_milp_reference_formulation). Ours: the jitted level-set solver
+(solve_eg_level — the production device path: one batched grid of
+candidate makespan levels with closed-form mandatory grants and a
+sort-once threshold welfare fill), warm-cache, on whatever accelerator
+JAX sees. Note the measured time includes the host<->device transfer of
+each solve's inputs/results; on tunneled single-chip hosts that
+round-trip is most of the number.
 
 Config: the stress shape from BASELINE.json ("1000 synthetic jobs x 256
 workers x 50 rounds"), deterministic seed. Prints ONE JSON line.
@@ -44,18 +49,18 @@ def make_problem(num_jobs, future_rounds, num_gpus, seed=0, regularizer=10.0):
 
 
 def main():
-    from shockwave_tpu.solver.eg_jax import solve_eg_greedy
+    from shockwave_tpu.solver.eg_jax import solve_eg_level
     from shockwave_tpu.solver.eg_milp import solve_eg_milp_reference_formulation
 
     problem = make_problem(num_jobs=1000, future_rounds=50, num_gpus=256)
 
     # Ours: warm-cache solve (the simulator reuses the compiled plan step
     # every window; first-compile cost is paid once per trace).
-    solve_eg_greedy(problem)
+    solve_eg_level(problem)
     runs = 3
     t0 = time.time()
     for _ in range(runs):
-        Y_tpu = solve_eg_greedy(problem)
+        Y_tpu = solve_eg_level(problem)
     tpu_s = (time.time() - t0) / runs
 
     # Baseline: reference-formulation MILP on host CPU.

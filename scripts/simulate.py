@@ -49,7 +49,7 @@ def main(args):
     num_gpus_per_server = {"v100": per_server[0], "p100": per_server[1], "k80": per_server[2]}
 
     shockwave_config = None
-    if args.policy in ("shockwave", "shockwave_tpu"):
+    if args.policy.startswith("shockwave"):
         if args.config:
             with open(args.config) as f:
                 shockwave_config = json.load(f)

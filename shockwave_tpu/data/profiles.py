@@ -114,11 +114,14 @@ def load_or_synthesize_profiles(
     """Load ``<trace>.profile.pickle`` if present, else synthesize (and
     cache) profiles for the trace's jobs. The cache is keyed on the job
     count, worker type, and an oracle fingerprint so a pickle built against
-    a different oracle is never silently reused."""
+    a different oracle is never silently reused. ``cache=False`` bypasses
+    the pickle entirely — no read and no write — so hermetic callers
+    (golden tests, the replication harness) always exercise the current
+    synthesis code rather than machine state."""
     base, _ = os.path.splitext(trace_file)
     pickle_path = base + ".profile.pickle"
     fingerprint = _oracle_fingerprint(throughputs, worker_type)
-    if os.path.exists(pickle_path):
+    if cache and os.path.exists(pickle_path):
         with open(pickle_path, "rb") as f:
             cached = pickle.load(f)
         if (

@@ -143,6 +143,10 @@ def get_policy(
         from shockwave_tpu.policies.shockwave import ShockwavePolicy
 
         return ShockwavePolicy(backend="native")
+    if policy_name == "shockwave_tpu_level":
+        from shockwave_tpu.policies.shockwave import ShockwavePolicy
+
+        return ShockwavePolicy(backend="level")
     if policy_name == "shockwave_tpu_relaxed":
         from shockwave_tpu.policies.shockwave import ShockwavePolicy
 
@@ -179,6 +183,7 @@ _ALL_POLICY_NAMES = [
     "shockwave",
     "shockwave_tpu",
     "shockwave_native",
+    "shockwave_tpu_level",
     "shockwave_tpu_relaxed",
 ]
 

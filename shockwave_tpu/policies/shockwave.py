@@ -6,11 +6,15 @@ gated on the policy name); the planning logic lives in
 :class:`ShockwavePlanner`, the equivalent of the reference's
 ``ShockwaveScheduler`` (reference: scheduler/shockwave.py:12-91).
 
-Two interchangeable solver backends:
+Interchangeable solver backends:
   * ``reference`` — the exact boolean program on host CPU via HiGHS
     (:mod:`shockwave_tpu.solver.eg_milp`), reference-math ground truth.
-  * ``tpu`` — the jitted relaxed solve + greedy recovery
-    (:mod:`shockwave_tpu.solver.eg_jax`), the TPU-native fast path.
+  * ``tpu`` — the production path: latency-aware dispatch between the
+    C++ host greedy (small solves, where device round-trip latency
+    dominates) and the jitted level-set solve on the accelerator
+    (:func:`shockwave_tpu.solver.eg_jax.solve_eg_level`).
+  * ``level`` / ``native`` / ``relaxed`` — each of the above forced,
+    for tests, benchmarks, and cross-checks.
 """
 
 from __future__ import annotations
@@ -224,6 +228,11 @@ class ShockwavePlanner:
             from shockwave_tpu.native import solve_eg_greedy_native
 
             Y = solve_eg_greedy_native(problem)
+        elif self.backend == "level":
+            # Forced JAX level-set solve (the device path of "tpu").
+            from shockwave_tpu.solver.eg_jax import solve_eg_level
+
+            Y = solve_eg_level(problem)
         elif self.backend == "relaxed":
             # Projected-gradient ascent on the exact continuous relaxation,
             # then integer rounding + per-round placement on host.
@@ -240,9 +249,32 @@ class ShockwavePlanner:
                 problem=problem,
             )
         else:
-            from shockwave_tpu.solver.eg_jax import solve_eg_greedy
+            # "tpu", the production path: latency-aware dispatch. A plan
+            # solve is a single problem whose result the round loop needs
+            # back on host immediately, so for SMALL instances the
+            # device's fixed dispatch + fetch latency dominates any
+            # compute advantage and the C++ host core wins (the same
+            # reasoning XLA itself applies when it keeps tiny ops on
+            # host). Above the work threshold — or when no C++ toolchain
+            # is available — the jitted level-set solve runs on the
+            # accelerator, where its grid of candidate levels evaluates
+            # in one batched launch. Both paths optimize the identical
+            # objective and are cross-checked by tests.
+            Y = None
+            work = (
+                float(problem.num_gpus)
+                * problem.future_rounds
+                * problem.num_jobs
+            )
+            if work < 4e6:
+                from shockwave_tpu import native
 
-            Y = solve_eg_greedy(problem)
+                if native.available():
+                    Y = native.solve_eg_greedy_native(problem)
+            if Y is None:
+                from shockwave_tpu.solver.eg_jax import solve_eg_level
+
+                Y = solve_eg_level(problem)
         return reorder_rounds(
             Y, problem.priorities, problem.nworkers, problem.num_gpus
         )
@@ -295,6 +327,7 @@ class ShockwavePolicy(Policy):
         self.name = {
             "reference": "Shockwave",
             "native": "Shockwave_Native",
+            "level": "Shockwave_TPU_Level",
             "relaxed": "Shockwave_TPU_Relaxed",
         }.get(backend, "Shockwave_TPU")
 

@@ -64,10 +64,11 @@ def order_schedule(
 
     Best effort: aggregate-budget-feasible counts are not always per-round
     packable with gang constraints (e.g. g=[2,2], G=3, R=2, counts=[2,1]),
-    so row sums of the result may fall short of ``counts``. The production
-    planner path avoids this entirely by tracking per-round capacity
-    inside the greedy solve (solve_eg_greedy); this placement is only used
-    to recover schedules from the relaxed solver.
+    so row sums of the result may fall short of ``counts``. Callers that
+    need every grant placed must check row sums against ``counts``:
+    solve_eg_level (the production device path) falls back to the
+    packable-by-construction greedy when this placement drops grants;
+    the relaxed backend accepts the shortfall.
     """
     counts = np.asarray(counts, dtype=np.int64)
     J = len(counts)

@@ -134,6 +134,50 @@ class TestTpuBackend:
         scale = max(1.0, abs(obj_milp))
         assert obj_tpu >= obj_milp - 0.08 * scale
 
+    @pytest.mark.parametrize("seed", range(8))
+    def test_level_schedule_near_milp_quality(self, seed):
+        """The level-set solver on tiny instances: feasible and inside the
+        same approximation band as the greedy."""
+        from shockwave_tpu.solver.eg_jax import solve_eg_level
+
+        rng = np.random.default_rng(100 + seed)
+        problem = random_problem(rng, J=6, R=4, num_gpus=3)
+        Y_milp = solve_eg_milp(problem, rel_gap=1e-6, time_limit=30)
+        Y = solve_eg_level(problem)
+        assert np.all(problem.nworkers @ Y <= problem.num_gpus + 1e-9)
+        assert np.all(Y.sum(axis=1) <= problem.future_rounds)
+        obj_milp = problem.objective_value(Y_milp)
+        scale = max(1.0, abs(obj_milp))
+        assert problem.objective_value(Y) >= obj_milp - 0.08 * scale
+
+    def test_level_unpackable_counts_fall_back_to_greedy(self):
+        """Gang widths that don't tile the cluster: aggregate-feasible
+        counts [2, 1] (two width-2 gangs, 3 GPUs, 2 rounds) can only
+        place [2, 0]; the level path must not return that starved
+        schedule when the packable greedy scores better."""
+        from shockwave_tpu.solver.eg_jax import solve_eg_level
+
+        problem = make_problem(
+            priorities=[1.0, 1.0],
+            completed=[0.0, 0.0],
+            total=[10.0, 10.0],
+            epoch_dur=[100.0, 100.0],
+            remaining=[1000.0, 1000.0],
+            nworkers=[2.0, 2.0],
+            num_gpus=3,
+            round_duration=100.0,
+            future_rounds=2,
+            regularizer=1.0,
+        )
+        Y_level = solve_eg_level(problem)
+        Y_greedy = solve_eg_greedy(problem)
+        assert np.all(problem.nworkers @ Y_level <= problem.num_gpus + 1e-9)
+        assert problem.objective_value(Y_level) >= problem.objective_value(
+            Y_greedy
+        ) - 1e-9
+        # Both jobs make progress.
+        assert np.all(Y_level.sum(axis=1) >= 1)
+
     def test_relaxed_solution_feasible(self):
         rng = np.random.default_rng(3)
         problem = random_problem(rng, J=8, R=5, num_gpus=4)
@@ -253,6 +297,21 @@ class TestMidScaleQuality:
         # Objectives are large and negative (makespan-dominated); the
         # greedy must land within 1% of the MILP.
         assert og >= om - 0.01 * abs(om)
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_level_matches_milp_objective(self, seed):
+        """The level-set solver (production device path) is held to the
+        same 1% bar as the exact-marginal greedy."""
+        from shockwave_tpu.solver.eg_jax import solve_eg_level
+
+        problem = self._problem(seed)
+        Y = solve_eg_level(problem)
+        assert np.all(problem.nworkers @ Y <= problem.num_gpus + 1e-9)
+        ol = problem.objective_value(Y)
+        om = problem.objective_value(
+            solve_eg_milp(problem, rel_gap=1e-3, time_limit=30)
+        )
+        assert ol >= om - 0.01 * abs(om)
 
     @pytest.mark.parametrize("seed", range(3))
     def test_relaxed_rounding_matches_milp_objective(self, seed):
