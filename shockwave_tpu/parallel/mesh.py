@@ -4,11 +4,14 @@ The framework's standard mesh axes:
   "data"  — batch (data parallelism; gradients psum over it)
   "model" — tensor parallelism (attention heads / MLP hidden / experts)
   "seq"   — sequence/context parallelism (ring attention shards)
+  "pipe"  — pipeline parallelism (transformer stages; GPipe microbatch
+            schedule in shockwave_tpu/parallel/pipeline.py)
 
-Jobs pick a (data, model, seq) factorization of their gang; single-chip
-jobs use a trivial 1x1x1 mesh. All collectives are emitted by XLA from
-sharding annotations — nothing here issues them by hand except ring
-attention's ppermute (shockwave_tpu/parallel/ring_attention.py).
+Jobs pick a (data, model, seq[, pipe]) factorization of their gang;
+single-chip jobs use a trivial 1x1x1x1 mesh. All collectives are emitted
+by XLA from sharding annotations — nothing here issues them by hand
+except ring attention's ppermute
+(shockwave_tpu/parallel/ring_attention.py).
 """
 
 from __future__ import annotations
@@ -19,19 +22,22 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-AXES = ("data", "model", "seq")
+AXES = ("data", "model", "seq", "pipe")
 
 
 def make_mesh(
-    shape: Optional[Tuple[int, int, int]] = None,
+    shape: Optional[Tuple[int, ...]] = None,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """Mesh over (data, model, seq). Default: all devices on "data"."""
+    """Mesh over (data, model, seq[, pipe]). A 3-tuple shape gets a
+    trailing pipe axis of 1 (back-compat). Default: all devices on
+    "data"."""
     if devices is None:
         devices = jax.devices()
     n = len(devices)
     if shape is None:
-        shape = (n, 1, 1)
+        shape = (n, 1, 1, 1)
+    shape = tuple(shape) + (1,) * (len(AXES) - len(shape))
     if int(np.prod(shape)) != n:
         raise ValueError(f"mesh shape {shape} != {n} devices")
     return Mesh(np.asarray(devices).reshape(shape), AXES)
@@ -50,11 +56,22 @@ def batch_spec() -> PartitionSpec:
     return PartitionSpec("data", "seq")
 
 
-def factorize_gang(num_devices: int, seq_parallel: int = 1, model_parallel: int = 1):
-    """(data, model, seq) shape for a gang of ``num_devices``."""
-    if num_devices % (seq_parallel * model_parallel) != 0:
+def factorize_gang(
+    num_devices: int,
+    seq_parallel: int = 1,
+    model_parallel: int = 1,
+    pipe_parallel: int = 1,
+):
+    """(data, model, seq, pipe) shape for a gang of ``num_devices``."""
+    denom = seq_parallel * model_parallel * pipe_parallel
+    if num_devices % denom != 0:
         raise ValueError(
             f"{num_devices} devices not divisible by model={model_parallel} "
-            f"x seq={seq_parallel}"
+            f"x seq={seq_parallel} x pipe={pipe_parallel}"
         )
-    return (num_devices // (seq_parallel * model_parallel), model_parallel, seq_parallel)
+    return (
+        num_devices // denom,
+        model_parallel,
+        seq_parallel,
+        pipe_parallel,
+    )

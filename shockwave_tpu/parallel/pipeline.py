@@ -1,0 +1,241 @@
+"""Pipeline parallelism over the "pipe" mesh axis, SPMD-style.
+
+The reference has no intra-job model parallelism of any kind (SURVEY
+§2.3); this is part of the TPU build's beyond-parity parallelism story
+(with tensor parallelism, ring-attention sequence parallelism, and MoE
+expert parallelism in shockwave_tpu/models/transformer.py).
+
+Design — the XLA-native formulation (no hand-written send/recv loop):
+
+  * The transformer's blocks are STACKED into S stages: every parameter
+    gains a leading [S] axis, sharded over the "pipe" mesh axis, so each
+    device group holds exactly one stage's weights.
+  * The GPipe schedule is one ``lax.scan`` over T = M + S - 1 ticks
+    (M = number of microbatches). The carry holds a [S, microbatch, ...]
+    activation buffer, also stage-sharded. Each tick applies
+    ``vmap(stage_fn)`` across the stage axis — under the sharding this
+    is embarrassingly parallel, one stage per device group — then ROLLS
+    the buffer by one stage. The roll of a pipe-sharded axis is exactly
+    a collective-permute over ICI, which is how XLA lowers it; no
+    explicit ppermute needed.
+  * Microbatch t enters stage 0 at tick t and exits stage S-1 at tick
+    t + S - 1; injections and collections are masked dynamic updates, so
+    shapes stay static and the whole schedule jits into a single scan.
+
+The pipeline is differentiable end to end (scan + gather/scatter +
+roll), so the same function serves forward and backward; the backward
+pass pipelines in reverse automatically under ``jax.grad``. Bubble
+fraction is the standard GPipe (S-1)/(M+S-1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def gpipe_apply(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    microbatches: jnp.ndarray,
+) -> jnp.ndarray:
+    """Run microbatches through S pipelined stages.
+
+    Args:
+      stage_fn: ``(params_of_one_stage, x [mb, ...]) -> y [mb, ...]`` —
+        one stage's computation, same activation shape in and out.
+      stage_params: pytree whose every leaf has a leading [S] stage axis
+        (shard it over "pipe" for real pipeline parallelism).
+      microbatches: ``[M, mb, ...]`` input microbatches.
+
+    Returns:
+      ``[M, mb, ...]`` outputs, microbatch-aligned with the input.
+    """
+    S = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    M = microbatches.shape[0]
+    T = M + S - 1
+    stage_apply = jax.vmap(stage_fn)
+
+    buf = jnp.zeros((S,) + microbatches.shape[1:], microbatches.dtype)
+    out = jnp.zeros_like(microbatches)
+
+    def tick(carry, t):
+        buf, out = carry
+        x_t = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.minimum(t, M - 1), keepdims=False
+        )
+        buf = buf.at[0].set(jnp.where(t < M, x_t, buf[0]))
+        y = stage_apply(stage_params, buf)
+        idx = jnp.clip(t - (S - 1), 0, M - 1)
+        done = y[S - 1]
+        prev = jax.lax.dynamic_index_in_dim(out, idx, keepdims=False)
+        out = jax.lax.dynamic_update_index_in_dim(
+            out, jnp.where(t >= S - 1, done, prev), idx, axis=0
+        )
+        # Stage s's output becomes stage s+1's input: a roll of the
+        # stage axis, which XLA lowers to a collective-permute when the
+        # axis is sharded over "pipe".
+        buf = jnp.roll(y, 1, axis=0)
+        return (buf, out), None
+
+    (_, out), _ = jax.lax.scan(
+        tick, (buf, out), jnp.arange(T, dtype=jnp.int32)
+    )
+    return out
+
+
+def sequential_apply(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    x: jnp.ndarray,
+) -> jnp.ndarray:
+    """Reference semantics: the stages applied back-to-back on one batch
+    (what the pipeline must numerically reproduce per microbatch)."""
+    S = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    for s in range(S):
+        params_s = jax.tree_util.tree_map(lambda p: p[s], stage_params)
+        x = stage_fn(params_s, x)
+    return x
+
+
+class PipelinedLM:
+    """The flagship transformer LM with its blocks pipelined over "pipe".
+
+    Embedding/unembedding and final LayerNorm run outside the pipeline
+    (replicated); the ``num_layers`` blocks are grouped into
+    ``num_stages`` stages of equal depth, their parameters stacked with
+    a leading stage axis and sharded over the mesh's "pipe" axis.
+
+    Plain-function flavor (init/loss as pure functions over a params
+    pytree) rather than a flax module: the stage stacking and the scan
+    schedule live in JAX-land where their sharding is explicit.
+    """
+
+    def __init__(self, config, num_stages: int, num_microbatches: int,
+                 mesh: Optional[Mesh] = None):
+        from shockwave_tpu.models.transformer import Block
+
+        if config.num_layers % num_stages != 0:
+            raise ValueError(
+                f"{config.num_layers} layers not divisible into "
+                f"{num_stages} stages"
+            )
+        self.config = config
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        self.mesh = mesh
+        self.layers_per_stage = config.num_layers // num_stages
+        # One stage = layers_per_stage Blocks applied in sequence. The
+        # blocks inside a stage are themselves stacked (cheap scan-free
+        # python loop over a small constant).
+        self._block = Block(config, mesh=None)
+
+    # -- parameters -----------------------------------------------------
+    def init(self, rng, tokens) -> dict:
+        cfg = self.config
+        S, Lps = self.num_stages, self.layers_per_stage
+        d = cfg.d_model
+        x = jnp.zeros(tokens[:, :-1].shape + (d,), jnp.float32)
+        rngs = jax.random.split(rng, S * Lps + 1)
+
+        def init_block(r):
+            import flax
+
+            params = self._block.init(r, x)["params"]
+            # Unbox flax partitioning metadata: the stage stacking below
+            # changes ranks, and the pipeline shards explicitly by axis
+            # position rather than by logical name.
+            return jax.tree_util.tree_map(
+                lambda p: p.value
+                if isinstance(p, flax.core.meta.Partitioned)
+                else p,
+                params,
+                is_leaf=lambda p: isinstance(p, flax.core.meta.Partitioned),
+            )
+
+        block_params = jax.vmap(init_block)(
+            rngs[: S * Lps]
+        )  # leading axis [S * Lps]
+        # Regroup into [S, Lps, ...].
+        block_params = jax.tree_util.tree_map(
+            lambda p: p.reshape((S, Lps) + p.shape[1:]), block_params
+        )
+        r = rngs[-1]
+        params = {
+            "blocks": block_params,
+            "embedding": jax.random.normal(
+                jax.random.fold_in(r, 0), (cfg.vocab_size, d)
+            )
+            * 0.02,
+            "positional": jax.random.normal(
+                jax.random.fold_in(r, 1), (cfg.max_len, d)
+            )
+            * 0.02,
+            "ln_f_scale": jnp.ones((d,)),
+            "ln_f_bias": jnp.zeros((d,)),
+        }
+        if self.mesh is not None:
+            params = self.shard_params(params)
+        return params
+
+    def shard_params(self, params: dict) -> dict:
+        """Place block params stage-sharded over "pipe", the rest
+        replicated."""
+        mesh = self.mesh
+        pipe = NamedSharding(mesh, PartitionSpec("pipe"))
+        rep = NamedSharding(mesh, PartitionSpec())
+        out = dict(params)
+        out["blocks"] = jax.tree_util.tree_map(
+            lambda p: jax.device_put(p, pipe), params["blocks"]
+        )
+        for k in ("embedding", "positional", "ln_f_scale", "ln_f_bias"):
+            out[k] = jax.device_put(params[k], rep)
+        return out
+
+    # -- compute --------------------------------------------------------
+    def _stage_fn(self, stage_params, x):
+        for i in range(self.layers_per_stage):
+            p_i = jax.tree_util.tree_map(lambda p: p[i], stage_params)
+            x = self._block.apply({"params": p_i}, x)
+        return x
+
+    def _embed(self, params, tokens):
+        x = params["embedding"][tokens]
+        return x + params["positional"][: tokens.shape[1]]
+
+    def _head(self, params, x):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        x = (x - mean) * jax.lax.rsqrt(var + 1e-6)
+        x = x * params["ln_f_scale"] + params["ln_f_bias"]
+        return x @ params["embedding"].T
+
+    def logits(self, params, tokens) -> jnp.ndarray:
+        """[B, S_len] tokens -> [B, S_len, vocab]; B must split into
+        num_microbatches."""
+        M = self.num_microbatches
+        B = tokens.shape[0]
+        if B % M != 0:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        x = self._embed(params, tokens)
+        mb = x.reshape((M, B // M) + x.shape[1:])
+        y = gpipe_apply(self._stage_fn, params["blocks"], mb)
+        y = y.reshape(x.shape)
+        return self._head(params, y)
+
+    def logits_sequential(self, params, tokens) -> jnp.ndarray:
+        """Non-pipelined reference path (for equivalence tests)."""
+        x = self._embed(params, tokens)
+        y = sequential_apply(self._stage_fn, params["blocks"], x)
+        return self._head(params, y)
+
+    def loss(self, params, tokens) -> jnp.ndarray:
+        from shockwave_tpu.models.small_models import token_xent
+
+        return token_xent(
+            self.logits(params, tokens[:, :-1]), tokens[:, 1:]
+        )

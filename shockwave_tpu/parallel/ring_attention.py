@@ -114,11 +114,15 @@ def ring_attention(
     batch_axis = other_axes[0] if len(other_axes) > 0 else None
     head_axis = other_axes[1] if len(other_axes) > 1 else None
     io_spec = P(batch_axis, seq_axis, head_axis, None)
+    # vma axes = exactly the axes the io spec shards over; pcast-ing the
+    # fresh loop carries to MORE axes (e.g. an unused "pipe" axis) would
+    # make the carry type diverge from the q-derived accumulator.
+    vary_axes = tuple(a for a in (batch_axis, seq_axis, head_axis) if a)
     fn = jax.shard_map(
         functools.partial(
             _ring_attention_local,
             axis_name=seq_axis,
-            all_axes=tuple(mesh.axis_names),
+            all_axes=vary_axes,
         ),
         mesh=mesh,
         in_specs=(io_spec, io_spec, io_spec),

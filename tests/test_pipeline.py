@@ -1,0 +1,111 @@
+"""Pipeline parallelism: the GPipe schedule must reproduce sequential
+stage application exactly, shard over a real "pipe" mesh axis, and train
+(finite loss + grads) end to end."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shockwave_tpu.parallel.mesh import make_mesh
+from shockwave_tpu.parallel.pipeline import (
+    PipelinedLM,
+    gpipe_apply,
+    sequential_apply,
+)
+
+
+def _toy_stage(params, x):
+    # One affine + nonlinearity per stage: enough to make stage order
+    # matter (non-commuting), cheap enough for exact comparison.
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _toy_params(rng, S, d):
+    return {
+        "w": jnp.asarray(rng.normal(size=(S, d, d)) * 0.3, jnp.float32),
+        "b": jnp.asarray(rng.normal(size=(S, d)) * 0.1, jnp.float32),
+    }
+
+
+@pytest.mark.parametrize("S,M", [(1, 1), (2, 4), (4, 2), (4, 8)])
+def test_gpipe_matches_sequential(S, M):
+    rng = np.random.default_rng(0)
+    d, mb = 8, 3
+    params = _toy_params(rng, S, d)
+    x = jnp.asarray(rng.normal(size=(M, mb, d)), jnp.float32)
+    y_pipe = gpipe_apply(_toy_stage, params, x)
+    y_seq = jnp.stack(
+        [sequential_apply(_toy_stage, params, x[m]) for m in range(M)]
+    )
+    np.testing.assert_allclose(
+        np.asarray(y_pipe), np.asarray(y_seq), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_gpipe_differentiable():
+    rng = np.random.default_rng(1)
+    S, M, d, mb = 2, 4, 8, 2
+    params = _toy_params(rng, S, d)
+    x = jnp.asarray(rng.normal(size=(M, mb, d)), jnp.float32)
+
+    def loss(p):
+        return jnp.sum(gpipe_apply(_toy_stage, p, x) ** 2)
+
+    g = jax.grad(loss)(params)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+        assert np.any(np.asarray(leaf) != 0)
+
+
+@pytest.mark.parametrize("pipe", [2, 4])
+def test_gpipe_sharded_over_pipe_axis(pipe):
+    """The stage-stacked params and activation buffer shard over a real
+    "pipe" mesh axis; results stay identical to the unsharded run."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = make_mesh((1, 1, 1, pipe), devices=jax.devices()[:pipe])
+    rng = np.random.default_rng(2)
+    S, M, d, mb = pipe, 2 * pipe, 8, 2
+    params = _toy_params(rng, S, d)
+    x = jnp.asarray(rng.normal(size=(M, mb, d)), jnp.float32)
+    y_ref = gpipe_apply(_toy_stage, params, x)
+
+    shard = NamedSharding(mesh, PartitionSpec("pipe"))
+    params_sharded = jax.tree_util.tree_map(
+        lambda p: jax.device_put(p, shard), params
+    )
+    with mesh:
+        y = jax.jit(lambda p, x: gpipe_apply(_toy_stage, p, x))(
+            params_sharded, x
+        )
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(y_ref), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_pipelined_lm_matches_sequential_and_trains():
+    from shockwave_tpu.models.transformer import TransformerConfig
+
+    mesh = make_mesh((2, 1, 1, 4))
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=16, num_heads=2, num_layers=4, d_ff=32,
+        max_len=12,
+    )
+    model = PipelinedLM(cfg, num_stages=4, num_microbatches=2, mesh=mesh)
+    rng = np.random.default_rng(3)
+    tokens = jnp.asarray(rng.integers(0, 64, (4, 13)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+
+    logits_pipe = model.logits(params, tokens[:, :-1])
+    logits_seq = model.logits_sequential(params, tokens[:, :-1])
+    np.testing.assert_allclose(
+        np.asarray(logits_pipe), np.asarray(logits_seq), rtol=2e-4,
+        atol=2e-4,
+    )
+
+    with mesh:
+        loss, grads = jax.jit(jax.value_and_grad(model.loss))(params, tokens)
+    assert np.isfinite(float(loss))
+    for leaf in jax.tree_util.tree_leaves(grads):
+        assert np.all(np.isfinite(np.asarray(leaf)))
