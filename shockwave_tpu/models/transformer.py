@@ -39,7 +39,7 @@ class TransformerConfig:
     d_ff: int = 512
     max_len: int = 512
     dtype: str = "float32"  # bfloat16 on real chips
-    attention: str = "dense"  # "dense" | "ring"
+    attention: str = "dense"  # "dense" | "ring" | "flash"
     num_experts: int = 0  # 0 = dense MLP; >0 = MoE over "model"
 
 
@@ -74,6 +74,19 @@ class Attention(nn.Module):
             if self.mesh is None:
                 raise ValueError("ring attention requires a mesh")
             out = ring_attention(q, k, v, self.mesh)
+        elif cfg.attention == "flash":
+            # Single-chip long-context path: the Pallas blockwise kernel
+            # (shockwave_tpu/ops/flash_attention.py). Falls back to dense
+            # when the sequence doesn't tile into kernel blocks.
+            from shockwave_tpu.ops.flash_attention import flash_attention
+
+            # TPU tiling needs full 128-row/col blocks; anything shorter
+            # or non-aligned takes the dense path.
+            S = x.shape[1]
+            if S >= 128 and S % 128 == 0:
+                out = flash_attention(q, k, v, block_q=128, block_k=128)
+            else:
+                out = dense_causal_attention(q, k, v)
         else:
             out = dense_causal_attention(q, k, v)
         out = out.reshape(x.shape)

@@ -1,0 +1,229 @@
+"""Blockwise (flash) causal attention as a Pallas TPU kernel.
+
+The dense attention path materializes the [S, S] score matrix in HBM —
+at long context that matrix, not the matmuls, is the bandwidth bill.
+This kernel streams K/V blocks through VMEM with an online softmax
+(running max + normalizer), so scores never leave the chip and HBM
+traffic is O(S * D) per head: the single-chip counterpart of the
+cross-chip ring attention in shockwave_tpu/parallel/ring_attention.py
+(which holds the same online-softmax state while blocks rotate over
+ICI). Pattern follows the public flash/blockwise-attention literature
+re-derived for Pallas.
+
+Forward: one pallas_call, grid (batch*heads, q_blocks, k_blocks) with
+the k dimension innermost ("arbitrary" semantics) accumulating into
+VMEM scratch; causally-dead k blocks are skipped via pl.when. The
+kernel also emits the per-row softmax stats (running max m, normalizer
+l).
+
+Backward: the standard flash backward recurrence in plain JAX, one
+lax.scan over K/V blocks re-computing probabilities from the saved
+stats — O(S * block) memory, no [S, S] materialization — wired through
+jax.custom_vjp so the kernel trains.
+
+Off-TPU (CPU tests) the kernel runs in interpret mode; numerics match
+the dense reference to float tolerance either way
+(tests/test_flash_attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_LANES = 128
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
+    acc_ref, m_ref, l_ref, *, block_q, block_k, scale,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Causal: k block strictly above the diagonal contributes nothing.
+    @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+    def _body():
+        q = q_ref[0]  # [block_q, D]
+        k = k_ref[0]  # [block_k, D]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(cols > rows, _NEG_INF, s)
+
+        m_prev = m_ref[:, :1]  # [block_q, 1]
+        l_prev = l_ref[:, :1]
+        m_blk = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(s - m_new)  # [block_q, block_k]
+        correction = jnp.exp(m_prev - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * correction + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        # Stats replicated across the 128-lane trailing dim (TPU tiling
+        # requires the last two block dims be (8k, 128m)); the host
+        # wrapper slices lane 0.
+        m_out_ref[0] = m_ref[...]
+        l_out_ref[0] = l_ref[...]
+
+
+def _flash_fwd_flat(q, k, v, block_q, block_k, interpret):
+    """q/k/v: [BH, S, D] -> (out [BH, S, D], m [BH, S], l [BH, S])."""
+    BH, S, D = q.shape
+    scale = 1.0 / float(np.sqrt(D))
+    grid = (BH, S // block_q, S // block_k)
+    kernel = functools.partial(
+        _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale
+    )
+    out, m3, l3 = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+    return out, m3[..., 0], l3[..., 0]
+
+
+def _flash_bwd_flat(q, k, v, out, m, l, g, block_k, scale):
+    """Flash backward: scan over K/V blocks, probabilities recomputed
+    from the saved stats; O(S * block_k) memory."""
+    BH, S, D = q.shape
+    nk = S // block_k
+    delta = jnp.sum(g * out, axis=-1)  # [BH, S]
+    rows = jnp.arange(S)
+    k_blocks = k.reshape(BH, nk, block_k, D).transpose(1, 0, 2, 3)
+    v_blocks = v.reshape(BH, nk, block_k, D).transpose(1, 0, 2, 3)
+
+    def one_block(dq, inputs):
+        j, k_j, v_j = inputs
+        s = jnp.einsum("bsd,btd->bst", q, k_j) * scale  # [BH, S, block_k]
+        cols = j * block_k + jnp.arange(block_k)
+        dead = cols[None, :] > rows[:, None]  # [S, block_k]
+        p = jnp.where(
+            dead[None], 0.0, jnp.exp(s - m[..., None])
+        ) / jnp.maximum(l[..., None], 1e-30)
+        dv_j = jnp.einsum("bst,bsd->btd", p, g)
+        dp = jnp.einsum("bsd,btd->bst", g, v_j)
+        ds = p * (dp - delta[..., None]) * scale
+        dk_j = jnp.einsum("bst,bsd->btd", ds, q)
+        dq = dq + jnp.einsum("bst,btd->bsd", ds, k_j)
+        return dq, (dk_j, dv_j)
+
+    dq, (dk_b, dv_b) = jax.lax.scan(
+        one_block,
+        jnp.zeros_like(q),
+        (jnp.arange(nk), k_blocks, v_blocks),
+    )
+    dk = dk_b.transpose(1, 0, 2, 3).reshape(BH, S, D)
+    dv = dv_b.transpose(1, 0, 2, 3).reshape(BH, S, D)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_flat(q, k, v, block_q, block_k, interpret):
+    out, _, _ = _flash_fwd_flat(q, k, v, block_q, block_k, interpret)
+    return out
+
+
+def _flash_flat_fwd(q, k, v, block_q, block_k, interpret):
+    out, m, l = _flash_fwd_flat(q, k, v, block_q, block_k, interpret)
+    return out, (q, k, v, out, m, l)
+
+
+def _flash_flat_bwd(block_q, block_k, interpret, res, g):
+    q, k, v, out, m, l = res
+    scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    dq, dk, dv = _flash_bwd_flat(
+        q, k, v, out, m, l, g.astype(jnp.float32), block_k, scale
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_flat.defvjp(_flash_flat_fwd, _flash_flat_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jnp.ndarray:
+    """Causal flash attention; [B, S, H, D] in and out, differentiable.
+
+    Same contract as
+    :func:`shockwave_tpu.parallel.ring_attention.dense_causal_attention`.
+    Sequence length must divide by the block sizes (callers fall back to
+    the dense path otherwise — see models/transformer.py).
+    """
+    B, S, H, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    if S % block_q or S % block_k:
+        raise ValueError(
+            f"seq len {S} not divisible by blocks ({block_q}, {block_k})"
+        )
+
+    def flat(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    out = _flash_flat(
+        flat(q), flat(k), flat(v), block_q, block_k, _use_interpret()
+    )
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
