@@ -1,0 +1,179 @@
+#!/usr/bin/env python3
+"""Postprocess a simulator/physical round log into per-job and per-round
+tables, and optionally regenerate a trace from it.
+
+The machine-readable counterpart of the reference's log tooling
+(reference: scripts/utils/postprocess_simulator_log.py parses the text
+log into per-job round activity; scripts/utils/
+generate_trace_from_scheduler_log.py rebuilds a trace from dispatch
+lines). Here the scheduler records structured events
+(Scheduler.save_round_log / `scripts/simulate.py --round_log`):
+
+  {"event": "job", "job_id": ..., "arrival": ..., <trace fields>}
+  {"event": "round", "round": N, "time": T, "jobs": {job_key: n_gpus}}
+  {"event": "complete", "job_id": ..., "time": T, "duration": ...}
+
+Usage:
+  python scripts/analysis/postprocess_log.py run.jsonl
+  python scripts/analysis/postprocess_log.py run.jsonl --emit_trace out.trace
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+
+def load_events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _job_ids_in_key(key):
+    """A round record's job key is str(JobId): "17" or "(3, 12)" for a
+    packed pair."""
+    return [int(tok) for tok in re.findall(r"\d+", key)]
+
+
+def per_job_table(events):
+    """Per-job summary rows: arrival, queueing delay, rounds run, mean
+    gang width, completion."""
+    jobs = {}
+    for ev in events:
+        if ev["event"] == "job":
+            jobs[ev["job_id"]] = {
+                "job_id": ev["job_id"],
+                "job_type": ev.get("job_type", "?"),
+                "scale_factor": ev.get("scale_factor", 1),
+                "arrival": ev.get("arrival", 0.0),
+                "first_scheduled": None,
+                "rounds_run": 0,
+                "completion_time": None,
+                "duration": None,
+            }
+    for ev in events:
+        if ev["event"] == "round":
+            for key, n_gpus in ev["jobs"].items():
+                for jid in _job_ids_in_key(key):
+                    row = jobs.get(jid)
+                    if row is None:
+                        continue
+                    row["rounds_run"] += 1
+                    if row["first_scheduled"] is None:
+                        row["first_scheduled"] = ev["time"]
+        elif ev["event"] == "complete":
+            row = jobs.get(ev["job_id"])
+            if row is not None:
+                row["completion_time"] = ev["time"]
+                row["duration"] = ev.get("duration")
+    for row in jobs.values():
+        fs = row["first_scheduled"]
+        row["queueing_delay"] = (
+            None if fs is None else fs - row["arrival"]
+        )
+    return [jobs[k] for k in sorted(jobs)]
+
+
+def per_round_occupancy(events, num_gpus=None):
+    """(round, time, jobs_scheduled, gpus_busy[, utilization]) rows."""
+    rows = []
+    for ev in events:
+        if ev["event"] != "round":
+            continue
+        busy = sum(ev["jobs"].values())
+        row = {
+            "round": ev["round"],
+            "time": ev["time"],
+            "jobs": len(ev["jobs"]),
+            "gpus_busy": busy,
+        }
+        if num_gpus:
+            row["utilization"] = busy / num_gpus
+        rows.append(row)
+    return rows
+
+
+def emit_trace(events, out_path):
+    """Rebuild a 12-field trace from the log's job events (reference:
+    scripts/utils/generate_trace_from_scheduler_log.py)."""
+    from shockwave_tpu.core.job import Job
+    from shockwave_tpu.data.trace import write_trace
+
+    jobs, arrivals = [], []
+    for ev in sorted(
+        (e for e in events if e["event"] == "job"),
+        key=lambda e: (e.get("arrival", 0.0), e["job_id"]),
+    ):
+        jobs.append(
+            Job(
+                job_type=ev["job_type"],
+                command=ev.get("command", ""),
+                working_directory=ev.get("working_directory", ""),
+                num_steps_arg=ev.get("num_steps_arg", "-n"),
+                needs_data_dir=bool(ev.get("needs_data_dir", False)),
+                total_steps=int(ev.get("total_steps", 0)),
+                duration=float(ev.get("duration") or 0.0),
+                scale_factor=int(ev.get("scale_factor", 1)),
+                mode=ev.get("mode", "static"),
+                priority_weight=float(ev.get("priority_weight", 1.0)),
+                SLO=ev.get("SLO"),
+            )
+        )
+        arrivals.append(float(ev.get("arrival", 0.0)))
+    write_trace(out_path, jobs, arrivals)
+    return len(jobs)
+
+
+def _fmt(v, width, nd=1):
+    if v is None:
+        return "-".rjust(width)
+    if isinstance(v, float):
+        return f"{v:.{nd}f}".rjust(width)
+    return str(v).rjust(width)
+
+
+def main(args):
+    events = load_events(args.log)
+    job_rows = per_job_table(events)
+    print(
+        "job_id  scale  arrival   queue_delay  rounds  completion  job_type"
+    )
+    for r in job_rows:
+        print(
+            f"{r['job_id']:>6}  {r['scale_factor']:>5}  "
+            f"{_fmt(r['arrival'], 8)}  {_fmt(r['queueing_delay'], 11)}  "
+            f"{r['rounds_run']:>6}  {_fmt(r['completion_time'], 10)}  "
+            f"{r['job_type']}"
+        )
+    occ = per_round_occupancy(events, num_gpus=args.num_gpus)
+    if occ:
+        busy = [r["gpus_busy"] for r in occ]
+        print(
+            f"\n{len(occ)} rounds; GPUs busy mean {sum(busy) / len(busy):.1f}"
+            f" max {max(busy)}"
+        )
+    if args.emit_trace:
+        n = emit_trace(events, args.emit_trace)
+        print(f"Wrote {n}-job trace to {args.emit_trace}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("log", type=str, help="round-log JSONL file")
+    parser.add_argument(
+        "--num_gpus", type=int, default=None,
+        help="cluster size, for utilization columns",
+    )
+    parser.add_argument(
+        "--emit_trace", type=str, default=None,
+        help="regenerate a 12-field trace here from the log's job events",
+    )
+    main(parser.parse_args())

@@ -116,6 +116,11 @@ def main(args):
         print(f"Unfair job fraction: {unfair_fraction:.1f}%")
     print(f"Rounds: {sched._num_completed_rounds}; sim wall-clock: {wall:.1f} s")
 
+    if args.round_log:
+        os.makedirs(os.path.dirname(args.round_log) or ".", exist_ok=True)
+        sched.save_round_log(args.round_log)
+        print(f"Wrote {args.round_log}")
+
     if args.output_pickle:
         result = {
             "trace_file": args.trace_file,
@@ -156,6 +161,13 @@ if __name__ == "__main__":
     parser.add_argument("-e", "--window-end", type=int, default=None)
     parser.add_argument("--config", type=str, default=None, help="Shockwave JSON config")
     parser.add_argument("--output_pickle", type=str, default=None)
+    parser.add_argument(
+        "--round_log",
+        type=str,
+        default=None,
+        help="write the structured per-round event log (JSONL) here; "
+        "consumed by scripts/analysis/postprocess_log.py",
+    )
     parser.add_argument("--no_profile_cache", action="store_true")
     parser.add_argument(
         "--profiling_percentage",

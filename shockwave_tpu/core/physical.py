@@ -285,6 +285,17 @@ class PhysicalScheduler(Scheduler):
                     if all(s in self._jobs for s in key.singletons())
                 )
                 self._current_worker_assignments = assignments
+                self._round_log.append(
+                    {
+                        "event": "round",
+                        "round": self._round_id,
+                        "time": self.get_current_timestamp(),
+                        "jobs": {
+                            str(key): len(ids)
+                            for key, ids in assignments.items()
+                        },
+                    }
+                )
                 for key, worker_ids in assignments.items():
                     if key in extended:
                         continue  # still running under an extended lease

@@ -115,6 +115,15 @@ class Scheduler:
         self._num_jobs_in_trace = 0
         self._in_progress_updates: Dict[JobId, list] = {}
         self._job_timelines: Dict[JobId, list] = {}
+        # Structured event log (job admissions, per-round assignments,
+        # completions) consumed by scripts/analysis/postprocess_log.py —
+        # the machine-readable equivalent of the reference's text-log
+        # postprocessing pipeline (reference:
+        # scripts/utils/postprocess_simulator_log.py,
+        # scripts/utils/generate_trace_from_scheduler_log.py). Always
+        # recorded: one small dict per round/job, cheap next to the
+        # per-iterator-line _job_timelines, and checkpointed with them.
+        self._round_log: list = []
         # Absolute per-job deadlines, tracked only for SLO-aware policies
         # (reference: scheduler.py:583-587).
         self._slos: Optional[Dict[JobId, float]] = (
@@ -289,6 +298,24 @@ class Scheduler:
         if timestamp is None:
             timestamp = self.get_current_timestamp()
         self._per_job_start_timestamps[job_id] = timestamp
+        self._round_log.append(
+            {
+                "event": "job",
+                "job_id": job_id.integer,
+                "arrival": timestamp,
+                "job_type": job.job_type,
+                "command": job.command,
+                "working_directory": job.working_directory,
+                "num_steps_arg": job.num_steps_arg,
+                "needs_data_dir": job.needs_data_dir,
+                "total_steps": job.total_steps,
+                "scale_factor": job.scale_factor,
+                "mode": job.mode,
+                "priority_weight": job.priority_weight,
+                "SLO": job.SLO,
+                "duration": job.duration,
+            }
+        )
         self._logger.info("[Job dispatched]\tJob ID: %s", job_id)
         return job_id
 
@@ -307,6 +334,14 @@ class Scheduler:
             self._job_completion_times[job_id] = None
         else:
             self._job_completion_times[job_id] = duration
+        self._round_log.append(
+            {
+                "event": "complete",
+                "job_id": job_id.integer,
+                "time": self.get_current_timestamp(),
+                "duration": self._job_completion_times[job_id],
+            }
+        )
         job_type_key = self._job_id_to_job_type[job_id]
         self._job_type_to_job_ids[job_type_key].discard(job_id)
         del self._steps_run_so_far[job_id]
@@ -1299,6 +1334,17 @@ class Scheduler:
                 ) == set(scheduled_jobs[job_id]):
                     self._num_lease_extensions += 1
             self._current_worker_assignments = scheduled_jobs
+            self._round_log.append(
+                {
+                    "event": "round",
+                    "round": self._num_completed_rounds,
+                    "time": self._current_timestamp,
+                    "jobs": {
+                        str(job_id): len(worker_ids)
+                        for job_id, worker_ids in scheduled_jobs.items()
+                    },
+                }
+            )
 
             for job_id, worker_ids in scheduled_jobs.items():
                 worker_type = self._worker_id_to_worker_type[worker_ids[0]]
@@ -1364,6 +1410,7 @@ class Scheduler:
         "_slos",
         "_in_progress_updates",
         "_job_timelines",
+        "_round_log",
         "_current_worker_assignments",
         "_available_worker_ids",
     ]
@@ -1387,6 +1434,15 @@ class Scheduler:
         for field, value in state["fields"].items():
             setattr(self, field, value)
         return state["extra"]
+
+    def save_round_log(self, path: str) -> None:
+        """Write the structured event log (job / round / complete events)
+        as JSON lines, for scripts/analysis/postprocess_log.py."""
+        import json
+
+        with open(path, "w") as f:
+            for record in self._round_log:
+                f.write(json.dumps(record) + "\n")
 
     def save_job_timelines(self, directory: str) -> None:
         """One per-job file of structured iterator log excerpts

@@ -1,0 +1,86 @@
+"""The structured round log: recorded by the simulator, summarized by
+the postprocess tool, and round-trippable back into a parseable trace
+(capability of reference: scripts/utils/postprocess_simulator_log.py and
+generate_trace_from_scheduler_log.py)."""
+
+import importlib.util
+import os
+
+import pytest
+
+from tests.test_simulator import run_sim, tiny_trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_postprocess():
+    spec = importlib.util.spec_from_file_location(
+        "postprocess_log",
+        os.path.join(REPO, "scripts", "analysis", "postprocess_log.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def sim_log(tmp_path_factory):
+    jobs, arrivals = tiny_trace(num_jobs=5, epochs=2, arrival_gap=60.0)
+    sched, makespan = run_sim("max_min_fairness", jobs, arrivals)
+    path = tmp_path_factory.mktemp("logs") / "run.jsonl"
+    sched.save_round_log(str(path))
+    return str(path), jobs, arrivals, sched
+
+
+def test_round_log_events_complete(sim_log):
+    path, jobs, arrivals, sched = sim_log
+    pp = _load_postprocess()
+    events = pp.load_events(path)
+    kinds = {e["event"] for e in events}
+    assert kinds == {"job", "round", "complete"}
+    assert sum(e["event"] == "job" for e in events) == len(jobs)
+    assert sum(e["event"] == "complete" for e in events) == len(jobs)
+    assert (
+        sum(e["event"] == "round" for e in events)
+        == sched._num_completed_rounds
+    )
+
+
+def test_per_job_table(sim_log):
+    path, jobs, arrivals, _ = sim_log
+    pp = _load_postprocess()
+    rows = pp.per_job_table(pp.load_events(path))
+    assert len(rows) == len(jobs)
+    for row, arrival in zip(rows, arrivals):
+        assert row["arrival"] == pytest.approx(arrival)
+        assert row["rounds_run"] > 0
+        assert row["completion_time"] is not None
+        assert row["queueing_delay"] is not None
+        assert row["queueing_delay"] >= 0
+
+
+def test_per_round_occupancy(sim_log):
+    path, _, _, _ = sim_log
+    pp = _load_postprocess()
+    occ = pp.per_round_occupancy(pp.load_events(path), num_gpus=4)
+    assert occ
+    # Idle rounds (arrival gaps) legitimately record zero busy GPUs.
+    assert all(0 <= r["gpus_busy"] <= 4 for r in occ)
+    assert all(0 <= r["utilization"] <= 1.0 for r in occ)
+    assert any(r["gpus_busy"] > 0 for r in occ)
+
+
+def test_emit_trace_round_trips(sim_log, tmp_path):
+    from shockwave_tpu.data.trace import parse_trace
+
+    path, jobs, arrivals, _ = sim_log
+    pp = _load_postprocess()
+    out = tmp_path / "regenerated.trace"
+    n = pp.emit_trace(pp.load_events(path), str(out))
+    assert n == len(jobs)
+    re_jobs, re_arrivals = parse_trace(str(out))
+    assert [j.job_type for j in re_jobs] == [j.job_type for j in jobs]
+    assert [j.scale_factor for j in re_jobs] == [
+        j.scale_factor for j in jobs
+    ]
+    assert re_arrivals == pytest.approx(arrivals)

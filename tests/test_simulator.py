@@ -191,6 +191,10 @@ def test_checkpoint_save_load_continue_determinism(tmp_path):
         assert b._job_completion_times[job_id] == pytest.approx(jct)
     # The resumed run replays only the suffix.
     assert b._num_completed_rounds < ref._num_completed_rounds
+    # The structured round log is checkpointed too: a resumed run's log
+    # must still contain every job admission from before the checkpoint.
+    job_events = [e for e in b._round_log if e["event"] == "job"]
+    assert len(job_events) == len(jobs)
 
 
 def test_cost_accounting_constant_and_spot_schedule():
