@@ -1,0 +1,87 @@
+#!/usr/bin/env python3
+"""Summarize one or more trace files: arrivals, scale factors, modes,
+model families, durations.
+
+The trace-side analysis counterpart of the reference's
+scripts/utils/analyze_msr_trace_logs.py (which profiles the Philly/msr
+logs its traces derive from — those logs are stripped from the
+reference snapshot, so this tool profiles the trace files themselves,
+which is what the repo actually ships).
+
+  python scripts/analysis/trace_stats.py traces/*.trace
+"""
+
+import argparse
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+
+def stats(trace_file):
+    from shockwave_tpu.data import parse_trace
+    from shockwave_tpu.data.workload_info import parse_job_type
+
+    jobs, arrivals = parse_trace(trace_file)
+    gaps = [b - a for a, b in zip(arrivals, arrivals[1:])]
+    durations = [j.duration or 0.0 for j in jobs]
+    gpu_seconds = [d * j.scale_factor for d, j in zip(durations, jobs)]
+    srt = sorted(durations)
+
+    def pct(p):
+        return srt[min(len(srt) - 1, int(p * len(srt)))] if srt else 0.0
+
+    return {
+        "trace": os.path.basename(trace_file),
+        "num_jobs": len(jobs),
+        "arrival_span_s": (arrivals[-1] - arrivals[0]) if arrivals else 0.0,
+        "mean_interarrival_s": (
+            sum(gaps) / len(gaps) if gaps else 0.0
+        ),
+        "scale_factors": dict(
+            sorted(Counter(j.scale_factor for j in jobs).items())
+        ),
+        "modes": dict(sorted(Counter(j.mode for j in jobs).items())),
+        "families": dict(
+            sorted(
+                Counter(
+                    parse_job_type(j.job_type)[0] for j in jobs
+                ).items()
+            )
+        ),
+        "duration_mean_s": sum(durations) / len(durations) if jobs else 0.0,
+        "duration_p50_s": pct(0.5),
+        "duration_p90_s": pct(0.9),
+        "total_gpu_hours": sum(gpu_seconds) / 3600.0,
+    }
+
+
+def _fmt_dist(d, total):
+    return ", ".join(f"{k}: {v} ({100.0 * v / total:.0f}%)" for k, v in d.items())
+
+
+def main(args):
+    for path in args.traces:
+        s = stats(path)
+        n = s["num_jobs"]
+        print(f"== {s['trace']} ==")
+        print(f"  jobs: {n}, arrival span {s['arrival_span_s']:.0f} s, "
+              f"mean interarrival {s['mean_interarrival_s']:.1f} s")
+        print(f"  scale factors: {_fmt_dist(s['scale_factors'], n)}")
+        print(f"  modes: {_fmt_dist(s['modes'], n)}")
+        print(f"  families: {_fmt_dist(s['families'], n)}")
+        print(f"  duration mean {s['duration_mean_s']:.0f} s, "
+              f"p50 {s['duration_p50_s']:.0f} s, p90 {s['duration_p90_s']:.0f} s; "
+              f"total {s['total_gpu_hours']:.1f} GPU-hours")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("traces", nargs="+", help="trace files")
+    main(parser.parse_args())

@@ -1,0 +1,120 @@
+#!/usr/bin/env python3
+"""Kill stale workload processes left behind on a worker host.
+
+TPU-side analog of the reference's GPU hygiene tool (reference:
+scripts/utils/kill_gpu_processes.py, which SIGKILLs every process
+holding a GPU). Here stragglers are identified by the dispatcher's env
+contract: every workload subprocess it launches carries
+``SHOCKWAVE_JOB_ID`` in its environment
+(shockwave_tpu/runtime/dispatcher.py), whatever its command line is —
+so crashed-agent leftovers are found regardless of which trace command
+(`python3 main.py ...`, synthetic workloads, ...) they ran.
+``--pattern`` switches to a cmdline substring match instead.
+
+  python scripts/kill_stale_workloads.py            # list only
+  python scripts/kill_stale_workloads.py --kill     # SIGTERM, then KILL
+"""
+
+import argparse
+import os
+import signal
+import time
+
+ENV_MARKER = "SHOCKWAVE_JOB_ID="
+
+
+def _cmdline(pid):
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return (
+                f.read().replace(b"\0", b" ").decode(errors="replace").strip()
+            )
+    except OSError:
+        return None
+
+
+def _has_env_marker(pid, marker=ENV_MARKER):
+    try:
+        with open(f"/proc/{pid}/environ", "rb") as f:
+            return marker.encode() in f.read()
+    except OSError:
+        return False
+
+
+def _alive(pid):
+    """Running and not a zombie (a zombie's /proc entry persists until
+    its parent reaps it, but it holds no resources worth waiting for)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            # field 3 (after the parenthesized comm, which may contain
+            # spaces) is the state letter.
+            state = f.read().rpartition(")")[2].split()[0]
+        return state != "Z"
+    except OSError:
+        return False
+
+
+def find_stale(pattern=None):
+    """(pid, cmdline) of every live workload process: dispatcher-launched
+    (SHOCKWAVE_JOB_ID in env) by default, or cmdline-matching
+    ``pattern``."""
+    found = []
+    for pid_str in os.listdir("/proc"):
+        if not pid_str.isdigit():
+            continue
+        pid = int(pid_str)
+        if pid == os.getpid() or not _alive(pid):
+            continue
+        cmdline = _cmdline(pid)
+        if cmdline is None:
+            continue
+        if pattern is not None:
+            if pattern in cmdline:
+                found.append((pid, cmdline))
+        elif _has_env_marker(pid):
+            found.append((pid, cmdline))
+    return found
+
+
+def kill(pids, grace_s=3.0):
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except OSError:
+            pass
+    deadline = time.time() + grace_s
+    while time.time() < deadline:
+        if not any(_alive(pid) for pid in pids):
+            return
+        time.sleep(0.2)
+    for pid in pids:
+        if _alive(pid):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+
+
+def main(args):
+    stale = find_stale(args.pattern)
+    if not stale:
+        print("No stale workload processes.")
+        return
+    for pid, cmdline in stale:
+        print(f"{pid}: {cmdline[:120]}")
+    if args.kill:
+        kill([pid for pid, _ in stale])
+        print(f"Killed {len(stale)} process(es).")
+    else:
+        print("(dry run; pass --kill to terminate)")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--pattern", type=str, default=None,
+        help="match this cmdline substring instead of the "
+        "SHOCKWAVE_JOB_ID env marker",
+    )
+    parser.add_argument("--kill", action="store_true")
+    main(parser.parse_args())

@@ -1,0 +1,86 @@
+"""The worker-hygiene tool must find dispatcher-launched workloads (by
+the SHOCKWAVE_JOB_ID env marker or a cmdline pattern), kill them, and
+leave everything else alone."""
+
+import importlib.util
+import os
+import subprocess
+import sys
+import time
+
+
+def _load():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "kill_stale_workloads",
+        os.path.join(repo, "scripts", "kill_stale_workloads.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _spawn(extra_env=None, marker=""):
+    env = dict(os.environ)
+    env.pop("SHOCKWAVE_JOB_ID", None)
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(60)", marker],
+        env=env,
+    )
+
+
+def test_find_by_env_marker_and_kill():
+    mod = _load()
+    # The dispatcher's env contract is the identifier, whatever the
+    # command line looks like.
+    proc = _spawn(extra_env={"SHOCKWAVE_JOB_ID": "7"})
+    other = _spawn()
+    try:
+        time.sleep(0.3)
+        pids = [pid for pid, _ in mod.find_stale()]
+        assert proc.pid in pids
+        assert other.pid not in pids
+        mod.kill([proc.pid], grace_s=2.0)
+        assert proc.wait(timeout=5) != 0
+        assert proc.pid not in [pid for pid, _ in mod.find_stale()]
+    finally:
+        for p in (proc, other):
+            if p.poll() is None:
+                p.kill()
+
+
+def test_find_by_cmdline_pattern():
+    mod = _load()
+    marker = f"stale-marker-{os.getpid()}"
+    proc = _spawn(marker=marker)
+    try:
+        time.sleep(0.3)
+        found = mod.find_stale(pattern=marker)
+        assert [pid for pid, _ in found] == [proc.pid]
+    finally:
+        proc.kill()
+
+
+def test_kill_does_not_wait_on_zombies():
+    """A SIGTERM'd child whose parent has not reaped it is a zombie; the
+    grace loop must not burn the full grace period waiting for its
+    /proc entry."""
+    mod = _load()
+    proc = _spawn()
+    try:
+        time.sleep(0.3)
+        start = time.time()
+        mod.kill([proc.pid], grace_s=10.0)
+        # The zombie persists until wait() below, yet kill() returned
+        # well before the 10 s grace deadline.
+        assert time.time() - start < 5.0
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_no_match_is_empty():
+    mod = _load()
+    assert mod.find_stale(pattern="no-such-process-pattern-xyz") == []

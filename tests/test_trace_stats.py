@@ -1,0 +1,30 @@
+"""Trace statistics tool: correct counts and distributions on the
+committed standalone traces."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "trace_stats",
+        os.path.join(REPO, "scripts", "analysis", "trace_stats.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_stats_on_committed_trace():
+    mod = _load()
+    s = mod.stats(os.path.join(REPO, "traces", "small_12_dynamic.trace"))
+    assert s["num_jobs"] == 12
+    assert sum(s["scale_factors"].values()) == 12
+    assert sum(s["modes"].values()) == 12
+    assert sum(s["families"].values()) == 12
+    assert s["duration_mean_s"] > 0
+    assert s["total_gpu_hours"] > 0
+    assert s["arrival_span_s"] > 0
+    assert s["duration_p50_s"] <= s["duration_p90_s"]
