@@ -12,6 +12,7 @@ which is what the repo actually ships).
 """
 
 import argparse
+import math
 import os
 import sys
 from collections import Counter
@@ -35,7 +36,10 @@ def stats(trace_file):
     srt = sorted(durations)
 
     def pct(p):
-        return srt[min(len(srt) - 1, int(p * len(srt)))] if srt else 0.0
+        # Nearest-rank percentile: index ceil(p*n) - 1.
+        if not srt:
+            return 0.0
+        return srt[max(0, math.ceil(p * len(srt)) - 1)]
 
     return {
         "trace": os.path.basename(trace_file),

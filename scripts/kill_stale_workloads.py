@@ -8,8 +8,11 @@ contract: every workload subprocess it launches carries
 ``SHOCKWAVE_JOB_ID`` in its environment
 (shockwave_tpu/runtime/dispatcher.py), whatever its command line is —
 so crashed-agent leftovers are found regardless of which trace command
-(`python3 main.py ...`, synthetic workloads, ...) they ran.
-``--pattern`` switches to a cmdline substring match instead.
+(`python3 main.py ...`, synthetic workloads, ...) they ran. By default
+only ORPHANED workloads count (reparented to init — the crashed-agent
+signature; a live agent's in-flight workloads are left alone);
+``--all`` drops that requirement and ``--pattern`` switches to a
+cmdline substring match instead.
 
   python scripts/kill_stale_workloads.py            # list only
   python scripts/kill_stale_workloads.py --kill     # SIGTERM, then KILL
@@ -36,28 +39,51 @@ def _cmdline(pid):
 def _has_env_marker(pid, marker=ENV_MARKER):
     try:
         with open(f"/proc/{pid}/environ", "rb") as f:
-            return marker.encode() in f.read()
+            block = f.read()
     except OSError:
         return False
+    # Exact variable-name match over the NUL-separated block (a plain
+    # substring would also hit e.g. OLD_SHOCKWAVE_JOB_ID=...).
+    return any(
+        entry.startswith(marker.encode()) for entry in block.split(b"\0")
+    )
+
+
+def _stat_fields(pid):
+    """(state, ppid) from /proc/<pid>/stat, parsed after the
+    parenthesized comm (which may itself contain spaces)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            rest = f.read().rpartition(")")[2].split()
+        return rest[0], int(rest[1])
+    except (OSError, IndexError, ValueError):
+        return None, None
 
 
 def _alive(pid):
     """Running and not a zombie (a zombie's /proc entry persists until
     its parent reaps it, but it holds no resources worth waiting for)."""
-    try:
-        with open(f"/proc/{pid}/stat") as f:
-            # field 3 (after the parenthesized comm, which may contain
-            # spaces) is the state letter.
-            state = f.read().rpartition(")")[2].split()[0]
-        return state != "Z"
-    except OSError:
-        return False
+    state, _ = _stat_fields(pid)
+    return state is not None and state != "Z"
 
 
-def find_stale(pattern=None):
-    """(pid, cmdline) of every live workload process: dispatcher-launched
-    (SHOCKWAVE_JOB_ID in env) by default, or cmdline-matching
-    ``pattern``."""
+def _orphaned(pid):
+    """Reparented to init/subreaper — the signature of a crashed parent
+    (the dispatcher launches workloads with start_new_session=True, so
+    they survive the agent and get ppid 1)."""
+    _, ppid = _stat_fields(pid)
+    return ppid == 1
+
+
+def find_stale(pattern=None, include_parented=False):
+    """(pid, cmdline) of stale workload processes.
+
+    Default: dispatcher-launched (exact SHOCKWAVE_JOB_ID env marker) AND
+    orphaned (ppid 1 — the crashed-agent signature; a live agent's
+    in-flight workloads keep the agent as parent and are left alone).
+    ``include_parented`` drops the orphan requirement; ``pattern``
+    switches to a cmdline substring match instead of the env marker.
+    """
     found = []
     for pid_str in os.listdir("/proc"):
         if not pid_str.isdigit():
@@ -71,7 +97,9 @@ def find_stale(pattern=None):
         if pattern is not None:
             if pattern in cmdline:
                 found.append((pid, cmdline))
-        elif _has_env_marker(pid):
+        elif _has_env_marker(pid) and (
+            include_parented or _orphaned(pid)
+        ):
             found.append((pid, cmdline))
     return found
 
@@ -96,7 +124,7 @@ def kill(pids, grace_s=3.0):
 
 
 def main(args):
-    stale = find_stale(args.pattern)
+    stale = find_stale(args.pattern, include_parented=args.all)
     if not stale:
         print("No stale workload processes.")
         return
@@ -115,6 +143,11 @@ if __name__ == "__main__":
         "--pattern", type=str, default=None,
         help="match this cmdline substring instead of the "
         "SHOCKWAVE_JOB_ID env marker",
+    )
+    parser.add_argument(
+        "--all", action="store_true",
+        help="also match workloads whose worker agent is still alive "
+        "(default: only orphans, ppid 1)",
     )
     parser.add_argument("--kill", action="store_true")
     main(parser.parse_args())

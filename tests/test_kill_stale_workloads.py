@@ -34,21 +34,65 @@ def _spawn(extra_env=None, marker=""):
 def test_find_by_env_marker_and_kill():
     mod = _load()
     # The dispatcher's env contract is the identifier, whatever the
-    # command line looks like.
+    # command line looks like; this test's children are still PARENTED
+    # (to us), so only the include_parented mode may see them — the
+    # default (orphans only) must leave a live agent's workloads alone.
     proc = _spawn(extra_env={"SHOCKWAVE_JOB_ID": "7"})
     other = _spawn()
+    near_miss = _spawn(extra_env={"OLD_SHOCKWAVE_JOB_ID": "7"})
     try:
         time.sleep(0.3)
-        pids = [pid for pid, _ in mod.find_stale()]
+        default_pids = [pid for pid, _ in mod.find_stale()]
+        assert proc.pid not in default_pids  # parented => not stale
+        pids = [pid for pid, _ in mod.find_stale(include_parented=True)]
         assert proc.pid in pids
         assert other.pid not in pids
+        assert near_miss.pid not in pids  # exact env-name match only
         mod.kill([proc.pid], grace_s=2.0)
         assert proc.wait(timeout=5) != 0
-        assert proc.pid not in [pid for pid, _ in mod.find_stale()]
+        assert proc.pid not in [
+            pid for pid, _ in mod.find_stale(include_parented=True)
+        ]
     finally:
-        for p in (proc, other):
+        for p in (proc, other, near_miss):
             if p.poll() is None:
                 p.kill()
+
+
+def test_orphaned_workload_found_by_default():
+    """A workload whose agent died (double-fork => reparented to init)
+    IS stale and found without flags."""
+    mod = _load()
+    code = (
+        "import os, subprocess, sys\n"
+        "env = dict(os.environ); env['SHOCKWAVE_JOB_ID'] = '9'\n"
+        "p = subprocess.Popen([sys.executable, '-c',"
+        " 'import time; time.sleep(60)'], env=env,"
+        " start_new_session=True, stdout=subprocess.DEVNULL,"
+        " stderr=subprocess.DEVNULL)\n"
+        "print(p.pid, flush=True)\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=30,
+    )
+    grandchild = int(out.stdout.strip())
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            _, ppid = mod._stat_fields(grandchild)
+            if ppid == 1:
+                break
+            time.sleep(0.1)
+        assert mod._orphaned(grandchild), "grandchild not reparented"
+        assert grandchild in [pid for pid, _ in mod.find_stale()]
+        mod.kill([grandchild], grace_s=2.0)
+        assert grandchild not in [pid for pid, _ in mod.find_stale()]
+    finally:
+        try:
+            os.kill(grandchild, 9)
+        except OSError:
+            pass
 
 
 def test_find_by_cmdline_pattern():

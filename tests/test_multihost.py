@@ -1,0 +1,70 @@
+"""Multi-host (multi-process) distributed training: the scheduler's gang
+contract — ``--distributed_addr/--num_workers/--worker_rank`` — must
+bring up jax.distributed across processes and synchronize the gang
+(capability of reference: DDP rendezvous args appended at
+scheduler/scheduler.py:1943-1950 + NCCL inside workloads; here the data
+plane is jax.distributed collectives — Gloo on CPU, ICI/DCN on TPU
+fleets)."""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_gang_trains_in_sync(tmp_path):
+    from shockwave_tpu.utils.virtual_devices import force_cpu_device_env
+
+    env = force_cpu_device_env(1, dict(os.environ))
+    addr = f"127.0.0.1:{_free_port()}"
+    procs = []
+    try:
+        for rank in range(2):
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m", "shockwave_tpu.models.train",
+                        "--model", "ResNet-18", "-n", "2",
+                        "--batch_size", "8",
+                        "--distributed_addr", addr, "--num_workers", "2",
+                        "--worker_rank", str(rank),
+                    ],
+                    env=env, cwd=REPO,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                )
+            )
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=280)
+            outs.append(out.decode())
+    finally:
+        # A failed rendezvous leaves the other rank blocked on the
+        # coordinator barrier; never leak it past the test.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-2000:]}"
+    # Each rank generates a DIFFERENT data shard (train.py folds
+    # process_index into the rng), so identical reported losses can only
+    # come from the shared global-batch computation: if the gang
+    # silently fell apart into independent replicas, the two ranks would
+    # be training on different data and report different losses.
+    losses = []
+    for out in outs:
+        m = re.search(r"steps=2 loss=([0-9.]+)", out)
+        assert m, out[-2000:]
+        losses.append(float(m.group(1)))
+    assert losses[0] == pytest.approx(losses[1], abs=1e-4)
