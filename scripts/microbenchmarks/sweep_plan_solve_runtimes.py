@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""Shockwave plan-solve runtime sweep across backends and job counts.
+
+Complements sweep_policy_runtimes.py (which times
+``policy.get_allocation`` for the Gavel policy library): the Shockwave
+planner bypasses get_allocation, so this sweep times one planning solve
+per backend — the reference-formulation HiGHS MILP (the same
+boolean-boundary encoding bench.py baselines against), the tightened
+production MILP, the C++ host greedy, the jitted JAX level-set solver
+(warm cache), and the jitted exact-marginal greedy — on
+reference-shaped instances (J jobs x 20 future rounds, J//4 GPUs,
+dynamic priorities), the scaling view behind bench.py's single stress
+point.
+
+Writes one JSON artifact (default results/plan_solve_runtimes.json):
+  {backend: {num_jobs: seconds_mean}} plus objective gaps vs the MILP.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+DEFAULT_NUM_JOBS = [64, 128, 256, 512, 1024]
+
+
+def make_problem(num_jobs, seed=0):
+    import bench
+
+    return bench.make_problem(
+        num_jobs=num_jobs,
+        future_rounds=20,
+        num_gpus=max(16, num_jobs // 4),
+        seed=seed,
+    )
+
+
+def backends():
+    from shockwave_tpu import native
+    from shockwave_tpu.solver.eg_jax import solve_eg_greedy, solve_eg_level
+    from shockwave_tpu.solver.eg_milp import (
+        solve_eg_milp,
+        solve_eg_milp_reference_formulation,
+    )
+
+    out = {
+        "milp_reference": lambda p: solve_eg_milp_reference_formulation(
+            p, rel_gap=1e-3, time_limit=120
+        ),
+        "milp_tightened": lambda p: solve_eg_milp(
+            p, rel_gap=1e-3, time_limit=120
+        ),
+        "jax_level": solve_eg_level,
+        "jax_greedy": solve_eg_greedy,
+    }
+    if native.available():
+        out["native_greedy"] = native.solve_eg_greedy_native
+    return out
+
+
+def main(args):
+    results = {}
+    gaps = {}
+    solvers = backends()
+    for name in solvers:
+        results[name] = {}
+        gaps[name] = {}
+    for J in args.num_jobs:
+        problem = make_problem(J, seed=args.seed)
+        obj = {}
+        for name, solve in solvers.items():
+            if name.startswith("milp") and J > args.milp_max_jobs:
+                continue
+            if name.startswith("jax"):
+                solve(problem)  # warm the jit cache (host backends have
+                # no cache; an extra MILP solve would just be wasted)
+            t0 = time.time()
+            for _ in range(args.runs):
+                Y = solve(problem)
+            secs = (time.time() - t0) / args.runs
+            results[name][str(J)] = round(secs, 4)
+            obj[name] = problem.objective_value(Y)
+            print(f"{name:>15} J={J:>5}: {secs:.4f} s", flush=True)
+        ref = obj.get("milp_reference")
+        if ref is not None:
+            for name, o in obj.items():
+                gaps[name][str(J)] = round((ref - o) / max(1.0, abs(ref)), 6)
+    artifact = {
+        "config": (
+            "J jobs x 20 future rounds x max(16, J//4) GPUs, seed "
+            f"{args.seed}, mean of {args.runs} runs (jax rows "
+            "warm-cache); gap = (milp_reference_objective - "
+            "backend_objective) / |milp_reference_objective|. "
+            "Note: jax_* rows include the host's fixed device round-trip "
+            "latency (~0.1 s on tunneled single-chip hosts), which "
+            "dominates them at these sizes — the on-device compute is "
+            "the flat-vs-J part; host backends have no such floor."
+        ),
+        "results": results,
+        "objective_gap_vs_milp": gaps,
+    }
+    os.makedirs(os.path.dirname(args.output) or ".", exist_ok=True)
+    with open(args.output, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(f"Wrote {args.output}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--num_jobs", type=int, nargs="+", default=DEFAULT_NUM_JOBS
+    )
+    parser.add_argument("--runs", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--milp_max_jobs", type=int, default=1024,
+        help="skip the exact MILP above this size",
+    )
+    parser.add_argument(
+        "--output", type=str, default="results/plan_solve_runtimes.json"
+    )
+    main(parser.parse_args())

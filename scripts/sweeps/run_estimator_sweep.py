@@ -1,0 +1,134 @@
+#!/usr/bin/env python3
+"""Throughput-estimation sweep: scheduling quality vs profiling budget.
+
+The reference sweeps the online throughput estimator's two knobs —
+profiling percentage and number of reference models — and parses the
+resulting logs (reference: throughput_estimator.py +
+scripts/utils/parse_throughput_estimation_sweep_log.py). Here the sweep
+drives the simulator directly: a packing policy scheduling a trace
+where the allocator sees matrix-completed estimates instead of the
+oracle, compared against the full-oracle run.
+
+Writes one JSON artifact (default results/estimator_sweep.json):
+  {"oracle": {...metrics}, "cells": {"p<pct>_r<refs>": {...metrics}}}.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+from shockwave_tpu.core.scheduler import Scheduler
+from shockwave_tpu.data import load_or_synthesize_profiles, parse_trace
+from shockwave_tpu.data.default_oracle import generate_oracle
+from shockwave_tpu.policies import get_policy
+
+DEFAULT_TRACE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "traces",
+    "small_12_dynamic.trace",
+)
+
+
+def load_inputs(trace_file):
+    """Parse + synthesize once; every sweep cell shares these (the trace
+    and oracle are cell-invariant)."""
+    jobs, arrivals = parse_trace(trace_file)
+    oracle = generate_oracle()
+    profiles = load_or_synthesize_profiles(
+        trace_file, jobs, oracle, cache=False
+    )
+    for i, job in enumerate(jobs):
+        job.duration = sum(profiles[i]["duration_every_epoch"])
+    return jobs, arrivals, oracle, profiles
+
+
+def run_cell(trace_file, policy_name, num_gpus, profiling_percentage,
+             num_reference_models, seed=0, inputs=None):
+    jobs, arrivals, oracle, profiles = inputs or load_inputs(trace_file)
+    # The scheduler mutates jobs (steps run, bs rescale) AND the oracle
+    # dict (the estimator writes estimated entries into it); each cell
+    # gets fresh copies — still far cheaper than re-parsing and
+    # re-synthesizing, which is what the shared load_inputs avoids.
+    import copy
+
+    jobs = copy.deepcopy(jobs)
+    oracle = copy.deepcopy(oracle)
+    profiles = copy.deepcopy(profiles)
+    sched = Scheduler(
+        get_policy(policy_name, seed=seed),
+        throughputs=oracle,
+        seed=seed,
+        time_per_iteration=120,
+        profiles=profiles,
+        profiling_percentage=profiling_percentage,
+        num_reference_models=num_reference_models,
+    )
+    start = time.time()
+    makespan = sched.simulate({"v100": num_gpus}, arrivals, jobs)
+    ftf, unfair = sched.get_finish_time_fairness()
+    return {
+        "makespan": round(makespan, 1),
+        "avg_jct": round(sched.get_average_jct(), 1),
+        "worst_ftf": max(ftf) if ftf else None,
+        "unfair_fraction": round(unfair, 1),
+        "wall_s": round(time.time() - start, 1),
+    }
+
+
+def main(args):
+    cells = {}
+    inputs = load_inputs(args.trace_file)
+    oracle_run = run_cell(
+        args.trace_file, args.policy, args.num_gpus, 1.0, None, args.seed,
+        inputs=inputs,
+    )
+    print(f"oracle: {oracle_run}")
+    for pct in args.profiling_percentages:
+        for refs in args.num_reference_models:
+            cell = run_cell(
+                args.trace_file, args.policy, args.num_gpus, pct, refs,
+                args.seed, inputs=inputs,
+            )
+            cells[f"p{pct}_r{refs}"] = cell
+            print(f"p={pct} refs={refs}: {cell}")
+    artifact = {
+        "trace": os.path.basename(args.trace_file),
+        "policy": args.policy,
+        "num_gpus": args.num_gpus,
+        "oracle": oracle_run,
+        "cells": cells,
+    }
+    os.makedirs(os.path.dirname(args.output) or ".", exist_ok=True)
+    with open(args.output, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(f"Wrote {args.output}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("-t", "--trace_file", type=str, default=DEFAULT_TRACE)
+    parser.add_argument(
+        "-p", "--policy", type=str, default="max_min_fairness_packed"
+    )
+    parser.add_argument("-c", "--num_gpus", type=int, default=8)
+    parser.add_argument(
+        "--profiling_percentages", type=float, nargs="+",
+        default=[0.2, 0.5, 0.8],
+    )
+    parser.add_argument(
+        "--num_reference_models", type=int, nargs="+", default=[4, 8]
+    )
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--output", type=str, default="results/estimator_sweep.json"
+    )
+    main(parser.parse_args())

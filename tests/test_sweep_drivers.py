@@ -1,0 +1,44 @@
+"""Smoke tests for the microbenchmark/sweep drivers' core cells (the
+full sweeps run offline and commit artifacts under results/)."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(rel):
+    spec = importlib.util.spec_from_file_location(
+        os.path.basename(rel)[:-3], os.path.join(REPO, rel)
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_plan_solve_backends_agree_on_small_instance():
+    mod = _load("scripts/microbenchmarks/sweep_plan_solve_runtimes.py")
+    problem = mod.make_problem(24, seed=1)
+    solvers = mod.backends()
+    assert {
+        "milp_reference", "milp_tightened", "jax_level", "jax_greedy"
+    } <= set(solvers)
+    objs = {
+        name: problem.objective_value(solve(problem))
+        for name, solve in solvers.items()
+    }
+    ref = objs["milp_reference"]
+    for name, o in objs.items():
+        assert o >= ref - 0.01 * abs(ref), (name, o, ref)
+
+
+def test_estimator_sweep_cell_runs_and_degrades_gracefully():
+    mod = _load("scripts/sweeps/run_estimator_sweep.py")
+    oracle_run = mod.run_cell(mod.DEFAULT_TRACE, "max_min_fairness_packed",
+                              8, 1.0, None)
+    est_run = mod.run_cell(mod.DEFAULT_TRACE, "max_min_fairness_packed",
+                           8, 0.5, 4)
+    assert oracle_run["makespan"] > 0 and est_run["makespan"] > 0
+    # Estimated throughputs must not blow scheduling quality up: within
+    # 25% of the oracle makespan on the committed 12-job trace.
+    assert est_run["makespan"] <= 1.25 * oracle_run["makespan"]
