@@ -69,6 +69,7 @@ def backends():
 def main(args):
     results = {}
     gaps = {}
+    gap_reference = {}
     solvers = backends()
     for name in solvers:
         results[name] = {}
@@ -89,8 +90,19 @@ def main(args):
             results[name][str(J)] = round(secs, 4)
             obj[name] = problem.objective_value(Y)
             print(f"{name:>15} J={J:>5}: {secs:.4f} s", flush=True)
-        ref = obj.get("milp_reference")
-        if ref is not None:
+        ref_name = next(
+            (n for n in ("milp_reference", "milp_tightened") if n in obj),
+            None,
+        )
+        if ref_name is None:
+            print(
+                f"[note] J={J}: no MILP solved (--milp_max_jobs); "
+                "objective gaps unrecorded at this size",
+                flush=True,
+            )
+        else:
+            gap_reference[str(J)] = ref_name
+            ref = obj[ref_name]
             for name, o in obj.items():
                 gaps[name][str(J)] = round((ref - o) / max(1.0, abs(ref)), 6)
     artifact = {
@@ -106,6 +118,7 @@ def main(args):
         ),
         "results": results,
         "objective_gap_vs_milp": gaps,
+        "gap_reference": gap_reference,
     }
     os.makedirs(os.path.dirname(args.output) or ".", exist_ok=True)
     with open(args.output, "w") as f:
