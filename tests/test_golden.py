@@ -25,11 +25,33 @@ GOLDEN = {
     "max_min_fairness": dict(
         makespan=12976.601, avg_jct=5178.854, worst_ftf=2.116
     ),
+    # Planner backends (deterministic: C++ greedy / jitted level-set
+    # solve; pinning them guards the whole plan->round pipeline, not
+    # just the solver objective).
+    "shockwave_native": dict(
+        makespan=13336.436, avg_jct=5713.232, worst_ftf=2.029
+    ),
+    "shockwave_tpu_level": dict(
+        makespan=13696.373, avg_jct=5691.407, worst_ftf=2.029
+    ),
+}
+
+SHOCKWAVE_CONFIG = {
+    "num_gpus": 8,
+    "time_per_iteration": 120,
+    "future_rounds": 20,
+    "lambda": 5.0,
+    "k": 10.0,
 }
 
 
 @pytest.mark.parametrize("policy_name", sorted(GOLDEN))
 def test_golden_metrics_on_committed_trace(policy_name):
+    if policy_name == "shockwave_native":
+        from shockwave_tpu import native
+
+        if not native.available():
+            pytest.skip("no C++ compiler")
     jobs, arrivals = parse_trace(TRACE)
     oracle = generate_oracle()
     profiles = load_or_synthesize_profiles(TRACE, jobs, oracle, cache=False)
@@ -41,6 +63,11 @@ def test_golden_metrics_on_committed_trace(policy_name):
         seed=0,
         time_per_iteration=120,
         profiles=profiles,
+        shockwave_config=(
+            dict(SHOCKWAVE_CONFIG)
+            if policy_name.startswith("shockwave")
+            else None
+        ),
     )
     makespan = sched.simulate({"v100": 8}, arrivals, jobs)
     ftf_list, _ = sched.get_finish_time_fairness()
