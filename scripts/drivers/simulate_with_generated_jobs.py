@@ -39,6 +39,7 @@ from shockwave_tpu.data.generate import (
 from shockwave_tpu.data.profiles import synthesize_profiles
 from shockwave_tpu.data.throughputs import read_throughputs
 from shockwave_tpu.policies import get_available_policies, get_policy
+from shockwave_tpu.utils.cluster_spec import parse_cluster_spec
 
 
 def main(args):
@@ -78,12 +79,7 @@ def main(args):
     for i, job in enumerate(jobs):
         job.duration = sum(profiles[i]["duration_every_epoch"])
 
-    counts = [int(x) for x in args.cluster_spec.split(":")]
-    cluster_spec = {
-        wt: n
-        for wt, n in zip(("v100", "p100", "k80"), counts)
-        if n > 0
-    }
+    cluster_spec = parse_cluster_spec(args.cluster_spec)
 
     shockwave_config = None
     if args.policy.startswith("shockwave"):

@@ -81,14 +81,21 @@ def build_step(family, batch_size):
     return one_step
 
 
+def _sync(loss):
+    """Force completion by fetching a scalar: on tunneled plugin
+    backends (axon) block_until_ready can return before the computation
+    finishes, which would time only the async dispatch."""
+    return float(loss)
+
+
 def measure_isolated(one_step, warmup, steps):
     for _ in range(warmup):
         loss = one_step()
-    loss.block_until_ready()
+    _sync(loss)
     start = time.time()
     for _ in range(steps):
         loss = one_step()
-    loss.block_until_ready()
+    _sync(loss)
     return steps / (time.time() - start)
 
 
@@ -98,13 +105,13 @@ def measure_pair(step_a, step_b, warmup, steps):
     for _ in range(warmup):
         la = step_a()
         lb = step_b()
-    lb.block_until_ready()
+    _sync(lb)
     start = time.time()
     for _ in range(steps):
         la = step_a()
         lb = step_b()
-    la.block_until_ready()
-    lb.block_until_ready()
+    _sync(la)
+    _sync(lb)
     elapsed = time.time() - start
     return steps / elapsed, steps / elapsed
 

@@ -26,6 +26,7 @@ from shockwave_tpu.data import (
 )
 from shockwave_tpu.data.default_oracle import generate_oracle
 from shockwave_tpu.policies import get_available_policies, get_policy
+from shockwave_tpu.utils.cluster_spec import parse_cluster_spec
 
 
 def main(args):
@@ -36,17 +37,38 @@ def main(args):
     else:
         throughputs = generate_oracle()
 
+    cluster_spec = parse_cluster_spec(args.cluster_spec)
+    if "=" in args.cluster_spec:
+        # Named clusters default to 1 chip per server; a colon-form
+        # per-server spec has no type names to match against, so
+        # require the named form rather than silently ignoring it.
+        if "=" not in args.num_gpus_per_server:
+            if args.num_gpus_per_server != "1:1:1":
+                raise SystemExit(
+                    "--num_gpus_per_server must use the type=count form "
+                    "when --cluster_spec does"
+                )
+            num_gpus_per_server = {wt: 1 for wt in cluster_spec}
+        else:
+            num_gpus_per_server = {wt: 1 for wt in cluster_spec}
+            num_gpus_per_server.update(
+                parse_cluster_spec(args.num_gpus_per_server)
+            )
+    else:
+        per_server = [int(x) for x in args.num_gpus_per_server.split(":")]
+        num_gpus_per_server = {
+            "v100": per_server[0], "p100": per_server[1], "k80": per_server[2]
+        }
+
     profiles = load_or_synthesize_profiles(
-        args.trace_file, jobs, throughputs, cache=not args.no_profile_cache
+        args.trace_file,
+        jobs,
+        throughputs,
+        worker_type=next(iter(cluster_spec)),
+        cache=not args.no_profile_cache,
     )
     for i, job in enumerate(jobs):
         job.duration = sum(profiles[i]["duration_every_epoch"])
-
-    counts = [int(x) for x in args.cluster_spec.split(":")]
-    cluster_spec = {"v100": counts[0], "p100": counts[1], "k80": counts[2]}
-    cluster_spec = {wt: n for wt, n in cluster_spec.items() if n > 0}
-    per_server = [int(x) for x in args.num_gpus_per_server.split(":")]
-    num_gpus_per_server = {"v100": per_server[0], "p100": per_server[1], "k80": per_server[2]}
 
     shockwave_config = None
     if args.policy.startswith("shockwave"):
@@ -66,7 +88,11 @@ def main(args):
         shockwave_config.setdefault("solver_timeout", 15)
         shockwave_config["time_per_iteration"] = args.time_per_iteration
         # cluster_spec counts GPUs directly (servers = count // per_server).
-        shockwave_config["num_gpus"] = cluster_spec.get("v100", 0)
+        # Homogeneous planning capacity: the v100 pool in the reference
+        # vocabulary, else the whole (named-type) cluster.
+        shockwave_config["num_gpus"] = cluster_spec.get(
+            "v100", sum(cluster_spec.values())
+        )
 
     policy = get_policy(args.policy, solver=args.solver, seed=args.seed)
     sched = Scheduler(
@@ -125,7 +151,9 @@ def main(args):
         result = {
             "trace_file": args.trace_file,
             "policy": args.policy,
-            "num_gpus": str(counts[0]),
+            "num_gpus": str(
+                cluster_spec.get("v100", sum(cluster_spec.values()))
+            ),
             "makespan": makespan,
             "avg_jct": avg_jct,
             "worst_ftf": max(ftf_list) if ftf_list else None,

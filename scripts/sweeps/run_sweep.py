@@ -97,10 +97,9 @@ def run_cell(cell):
 
 
 def main(args):
-    counts = [int(x) for x in args.cluster_spec.split(":")]
-    cluster_spec = {
-        wt: n for wt, n in zip(("v100", "p100", "k80"), counts) if n > 0
-    }
+    from shockwave_tpu.utils.cluster_spec import parse_cluster_spec
+
+    cluster_spec = parse_cluster_spec(args.cluster_spec)
     os.makedirs(args.out, exist_ok=True)
     results_path = os.path.join(args.out, "results.jsonl")
 

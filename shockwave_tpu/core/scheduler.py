@@ -743,8 +743,21 @@ class Scheduler:
 
     def _shockwave_schedule_helper(self) -> Dict[str, List[Tuple[JobId, int]]]:
         """Pull this round's job list from the Shockwave planner
-        (reference: scheduler.py:991-1014; v100-only by design)."""
+        (reference: scheduler.py:991-1014; v100-only by design — here
+        generalized to "the homogeneous pool": v100 when present, else
+        the cluster's sole worker type)."""
         worker_type = "v100"
+        if worker_type not in self._worker_type_to_worker_ids:
+            types = list(self._worker_type_to_worker_ids)
+            if len(types) == 1:
+                worker_type = types[0]
+            else:
+                # Silently planning onto an absent pool would end the
+                # simulation with zero work (empty schedule == done).
+                raise ValueError(
+                    "Shockwave plans a homogeneous pool: need a 'v100' "
+                    f"pool or a single worker type, got {types}"
+                )
         scheduled: Dict[str, List[Tuple[JobId, int]]] = {worker_type: []}
         self._current_round_scheduled_jobs = self._shockwave.current_round_schedule()
         for job_id in self._current_round_scheduled_jobs:
@@ -785,9 +798,15 @@ class Scheduler:
         if not self._is_shockwave:
             self._update_priorities()
 
+        # The reference's fixed goodness order for its GPU vocabulary;
+        # any other worker types (e.g. measured "tpu_v5e" oracles) come
+        # after, alphabetically — not silently unschedulable.
+        known = ["v100", "p100", "k80"]
         worker_types = [
-            wt for wt in ["v100", "p100", "k80"] if wt in self._worker_type_to_worker_ids
-        ]
+            wt for wt in known if wt in self._worker_type_to_worker_ids
+        ] + sorted(
+            wt for wt in self._worker_type_to_worker_ids if wt not in known
+        )
         if "Perf" not in self._policy.name and "Packing" not in self._policy.name:
             self._worker_type_shuffler.shuffle(worker_types)
 

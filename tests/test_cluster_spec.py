@@ -1,0 +1,62 @@
+"""Cluster-spec parsing: the reference's colon form and the named form,
+shared by every driver CLI."""
+
+import pytest
+
+from shockwave_tpu.utils.cluster_spec import parse_cluster_spec
+
+
+def test_reference_colon_form():
+    assert parse_cluster_spec("8:4:0") == {"v100": 8, "p100": 4}
+    assert parse_cluster_spec("25:0:0") == {"v100": 25}
+
+
+def test_named_form():
+    assert parse_cluster_spec("tpu_v5e=8") == {"tpu_v5e": 8}
+    assert parse_cluster_spec("tpu_v5e=8,tpu_v4=4") == {
+        "tpu_v5e": 8,
+        "tpu_v4": 4,
+    }
+
+
+def test_named_form_strips_whitespace_and_drops_zero():
+    assert parse_cluster_spec(" tpu=4, v4=2 ") == {"tpu": 4, "v4": 2}
+    assert parse_cluster_spec("a=4,b=0") == {"a": 4}
+
+
+def test_bad_named_token_raises():
+    with pytest.raises(ValueError):
+        parse_cluster_spec("a=b=c")
+    with pytest.raises(ValueError):
+        parse_cluster_spec("=4")
+
+
+def test_shockwave_rejects_multi_type_cluster_without_v100():
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.policies import get_policy
+    from tests.test_simulator import tiny_trace
+    from shockwave_tpu.data.profiles import synthesize_profiles
+
+    oracle = generate_oracle()
+    # Fabricate a second non-v100 pool from the v100 entries.
+    oracle["tpu_a"] = oracle["v100"]
+    oracle["tpu_b"] = oracle["v100"]
+    jobs, arrivals = tiny_trace(num_jobs=2, epochs=1)
+    profiles = synthesize_profiles(jobs, oracle)
+    sched = Scheduler(
+        get_policy("shockwave_tpu", seed=0),
+        throughputs=oracle,
+        seed=0,
+        time_per_iteration=120,
+        profiles=profiles,
+        shockwave_config={
+            "num_gpus": 4,
+            "time_per_iteration": 120,
+            "future_rounds": 5,
+            "lambda": 5.0,
+            "k": 10.0,
+        },
+    )
+    with pytest.raises(ValueError, match="homogeneous"):
+        sched.simulate({"tpu_a": 2, "tpu_b": 2}, arrivals, jobs)

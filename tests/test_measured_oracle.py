@@ -1,0 +1,71 @@
+"""The committed real-TPU measured oracle must parse, be self-consistent,
+and drive a simulation end to end (the reference cannot ship this — its
+measured profile pickles are stripped from its snapshot)."""
+
+import os
+
+import pytest
+
+from shockwave_tpu.data.throughputs import read_throughputs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ORACLE = os.path.join(REPO, "results", "measured_oracle_tpu.json")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(ORACLE), reason="measured oracle not committed"
+)
+
+
+def test_oracle_parses_and_is_sane():
+    t = read_throughputs(ORACLE)
+    assert "tpu_v5e" in t
+    entries = t["tpu_v5e"]
+    isolated = {k: v["null"] for k, v in entries.items()}
+    assert len(isolated) >= 28  # 7 families x >= 1 bs x 4 scale factors
+    for (job_type, sf), tput in isolated.items():
+        assert tput > 0, (job_type, sf)
+    # Gang extrapolation is monotone in scale factor.
+    for (job_type, sf), tput in isolated.items():
+        if (job_type, 2 * sf) in isolated:
+            assert isolated[(job_type, 2 * sf)] > tput
+
+
+def test_oracle_drives_a_simulation():
+    from shockwave_tpu.core.job import Job
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data.profiles import synthesize_profiles
+    from shockwave_tpu.data.workload_info import steps_per_epoch
+    from shockwave_tpu.policies import get_policy
+
+    oracle = read_throughputs(ORACLE)
+    jobs = []
+    for job_type in [
+        "ResNet-18 (batch size 32)",
+        "LM (batch size 20)",
+        "Recommendation (batch size 1024)",
+        "Transformer (batch size 64)",
+    ]:
+        model = job_type.split(" (")[0]
+        bs = int(job_type.rstrip(")").split("size ")[1])
+        jobs.append(
+            Job(
+                job_type=job_type,
+                total_steps=steps_per_epoch(model, bs) * 2,
+                mode="static",
+            )
+        )
+    profiles = synthesize_profiles(jobs, oracle, worker_type="tpu_v5e")
+    for i, job in enumerate(jobs):
+        job.duration = sum(profiles[i]["duration_every_epoch"])
+    sched = Scheduler(
+        get_policy("max_min_fairness", seed=0),
+        throughputs=oracle,
+        seed=0,
+        time_per_iteration=120,
+        profiles=profiles,
+    )
+    makespan = sched.simulate({"tpu_v5e": 2}, [0.0] * len(jobs), jobs)
+    assert makespan > 0
+    assert all(
+        t is not None for t in sched._job_completion_times.values()
+    )
