@@ -29,7 +29,7 @@ sys.path.insert(
     ),
 )
 
-DEFAULT_NUM_JOBS = [64, 128, 256, 512, 1024]
+DEFAULT_NUM_JOBS = [64, 128, 256, 512, 1024, 2048]
 
 
 def make_problem(num_jobs, seed=0):

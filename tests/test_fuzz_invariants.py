@@ -1,0 +1,97 @@
+"""Randomized simulator invariants: random traces under random
+policies, checked via the structured round log — capacity never
+exceeded, no job lost, every completed job ran all its steps, gang
+widths respected. The property-level safety net behind the per-policy
+golden and e2e tests."""
+
+import re
+
+import pytest
+
+from shockwave_tpu.core.scheduler import Scheduler
+from shockwave_tpu.data.default_oracle import generate_oracle
+from shockwave_tpu.data.generate import generate_trace_jobs
+from shockwave_tpu.data.profiles import synthesize_profiles
+from shockwave_tpu.policies import get_policy
+
+POLICIES = [
+    "fifo",
+    "max_min_fairness",
+    "finish_time_fairness_perf",
+    "gandiva",
+    "shockwave_tpu",
+]
+
+
+@pytest.mark.parametrize("mode_mix", ["static", "dynamic"])
+@pytest.mark.parametrize("seed", range(3))
+@pytest.mark.parametrize("policy_name", POLICIES)
+def test_random_trace_invariants(policy_name, seed, mode_mix):
+    from shockwave_tpu.data.generate import DYNAMIC_MODE_DIST
+
+    oracle = generate_oracle()
+    jobs, arrivals = generate_trace_jobs(
+        num_jobs=10 + 3 * seed,
+        throughputs=oracle,
+        seed=seed,
+        lam=120.0,
+        **(
+            {"mode_dist": DYNAMIC_MODE_DIST}
+            if mode_mix == "dynamic"
+            else {}
+        ),
+    )
+    profiles = synthesize_profiles(jobs, oracle)
+    for i, job in enumerate(jobs):
+        job.duration = sum(profiles[i]["duration_every_epoch"])
+    num_gpus = 6
+    shockwave_config = (
+        {
+            "num_gpus": num_gpus,
+            "time_per_iteration": 120,
+            "future_rounds": 10,
+            "lambda": 5.0,
+            "k": 10.0,
+        }
+        if policy_name.startswith("shockwave")
+        else None
+    )
+    sched = Scheduler(
+        get_policy(policy_name, seed=seed),
+        throughputs=oracle,
+        seed=seed,
+        time_per_iteration=120,
+        profiles=profiles,
+        shockwave_config=shockwave_config,
+    )
+    makespan = sched.simulate({"v100": num_gpus}, arrivals, jobs)
+    assert makespan > 0
+
+    # No job lost: every admitted job reaches a completion record.
+    assert len(sched._job_completion_times) == len(jobs)
+    for job_id, jct in sched._job_completion_times.items():
+        assert jct is not None and jct > 0, job_id
+
+    # Completed steps. Static jobs must have run EXACTLY-or-more their
+    # total steps; dynamic (accordion/gns) jobs rescale total_steps
+    # mid-run, so the invariant there is positive progress.
+    scale = {i: j.scale_factor for i, j in enumerate(jobs)}
+    steps_run = sched.get_completed_steps()
+    for i, job in enumerate(jobs):
+        steps = steps_run[i]
+        if job.mode == "static":
+            assert steps >= job.total_steps, (i, steps, job.total_steps)
+        else:
+            assert steps > 0, i
+
+    # Capacity and gang width, via the round log: never over capacity,
+    # and a scheduled gang occupies exactly scale_factor workers.
+    for ev in sched._round_log:
+        if ev["event"] != "round":
+            continue
+        assert sum(ev["jobs"].values()) <= num_gpus, ev
+        for key, width in ev["jobs"].items():
+            assert width >= 1, ev
+            ids = [int(tok) for tok in re.findall(r"\d+", key)]
+            if len(ids) == 1 and ids[0] in scale:
+                assert width == scale[ids[0]], (key, width)
