@@ -52,6 +52,28 @@ def load_results(pickle_dir):
     return data
 
 
+def _title_from(data):
+    """Derive the suptitle from the results' own trace filename: works
+    for both reference-style names ("220_..._dynamic.trace") and the
+    repo's generated ones ("generated_220_dynamic.trace")."""
+    import re
+
+    for per_policy in data.values():
+        for r in per_policy.values():
+            trace = os.path.basename(str(r.get("trace_file", "")))
+            m = re.search(r"(\d{2,})_", trace)
+            if m:
+                kind = "static" if "static" in trace else (
+                    "dynamic" if "dynamic" in trace else ""
+                )
+                kind = f"-job {kind} trace" if kind else "-job trace"
+                return (
+                    f"Shockwave scale replication: {m.group(1)}{kind}, "
+                    "120 s rounds"
+                )
+    return "Shockwave scale replication, 120 s rounds"
+
+
 def plot(data, out_path):
     sizes = sorted(data)
     policies = [
@@ -90,10 +112,7 @@ def plot(data, out_path):
         fontsize=9,
         frameon=False,
     )
-    fig.suptitle(
-        "Shockwave scale replication: 220-job dynamic trace, 120 s rounds",
-        fontsize=12,
-    )
+    fig.suptitle(_title_from(data), fontsize=12)
     fig.tight_layout(rect=(0, 0, 1, 0.88))
     fig.savefig(out_path, dpi=150)
     print(f"Wrote {out_path}")
