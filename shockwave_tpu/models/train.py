@@ -215,7 +215,7 @@ def main(argv=None):
     parser.add_argument("--num_layers", type=int, default=2)
     parser.add_argument("--seq_len", type=int, default=128)
     parser.add_argument("--attention", type=str, default="dense",
-                        choices=["dense", "ring", "flash"])
+                        choices=["dense", "ring", "ulysses", "flash"])
     parser.add_argument("--num_experts", type=int, default=0)
     parser.add_argument("--model_parallel", type=int, default=1)
     parser.add_argument("--seq_parallel", type=int, default=1)

@@ -39,7 +39,7 @@ class TransformerConfig:
     d_ff: int = 512
     max_len: int = 512
     dtype: str = "float32"  # bfloat16 on real chips
-    attention: str = "dense"  # "dense" | "ring" | "flash"
+    attention: str = "dense"  # "dense" | "ring" | "ulysses" | "flash"
     num_experts: int = 0  # 0 = dense MLP; >0 = MoE over "model"
 
 
@@ -74,16 +74,32 @@ class Attention(nn.Module):
             if self.mesh is None:
                 raise ValueError("ring attention requires a mesh")
             out = ring_attention(q, k, v, self.mesh)
+        elif cfg.attention == "ulysses":
+            # All-to-all sequence parallelism: two collectives per call
+            # instead of the ring's P-1 hops; needs heads divisible by
+            # the seq axis (shockwave_tpu/parallel/ulysses.py).
+            if self.mesh is None:
+                raise ValueError("ulysses attention requires a mesh")
+            from shockwave_tpu.parallel.ulysses import ulysses_attention
+
+            # Each device holds the full gathered sequence after the
+            # all-to-all; ulysses_attention downgrades to a dense local
+            # kernel when that sequence doesn't tile into flash blocks.
+            out = ulysses_attention(
+                q, k, v, self.mesh, local_attention="flash"
+            )
         elif cfg.attention == "flash":
             # Single-chip long-context path: the Pallas blockwise kernel
             # (shockwave_tpu/ops/flash_attention.py). Falls back to dense
             # when the sequence doesn't tile into kernel blocks.
-            from shockwave_tpu.ops.flash_attention import flash_attention
+            from shockwave_tpu.ops.flash_attention import (
+                flash_attention,
+                flash_tiles,
+            )
 
-            # TPU tiling needs full 128-row/col blocks; anything shorter
-            # or non-aligned takes the dense path.
-            S = x.shape[1]
-            if S >= 128 and S % 128 == 0:
+            # TPU tiling needs full kernel blocks; anything shorter or
+            # non-aligned takes the dense path.
+            if flash_tiles(x.shape[1]):
                 out = flash_attention(q, k, v, block_q=128, block_k=128)
             else:
                 out = dense_causal_attention(q, k, v)

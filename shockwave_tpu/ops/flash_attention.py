@@ -198,6 +198,14 @@ def _flash_flat_bwd(block_q, block_k, interpret, res, g):
 _flash_flat.defvjp(_flash_flat_fwd, _flash_flat_bwd)
 
 
+def flash_tiles(seq_len: int) -> bool:
+    """Whether a sequence fills whole default-sized kernel blocks.
+
+    Callers that want a dense fallback instead of the ValueError below
+    gate on this (models/transformer.py, parallel/ulysses.py)."""
+    return seq_len >= 128 and seq_len % 128 == 0
+
+
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,

@@ -1,0 +1,108 @@
+"""All-to-all (Ulysses-style) sequence/context parallelism.
+
+The second of the framework's two long-context strategies (SURVEY §5.7;
+the reference has neither). Ring attention (ring_attention.py) keeps the
+sequence sharded and rotates K/V blocks over ICI — communication scales
+with the number of hops. Ulysses instead re-shards *once* per attention
+call: an all-to-all over the "seq" mesh axis exchanges the sequence
+sharding for a head sharding, so every device holds the FULL sequence
+for H/P of the heads, runs an ordinary (or flash) causal attention
+locally, and a second all-to-all restores the sequence sharding. Two
+collectives total, each moving S*H*D/P elements per device — cheaper
+than the ring when heads are plentiful and the per-hop latency of P-1
+ppermutes would dominate; the trade-off follows the public DeepSpeed-
+Ulysses pattern, re-derived for jax.shard_map + lax.all_to_all.
+
+Requires the local head count to divide by the seq-axis size (heads may
+additionally be tensor-parallel over "model"; the constraint applies
+after that split).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from shockwave_tpu.parallel.ring_attention import dense_causal_attention
+
+
+def _ulysses_local(q, k, v, axis_name: str, local_attention: str):
+    """Per-shard body under shard_map.
+
+    q/k/v: the local [B, S/P, H, D] block (H already divided by any
+    tensor-parallel axis). all_to_all trades the seq shard for a head
+    shard, attention runs on the full sequence, and the inverse
+    all_to_all restores the input sharding.
+    """
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name, tiled=True)
+    # [B, S/P, H, D] -> [B, S, H/P, D]; tiled all_to_all concatenates the
+    # received pieces in device order, so sequence blocks land in
+    # position order and the plain causal mask is correct.
+    q = a2a(q, split_axis=2, concat_axis=1)
+    k = a2a(k, split_axis=2, concat_axis=1)
+    v = a2a(v, split_axis=2, concat_axis=1)
+    if local_attention == "flash":
+        from shockwave_tpu.ops.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v)
+    else:
+        out = dense_causal_attention(q, k, v)
+    # [B, S, H/P, D] -> [B, S/P, H, D]
+    return a2a(out, split_axis=1, concat_axis=2)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    seq_axis: str = "seq",
+    local_attention: str = "dense",
+) -> jnp.ndarray:
+    """Causal attention with all-to-all sequence parallelism.
+
+    Same contract as :func:`ring_attention`: q/k/v are
+    [batch, seq, heads, head_dim] with seq sharded on ``seq_axis``,
+    batch on the mesh's first non-seq axis and heads on the second
+    (canonically "data" and "model"). ``local_attention`` selects the
+    per-device kernel: "dense" or "flash" (the Pallas kernel from
+    shockwave_tpu/ops/flash_attention.py).
+    """
+    seq_par = mesh.shape[seq_axis]
+    other_axes = [a for a in mesh.axis_names if a != seq_axis]
+    batch_axis = other_axes[0] if len(other_axes) > 0 else None
+    head_axis = other_axes[1] if len(other_axes) > 1 else None
+    heads_local = q.shape[2]
+    if head_axis is not None:
+        heads_local //= mesh.shape[head_axis]
+    if heads_local % seq_par != 0:
+        raise ValueError(
+            f"{heads_local} local heads not divisible by seq axis "
+            f"{seq_axis}={seq_par}; use ring attention instead"
+        )
+    # The gathered per-device sequence equals the global S, so the flash
+    # kernel's tiling constraint resolves here, once: anything that
+    # doesn't fill its blocks runs the dense local path.
+    if local_attention == "flash":
+        from shockwave_tpu.ops.flash_attention import flash_tiles
+
+        if not flash_tiles(q.shape[1]):
+            local_attention = "dense"
+    io_spec = P(batch_axis, seq_axis, head_axis, None)
+    fn = jax.shard_map(
+        functools.partial(
+            _ulysses_local,
+            axis_name=seq_axis,
+            local_attention=local_attention,
+        ),
+        mesh=mesh,
+        in_specs=(io_spec, io_spec, io_spec),
+        out_specs=io_spec,
+        # pallas_call's out_shapes carry no vma annotation, so the flash
+        # local kernel can't run under shard_map's vma checking.
+        check_vma=(local_attention != "flash"),
+    )
+    return fn(q, k, v)
