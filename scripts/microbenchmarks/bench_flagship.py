@@ -1,0 +1,193 @@
+#!/usr/bin/env python3
+"""Flagship-workload device benchmark: transformer train-step throughput.
+
+Measures the full train step (forward + backward + adamw) of the
+flagship TransformerLM on whatever accelerator JAX sees — the real TPU
+chip on the bench host — across attention kernels (dense vs the Pallas
+flash kernel, ops/flash_attention.py) and activation dtypes (float32 vs
+bfloat16 mixed precision), at long context. Reports steps/s, tokens/s,
+and an approximate model-flops utilization (MFU) against the chip's
+advertised bf16 peak when known.
+
+Rates are slope-based like scripts/profiling/measure_throughput.py: the
+difference between an n-step and a 2n-step timed run cancels the
+tunneled host's fixed ~0.1 s dispatch/fetch cost.
+
+Example:
+  python scripts/microbenchmarks/bench_flagship.py \\
+      -o results/flagship_tpu_bench.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+# Advertised dense bf16 peak FLOP/s per chip, for the MFU estimate.
+_PEAK_FLOPS = {
+    "TPU v5e": 197e12,
+    "TPU v5 lite": 197e12,
+    "TPU v4": 275e12,
+    "TPU v6e": 918e12,
+}
+
+
+def build_step(seq_len, batch, dtype, attention, d_model, num_heads,
+               num_layers, vocab_size):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from shockwave_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+        lm_loss,
+    )
+    from shockwave_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh((1, 1, 1), devices=jax.devices()[:1])
+    cfg = TransformerConfig(
+        vocab_size=vocab_size,
+        d_model=d_model,
+        num_heads=num_heads,
+        num_layers=num_layers,
+        d_ff=4 * d_model,
+        max_len=seq_len,
+        dtype=dtype,
+        attention=attention,
+    )
+    model = TransformerLM(cfg, mesh=mesh)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, vocab_size, (batch, seq_len + 1)),
+        jnp.int32,
+    )
+    variables = model.init(jax.random.PRNGKey(0), tokens[:, :-1])
+    tx = optax.adamw(1e-4)
+    opt_state = tx.init(variables)
+
+    @jax.jit
+    def train_step(variables, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda v: lm_loss(model, v, tokens)
+        )(variables)
+        update, opt_state = tx.update(grads, opt_state, variables)
+        variables = optax.apply_updates(variables, update)
+        return variables, opt_state, loss
+
+    params = sum(
+        int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(variables)
+    )
+    state = {"v": variables, "o": opt_state}
+
+    def run(n):
+        loss = None
+        for _ in range(n):
+            state["v"], state["o"], loss = train_step(
+                state["v"], state["o"], tokens
+            )
+        return float(loss)  # scalar fetch forces completion
+
+    return run, params
+
+
+def measure(run, min_slope_s=1.0, start_n=4, max_n=4096):
+    run(2)  # warmup (compile)
+    n = start_n
+    while True:
+        t0 = time.time()
+        run(n)
+        t1 = time.time()
+        run(2 * n)
+        t2 = time.time()
+        diff = (t2 - t1) - (t1 - t0)
+        if diff >= min_slope_s or n >= max_n:
+            return n / max(diff, 1e-9)
+        n *= 4
+
+
+def step_flops(params, batch, seq_len, d_model, num_layers, vocab_size):
+    """Approximate train-step model FLOPs: 6*N per token for the matmul
+    params (fwd+bwd) + 12*S*d per token for attention scores/values."""
+    tokens = batch * seq_len
+    return 6 * params * tokens + 12 * num_layers * seq_len * d_model * tokens
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--seq_lens", type=int, nargs="+",
+                        default=[1024, 4096])
+    parser.add_argument("--tokens_per_step", type=int, default=32768)
+    parser.add_argument("--d_model", type=int, default=512)
+    parser.add_argument("--num_heads", type=int, default=8)
+    parser.add_argument("--num_layers", type=int, default=4)
+    parser.add_argument("--vocab_size", type=int, default=4096)
+    parser.add_argument("--dtypes", type=str, nargs="+",
+                        default=["float32", "bfloat16"])
+    parser.add_argument("--attentions", type=str, nargs="+",
+                        default=["dense", "flash"])
+    parser.add_argument("-o", "--output", type=str, default=None)
+    args = parser.parse_args(argv)
+
+    import jax
+
+    dev = jax.devices()[0]
+    peak = next(
+        (v for k, v in _PEAK_FLOPS.items()
+         if k.lower() in dev.device_kind.lower()),
+        None,
+    )
+    results = {
+        "device": dev.device_kind,
+        "platform": dev.platform,
+        "model": {
+            "d_model": args.d_model,
+            "num_heads": args.num_heads,
+            "num_layers": args.num_layers,
+            "vocab_size": args.vocab_size,
+        },
+        "runs": [],
+    }
+    for seq_len in args.seq_lens:
+        batch = max(1, args.tokens_per_step // seq_len)
+        for dtype in args.dtypes:
+            for attention in args.attentions:
+                run, params = build_step(
+                    seq_len, batch, dtype, attention, args.d_model,
+                    args.num_heads, args.num_layers, args.vocab_size,
+                )
+                rate = measure(run)
+                flops = step_flops(
+                    params, batch, seq_len, args.d_model,
+                    args.num_layers, args.vocab_size,
+                )
+                row = {
+                    "seq_len": seq_len,
+                    "batch": batch,
+                    "dtype": dtype,
+                    "attention": attention,
+                    "params": params,
+                    "steps_per_s": round(rate, 4),
+                    "tokens_per_s": round(rate * batch * seq_len, 1),
+                    "mfu": (
+                        round(rate * flops / peak, 4) if peak else None
+                    ),
+                }
+                results["runs"].append(row)
+                print(json.dumps(row))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(results, f, indent=1)
+        print(f"wrote {args.output}")
+
+
+if __name__ == "__main__":
+    main()

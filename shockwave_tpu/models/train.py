@@ -91,6 +91,7 @@ def build_family(name, args, mesh):
             num_layers=args.num_layers,
             d_ff=4 * args.d_model,
             max_len=args.seq_len,
+            dtype=getattr(args, "dtype", "float32"),
             attention=args.attention,
             num_experts=args.num_experts,
         )
@@ -216,6 +217,9 @@ def main(argv=None):
     parser.add_argument("--seq_len", type=int, default=128)
     parser.add_argument("--attention", type=str, default="dense",
                         choices=["dense", "ring", "ulysses", "flash"])
+    parser.add_argument("--dtype", type=str, default="float32",
+                        choices=["float32", "bfloat16"],
+                        help="activation dtype (params stay float32)")
     parser.add_argument("--num_experts", type=int, default=0)
     parser.add_argument("--model_parallel", type=int, default=1)
     parser.add_argument("--seq_parallel", type=int, default=1)

@@ -43,11 +43,15 @@ class TransformerConfig:
     num_experts: int = 0  # 0 = dense MLP; >0 = MoE over "model"
 
 
-def _dense(features, name, kernel_axes):
+def _dense(features, name, kernel_axes, dtype=None):
+    """Dense with float32 params computing in ``dtype`` (mixed
+    precision: bfloat16 activations on the MXU, float32 master
+    weights)."""
     return nn.Dense(
         features,
         name=name,
         use_bias=False,
+        dtype=dtype,
         kernel_init=nn.with_partitioning(
             nn.initializers.lecun_normal(), kernel_axes
         ),
@@ -61,12 +65,13 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
         head_dim = cfg.d_model // cfg.num_heads
         # QKV projections: heads sharded over "model" (tensor parallelism).
         qkv_shape = (cfg.num_heads, head_dim)
 
         def proj(name):
-            y = _dense(cfg.d_model, name, (None, "model"))(x)
+            y = _dense(cfg.d_model, name, (None, "model"), dtype)(x)
             return y.reshape(x.shape[:-1] + qkv_shape)
 
         q, k, v = proj("query"), proj("key"), proj("value")
@@ -100,13 +105,13 @@ class Attention(nn.Module):
             # TPU tiling needs full kernel blocks; anything shorter or
             # non-aligned takes the dense path.
             if flash_tiles(x.shape[1]):
-                out = flash_attention(q, k, v, block_q=128, block_k=128)
+                out = flash_attention(q, k, v)
             else:
                 out = dense_causal_attention(q, k, v)
         else:
             out = dense_causal_attention(q, k, v)
         out = out.reshape(x.shape)
-        return _dense(cfg.d_model, "out", ("model", None))(out)
+        return _dense(cfg.d_model, "out", ("model", None), dtype)(out)
 
 
 class MoEMlp(nn.Module):
@@ -121,7 +126,8 @@ class MoEMlp(nn.Module):
         cfg = self.config
         E = cfg.num_experts
         gates = nn.Dense(E, name="router", use_bias=False)(x)
-        weights = jax.nn.softmax(gates, axis=-1)
+        # Routing decisions in float32 regardless of activation dtype.
+        weights = jax.nn.softmax(gates.astype(jnp.float32), axis=-1)
         top = jnp.argmax(weights, axis=-1)
         dispatch = jax.nn.one_hot(top, E, dtype=x.dtype)  # [B, S, E]
         gate_scale = jnp.sum(weights * dispatch, axis=-1, keepdims=True)
@@ -140,11 +146,15 @@ class MoEMlp(nn.Module):
             ),
             (E, cfg.d_ff, cfg.d_model),
         )
-        # token -> its expert's FFN, via dense one-hot dispatch.
+        # token -> its expert's FFN, via dense one-hot dispatch; expert
+        # weights cast to the activation dtype so the matmuls stay on
+        # the MXU's bfloat16 path under mixed precision.
+        w_in = jnp.asarray(w_in).astype(x.dtype)
+        w_out = jnp.asarray(w_out).astype(x.dtype)
         hidden = jnp.einsum("bse,bsd,edf->bsf", dispatch, x, w_in)
         hidden = nn.gelu(hidden)
         out = jnp.einsum("bse,bsf,efd->bsd", dispatch, hidden, w_out)
-        return out * gate_scale
+        return out * gate_scale.astype(x.dtype)
 
 
 class Mlp(nn.Module):
@@ -153,9 +163,10 @@ class Mlp(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        h = _dense(cfg.d_ff, "in", (None, "model"))(x)
+        dtype = jnp.dtype(cfg.dtype)
+        h = _dense(cfg.d_ff, "in", (None, "model"), dtype)(x)
         h = nn.gelu(h)
-        return _dense(cfg.d_model, "out", ("model", None))(h)
+        return _dense(cfg.d_model, "out", ("model", None), dtype)(h)
 
 
 class Block(nn.Module):
@@ -165,9 +176,11 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        y = nn.LayerNorm(name="ln1")(x)
+        # LayerNorm statistics in float32; the next matmul casts back
+        # down to the activation dtype.
+        y = nn.LayerNorm(name="ln1", dtype=jnp.float32)(x)
         x = x + Attention(cfg, self.mesh, name="attention")(y)
-        y = nn.LayerNorm(name="ln2")(x)
+        y = nn.LayerNorm(name="ln2", dtype=jnp.float32)(x)
         mlp = (
             MoEMlp(cfg, name="moe")
             if cfg.num_experts > 0
@@ -195,11 +208,21 @@ class TransformerLM(nn.Module):
             nn.with_partitioning(nn.initializers.normal(0.02), (None, None)),
             (cfg.max_len, cfg.d_model),
         )
-        x = jnp.asarray(emb)[tokens] + jnp.asarray(pos)[: tokens.shape[1]]
+        dtype = jnp.dtype(cfg.dtype)
+        x = (
+            jnp.asarray(emb)[tokens] + jnp.asarray(pos)[: tokens.shape[1]]
+        ).astype(dtype)
         for i in range(cfg.num_layers):
             x = Block(cfg, self.mesh, name=f"block_{i}")(x)
-        x = nn.LayerNorm(name="ln_f")(x)
-        return x @ jnp.asarray(emb).T  # tied output head
+        x = nn.LayerNorm(name="ln_f", dtype=jnp.float32)(x)
+        # Tied output head: vocab matmul in the activation dtype, logits
+        # accumulated and returned in float32 for the softmax loss.
+        return jnp.einsum(
+            "bsd,vd->bsv",
+            x.astype(dtype),
+            jnp.asarray(emb).astype(dtype),
+            preferred_element_type=jnp.float32,
+        )
 
 
 def lm_loss(model, params, tokens):

@@ -152,7 +152,11 @@ def _flash_bwd_flat(q, k, v, out, m, l, g, block_k, scale):
 
     def one_block(dq, inputs):
         j, k_j, v_j = inputs
-        s = jnp.einsum("bsd,btd->bst", q, k_j) * scale  # [BH, S, block_k]
+        # Scores recomputed in float32 (bfloat16 inputs would otherwise
+        # quantize the exp argument); matmul inputs stay in their dtype.
+        s = jnp.einsum(
+            "bsd,btd->bst", q, k_j, preferred_element_type=jnp.float32
+        ) * scale  # [BH, S, block_k]
         cols = j * block_k + jnp.arange(block_k)
         dead = cols[None, :] > rows[:, None]  # [S, block_k]
         p = jnp.where(
@@ -167,7 +171,7 @@ def _flash_bwd_flat(q, k, v, out, m, l, g, block_k, scale):
 
     dq, (dk_b, dv_b) = jax.lax.scan(
         one_block,
-        jnp.zeros_like(q),
+        jnp.zeros(q.shape, jnp.float32),
         (jnp.arange(nk), k_blocks, v_blocks),
     )
     dk = dk_b.transpose(1, 0, 2, 3).reshape(BH, S, D)

@@ -23,8 +23,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 def _block_attention(q, k, v, scale, mask):
     """Scores and value products for one (Q-block, K/V-block) pair.
-    q: [B, Sq, H, D], k/v: [B, Sk, H, D], mask: [Sq, Sk] additive."""
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    q: [B, Sq, H, D], k/v: [B, Sk, H, D], mask: [Sq, Sk] additive.
+    Softmax state is float32 regardless of the input dtype (bfloat16
+    exp/normalizer arithmetic loses too much precision); the matmuls
+    still run in the input dtype on the MXU."""
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
     scores = scores + mask[None, None, :, :]
     block_max = jnp.max(scores, axis=-1)  # [B, H, Sq]
     # A fully-masked row has block_max = -inf; subtracting it would give
@@ -32,7 +37,12 @@ def _block_attention(q, k, v, scale, mask):
     safe_max = jnp.where(jnp.isfinite(block_max), block_max, 0.0)
     probs = jnp.exp(scores - safe_max[..., None])
     block_denom = jnp.sum(probs, axis=-1)  # [B, H, Sq]
-    block_out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    block_out = jnp.einsum(
+        "bhqk,bkhd->bqhd",
+        probs.astype(v.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    )
     return block_out, block_max, block_denom
 
 
@@ -42,15 +52,15 @@ def _ring_attention_local(q, k, v, axis_name: str, all_axes: tuple):
     num_shards = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, S, H, D = q.shape
-    scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
 
     q_pos = my_idx * S + jnp.arange(S)
 
     def causal_mask(src_idx):
         k_pos = src_idx * S + jnp.arange(S)
-        return jnp.where(k_pos[None, :] > q_pos[:, None], -jnp.inf, 0.0).astype(
-            q.dtype
-        )
+        return jnp.where(
+            k_pos[None, :] > q_pos[:, None], -jnp.inf, 0.0
+        ).astype(jnp.float32)
 
     def step(i, carry):
         acc, m, l, k_blk, v_blk = carry
@@ -81,19 +91,19 @@ def _ring_attention_local(q, k, v, axis_name: str, all_axes: tuple):
         )
         return acc, new_m, l, k_blk, v_blk
 
-    acc0 = jnp.zeros_like(q)
-    m0 = jnp.full((B, H, S), -jnp.inf, dtype=q.dtype)
-    l0 = jnp.zeros((B, H, S), dtype=q.dtype)
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full((B, H, S), -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros((B, H, S), dtype=jnp.float32)
     # Mark the fresh carries as device-varying so the loop carry type
-    # matches the per-shard outputs (shard_map vma tracking; acc0 already
-    # inherits q's vma via zeros_like).
+    # matches the per-shard outputs (shard_map vma tracking).
+    acc0 = jax.lax.pcast(acc0, all_axes, to="varying")
     m0 = jax.lax.pcast(m0, all_axes, to="varying")
     l0 = jax.lax.pcast(l0, all_axes, to="varying")
     acc, m, l, _, _ = jax.lax.fori_loop(
         0, num_shards, step, (acc0, m0, l0, k, v)
     )
     denom = l.transpose(0, 2, 1)[..., None]
-    return acc / jnp.maximum(denom, 1e-20)
+    return (acc / jnp.maximum(denom, 1e-20)).astype(q.dtype)
 
 
 def ring_attention(
@@ -133,13 +143,21 @@ def ring_attention(
 
 def dense_causal_attention(q, k, v):
     """Reference single-device causal attention (tests compare against
-    this)."""
+    this). Softmax in float32; matmuls in the input dtype."""
     B, S, H, D = q.shape
-    scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
     mask = jnp.where(
         jnp.arange(S)[None, :] > jnp.arange(S)[:, None], -jnp.inf, 0.0
-    ).astype(q.dtype)
+    ).astype(jnp.float32)
     scores = scores + mask[None, None]
     probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd",
+        probs.astype(v.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)

@@ -77,6 +77,11 @@ def ulysses_attention(
     head_axis = other_axes[1] if len(other_axes) > 1 else None
     heads_local = q.shape[2]
     if head_axis is not None:
+        if heads_local % mesh.shape[head_axis]:
+            raise ValueError(
+                f"{heads_local} heads not divisible by mesh axis "
+                f"{head_axis}={mesh.shape[head_axis]}"
+            )
         heads_local //= mesh.shape[head_axis]
     if heads_local % seq_par != 0:
         raise ValueError(
