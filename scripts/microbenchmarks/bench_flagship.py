@@ -114,9 +114,10 @@ def measure(run, min_slope_s=1.0, start_n=4, max_n=4096):
         n *= 4
 
 
-def step_flops(params, batch, seq_len, d_model, num_layers, vocab_size):
+def step_flops(params, batch, seq_len, d_model, num_layers):
     """Approximate train-step model FLOPs: 6*N per token for the matmul
-    params (fwd+bwd) + 12*S*d per token for attention scores/values."""
+    params (fwd+bwd, tied head included in N) + 12*S*d per token for
+    attention scores/values."""
     tokens = batch * seq_len
     return 6 * params * tokens + 12 * num_layers * seq_len * d_model * tokens
 
@@ -166,8 +167,7 @@ def main(argv=None):
                 )
                 rate = measure(run)
                 flops = step_flops(
-                    params, batch, seq_len, args.d_model,
-                    args.num_layers, args.vocab_size,
+                    params, batch, seq_len, args.d_model, args.num_layers
                 )
                 row = {
                     "seq_len": seq_len,

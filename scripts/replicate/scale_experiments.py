@@ -63,11 +63,17 @@ SHOCKWAVE_CONFIG = {
 }
 
 
-def run_cell(trace_file, policy_name, num_gpus, round_duration, seed=0):
+def run_cell(trace_file, policy_name, num_gpus, round_duration, seed=0,
+             worker_type="v100", throughputs_file=None, gpus_per_server=4):
     jobs, arrival_times = parse_trace(trace_file)
-    throughputs = generate_oracle()
+    if throughputs_file:
+        from shockwave_tpu.data import read_throughputs
+
+        throughputs = read_throughputs(throughputs_file)
+    else:
+        throughputs = generate_oracle()
     profiles = load_or_synthesize_profiles(
-        trace_file, jobs, throughputs, cache=False
+        trace_file, jobs, throughputs, worker_type=worker_type, cache=False
     )
     for i, job in enumerate(jobs):
         job.duration = sum(profiles[i]["duration_every_epoch"])
@@ -90,10 +96,10 @@ def run_cell(trace_file, policy_name, num_gpus, round_duration, seed=0):
     )
     start = time.time()
     makespan = sched.simulate(
-        {"v100": num_gpus},
+        {worker_type: num_gpus},
         arrival_times,
         jobs,
-        num_gpus_per_server={"v100": 4},
+        num_gpus_per_server={worker_type: gpus_per_server},
     )
     wall = time.time() - start
     ftf_list, unfair_fraction = sched.get_finish_time_fairness()
@@ -126,7 +132,9 @@ def main(args):
                 continue
             print(f"[run ] {name} on {os.path.basename(trace)}")
             result = run_cell(
-                trace, policy_name, num_gpus, args.time_per_iteration, args.seed
+                trace, policy_name, num_gpus, args.time_per_iteration,
+                args.seed, args.worker_type, args.throughputs_file,
+                args.gpus_per_server,
             )
             with open(out_pickle, "wb") as f:
                 pickle.dump(result, f)
@@ -179,4 +187,13 @@ if __name__ == "__main__":
     parser.add_argument("--time_per_iteration", type=int, default=120)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--force", action="store_true")
+    parser.add_argument(
+        "--worker_type", type=str, default="v100",
+        help="homogeneous pool type, e.g. tpu_v5e with a measured oracle",
+    )
+    parser.add_argument(
+        "--throughputs_file", type=str, default=None,
+        help="oracle JSON (default: the built-in synthetic oracle)",
+    )
+    parser.add_argument("--gpus_per_server", type=int, default=4)
     main(parser.parse_args())

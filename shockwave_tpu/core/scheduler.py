@@ -741,23 +741,27 @@ class Scheduler:
             scheduled_jobs[worker_type].append((job_id, scale_factor))
         return scheduled_jobs
 
+    def _shockwave_pool_type(self) -> str:
+        """The homogeneous pool the Shockwave planner plans onto
+        (reference: v100-only by design, scheduler.py:991-1014; here
+        generalized to v100 when present, else the cluster's sole
+        worker type)."""
+        if "v100" in self._worker_type_to_worker_ids:
+            return "v100"
+        types = list(self._worker_type_to_worker_ids)
+        if len(types) == 1:
+            return types[0]
+        # Silently planning onto an absent pool would end the
+        # simulation with zero work (empty schedule == done).
+        raise ValueError(
+            "Shockwave plans a homogeneous pool: need a 'v100' "
+            f"pool or a single worker type, got {types}"
+        )
+
     def _shockwave_schedule_helper(self) -> Dict[str, List[Tuple[JobId, int]]]:
         """Pull this round's job list from the Shockwave planner
-        (reference: scheduler.py:991-1014; v100-only by design — here
-        generalized to "the homogeneous pool": v100 when present, else
-        the cluster's sole worker type)."""
-        worker_type = "v100"
-        if worker_type not in self._worker_type_to_worker_ids:
-            types = list(self._worker_type_to_worker_ids)
-            if len(types) == 1:
-                worker_type = types[0]
-            else:
-                # Silently planning onto an absent pool would end the
-                # simulation with zero work (empty schedule == done).
-                raise ValueError(
-                    "Shockwave plans a homogeneous pool: need a 'v100' "
-                    f"pool or a single worker type, got {types}"
-                )
+        (reference: scheduler.py:991-1014)."""
+        worker_type = self._shockwave_pool_type()
         scheduled: Dict[str, List[Tuple[JobId, int]]] = {worker_type: []}
         self._current_round_scheduled_jobs = self._shockwave.current_round_schedule()
         for job_id in self._current_round_scheduled_jobs:
@@ -1143,11 +1147,14 @@ class Scheduler:
     def _shockwave_scheduler_update(self) -> None:
         """Push epoch progress into the planner and advance its round
         (reference: scheduler.py:3598-3621)."""
+        pool_type = self._shockwave_pool_type()
         for job_id in self._current_round_scheduled_jobs:
             if job_id in self._completed_jobs:
                 self._shockwave.mark_complete(job_id)
                 continue
-            steps_run = self._steps_run_so_far.get(job_id, {}).get("v100", 0)
+            steps_run = self._steps_run_so_far.get(job_id, {}).get(
+                pool_type, 0
+            )
             if job_id in self._jobs:
                 bs = self._jobs[job_id].batch_size
                 model = self._jobs[job_id].model

@@ -169,3 +169,24 @@ def constraint_matrices(
         rows.append(row)
         rhs.append(1.0)
     return np.array(rows), np.array(rhs)
+
+
+def canonical_throughputs(throughputs: dict) -> dict:
+    """Type-agnostic throughput view: every worker type gets the job's
+    canonical rate — the reference's v100 number, or the sole type on
+    single-type clusters (e.g. a measured tpu_v5e pool). Multi-type
+    clusters without a v100 pool are ambiguous and raise rather than
+    silently optimizing against an arbitrary type's rate."""
+    flat = {}
+    for job_id, tput in throughputs.items():
+        if "v100" in tput:
+            canonical = tput["v100"]
+        elif len(tput) == 1:
+            canonical = next(iter(tput.values()))
+        else:
+            raise ValueError(
+                "type-agnostic policy needs a 'v100' pool or a single "
+                f"worker type, got {sorted(tput)} for job {job_id}"
+            )
+        flat[job_id] = {wt: canonical for wt in tput}
+    return flat

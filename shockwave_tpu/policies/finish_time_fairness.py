@@ -164,10 +164,9 @@ class FinishTimeFairnessPolicy(Policy):
         num_steps_remaining,
         cluster_spec,
     ):
-        flat = {
-            job_id: {wt: throughputs[job_id]["v100"] for wt in throughputs[job_id]}
-            for job_id in throughputs
-        }
+        from shockwave_tpu.policies.base import canonical_throughputs
+
+        flat = canonical_throughputs(throughputs)
         return self._perf_policy.get_allocation(
             flat,
             scale_factors,

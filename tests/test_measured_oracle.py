@@ -69,3 +69,65 @@ def test_oracle_drives_a_simulation():
     assert all(
         t is not None for t in sched._job_completion_times.values()
     )
+
+
+def test_shockwave_plans_on_tpu_pool():
+    """The Shockwave planner must see epoch progress on a tpu_v5e-only
+    cluster (regression: the progress reader once hardcoded the "v100"
+    step counter, so non-v100 pools planned against frozen progress)."""
+    from shockwave_tpu.core.job import Job
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data.profiles import synthesize_profiles
+    from shockwave_tpu.data.workload_info import steps_per_epoch
+    from shockwave_tpu.policies import get_policy
+
+    oracle = read_throughputs(ORACLE)
+    jobs = []
+    for job_type in [
+        "ResNet-18 (batch size 32)",
+        "LM (batch size 20)",
+        "Transformer (batch size 64)",
+    ]:
+        model = job_type.split(" (")[0]
+        bs = int(job_type.rstrip(")").split("size ")[1])
+        jobs.append(
+            Job(
+                job_type=job_type,
+                # Long enough that every job spans several rounds, so
+                # partial-epoch progress updates actually happen.
+                total_steps=steps_per_epoch(model, bs) * 40,
+                mode="static",
+            )
+        )
+    profiles = synthesize_profiles(jobs, oracle, worker_type="tpu_v5e")
+    for i, job in enumerate(jobs):
+        job.duration = sum(profiles[i]["duration_every_epoch"])
+    sched = Scheduler(
+        get_policy("shockwave_tpu", seed=0),
+        throughputs=oracle,
+        seed=0,
+        time_per_iteration=120,
+        profiles=profiles,
+        shockwave_config={
+            "future_rounds": 10,
+            "lambda": 5.0,
+            "k": 10.0,
+            "num_gpus": 2,
+            "time_per_iteration": 120,
+        },
+    )
+    progress_seen = []
+    real_set_progress = sched._shockwave.set_progress
+
+    def spy(job_id, num_epochs):
+        progress_seen.append(int(num_epochs))
+        return real_set_progress(job_id, num_epochs)
+
+    sched._shockwave.set_progress = spy
+    makespan = sched.simulate({"tpu_v5e": 2}, [0.0] * len(jobs), jobs)
+    assert makespan > 0
+    assert all(
+        t is not None for t in sched._job_completion_times.values()
+    )
+    # Mid-run partial progress (not just 0) must have reached the planner.
+    assert any(0 < e for e in progress_seen), progress_seen
