@@ -101,3 +101,24 @@ def test_transformer_flash_attention_path():
         np.asarray(logits_flash), np.asarray(logits_dense), rtol=2e-3,
         atol=2e-3,
     )
+
+
+def test_block_steps_down_for_odd_lane_multiples():
+    # S=384 is a multiple of 128 but not of the 256 default block: the
+    # kernel must step down to 128-wide blocks rather than raise or
+    # fall back to dense.
+    from shockwave_tpu.ops.flash_attention import flash_tiles
+
+    assert flash_tiles(384)
+    rng = np.random.default_rng(4)
+    q, k, v = _qkv(rng, 1, 384, 2, 16)
+    out = flash_attention(q, k, v)  # default 256-blocks
+    ref = dense_causal_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
+    )
+    # Sublane-unaligned lengths stay rejected.
+    assert not flash_tiles(132)
+    q2, k2, v2 = _qkv(rng, 1, 132, 1, 16)
+    with pytest.raises(ValueError):
+        flash_attention(q2, k2, v2)
