@@ -41,7 +41,7 @@ _PEAK_FLOPS = {
 
 
 def build_step(seq_len, batch, dtype, attention, d_model, num_heads,
-               num_layers, vocab_size):
+               num_layers, vocab_size, remat=False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -64,6 +64,7 @@ def build_step(seq_len, batch, dtype, attention, d_model, num_heads,
         max_len=seq_len,
         dtype=dtype,
         attention=attention,
+        remat=remat,
     )
     model = TransformerLM(cfg, mesh=mesh)
     tokens = jnp.asarray(
@@ -135,6 +136,7 @@ def main(argv=None):
                         default=["float32", "bfloat16"])
     parser.add_argument("--attentions", type=str, nargs="+",
                         default=["dense", "flash"])
+    parser.add_argument("--remat", action="store_true")
     parser.add_argument("-o", "--output", type=str, default=None)
     args = parser.parse_args(argv)
 
@@ -154,18 +156,37 @@ def main(argv=None):
             "num_heads": args.num_heads,
             "num_layers": args.num_layers,
             "vocab_size": args.vocab_size,
+            "remat": args.remat,
         },
         "runs": [],
     }
     for seq_len in args.seq_lens:
         batch = max(1, args.tokens_per_step // seq_len)
+        run = None
         for dtype in args.dtypes:
             for attention in args.attentions:
-                run, params = build_step(
-                    seq_len, batch, dtype, attention, args.d_model,
-                    args.num_heads, args.num_layers, args.vocab_size,
-                )
-                rate = measure(run)
+                try:
+                    # Drop the previous config's closure first: it pins
+                    # that model's params/opt state in HBM, which would
+                    # OOM near-limit shapes that fit on their own.
+                    run = None
+                    run, params = build_step(
+                        seq_len, batch, dtype, attention, args.d_model,
+                        args.num_heads, args.num_layers, args.vocab_size,
+                        remat=args.remat,
+                    )
+                    rate = measure(run)
+                except Exception as e:  # e.g. HBM OOM at this shape
+                    row = {
+                        "seq_len": seq_len,
+                        "batch": batch,
+                        "dtype": dtype,
+                        "attention": attention,
+                        "error": f"{type(e).__name__}: {str(e)[:200]}",
+                    }
+                    results["runs"].append(row)
+                    print(json.dumps(row))
+                    continue
                 flops = step_flops(
                     params, batch, seq_len, args.d_model, args.num_layers
                 )

@@ -94,6 +94,7 @@ def build_family(name, args, mesh):
             dtype=getattr(args, "dtype", "float32"),
             attention=args.attention,
             num_experts=args.num_experts,
+            remat=getattr(args, "remat", False),
         )
         model = TransformerLM(cfg, mesh=mesh)
         example = jnp.zeros((bs, args.seq_len), jnp.int32)
@@ -220,6 +221,9 @@ def main(argv=None):
     parser.add_argument("--dtype", type=str, default="float32",
                         choices=["float32", "bfloat16"],
                         help="activation dtype (params stay float32)")
+    parser.add_argument("--remat", action="store_true",
+                        help="rematerialize transformer blocks in the "
+                        "backward pass (less HBM, ~1/3 more FLOPs)")
     parser.add_argument("--num_experts", type=int, default=0)
     parser.add_argument("--model_parallel", type=int, default=1)
     parser.add_argument("--seq_parallel", type=int, default=1)
