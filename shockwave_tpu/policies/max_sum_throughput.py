@@ -46,16 +46,31 @@ class ThroughputNormalizedByCostSumWithPerfSLOs(Policy):
         rows, rhs = [], []
         for job_id in SLOs:
             i = job_ids.index(job_id)
+            required = num_steps_remaining[job_id] / SLOs[job_id]
+            # A job whose deadline is already unreachable even with the
+            # largest share the capacity constraints allow it alone
+            # (x <= num_workers / scale_factor, and <= 1) would make
+            # the whole LP infeasible; pruning it keeps the
+            # still-meetable deadlines enforceable. (The reference
+            # instead re-solves with ALL SLOs dropped on any
+            # infeasibility, reference: :91-96 — one doomed job
+            # disables SLO steering for everyone.)
+            cap = np.minimum(
+                1.0, self._num_workers / np.maximum(sf[i], 1e-9)
+            )
+            if required > (matrix[i] * cap).max() + 1e-12:
+                continue
             row = np.zeros(m * n)
             row[i * n : (i + 1) * n] = -matrix[i]
             rows.append(row)
-            rhs.append(-num_steps_remaining[job_id] / SLOs[job_id])
+            rhs.append(-required)
         if rows:
             A = np.vstack([A_base, np.array(rows)])
             b = np.concatenate([b_base, np.array(rhs)])
             x = max_sum_lp_general(objective, A, b)
             if x is None:
-                # SLOs unsatisfiable: drop them (reference: :91-96).
+                # Aggregate contention still unsatisfiable: drop SLOs
+                # (reference: :91-96).
                 x = max_sum_lp_general(objective, A_base, b_base)
         else:
             x = max_sum_lp_general(objective, A_base, b_base)
@@ -125,10 +140,17 @@ class ThroughputNormalizedByCostSumWithPackingSLOs(PolicyWithPacking):
         zero_mask = (sf.reshape(-1) == 0).astype(bool)
         rows, rhs = [], []
         coeff = all_m.reshape(S, C * W)
+        cap = np.minimum(
+            1.0, self._num_workers[None, :] / np.maximum(sf, 1e-9)
+        ).reshape(-1)
         for job_id in SLOs:
             i = single_job_ids.index(job_id)
+            required = num_steps_remaining[job_id] / SLOs[job_id]
+            # Same doomed-deadline pruning as the unpacked variant.
+            if required > (coeff[i] * cap).max() + 1e-12:
+                continue
             rows.append(-coeff[i])
-            rhs.append(-num_steps_remaining[job_id] / SLOs[job_id])
+            rhs.append(-required)
         if rows:
             A = np.vstack([A_base, np.array(rows)])
             b = np.concatenate([b_base, np.array(rhs)])

@@ -325,3 +325,61 @@ class TestMaxMinFairnessPacked:
             assert sum(alloc_packed[JobId(i)].values()) == pytest.approx(
                 sum(alloc_plain[JobId(i)].values()), abs=0.05
             )
+
+
+def test_slo_pruning_keeps_meetable_deadlines_enforceable():
+    """A doomed job (deadline unreachable even at full share) must not
+    disable SLO steering for jobs whose deadlines are still meetable —
+    the reference re-solves with ALL SLOs dropped on any infeasibility
+    (reference: policies/max_sum_throughput.py:91-96), so one doomed
+    job starves every other deadline there."""
+    from shockwave_tpu.policies import get_policy
+
+    pol = get_policy("max_sum_throughput_normalized_by_cost_perf_SLOs")
+    throughputs = {0: {"v100": 10.0}, 1: {"v100": 1.0}}
+    scale_factors = {0: 1, 1: 1}
+    cluster = {"v100": 1}
+
+    # Unconstrained max-throughput starves the slow job entirely.
+    a = pol.get_allocation(throughputs, scale_factors, cluster)
+    assert a[1]["v100"] < 1e-6
+
+    # A feasible deadline (needs an 0.8 time share) must be honored.
+    a = pol.get_allocation(
+        throughputs, scale_factors, cluster,
+        SLOs={1: 100.0}, num_steps_remaining={1: 80.0},
+    )
+    assert a[1]["v100"] >= 0.8 - 1e-6
+
+    # Adding a doomed job must not drop job 1's constraint.
+    throughputs[2] = {"v100": 1.0}
+    scale_factors[2] = 1
+    a = pol.get_allocation(
+        throughputs, scale_factors, cluster,
+        SLOs={1: 100.0, 2: 1.0}, num_steps_remaining={1: 80.0, 2: 1e9},
+    )
+    assert a[1]["v100"] >= 0.8 - 1e-6
+    assert a[2]["v100"] < 1e-6
+
+
+def test_slo_pruning_accounts_for_scale_factor_capacity():
+    """The reachability bound must include the capacity cap: a gang job
+    whose scale factor exceeds the cluster can only get
+    num_workers/scale_factor of a time share, so a deadline feasible at
+    x=1 but not at that cap is doomed and must be pruned (not left in
+    to make the LP infeasible and drop everyone's SLOs)."""
+    from shockwave_tpu.policies import get_policy
+
+    pol = get_policy("max_sum_throughput_normalized_by_cost_perf_SLOs")
+    throughputs = {0: {"v100": 10.0}, 1: {"v100": 1.0}, 2: {"v100": 10.0}}
+    scale_factors = {0: 1, 1: 1, 2: 4}  # job 2 wants 4 of the 2 GPUs
+    cluster = {"v100": 2}
+    a = pol.get_allocation(
+        throughputs, scale_factors, cluster,
+        # job 2's required rate 8 < its raw max 10, but its capacity-
+        # capped max is 10 * (2/4) = 5 -> doomed, must be pruned so
+        # job 1's meetable deadline stays enforced.
+        SLOs={1: 100.0, 2: 1.0},
+        num_steps_remaining={1: 80.0, 2: 8.0},
+    )
+    assert a[1]["v100"] >= 0.8 - 1e-6
