@@ -20,6 +20,7 @@ loops break before assigning the last epoch, utils.py:743-747 vs 749-752).
 
 from __future__ import annotations
 
+import functools
 from typing import List, Sequence, Tuple
 
 from shockwave_tpu.data.workload_info import MAX_BATCH_SIZES, parse_job_type
@@ -79,10 +80,17 @@ def accordion_pattern(
 ) -> List[int]:
     """Per-epoch batch sizes under Accordion
     (reference: scheduler/utils.py:635-688)."""
+    return list(_accordion_pattern(job_type, initial_batch_size, num_epochs))
+
+
+@functools.lru_cache(maxsize=4096)
+def _accordion_pattern(
+    job_type: str, initial_batch_size: int, num_epochs: int
+) -> Tuple[int, ...]:
     model, _ = parse_job_type(job_type)
     schedule = [initial_batch_size] * num_epochs
     if model in _ACCORDION_EXEMPT:
-        return schedule
+        return tuple(schedule)
     max_bs = MAX_BATCH_SIZES.get(model, initial_batch_size)
     for epoch in range(num_epochs):
         in_critical = _generator_in_critical_regime(model, initial_batch_size, epoch)
@@ -90,7 +98,7 @@ def accordion_pattern(
         # final accuracy (reference: utils.py:683-686).
         if not in_critical and epoch > num_epochs * 0.3:
             schedule[epoch] = max_bs
-    return schedule
+    return tuple(schedule)
 
 
 # -- GNS ---------------------------------------------------------------------
@@ -142,10 +150,21 @@ def gns_pattern(
 ) -> List[int]:
     """Per-epoch batch sizes under GNS doubling
     (reference: scheduler/utils.py:714-1180)."""
+    return list(_gns_pattern(job_type, batch_size, num_epochs, scale_factor))
+
+
+# The simulator re-derives the schedule for every adaptive job every
+# round (scheduler._simulate_gns); the patterns are pure functions of
+# their arguments, so memoize (15k+ recomputes per 900-job trace
+# otherwise dominate the sim profile).
+@functools.lru_cache(maxsize=4096)
+def _gns_pattern(
+    job_type: str, batch_size: int, num_epochs: int, scale_factor: int
+) -> Tuple[int, ...]:
     model, _ = parse_job_type(job_type)
     schedule = [batch_size] * num_epochs
     if model in _GNS_EXEMPT:
-        return schedule
+        return tuple(schedule)
     breakpoints = _GNS_BREAKPOINTS.get((model, batch_size, scale_factor))
     if breakpoints is not None and num_epochs > breakpoints[0][0]:
         starts = [bp for bp, _ in breakpoints] + [num_epochs]
@@ -158,7 +177,7 @@ def gns_pattern(
                     break
                 schedule[epoch] = batch_size * mult
     limit = MAX_BATCH_SIZES[model]
-    return [min(bs, limit) for bs in schedule]
+    return tuple(min(bs, limit) for bs in schedule)
 
 
 def pattern_for_mode(

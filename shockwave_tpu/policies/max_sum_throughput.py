@@ -16,6 +16,25 @@ from shockwave_tpu.policies.base import (
 from shockwave_tpu.policies.lp_backend import max_sum_lp_general
 
 
+def _max_reachable_rate(tputs: np.ndarray, caps: np.ndarray) -> float:
+    """A single job's best achievable effective rate when it may split
+    its one unit of time share across worker types, each capped at
+    ``caps[w]`` (= min(1, num_workers/scale_factor), or 0 for cells the
+    LP forces to zero): fill types in descending-throughput order."""
+    order = np.argsort(-tputs)
+    share_left = 1.0
+    rate = 0.0
+    for w in order:
+        take = min(caps[w], share_left)
+        if take <= 0:
+            continue
+        rate += float(tputs[w]) * take
+        share_left -= take
+        if share_left <= 0:
+            break
+    return rate
+
+
 class ThroughputNormalizedByCostSumWithPerfSLOs(Policy):
     name = "ThroughputNormalizedByCostSum_PerfSLOs"
 
@@ -49,16 +68,18 @@ class ThroughputNormalizedByCostSumWithPerfSLOs(Policy):
             required = num_steps_remaining[job_id] / SLOs[job_id]
             # A job whose deadline is already unreachable even with the
             # largest share the capacity constraints allow it alone
-            # (x <= num_workers / scale_factor, and <= 1) would make
-            # the whole LP infeasible; pruning it keeps the
-            # still-meetable deadlines enforceable. (The reference
-            # instead re-solves with ALL SLOs dropped on any
-            # infeasibility, reference: :91-96 — one doomed job
-            # disables SLO steering for everyone.)
+            # (time split across types, each x <= num_workers /
+            # scale_factor and <= 1) would make the whole LP
+            # infeasible; pruning it keeps the still-meetable deadlines
+            # enforceable. (The reference instead re-solves with ALL
+            # SLOs dropped on any infeasibility, reference: :91-96 —
+            # one doomed job disables SLO steering for everyone.)
             cap = np.minimum(
-                1.0, self._num_workers / np.maximum(sf[i], 1e-9)
+                1.0,
+                np.asarray(self._num_workers, dtype=float)
+                / np.maximum(sf[i], 1e-9),
             )
-            if required > (matrix[i] * cap).max() + 1e-12:
+            if required > _max_reachable_rate(matrix[i], cap) + 1e-12:
                 continue
             row = np.zeros(m * n)
             row[i * n : (i + 1) * n] = -matrix[i]
@@ -141,13 +162,17 @@ class ThroughputNormalizedByCostSumWithPackingSLOs(PolicyWithPacking):
         rows, rhs = [], []
         coeff = all_m.reshape(S, C * W)
         cap = np.minimum(
-            1.0, self._num_workers[None, :] / np.maximum(sf, 1e-9)
+            1.0,
+            np.asarray(self._num_workers, dtype=float)[None, :]
+            / np.maximum(sf, 1e-9),
         ).reshape(-1)
+        # Cells the LP pins to zero (mixed-scale pairs) can't contribute.
+        cap[zero_mask] = 0.0
         for job_id in SLOs:
             i = single_job_ids.index(job_id)
             required = num_steps_remaining[job_id] / SLOs[job_id]
             # Same doomed-deadline pruning as the unpacked variant.
-            if required > (coeff[i] * cap).max() + 1e-12:
+            if required > _max_reachable_rate(coeff[i], cap) + 1e-12:
                 continue
             rows.append(-coeff[i])
             rhs.append(-required)

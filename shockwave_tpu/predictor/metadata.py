@@ -67,6 +67,12 @@ class JobMetadata:
         # round_id -> (throughput, batch size), insertion-ordered.
         self.throughput_schedule: Dict[int, tuple] = {}
         self.round_duration = round_duration
+        # The duration rescale is a pure (and idempotent) function of the
+        # throughput schedule; the planner calls it for every job on
+        # every replan, so memoize on the schedule's version.
+        self._schedule_version = 0
+        self._rescale_key: Optional[int] = None
+        self._bs_durations_cache: Optional[Dict[int, float]] = None
 
     # -- lifecycle ------------------------------------------------------
     def submit(self, time: float) -> None:
@@ -86,6 +92,7 @@ class JobMetadata:
     def record_round_throughput(self, round_id: int, throughput: float, bs: int) -> None:
         """(reference: job_metadata.py:80-92)"""
         self.throughput_schedule[int(round_id)] = (float(throughput), int(bs))
+        self._schedule_version += 1
 
     # -- duration model -------------------------------------------------
     def recompute_epoch_durations(self) -> None:
@@ -100,6 +107,10 @@ class JobMetadata:
         """
         if not self.throughput_schedule:
             return
+        if self._schedule_version == self._rescale_key:
+            return
+        self._rescale_key = self._schedule_version
+        self._bs_durations_cache = None
         rounds = np.array(sorted(self.throughput_schedule), dtype=np.int64)
         tputs = np.array(
             [self.throughput_schedule[r][0] for r in rounds], dtype=np.float64
@@ -137,10 +148,13 @@ class JobMetadata:
         """Mean epoch duration per batch-size regime, after rescaling
         (reference: job_metadata.py:150-165)."""
         self.recompute_epoch_durations()
+        if self._bs_durations_cache is not None:
+            return self._bs_durations_cache
         out: Dict[int, float] = {}
         for bs in self.regimes:
             mask = self.epoch_batch_sizes == bs
             out[int(bs)] = float(np.mean(self.epoch_durations[mask]))
+        self._bs_durations_cache = out
         return out
 
     def mean_epoch_duration(self) -> float:

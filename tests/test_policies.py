@@ -383,3 +383,52 @@ def test_slo_pruning_accounts_for_scale_factor_capacity():
         num_steps_remaining={1: 80.0, 2: 8.0},
     )
     assert a[1]["v100"] >= 0.8 - 1e-6
+
+
+def test_slo_bound_allows_multi_type_splitting():
+    """The reachability bound must price a time share split across
+    worker types: required rate 9.3 is unreachable on either type alone
+    under the caps (v100 capped at 0.5 by the gang size) but reachable
+    with x=(0.5, 0.5) -> 10*0.5 + 9*0.5 = 9.5, so the constraint must
+    be kept and enforced."""
+    from shockwave_tpu.policies import get_policy
+
+    pol = get_policy("max_sum_throughput_normalized_by_cost_perf_SLOs")
+    throughputs = {
+        0: {"v100": 10.0, "p100": 9.0},
+        1: {"v100": 100.0, "p100": 1.0},
+    }
+    scale_factors = {0: 2, 1: 1}
+    cluster = {"v100": 1, "p100": 2}
+    a = pol.get_allocation(
+        throughputs, scale_factors, cluster,
+        SLOs={0: 10.0}, num_steps_remaining={0: 93.0},
+    )
+    rate = 10.0 * a[0]["v100"] + 9.0 * a[0]["p100"]
+    assert rate >= 9.3 - 1e-6, a
+
+
+def test_packed_slo_policy_runs_and_prunes():
+    """The packed SLO variant must run (regression: its capacity-cap
+    expression once indexed a plain list with [None, :]) and apply the
+    same doomed-deadline pruning as the unpacked one."""
+    from shockwave_tpu.core.ids import JobId
+    from shockwave_tpu.policies import get_policy
+
+    pol = get_policy("max_sum_throughput_normalized_by_cost_packed_SLOs")
+    throughputs = {
+        JobId(0): {"v100": 10.0},
+        JobId(1): {"v100": 1.0},
+    }
+    scale_factors = {JobId(0): 1, JobId(1): 1}
+    cluster = {"v100": 1}
+    # No SLOs: must simply run.
+    a = pol.get_allocation(throughputs, scale_factors, cluster)
+    assert a is not None
+    # A doomed deadline must not disable job 1's meetable one.
+    a = pol.get_allocation(
+        throughputs, scale_factors, cluster,
+        SLOs={JobId(1): 100.0, JobId(0): 1.0},
+        num_steps_remaining={JobId(1): 80.0, JobId(0): 1e9},
+    )
+    assert a[JobId(1)]["v100"] >= 0.8 - 1e-6, a
