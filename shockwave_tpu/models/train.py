@@ -207,6 +207,10 @@ def main(argv=None):
     parser.add_argument("--batch_size", type=int, default=32)
     parser.add_argument("-n", "--num_steps", type=int, required=True)
     parser.add_argument("--checkpoint_dir", type=str, default=None)
+    parser.add_argument("--ckpt_backend", type=str, default="msgpack",
+                        choices=["msgpack", "orbax"],
+                        help="checkpoint format: one msgpack file, or an "
+                        "orbax directory (sharded/async-capable)")
     parser.add_argument("--enable_shockwave_iterator", action="store_true")
     parser.add_argument("--learning_rate", type=float, default=1e-3)
     parser.add_argument("--seed", type=int, default=0)
@@ -260,25 +264,54 @@ def main(argv=None):
         args.model, args, mesh
     )
 
-    # Restore from a previous round's checkpoint.
-    from flax import serialization
+    # Restore from a previous round's checkpoint. Two backends:
+    # msgpack (flax.serialization, one file, host-memory bound) and
+    # orbax (directory tree, sharded/async-capable — the idiomatic TPU
+    # checkpointer once states outgrow one host buffer).
+    if getattr(args, "ckpt_backend", "msgpack") == "orbax":
+        import orbax.checkpoint as ocp
 
-    ckpt_path = (
-        os.path.join(args.checkpoint_dir, "train_state.msgpack")
-        if args.checkpoint_dir
-        else None
-    )
-    if ckpt_path and os.path.exists(ckpt_path):
-        with open(ckpt_path, "rb") as f:
-            variables, opt_state = serialization.from_bytes(
-                (variables, opt_state), f.read()
+        orbax_dir = (
+            os.path.join(os.path.abspath(args.checkpoint_dir), "orbax_state")
+            if args.checkpoint_dir
+            else None
+        )
+        checkpointer = ocp.StandardCheckpointer()
+        if orbax_dir and os.path.exists(orbax_dir):
+            restored = checkpointer.restore(
+                orbax_dir, {"variables": variables, "opt": opt_state}
             )
+            variables, opt_state = restored["variables"], restored["opt"]
 
-    def save_checkpoint():
-        if not ckpt_path:
-            return
-        with open(ckpt_path, "wb") as f:
-            f.write(serialization.to_bytes((variables, opt_state)))
+        def save_checkpoint():
+            if not orbax_dir:
+                return
+            checkpointer.save(
+                orbax_dir,
+                {"variables": variables, "opt": opt_state},
+                force=True,
+            )
+            checkpointer.wait_until_finished()
+
+    else:
+        from flax import serialization
+
+        ckpt_path = (
+            os.path.join(args.checkpoint_dir, "train_state.msgpack")
+            if args.checkpoint_dir
+            else None
+        )
+        if ckpt_path and os.path.exists(ckpt_path):
+            with open(ckpt_path, "rb") as f:
+                variables, opt_state = serialization.from_bytes(
+                    (variables, opt_state), f.read()
+                )
+
+        def save_checkpoint():
+            if not ckpt_path:
+                return
+            with open(ckpt_path, "wb") as f:
+                f.write(serialization.to_bytes((variables, opt_state)))
 
     jit_step = jax.jit(step_fn, donate_argnums=(0, 1))
     # Each gang member generates ITS OWN data shard (distinct rng per

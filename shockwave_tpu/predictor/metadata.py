@@ -148,14 +148,15 @@ class JobMetadata:
         """Mean epoch duration per batch-size regime, after rescaling
         (reference: job_metadata.py:150-165)."""
         self.recompute_epoch_durations()
-        if self._bs_durations_cache is not None:
-            return self._bs_durations_cache
-        out: Dict[int, float] = {}
-        for bs in self.regimes:
-            mask = self.epoch_batch_sizes == bs
-            out[int(bs)] = float(np.mean(self.epoch_durations[mask]))
-        self._bs_durations_cache = out
-        return out
+        if self._bs_durations_cache is None:
+            out: Dict[int, float] = {}
+            for bs in self.regimes:
+                mask = self.epoch_batch_sizes == bs
+                out[int(bs)] = float(np.mean(self.epoch_durations[mask]))
+            self._bs_durations_cache = out
+        # Copy: callers may adjust the mapping for what-if math without
+        # corrupting the cache.
+        return dict(self._bs_durations_cache)
 
     def mean_epoch_duration(self) -> float:
         """Interpolated epoch duration: mean over the completed epochs plus

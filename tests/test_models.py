@@ -226,3 +226,30 @@ def test_remat_matches_baseline_loss_and_grads():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
         )
+
+
+def test_orbax_checkpoint_roundtrip(tmp_path):
+    """--ckpt_backend orbax must save on exit and restore on relaunch,
+    continuing the loss trajectory like the msgpack backend."""
+    import os
+    import subprocess
+
+    cmd = [
+        sys.executable, "-m", "shockwave_tpu.models.train",
+        "--model", "Recommendation", "--batch_size", "64", "-n", "3",
+        "--checkpoint_dir", str(tmp_path), "--ckpt_backend", "orbax",
+    ]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    out1 = subprocess.run(cmd, capture_output=True, text=True, env=env)
+    assert out1.returncode == 0, out1.stderr[-2000:]
+    assert (tmp_path / "orbax_state").exists()
+    out2 = subprocess.run(cmd, capture_output=True, text=True, env=env)
+    assert out2.returncode == 0, out2.stderr[-2000:]
+
+    def loss_of(out):
+        import re
+
+        return float(re.search(r"loss=([\d.]+)", out.stdout).group(1))
+
+    # Training continued from the restored state: loss kept dropping.
+    assert loss_of(out2) < loss_of(out1)
