@@ -22,16 +22,20 @@ class JobId:
     packed together (the pair is stored in canonical sorted order).
     """
 
-    __slots__ = ("_ids",)
+    __slots__ = ("_ids", "_hash")
 
     def __init__(self, first: int, second: Optional[int] = None):
         if first is None:
             raise ValueError("JobId requires at least one integer id")
         if second is None:
             self._ids: Tuple[int, ...] = (int(first),)
+            # A single JobId hashes like its integer so {JobId(3), 3}
+            # collide, mirroring the reference's int-compatible equality.
+            self._hash = hash(self._ids[0])
         else:
             a, b = int(first), int(second)
             self._ids = (a, b) if a <= b else (b, a)
+            self._hash = hash(self._ids)
 
     # -- identity ----------------------------------------------------------
     @property
@@ -88,11 +92,7 @@ class JobId:
         return NotImplemented
 
     def __hash__(self) -> int:
-        if self.is_pair:
-            return hash(self._ids)
-        # A single JobId hashes like its integer so {JobId(3), 3} collide,
-        # mirroring the reference's int-compatible equality.
-        return hash(self._ids[0])
+        return self._hash
 
     def __repr__(self) -> str:
         if self.is_pair:
