@@ -55,8 +55,25 @@ def main():
     problem = make_problem(num_jobs=1000, future_rounds=50, num_gpus=256)
 
     # Ours: warm-cache solve (the simulator reuses the compiled plan step
-    # every window; first-compile cost is paid once per trace).
-    solve_eg_level(problem)
+    # every window; first-compile cost is paid once per trace). The
+    # tunneled remote-compile endpoint on single-chip bench hosts fails
+    # transiently (~HTTP 500) under load; retry the warmup rather than
+    # lose the round's benchmark artifact to one hiccup.
+    import sys
+
+    for attempt in range(3):
+        try:
+            solve_eg_level(problem)
+            break
+        except Exception as e:
+            if attempt == 2:
+                raise
+            print(
+                f"warmup attempt {attempt} failed "
+                f"({type(e).__name__}: {str(e)[:200]}); retrying",
+                file=sys.stderr,
+            )
+            time.sleep(10)
     runs = 3
     t0 = time.time()
     for _ in range(runs):
