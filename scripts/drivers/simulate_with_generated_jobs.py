@@ -30,11 +30,8 @@ from shockwave_tpu.core.scheduler import Scheduler
 from shockwave_tpu.data import write_trace
 from shockwave_tpu.data.default_oracle import generate_oracle
 from shockwave_tpu.data.generate import (
-    DYNAMIC_MODE_DIST,
-    GAVEL_SCALE_FACTOR_DIST,
-    SHOCKWAVE_SCALE_FACTOR_DIST,
-    STATIC_MODE_DIST,
     generate_trace_jobs,
+    style_job_kwargs,
 )
 from shockwave_tpu.data.profiles import synthesize_profiles
 from shockwave_tpu.data.throughputs import read_throughputs
@@ -48,21 +45,8 @@ def main(args):
     else:
         throughputs = generate_oracle()
 
-    style_kwargs = (
-        dict(
-            scale_factor_dist=SHOCKWAVE_SCALE_FACTOR_DIST,
-            mode_dist=DYNAMIC_MODE_DIST,
-        )
-        if args.style == "shockwave"
-        else dict(
-            scale_factor_dist=(
-                GAVEL_SCALE_FACTOR_DIST
-                if args.generate_multi_gpu_jobs
-                else {1: 1.0}
-            ),
-            mode_dist=STATIC_MODE_DIST,
-            duration_hours=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10],
-        )
+    style_kwargs = style_job_kwargs(
+        args.style, multi_gpu=args.generate_multi_gpu_jobs
     )
     jobs, arrivals = generate_trace_jobs(
         args.num_jobs,

@@ -39,9 +39,8 @@ def run_cell(cell):
     from shockwave_tpu.core.scheduler import Scheduler
     from shockwave_tpu.data.default_oracle import generate_oracle
     from shockwave_tpu.data.generate import (
-        GAVEL_SCALE_FACTOR_DIST,
-        STATIC_MODE_DIST,
         generate_trace_jobs,
+        style_job_kwargs,
     )
     from shockwave_tpu.data.profiles import synthesize_profiles
     from shockwave_tpu.policies import get_policy
@@ -52,11 +51,7 @@ def run_cell(cell):
         throughputs,
         seed=cell["seed"],
         lam=cell["lam"],
-        scale_factor_dist=(
-            GAVEL_SCALE_FACTOR_DIST if cell["multi_gpu"] else {1: 1.0}
-        ),
-        mode_dist=STATIC_MODE_DIST,
-        duration_hours=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10],
+        **style_job_kwargs(cell["style"], multi_gpu=cell["multi_gpu"]),
     )
     profiles = synthesize_profiles(jobs, throughputs)
     for i, job in enumerate(jobs):
@@ -85,9 +80,15 @@ def run_cell(cell):
     makespan = sched.simulate(
         cell["cluster_spec"], arrivals, jobs, jobs_to_complete=jobs_to_complete
     )
-    ftf_list, unfair_fraction = sched.get_finish_time_fairness()
+    # Every metric restricted to the measurement window, not just JCT.
+    ftf_list, unfair_fraction = sched.get_finish_time_fairness(
+        jobs_to_complete
+    )
     return {
-        **{k: cell[k] for k in ("policy", "lam", "seed", "num_jobs", "mode")},
+        **{
+            k: cell[k]
+            for k in ("policy", "lam", "seed", "num_jobs", "mode", "style")
+        },
         "makespan": makespan,
         "avg_jct": sched.get_average_jct(jobs_to_complete),
         "utilization": sched.get_cluster_utilization(),
@@ -108,7 +109,11 @@ def main(args):
         with open(results_path) as f:
             for line in f:
                 r = json.loads(line)
-                done.add((r["policy"], r["lam"], r["seed"]))
+                # Older result files carry no style field; key them under
+                # the default so they aren't silently re-attributed.
+                done.add(
+                    (r["policy"], r["lam"], r["seed"], r.get("style", "gavel"))
+                )
 
     window = None
     if args.window_start is not None and args.window_end is not None:
@@ -119,7 +124,7 @@ def main(args):
     for policy in args.policies:
         for lam in lams:
             for seed in args.seeds:
-                if (policy, lam, seed) in done:
+                if (policy, lam, seed, args.style) in done:
                     print(f"[skip] {policy} lam={lam} seed={seed}")
                     continue
                 cells.append(
@@ -133,6 +138,7 @@ def main(args):
                         multi_gpu=args.generate_multi_gpu_jobs,
                         window=window,
                         mode=args.mode,
+                        style=args.style,
                     )
                 )
 
@@ -168,6 +174,10 @@ if __name__ == "__main__":
     parser.add_argument("-c", "--cluster_spec", type=str, default="36:0:0")
     parser.add_argument("--time_per_iteration", type=int, default=360)
     parser.add_argument("--generate_multi_gpu_jobs", action="store_true")
+    parser.add_argument("--style", choices=["gavel", "shockwave"],
+                        default="gavel",
+                        help="gavel: static jobs, whole-hour durations; "
+                        "shockwave: dynamic-adaptation jobs")
     parser.add_argument("--window_start", type=int, default=None)
     parser.add_argument("--window_end", type=int, default=None)
     parser.add_argument("--processes", type=int, default=4)

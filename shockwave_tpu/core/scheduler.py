@@ -1525,15 +1525,19 @@ class Scheduler:
             return None
         return float(np.mean(utilizations))
 
-    def get_finish_time_fairness(self):
+    def get_finish_time_fairness(self, job_ids=None):
         """rho = JCT / (isolated duration x contention factor); also the
-        fraction of jobs with rho > 1.1 (reference: scheduler.py:3627-3655)."""
+        fraction of jobs with rho > 1.1 (reference: scheduler.py:3627-3655).
+        ``job_ids`` restricts to a measurement window (continuous sweeps
+        exclude the warmup/tail jobs from every metric, not just JCT)."""
         num_gpus = len(self._worker_ids)
         if len(self._job_completion_times) == 0:
             return [], 0.0
         ftf_list = []
         contention = max(1.0, self._num_jobs_in_trace / max(1, num_gpus))
         for job_id in sorted(self._job_completion_times.keys()):
+            if job_ids is not None and job_id not in job_ids:
+                continue
             jct = self._job_completion_times[job_id]
             if jct is None:
                 continue

@@ -193,3 +193,24 @@ def generate_trace_file(
     )
     write_trace(path, jobs, arrivals)
     return jobs, arrivals
+
+
+def style_job_kwargs(style: str, multi_gpu: bool = True) -> dict:
+    """Generation kwargs for the two canonical workload styles, shared
+    by every driver/sweep CLI: "shockwave" = dynamic-adaptation jobs
+    (accordion/gns, 60/30/9/1 scale factors, log-uniform durations);
+    "gavel" = static jobs with whole-hour durations."""
+    if style == "shockwave":
+        return dict(
+            scale_factor_dist=SHOCKWAVE_SCALE_FACTOR_DIST,
+            mode_dist=DYNAMIC_MODE_DIST,
+        )
+    if style == "gavel":
+        return dict(
+            scale_factor_dist=(
+                GAVEL_SCALE_FACTOR_DIST if multi_gpu else {1: 1.0}
+            ),
+            mode_dist=STATIC_MODE_DIST,
+            duration_hours=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10],
+        )
+    raise ValueError(f"unknown workload style {style!r}")

@@ -239,3 +239,33 @@ def test_cost_accounting_constant_and_spot_schedule():
     spot.simulate({"v100": 2}, arrivals2, jobs2)
     # The second breakpoint never activates: same cost as the constant.
     assert spot.get_total_cost() == pytest.approx(flat.get_total_cost())
+
+
+def test_jobs_to_complete_window_ends_simulation_early():
+    """The continuous-sweep measurement window (reference:
+    simulate with jobs_to_complete, scheduler.py:1365's window
+    machinery): the sim ends once the window's jobs finish, and the
+    metrics getters restrict to the window."""
+    jobs, arrivals = tiny_trace(num_jobs=8, epochs=2, arrival_gap=600.0)
+    window = {JobId(i) for i in range(3)}
+    sched, makespan = run_sim(
+        "fifo", jobs, arrivals, cluster={"v100": 2},
+        jobs_to_complete=window,
+    )
+    # Window jobs all completed...
+    for job_id in window:
+        assert sched._job_completion_times.get(job_id) is not None
+    # ...and the run stopped before draining the late arrivals.
+    assert len(sched._job_completion_times) < 8
+    # Windowed metrics cover exactly the window jobs (the stored
+    # completion values are JCT durations), not every completed job.
+    expected = sum(sched._job_completion_times[j] for j in window) / len(
+        window
+    )
+    assert sched.get_average_jct(window) == pytest.approx(expected)
+    assert sched.get_average_jct(window) != pytest.approx(
+        sched.get_average_jct()
+    )
+    ftf_window, _ = sched.get_finish_time_fairness(window)
+    assert len(ftf_window) == len(window)
+    assert len(sched.get_finish_time_fairness()[0]) > len(window)
