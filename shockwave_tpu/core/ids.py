@@ -94,6 +94,15 @@ class JobId:
     def __hash__(self) -> int:
         return self._hash
 
+    def __setstate__(self, state):
+        # Pickles written before the cached-hash slot existed carry only
+        # _ids; rebuild the hash on load so old checkpoints still work.
+        slots = state[1] if isinstance(state, tuple) else state
+        self._ids = tuple(slots["_ids"])
+        self._hash = (
+            hash(self._ids[0]) if len(self._ids) == 1 else hash(self._ids)
+        )
+
     def __repr__(self) -> str:
         if self.is_pair:
             return "(%d, %d)" % self._ids

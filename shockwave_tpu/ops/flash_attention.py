@@ -216,7 +216,7 @@ def _resolve_block(requested: int, seq_len: int) -> int:
     default instead of falling back to dense)."""
     b = min(requested, seq_len)
     if seq_len % b and seq_len % _LANES == 0:
-        b = (b // _LANES) * _LANES
+        b = max(_LANES, (b // _LANES) * _LANES)
         while seq_len % b:
             b -= _LANES
     if seq_len % b or b % 8:

@@ -122,3 +122,21 @@ def test_block_steps_down_for_odd_lane_multiples():
     q2, k2, v2 = _qkv(rng, 1, 132, 1, 16)
     with pytest.raises(ValueError):
         flash_attention(q2, k2, v2)
+
+
+def test_small_requested_block_steps_up_not_div0():
+    # An explicitly requested block below the 128-lane width used to hit
+    # a ZeroDivisionError in _resolve_block; it must resolve to a valid
+    # lane-multiple block instead (ADVICE r2).
+    from shockwave_tpu.ops.flash_attention import _resolve_block
+
+    assert _resolve_block(100, 384) == 128
+    # A small block that divides evenly is left alone (sublane-aligned).
+    assert _resolve_block(8, 256) == 8
+    rng = np.random.default_rng(7)
+    q, k, v = _qkv(rng, 1, 384, 2, 16)
+    out = flash_attention(q, k, v, block_q=100, block_k=100)
+    ref = dense_causal_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
+    )

@@ -231,6 +231,7 @@ def test_remat_matches_baseline_loss_and_grads():
 def test_orbax_checkpoint_roundtrip(tmp_path):
     """--ckpt_backend orbax must save on exit and restore on relaunch,
     continuing the loss trajectory like the msgpack backend."""
+    pytest.importorskip("orbax.checkpoint")
     import os
     import subprocess
 

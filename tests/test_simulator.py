@@ -269,3 +269,18 @@ def test_jobs_to_complete_window_ends_simulation_early():
     ftf_window, _ = sched.get_finish_time_fairness(window)
     assert len(ftf_window) == len(window)
     assert len(sched.get_finish_time_fairness()[0]) > len(window)
+
+
+def test_jobid_unpickles_from_pre_hash_slot_state():
+    # Checkpoints written before JobId cached its hash carry only _ids in
+    # the slot state; __setstate__ must rebuild _hash (ADVICE r2).
+    j_pair = JobId.__new__(JobId)
+    j_pair.__setstate__((None, {"_ids": (3, 7)}))
+    assert hash(j_pair) == hash(JobId(3, 7))
+    j_single = JobId.__new__(JobId)
+    j_single.__setstate__({"_ids": (5,)})
+    assert hash(j_single) == hash(5) and j_single == 5
+    import pickle
+
+    rt = pickle.loads(pickle.dumps(JobId(9, 2)))
+    assert rt == JobId(2, 9) and hash(rt) == hash(JobId(2, 9))
