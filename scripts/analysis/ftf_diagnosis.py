@@ -1,0 +1,164 @@
+#!/usr/bin/env python3
+"""Per-job FTF diagnosis for the TPU-oracle scale experiment.
+
+Explains the max_min_fairness worst-FTF collapse on the measured TPU
+oracle (results/scale_tpu/summary.json: 37.0 at 64 chips vs 4.9 on the
+v100 oracle) by dumping per-job (isolated runtime, JCT, rho, absolute
+delay) for the same trace under both oracles and both policies.
+
+rho = JCT / (isolated * contention) (reference:
+scheduler/scheduler.py:3627-3655). On the v5e oracle the profile
+durations shrink ~10x while the 120 s round length and the arrival
+pattern stay fixed, so the shortest jobs become sub-round (min 10 s
+isolated) and any queueing wait divides by a tiny denominator. LAS
+(max_min_fairness) is length-blind — short jobs wait through the same
+fair-share rotation as long ones — so its rho blows up exactly on the
+short jobs; Shockwave's FTF priorities finish them promptly.
+
+Writes results/scale_tpu/ftf_diagnosis.json.
+
+Usage: python scripts/analysis/ftf_diagnosis.py [--num_gpus 64]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+REFERENCE_TRACE = (
+    "/root/reference/scheduler/traces/shockwave/"
+    "220_0.2_5_100_25_4_0,0.5,0.5_0.6,0.3,0.09,0.01_multigpu_dynamic.trace"
+)
+FALLBACK_TRACE = os.path.join("traces", "generated_220_dynamic.trace")
+
+
+def run(trace, worker_type, throughputs, num_gpus, policy_name):
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data import load_or_synthesize_profiles, parse_trace
+    from shockwave_tpu.policies import get_policy
+
+    jobs, arrivals = parse_trace(trace)
+    profiles = load_or_synthesize_profiles(
+        trace, jobs, throughputs, worker_type=worker_type, cache=False
+    )
+    for i, job in enumerate(jobs):
+        job.duration = sum(profiles[i]["duration_every_epoch"])
+    shockwave_config = None
+    if policy_name.startswith("shockwave"):
+        shockwave_config = {
+            "future_rounds": 20,
+            "lambda": 5.0,
+            "k": 10.0,
+            "log_approximation_bases": [0.0, 0.2, 0.4, 0.6, 0.8, 1.0],
+            "solver_rel_gap": 1e-3,
+            "solver_num_threads": 24,
+            "solver_timeout": 15,
+            "time_per_iteration": 120,
+            "num_gpus": num_gpus,
+        }
+    sched = Scheduler(
+        get_policy(policy_name, seed=0),
+        simulate=True,
+        throughputs=throughputs,
+        seed=0,
+        time_per_iteration=120,
+        profiles=profiles,
+        shockwave_config=shockwave_config,
+    )
+    sched.simulate(
+        {worker_type: num_gpus},
+        arrivals,
+        jobs,
+        num_gpus_per_server={worker_type: 4},
+    )
+    contention = max(1.0, len(jobs) / num_gpus)
+    rows = []
+    for jid, jct in sched._job_completion_times.items():
+        if jct is None:
+            continue
+        prof = sched._profiles.get(jid.integer)
+        if prof is None:
+            continue
+        iso = float(sum(prof["duration_every_epoch"]))
+        rows.append(
+            {
+                "job": jid.integer,
+                "jct": round(float(jct), 1),
+                "isolated": round(iso, 1),
+                "rho": round(float(jct) / (iso * contention), 3),
+                "abs_delay": round(float(jct) - iso * contention, 1),
+            }
+        )
+    return sorted(rows, key=lambda r: -r["rho"])
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_gpus", type=int, default=64)
+    parser.add_argument(
+        "-o", "--output", default="results/scale_tpu/ftf_diagnosis.json"
+    )
+    args = parser.parse_args(argv)
+
+    from shockwave_tpu.data import read_throughputs
+    from shockwave_tpu.data.default_oracle import generate_oracle
+
+    trace = (
+        REFERENCE_TRACE if os.path.exists(REFERENCE_TRACE) else FALLBACK_TRACE
+    )
+    tpu_oracle = read_throughputs("results/measured_oracle_tpu.json")
+    cells = {
+        "max_min_fairness/tpu_v5e": run(
+            trace, "tpu_v5e", tpu_oracle, args.num_gpus, "max_min_fairness"
+        ),
+        "max_min_fairness/v100": run(
+            trace, "v100", generate_oracle(), args.num_gpus,
+            "max_min_fairness",
+        ),
+        "shockwave_tpu/tpu_v5e": run(
+            trace, "tpu_v5e", tpu_oracle, args.num_gpus, "shockwave_tpu"
+        ),
+    }
+    out = {"trace": os.path.basename(trace), "num_gpus": args.num_gpus}
+    for name, rows in cells.items():
+        rho = np.array([r["rho"] for r in rows])
+        iso = np.array([r["isolated"] for r in rows])
+        out[name] = {
+            "worst_rho": float(rho.max()),
+            "median_rho": float(np.median(rho)),
+            "median_isolated_s": float(np.median(iso)),
+            "min_isolated_s": float(iso.min()),
+            "corr_log_rho_log_isolated": float(
+                np.corrcoef(np.log(rho), np.log(iso))[0, 1]
+            ),
+            "worst_10": rows[:10],
+        }
+        print(
+            f"{name}: worst rho {rho.max():.1f}, median iso "
+            f"{np.median(iso):.0f}s, corr(log rho, log iso) "
+            f"{out[name]['corr_log_rho_log_isolated']:.2f}"
+        )
+    # The same worst jobs under every cell, to show the numerator
+    # (absolute delay) barely moves while the denominator collapses.
+    worst = [r["job"] for r in cells["max_min_fairness/tpu_v5e"][:10]]
+    join = {}
+    for name, rows in cells.items():
+        byjob = {r["job"]: r for r in rows}
+        join[name] = {j: byjob.get(j) for j in worst}
+    out["worst_tpu_jobs_across_cells"] = join
+    with open(args.output, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {args.output}")
+
+
+if __name__ == "__main__":
+    main()

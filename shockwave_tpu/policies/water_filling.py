@@ -112,26 +112,34 @@ class WaterFillingAlgorithm:
         bounds.append((None, None))
         res = linprog(c, A_ub=A, b_ub=b, bounds=bounds, method="highs")
         if not res.success:
-            return None, None
-        return res.x[:n_var], res.x[-1]
+            return None, None, None
+        # Duals of the per-job level rows: only jobs whose row binds with
+        # a nonzero multiplier can be bottlenecks this round — the rest
+        # provably have headroom, so the saturation probe can skip them.
+        level_duals = np.zeros(len(lower_bounds))
+        level_duals[np.where(unsaturated)[0]] = res.ineqlin.marginals[
+            A_base.shape[0] + len(lower_bounds):
+        ]
+        return res.x[:n_var], res.x[-1], level_duals
 
     def _is_saturated(
-        self, i, coeff_rows, lower_bounds, A_base, b_base, zero_mask=None
+        self, i, A_sat, b_base, coeff_rows, lower_bounds, zero_mask=None
     ):
         """Feasibility LP: can job i exceed its level by SLACK while every
         job keeps its lower bound? (counterpart of the reference's MILP,
-        water_filling.py:191-302)."""
+        water_filling.py:191-302). ``A_sat`` is the prebuilt
+        [A_base; -coeff_rows] matrix — only the rhs changes per probe."""
         n_var = coeff_rows.shape[1]
         target = lower_bounds.copy()
         target[i] = lower_bounds[i] * SLACK + EPSILON
-        A = np.vstack([A_base, -coeff_rows])
         b = np.concatenate([b_base, -target])
         bounds = [
             (0, 0) if zero_mask is not None and zero_mask[j] else (0, None)
             for j in range(n_var)
         ]
         res = linprog(
-            np.zeros(n_var), A_ub=A, b_ub=b, bounds=bounds, method="highs"
+            np.zeros(n_var), A_ub=A_sat, b_ub=b, bounds=bounds,
+            method="highs",
         )
         return not res.success
 
@@ -151,6 +159,7 @@ class WaterFillingAlgorithm:
         lower_bounds = np.zeros(m)
         finalized: Dict = {}
         x = None
+        A_sat = np.vstack([A_base, -coeff_rows])
         for _ in range(m + 1):
             weights_dict = self._compute_priority_weights(
                 entity_weights, priority_weights, entity_to_job_mapping,
@@ -170,7 +179,7 @@ class WaterFillingAlgorithm:
             )
             if not unsaturated.any():
                 break
-            x_new, level = self._raise_level(
+            x_new, level, level_duals = self._raise_level(
                 coeff_rows, weights, lower_bounds, unsaturated, A_base, b_base,
                 zero_mask,
             )
@@ -180,12 +189,28 @@ class WaterFillingAlgorithm:
             nets = coeff_rows @ x
             for i in np.where(unsaturated)[0]:
                 lower_bounds[i] = nets[i]
+            candidates = [
+                i for i in np.where(unsaturated)[0]
+                if abs(level_duals[i]) > 1e-9
+            ]
+            skipped = [
+                i for i in np.where(unsaturated)[0] if i not in candidates
+            ]
             newly_saturated = []
-            for i in np.where(unsaturated)[0]:
+            for i in candidates:
                 if self._is_saturated(
-                    i, coeff_rows, lower_bounds, A_base, b_base, zero_mask
+                    i, A_sat, b_base, coeff_rows, lower_bounds, zero_mask
                 ):
                     newly_saturated.append(i)
+            if not newly_saturated:
+                # A degenerate optimum can leave a genuinely stuck job
+                # with a zero dual on its binding row; before concluding
+                # nothing is stuck, probe the jobs the filter skipped.
+                for i in skipped:
+                    if self._is_saturated(
+                        i, A_sat, b_base, coeff_rows, lower_bounds, zero_mask
+                    ):
+                        newly_saturated.append(i)
             if not newly_saturated:
                 # Nothing is provably stuck: the remaining jobs rose
                 # together and will again; finalize them all at this level.

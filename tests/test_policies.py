@@ -263,6 +263,70 @@ class TestWaterFilling:
             >= sum(alloc[JobId(1)].values()) - 0.05
         )
 
+    def test_hierarchical_mixed_policy_stress(self):
+        """10 entities with randomly mixed fifo/fairness reweighting over
+        300 jobs on a 3x64 heterogeneous cluster — the reference's
+        hierarchical stress (reference:
+        scheduler/tests/water_filling_tests_hierarchical.py:14-89) with
+        level/saturation invariants and a runtime bound added."""
+        import random
+        import time
+
+        random.seed(0)
+        num_entities, num_jobs = 10, 300
+        worker_types = ["k80", "p100", "v100"]
+        cluster = {wt: 64 for wt in worker_types}
+        prp, e2j, ew, pw, tputs, sf = {}, {}, {}, {}, {}, {}
+        for i in range(num_entities):
+            ent = f"entity{i}"
+            prp[ent] = ["fifo", "fairness"][random.randint(0, 1)]
+            e2j[ent] = []
+            ew[ent] = random.randint(1, 5)
+        for i in range(num_jobs):
+            ths = sorted(random.random() for _ in worker_types)
+            tputs[JobId(i)] = dict(zip(worker_types, ths))
+            sf[JobId(i)] = 2 ** random.randint(0, 2)
+            ent = f"entity{random.randint(0, num_entities - 1)}"
+            w = random.randint(1, 5)
+            if prp[ent] == "fifo":
+                w = 1.0
+            pw[JobId(i)] = w
+            e2j[ent].append(JobId(i))
+
+        pol = get_policy("max_min_fairness_water_filling_perf")
+        pol._priority_reweighting_policies = prp
+        t0 = time.time()
+        alloc = pol.get_allocation(
+            tputs, sf, pw, cluster,
+            entity_weights=ew, entity_to_job_mapping=e2j,
+        )
+        wall = time.time() - t0
+        # Generous bound (24x the ~5 s local runtime): catches a return
+        # to the pre-dual-filter O(jobs) probes per round (~80 s) without
+        # flaking on a loaded host.
+        assert wall < 120.0, f"water filling took {wall:.1f}s"
+        assert set(alloc) == set(tputs)
+        validity(alloc, tputs, sf, cluster)
+        # Saturation invariant: with 561 workers requested and only 192
+        # available, every worker must be in use (no idle capacity left
+        # behind by the level raises).
+        for wt in worker_types:
+            used = sum(alloc[j][wt] * sf[j] for j in alloc)
+            assert used > 64 * 0.98, (wt, used)
+        # Entity-policy invariant: within each fifo entity the earliest
+        # job is the active one, so it receives at least as much total
+        # time share as any later job in the same entity.
+        for ent, jobs in e2j.items():
+            if prp[ent] != "fifo" or len(jobs) < 2:
+                continue
+            first = min(jobs)
+            first_share = sum(alloc[first].values())
+            for j in jobs:
+                if j != first:
+                    assert (
+                        first_share >= sum(alloc[j].values()) - 0.05
+                    ), (ent, first, j)
+
     def test_packed_variant_valid(self):
         pol = get_policy("max_min_fairness_water_filling_packed")
         tputs = simple_throughputs(2)
