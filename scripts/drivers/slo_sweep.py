@@ -1,0 +1,164 @@
+#!/usr/bin/env python3
+"""SLO steering sweep: load (Poisson lambda) x deadline tightness.
+
+Runs max_sum_throughput_normalized_by_cost_perf with and without SLO
+constraints on identical generated workloads (same jobs, arrivals, and
+deadlines — only the solver's visibility of the deadlines differs),
+across a grid of arrival rates and SLO-factor mixes, and reports
+violations / avg JCT / makespan per cell.
+
+The round-2 artifact sat in a single overloaded cell (lam=900 s on 8
+GPUs) where violations are queueing-dominated: a job that waits out its
+1.2x slack in the queue is doomed before any allocation decision, so
+steering cannot help (29 vs 28 violations). This sweep maps where
+steering *can* pay: moderate load where deadlines are individually
+reachable but the blind throughput/cost objective starves
+poor-throughput jobs past their deadlines.
+
+Deadline semantics: deadline = SLO * isolated duration from submission
+(core/scheduler.py:273-276; reference policy:
+scheduler/policies/max_sum_throughput.py:44-97).
+
+Usage:
+  python scripts/drivers/slo_sweep.py -o results/slo/sweep.json
+"""
+
+import argparse
+import copy
+import json
+import os
+import random
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+from shockwave_tpu.core.scheduler import Scheduler  # noqa: E402
+from shockwave_tpu.data.default_oracle import generate_oracle  # noqa: E402
+from shockwave_tpu.data.generate import generate_trace_jobs  # noqa: E402
+from shockwave_tpu.data.profiles import synthesize_profiles  # noqa: E402
+from shockwave_tpu.policies import get_policy  # noqa: E402
+
+BLIND = "max_sum_throughput_normalized_by_cost_perf"
+AWARE = "max_sum_throughput_normalized_by_cost_perf_SLOs"
+
+MIXES = {
+    # (factors, weights): tightness distributions over SLO factors.
+    "tight": ([1.2, 2.0], [0.5, 0.5]),
+    "mixed": ([1.2, 2.0, 10.0], [1 / 3, 1 / 3, 1 / 3]),
+    "loose": ([2.0, 10.0], [0.5, 0.5]),
+}
+
+
+def build_workload(num_jobs, lam, mix, seed, throughputs):
+    jobs, arrivals = generate_trace_jobs(
+        num_jobs, throughputs, seed=seed, lam=lam
+    )
+    factors, weights = MIXES[mix]
+    slo_rng = random.Random(seed + 17)
+    for job in jobs:
+        job.SLO = slo_rng.choices(factors, weights=weights)[0]
+    profiles = synthesize_profiles(jobs, throughputs)
+    for i, job in enumerate(jobs):
+        job.duration = sum(profiles[i]["duration_every_epoch"])
+    return jobs, arrivals, profiles
+
+
+def run_cell(policy_name, jobs, arrivals, profiles, throughputs,
+             cluster, seed, round_s):
+    jobs = copy.deepcopy(jobs)
+    sched = Scheduler(
+        get_policy(policy_name, seed=seed),
+        simulate=True,
+        throughputs=throughputs,
+        seed=seed,
+        time_per_iteration=round_s,
+        profiles=profiles,
+    )
+    makespan = sched.simulate(dict(cluster), arrivals, jobs)
+    # Violations counted post-hoc against the SAME deadlines for both
+    # policies (the scheduler's own get_num_SLO_violations only tracks
+    # deadlines when the policy is SLO-aware): deadline = arrival +
+    # SLO * isolated duration, matching core/scheduler.py:273-276.
+    from shockwave_tpu.core.ids import JobId
+
+    violations = 0
+    for i, (job, arrival) in enumerate(zip(jobs, arrivals)):
+        jid = JobId(i)
+        deadline = arrival + job.SLO * job.duration
+        finished_at = sched._per_job_latest_timestamps.get(jid)
+        completed = sched._job_completion_times.get(jid) is not None
+        if not completed or finished_at > deadline:
+            violations += 1
+    return {
+        "makespan": round(makespan, 1),
+        "avg_jct": round(sched.get_average_jct() or 0.0, 1),
+        "slo_violations": violations,
+        "jobs": len(jobs),
+    }
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--num_jobs", type=int, default=60)
+    parser.add_argument("--gpus", type=int, default=8)
+    parser.add_argument("--lams", type=float, nargs="+",
+                        default=[900, 1800, 3600])
+    parser.add_argument("--mixes", type=str, nargs="+",
+                        default=["tight", "mixed", "loose"])
+    parser.add_argument("--seeds", type=int, nargs="+", default=[0, 1])
+    parser.add_argument("--round_s", type=float, default=360.0)
+    parser.add_argument("-o", "--output",
+                        default="results/slo/sweep.json")
+    args = parser.parse_args(argv)
+
+    throughputs = generate_oracle()
+    cluster = {"v100": args.gpus}
+    cells = []
+    for lam in args.lams:
+        for mix in args.mixes:
+            for seed in args.seeds:
+                jobs, arrivals, profiles = build_workload(
+                    args.num_jobs, lam, mix, seed, throughputs
+                )
+                row = {"lam": lam, "mix": mix, "seed": seed}
+                for tag, policy in (("blind", BLIND), ("aware", AWARE)):
+                    row[tag] = run_cell(
+                        policy, jobs, arrivals, profiles, throughputs,
+                        cluster, seed, args.round_s,
+                    )
+                row["violations_delta"] = (
+                    row["aware"]["slo_violations"]
+                    - row["blind"]["slo_violations"]
+                )
+                cells.append(row)
+                print(
+                    f"lam={lam} mix={mix} seed={seed}: "
+                    f"blind {row['blind']['slo_violations']} vs aware "
+                    f"{row['aware']['slo_violations']} violations "
+                    f"(jct {row['blind']['avg_jct']:.0f} vs "
+                    f"{row['aware']['avg_jct']:.0f})",
+                    flush=True,
+                )
+    wins = [c for c in cells if c["violations_delta"] < 0]
+    out = {
+        "cluster": f"v100:{args.gpus}",
+        "num_jobs": args.num_jobs,
+        "round_s": args.round_s,
+        "policies": {"blind": BLIND, "aware": AWARE},
+        "cells": cells,
+        "winning_cells": len(wins),
+    }
+    os.makedirs(os.path.dirname(args.output), exist_ok=True)
+    with open(args.output, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {args.output}; {len(wins)}/{len(cells)} cells with "
+          "strictly fewer violations under steering")
+
+
+if __name__ == "__main__":
+    main()

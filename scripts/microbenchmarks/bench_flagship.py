@@ -40,6 +40,14 @@ _PEAK_FLOPS = {
 }
 
 
+def _enable_compile_cache():
+    """Persistent compilation cache: on the tunneled bench host repeat
+    compiles drop from ~40 s to ~2 s."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+
+
 def build_step(seq_len, batch, dtype, attention, d_model, num_heads,
                num_layers, vocab_size, remat=False):
     import jax
@@ -142,6 +150,7 @@ def main(argv=None):
 
     import jax
 
+    _enable_compile_cache()
     dev = jax.devices()[0]
     peak = next(
         (v for k, v in _PEAK_FLOPS.items()
@@ -165,24 +174,50 @@ def main(argv=None):
         run = None
         for dtype in args.dtypes:
             for attention in args.attentions:
-                try:
-                    # Drop the previous config's closure first: it pins
-                    # that model's params/opt state in HBM, which would
-                    # OOM near-limit shapes that fit on their own.
-                    run = None
-                    run, params = build_step(
-                        seq_len, batch, dtype, attention, args.d_model,
-                        args.num_heads, args.num_layers, args.vocab_size,
-                        remat=args.remat,
-                    )
-                    rate = measure(run)
-                except Exception as e:  # e.g. HBM OOM at this shape
+                # The tunneled compile endpoint fails transiently (HTTP
+                # 500 / closed body); retry so a committed error row
+                # means the shape genuinely cannot run, not that the
+                # tunnel hiccuped (the round-2 large-model artifact was
+                # ambiguous for exactly this reason).
+                last_err = None
+                rate = None
+                for attempt in range(3):
+                    try:
+                        # Drop the previous config's closure first: it
+                        # pins that model's params/opt state in HBM,
+                        # which would OOM near-limit shapes that fit on
+                        # their own.
+                        run = None
+                        run, params = build_step(
+                            seq_len, batch, dtype, attention, args.d_model,
+                            args.num_heads, args.num_layers,
+                            args.vocab_size, remat=args.remat,
+                        )
+                        rate = measure(run)
+                        last_err = None
+                        break
+                    except Exception as e:  # e.g. HBM OOM at this shape
+                        last_err = e
+                        transient = any(
+                            pat in str(e)
+                            for pat in ("HTTP", "read body", "UNAVAILABLE")
+                        )
+                        print(
+                            f"attempt {attempt + 1} failed "
+                            f"({type(e).__name__}"
+                            f"{', transient' if transient else ''}); "
+                            f"{'retrying' if attempt < 2 else 'giving up'}"
+                        )
+                if last_err is not None:
                     row = {
                         "seq_len": seq_len,
                         "batch": batch,
                         "dtype": dtype,
                         "attention": attention,
-                        "error": f"{type(e).__name__}: {str(e)[:200]}",
+                        "error": (
+                            f"{type(last_err).__name__} (persisted across "
+                            f"3 attempts): {str(last_err)[:300]}"
+                        ),
                     }
                     results["runs"].append(row)
                     print(json.dumps(row))

@@ -1,0 +1,212 @@
+#!/usr/bin/env python3
+"""Component-level profile of the flagship train step on the real chip.
+
+Breaks the 110M-parameter TransformerLM bf16 train step into its big
+pieces — a matmul calibration (what the chip actually delivers), the
+full step, forward-only, fwd+bwd without the optimizer, one block,
+the tied head + cross entropy, and the flash attention kernels — each
+measured with the bench-host recipe that actually works through the
+axon tunnel (see results/flagship_profile_breakdown.md): arrays passed
+as jit arguments (never closed over: closures become HLO constants,
+inflating compiles and corrupting runtime numbers), chained inputs so
+repeated dispatches cannot be collapsed, a real fetch to synchronize,
+and n-vs-2n slope timing to cancel fixed dispatch costs.
+
+Usage:
+  python scripts/profiling/profile_flagship.py -o results/profile.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def fetch(tree):
+    leaf = jax.tree_util.tree_leaves(tree)[0]
+    return float(jnp.sum(leaf.astype(jnp.float32)))
+
+
+def slope(step, x0, max_n=128):
+    """Per-iteration seconds via n-vs-2n chained runs."""
+    fetch(step(x0))  # compile + warm
+    n = 8
+    while True:
+        t0 = time.time()
+        x = x0
+        for _ in range(n):
+            x = step(x)
+        fetch(x)
+        t1 = time.time()
+        x = x0
+        for _ in range(2 * n):
+            x = step(x)
+        fetch(x)
+        t2 = time.time()
+        d = (t2 - t1) - (t1 - t0)
+        if d > 0.4 or n >= max_n:
+            return d / n
+        n *= 4
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq_len", type=int, default=2048)
+    parser.add_argument("--d_model", type=int, default=1024)
+    parser.add_argument("--num_heads", type=int, default=16)
+    parser.add_argument("--num_layers", type=int, default=8)
+    parser.add_argument("--vocab_size", type=int, default=8192)
+    parser.add_argument("-o", "--output", default=None)
+    args = parser.parse_args(argv)
+
+    import optax
+
+    from shockwave_tpu.models.small_models import token_xent
+    from shockwave_tpu.models.transformer import (
+        Block,
+        TransformerConfig,
+        TransformerLM,
+        lm_loss,
+    )
+    from shockwave_tpu.ops.flash_attention import flash_attention
+    from shockwave_tpu.parallel.mesh import make_mesh
+
+    B, S, DM, V = args.batch, args.seq_len, args.d_model, args.vocab_size
+    H = args.num_heads
+    D = DM // H
+    rng = np.random.default_rng(0)
+    rows = {}
+
+    # Matmul calibration.
+    M, K, N = B * S, DM, 4 * DM
+    a0 = jnp.asarray(rng.standard_normal((M, K)), jnp.bfloat16)
+    w1 = jnp.asarray(rng.standard_normal((K, N)), jnp.bfloat16)
+    w2 = jnp.asarray(rng.standard_normal((N, K)), jnp.bfloat16)
+    mm = jax.jit(lambda a, w1, w2: (a @ w1) @ w2)
+    t = slope(lambda a: mm(a, w1, w2), a0)
+    rows["matmul_calibration"] = {
+        "shape": f"[{M}x{K}x{N}] x2 bf16",
+        "ms": round(t * 1e3, 3),
+        "tflops_per_s": round(2 * M * K * N * 2 / t / 1e12, 1),
+    }
+    print(rows["matmul_calibration"], flush=True)
+
+    # Flash attention kernels at model shapes.
+    q0 = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.bfloat16)
+    k0 = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.bfloat16)
+    v0 = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.bfloat16)
+    fa = jax.jit(lambda q, k, v: flash_attention(q, k, v))
+    t = slope(lambda q: fa(q, k0, v0), q0, 64)
+    rows["flash_fwd"] = {"ms": round(t * 1e3, 2)}
+    ga = jax.jit(
+        jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v).astype(jnp.float32) ** 2
+            )
+        )
+    )
+    t = slope(lambda q: ga(q, k0, v0).astype(jnp.bfloat16), q0, 64)
+    rows["flash_fwd_bwd"] = {"ms": round(t * 1e3, 2)}
+    print({k: rows[k] for k in ("flash_fwd", "flash_fwd_bwd")}, flush=True)
+
+    # Head + cross entropy.
+    x0 = jnp.asarray(rng.standard_normal((B, S, DM)), jnp.bfloat16)
+    emb0 = jnp.asarray(rng.standard_normal((V, DM)), jnp.float32)
+    tg = jnp.asarray(rng.integers(0, V, (B, S)), jnp.int32)
+
+    def head(x, emb):
+        logits = jnp.einsum(
+            "bsd,vd->bsv",
+            x.astype(jnp.bfloat16),
+            emb.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        return token_xent(logits, tg)
+
+    hg = jax.jit(jax.grad(head))
+    t = slope(lambda x: hg(x, emb0).astype(jnp.bfloat16), x0, 64)
+    rows["head_xent_fwd_bwd"] = {"ms": round(t * 1e3, 2)}
+    print(rows["head_xent_fwd_bwd"], flush=True)
+
+    # One transformer block.
+    cfg = TransformerConfig(
+        vocab_size=V, d_model=DM, num_heads=H, num_layers=args.num_layers,
+        d_ff=4 * DM, max_len=S, dtype="bfloat16", attention="flash",
+    )
+    mesh = make_mesh((1, 1, 1), devices=jax.devices()[:1])
+    blk = Block(cfg, mesh)
+    bp = blk.init(jax.random.PRNGKey(0), x0)
+    bg = jax.jit(
+        jax.grad(
+            lambda p, x: jnp.sum(blk.apply(p, x).astype(jnp.float32) ** 2),
+            argnums=1,
+        )
+    )
+    t = slope(lambda x: bg(bp, x).astype(jnp.bfloat16), x0, 64)
+    rows["block_fwd_bwd"] = {
+        "ms": round(t * 1e3, 2),
+        "x_layers_ms": round(args.num_layers * t * 1e3, 1),
+    }
+    print(rows["block_fwd_bwd"], flush=True)
+
+    # Full train step.
+    model = TransformerLM(cfg, mesh=mesh)
+    tokens = jnp.asarray(rng.integers(0, V, (B, S + 1)), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens[:, :-1])
+    tx = optax.adamw(1e-4)
+    opt_state = tx.init(variables)
+    nparams = sum(
+        int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(variables)
+    )
+
+    @jax.jit
+    def train_step(state):
+        variables, opt_state = state
+        loss, grads = jax.value_and_grad(
+            lambda v: lm_loss(model, v, tokens)
+        )(variables)
+        upd, opt2 = tx.update(grads, opt_state, variables)
+        return (optax.apply_updates(variables, upd), opt2)
+
+    t = slope(train_step, (variables, opt_state), 64)
+    flops = 6 * nparams * B * S + 12 * args.num_layers * S * DM * B * S
+    rows["full_step"] = {
+        "ms": round(t * 1e3, 1),
+        "steps_per_s": round(1 / t, 2),
+        "params": nparams,
+        "mfu_at_197tf": round(flops / t / 197e12, 4),
+    }
+    print(rows["full_step"], flush=True)
+
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(
+                {
+                    "device": jax.devices()[0].device_kind,
+                    "config": vars(args),
+                    "rows": rows,
+                },
+                f,
+                indent=1,
+            )
+        print(f"wrote {args.output}")
+
+
+if __name__ == "__main__":
+    main()

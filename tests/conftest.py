@@ -16,3 +16,11 @@ jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) == 8, (
     f"expected 8 virtual CPU devices, got {jax.devices()}"
 )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running integration tier (subprocess / distributed / "
+        "multi-round physical tests); deselect with -m 'not slow'",
+    )

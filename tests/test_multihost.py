@@ -14,6 +14,9 @@ import sys
 
 import pytest
 
+# Whole module spawns real multi-process jax.distributed training.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -258,6 +258,7 @@ def test_shockwave_tpu_policy_drives_physical_cluster(tmp_path):
         sched.shutdown()
 
 
+@pytest.mark.slow
 def test_distributed_gang_trains_under_scheduler(tmp_path):
     """Full stack, gang edition: a scale_factor=2 job whose payload is
     the REAL training program — the scheduler appends the jax.distributed
