@@ -206,8 +206,13 @@ def main(argv=None):
                             f"attempt {attempt + 1} failed "
                             f"({type(e).__name__}"
                             f"{', transient' if transient else ''}); "
-                            f"{'retrying' if attempt < 2 else 'giving up'}"
+                            f"{'retrying' if transient and attempt < 2 else 'giving up'}"
                         )
+                        if not transient:
+                            # Deterministic failure (e.g. OOM): don't pay
+                            # two more model builds + compiles for the
+                            # same error.
+                            break
                 if last_err is not None:
                     row = {
                         "seq_len": seq_len,
@@ -215,8 +220,9 @@ def main(argv=None):
                         "dtype": dtype,
                         "attention": attention,
                         "error": (
-                            f"{type(last_err).__name__} (persisted across "
-                            f"3 attempts): {str(last_err)[:300]}"
+                            f"{type(last_err).__name__} (attempt "
+                            f"{attempt + 1}, retries only on transient "
+                            f"tunnel errors): {str(last_err)[:300]}"
                         ),
                     }
                     results["runs"].append(row)

@@ -46,6 +46,7 @@ def slope(step, x0, max_n=128):
     """Per-iteration seconds via n-vs-2n chained runs."""
     fetch(step(x0))  # compile + warm
     n = 8
+    noise_retries = 2
     while True:
         t0 = time.time()
         x = x0
@@ -59,6 +60,17 @@ def slope(step, x0, max_n=128):
         fetch(x)
         t2 = time.time()
         d = (t2 - t1) - (t1 - t0)
+        if d <= 0:
+            # A latency spike during the n-run on this tunneled host can
+            # make the difference non-positive; retry rather than commit
+            # a negative time to the artifact.
+            if noise_retries > 0:
+                noise_retries -= 1
+                continue
+            raise RuntimeError(
+                f"slope timing non-positive at n={n} ({d:.4f}s); host too "
+                "noisy for a trustworthy measurement"
+            )
         if d > 0.4 or n >= max_n:
             return d / n
         n *= 4

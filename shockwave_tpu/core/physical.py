@@ -207,7 +207,14 @@ class PhysicalScheduler(Scheduler):
     def _dispatch(self, key: JobId, worker_ids) -> None:
         """Send RunJob for every worker of a (possibly packed) assignment."""
         lead_ip, lead_port = self._worker_addrs[worker_ids[0]]
-        lead_addr = f"{lead_ip}:{10000 + (key.as_tuple()[0] % 40000)}"
+        # The gang coordinator port must differ across a job's attempts:
+        # a relaunch that reuses the previous attempt's port can meet
+        # the stale coordination service ("connected with a different
+        # incarnation") and fail rendezvous forever after one bad round.
+        lead_addr = (
+            f"{lead_ip}:"
+            f"{10000 + ((key.as_tuple()[0] * 131 + self._round_id) % 40000)}"
+        )
         scale_factor = len(worker_ids)
         self._dispatch_times[key] = self.get_current_timestamp()
         self._dispatched_worker_ids[key] = tuple(worker_ids)

@@ -103,7 +103,21 @@ class Dispatcher:
             results = [None] * len(job_descriptions)
 
             def launch(i, job):
-                results[i] = self._launch_job(job, accel_id, worker_id, round_id)
+                try:
+                    results[i] = self._launch_job(
+                        job, accel_id, worker_id, round_id
+                    )
+                except Exception:
+                    # A spawn that fails outright (bad working directory,
+                    # missing interpreter) must still produce a Done
+                    # report: a silently dead launcher leaves the
+                    # assignment outstanding forever and wedges the
+                    # scheduler's round loop.
+                    LOG.error(
+                        "launch of job %s failed", job.get("job_id"),
+                        exc_info=True,
+                    )
+                    results[i] = (0, 0.0, "")
 
             launchers = [
                 threading.Thread(target=launch, args=(i, job), daemon=True)

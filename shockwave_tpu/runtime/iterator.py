@@ -185,6 +185,14 @@ class ShockwaveIterator:
         if not self._complete_called:
             self._complete_called = True
             self._done = True
+            # Duration accumulates between __next__ calls, so the final
+            # step's time is still unaccounted here — and for a 1-step
+            # micro-task that is ALL of it: reporting duration 0 makes
+            # the scheduler's merge judge the attempt failed
+            # (core/scheduler.py physical-mode no-progress check).
+            if self._prev_time is not None:
+                self._duration += time.time() - self._prev_time
+                self._prev_time = None
             self._write_log("JOB", "INFO", "complete")
             self._write_progress()
 

@@ -68,6 +68,7 @@ def test_indivisible_seq_raises():
         flash_attention(q, k, v, block_q=32, block_k=32)
 
 
+@pytest.mark.slow
 def test_transformer_flash_attention_path():
     from shockwave_tpu.models.transformer import (
         TransformerConfig,

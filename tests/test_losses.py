@@ -1,0 +1,32 @@
+"""Loss-function unit tests (fast tier: no subprocesses, no models)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def test_token_xent_matches_naive_log_softmax():
+    """The fused logsumexp/select-reduce cross entropy (rewritten for
+    TPU: the take_along_axis gather's scatter backward cost 58 ms
+    fwd+bwd at [16384, 8192] on a v5e) must match the naive
+    log-softmax formulation exactly, values and gradients."""
+    from shockwave_tpu.models.small_models import token_xent
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.standard_normal((4, 16, 32)), jnp.float32)
+    targets = jnp.asarray(rng.integers(0, 32, (4, 16)), jnp.int32)
+
+    def naive(lg):
+        logp = jax.nn.log_softmax(lg, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+    v_new, g_new = jax.value_and_grad(lambda lg: token_xent(lg, targets))(
+        logits
+    )
+    v_old, g_old = jax.value_and_grad(naive)(logits)
+    assert float(v_new) == pytest.approx(float(v_old), rel=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(g_new), np.asarray(g_old), rtol=1e-5, atol=1e-7
+    )

@@ -9,6 +9,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# Whole module drives training subprocesses / full simulations.
+pytestmark = pytest.mark.slow
+
 from shockwave_tpu.models.train import build_family, main as train_main
 from shockwave_tpu.parallel.mesh import make_mesh
 

@@ -84,6 +84,7 @@ def test_gpipe_sharded_over_pipe_axis(pipe):
     )
 
 
+@pytest.mark.slow
 def test_pipelined_lm_matches_sequential_and_trains():
     from shockwave_tpu.models.transformer import TransformerConfig
 

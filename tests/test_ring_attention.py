@@ -42,6 +42,7 @@ def test_combined_data_model_seq_mesh():
     )
 
 
+@pytest.mark.slow
 def test_grad_flows_through_ring():
     mesh = make_mesh((1, 1, 2), devices=jax.devices()[:2])
     rng = np.random.default_rng(2)

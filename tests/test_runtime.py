@@ -144,6 +144,39 @@ def test_failed_attempts_drop_job_and_spare_healthy_one(cluster):
     assert sched._total_steps_run[healthy] >= 400
 
 
+def test_single_step_job_completes(cluster):
+    """A 1-step job's only step happens after the iterator's last
+    __next__ interval, so complete() must account it — reporting
+    duration 0 made the scheduler's physical-mode merge judge every
+    attempt failed and drop the job."""
+    sched, worker, tmp_path = cluster
+    job_id = sched.add_job(make_job(1))
+    runner = threading.Thread(target=sched.run, kwargs={"max_rounds": 15})
+    runner.start()
+    runner.join(timeout=90)
+    assert not runner.is_alive()
+    assert sched._job_completion_times.get(job_id) is not None
+    assert sched._total_steps_run[job_id] >= 1
+
+
+def test_unspawnable_job_is_dropped_not_wedged(cluster):
+    """A job whose process cannot even spawn (nonexistent working
+    directory) must still produce a Done report per attempt so the
+    failed-attempts logic drops it — a silently dead launcher thread
+    used to leave the assignment outstanding and wedge the round loop."""
+    sched, worker, tmp_path = cluster
+    bad = make_job(400)
+    bad.working_directory = str(tmp_path / "does-not-exist")
+    bad_id = sched.add_job(bad)
+    healthy = sched.add_job(make_job(400))
+    runner = threading.Thread(target=sched.run, kwargs={"max_rounds": 40})
+    runner.start()
+    runner.join(timeout=300)
+    assert not runner.is_alive(), "round loop wedged on the unspawnable job"
+    assert sched._job_completion_times[bad_id] is None
+    assert sched._job_completion_times[healthy] is not None
+
+
 def test_transient_failures_are_retried_to_completion(cluster):
     """Two crash-on-launch attempts, then normal training: the scheduler
     must re-dispatch after each failure and the job must still finish."""

@@ -7,6 +7,9 @@ with comparable system metrics.
 
 import pytest
 
+# Whole module drives training subprocesses / full simulations.
+pytestmark = pytest.mark.slow
+
 from shockwave_tpu.core.job import Job
 from shockwave_tpu.core.scheduler import Scheduler
 from shockwave_tpu.data.default_oracle import generate_oracle

@@ -1,6 +1,10 @@
 """Smoke tests for the microbenchmark/sweep drivers' core cells (the
 full sweeps run offline and commit artifacts under results/)."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import importlib.util
 import os
 

@@ -71,6 +71,7 @@ def test_flash_local_attention(S):
     )
 
 
+@pytest.mark.slow
 def test_grad_matches_dense():
     mesh = make_mesh((1, 1, 2), devices=jax.devices()[:2])
     q, k, v = _qkv(np.random.default_rng(3), 1, 8, 2, 4)
