@@ -40,6 +40,10 @@ from shockwave_tpu.data import parse_trace  # noqa: E402
 from shockwave_tpu.data.default_oracle import generate_oracle  # noqa: E402
 from shockwave_tpu.data.profiles import synthesize_profiles  # noqa: E402
 from shockwave_tpu.policies import get_policy  # noqa: E402
+from shockwave_tpu.utils.hostenv import (  # noqa: E402
+    cpu_compile_cache_dir,
+    free_port,
+)
 from shockwave_tpu.utils.virtual_devices import (  # noqa: E402
     force_cpu_device_env,
 )
@@ -91,16 +95,6 @@ def localize_jobs(jobs):
         job.working_directory = None
         job.needs_data_dir = False
     return jobs
-
-
-def free_port():
-    import socket
-
-    s = socket.socket()
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def main(argv=None):
@@ -157,7 +151,7 @@ def main(argv=None):
     # Without the persistent compile cache a preempted job recompiles
     # from scratch on every relaunch and can livelock against the round
     # length on slow-compiling families (ResNet-50 on CPU).
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jaxcache-cpu")
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", cpu_compile_cache_dir())
     worker_proc = subprocess.Popen(
         [
             sys.executable, "-m", "shockwave_tpu.runtime.worker",
@@ -200,6 +194,7 @@ def main(argv=None):
         completed = {
             str(j): t for j, t in sched._job_completion_times.items()
         }
+        avg_jct = sched.get_average_jct()
         summary = {
             "policy": args.policy,
             "trace": args.trace,
@@ -208,9 +203,7 @@ def main(argv=None):
             "wall_clock_s": round(time.time() - t_start, 1),
             "makespan_s": round(sched.get_current_timestamp(), 1),
             "avg_jct_s": (
-                round(sched.get_average_jct(), 1)
-                if sched.get_average_jct()
-                else None
+                round(avg_jct, 1) if avg_jct is not None else None
             ),
             "completed_jobs": sum(
                 1 for t in completed.values() if t is not None

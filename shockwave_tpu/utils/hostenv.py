@@ -1,0 +1,29 @@
+"""Small host-environment helpers shared by the runtime, drivers, and
+tests: ephemeral port allocation and the per-user persistent XLA
+compile-cache location (preempted training subprocesses relaunch every
+round; without the cache a slow-compiling payload can livelock against
+the round length)."""
+
+from __future__ import annotations
+
+import getpass
+import os
+import socket
+import tempfile
+
+
+def free_port() -> int:
+    """Ask the kernel for a free TCP port (bind to port 0, release)."""
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def cpu_compile_cache_dir() -> str:
+    """Per-user persistent JAX compilation cache path for CPU payload
+    subprocesses."""
+    try:
+        user = getpass.getuser()
+    except Exception:
+        user = str(os.getuid()) if hasattr(os, "getuid") else "shared"
+    return os.path.join(tempfile.gettempdir(), f"jaxcache-cpu-{user}")

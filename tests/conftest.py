@@ -1,5 +1,13 @@
 """Test configuration.
 
+Tiers (all green serially; wall-clock tests flake under parallel load):
+  pytest -m "not slow"                             # unit tier, ~2 min
+  pytest -m slow --ignore=tests/test_runtime.py \
+         --ignore=tests/test_multihost.py          # compile-heavy, ~4.5 min
+  pytest tests/test_runtime.py tests/test_multihost.py  # wall-clock, ~6 min
+Run the wall-clock tier on an otherwise idle machine: its tests use real
+rounds/leases and training subprocesses (see the slow marks).
+
 Tests run on CPU with 8 virtual devices so multi-chip sharding logic is
 exercised without TPU hardware. Must be set before JAX is imported; the
 shared recipe lives in shockwave_tpu.utils.virtual_devices (also used by

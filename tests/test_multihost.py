@@ -19,17 +19,14 @@ pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from shockwave_tpu.utils.hostenv import cpu_compile_cache_dir, free_port as _free_port  # noqa: E402
 
 
 def test_two_process_gang_trains_in_sync(tmp_path):
     from shockwave_tpu.utils.virtual_devices import force_cpu_device_env
 
     env = force_cpu_device_env(1, dict(os.environ))
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", cpu_compile_cache_dir())
     addr = f"127.0.0.1:{_free_port()}"
     procs = []
     try:
