@@ -291,6 +291,48 @@ class PhysicalScheduler(Scheduler):
                     for key, ids in assignments.items()
                     if all(s in self._jobs for s in key.singletons())
                 )
+                # Backfill workers the stale mid-round plan leaves idle.
+                # The reference plans each round mid-way through the
+                # previous one; with hour-long jobs a completion between
+                # planning and the boundary is rare, but on fast chips
+                # jobs are round-length and the lag strands a slot every
+                # round (observed: a 2-slot cluster running the 12-job
+                # trace one job per round). Replan and admit unassigned
+                # jobs onto workers the surviving plan doesn't occupy —
+                # never touching mid-round lease-extension promises
+                # (extended jobs survive the filter above and keep their
+                # workers via the planner's keep-previous pass).
+                assigned_singles = {
+                    s for key in assignments for s in key.singletons()
+                }
+                occupied = {
+                    wid for ids in assignments.values() for wid in ids
+                }
+                idle = len(self._worker_ids) - len(occupied)
+                # Only pay the second scheduling pass when some
+                # unassigned job can actually fit the idle workers.
+                min_unassigned_sf = min(
+                    (
+                        job.scale_factor
+                        for j, job in self._jobs.items()
+                        if j not in assigned_singles
+                    ),
+                    default=None,
+                )
+                if min_unassigned_sf is not None and min_unassigned_sf <= idle:
+                    for key, ids in self._schedule_jobs_on_workers().items():
+                        if key in assignments:
+                            continue
+                        if any(
+                            s not in self._jobs or s in assigned_singles
+                            for s in key.singletons()
+                        ):
+                            continue
+                        if occupied & set(ids):
+                            continue
+                        assignments[key] = ids
+                        assigned_singles.update(key.singletons())
+                        occupied.update(ids)
                 self._current_worker_assignments = assignments
                 self._round_log.append(
                     {

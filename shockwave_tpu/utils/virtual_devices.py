@@ -26,6 +26,11 @@ def force_cpu_device_env(n_devices: int, env=None) -> dict:
     if env is None:
         env = os.environ
     env["JAX_PLATFORMS"] = "cpu"
+    # The bench hosts' sitecustomize imports the TPU plugin (and with it
+    # jax) into EVERY python process when this var is set — ~2-5 s of
+    # startup that CPU-only subprocesses (training payloads, test
+    # re-execs) pay for a plugin they never use.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     flags = re.sub(
         r"--xla_force_host_platform_device_count=\d+",
         "",

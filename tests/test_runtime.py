@@ -103,6 +103,31 @@ def test_gang_job_merges_worker_reports(cluster):
     assert sched._total_steps_run[job_id] >= 600
 
 
+def test_short_jobs_backfill_idle_workers(cluster):
+    """Jobs that finish within a round go stale in the mid-round plan:
+    without round-start backfill a 2-slot cluster runs one short job
+    per round (each planned round contains a job that completed before
+    the boundary). Six sub-round jobs on 2 slots must finish in ~3-4
+    working rounds, not 6+."""
+    sched, worker, tmp_path = cluster
+    # ~1s of work each at 200 steps/s and 3s rounds.
+    job_ids = [sched.add_job(make_job(200)) for _ in range(6)]
+    runner = threading.Thread(target=sched.run, kwargs={"max_rounds": 8})
+    runner.start()
+    runner.join(timeout=60)
+    assert not runner.is_alive(), "round loop did not converge"
+    done = [
+        j for j in job_ids if sched._job_completion_times.get(j) is not None
+    ]
+    assert len(done) == 6, f"only {len(done)}/6 completed in 8 rounds"
+    # The discriminating assertion: 2 jobs per round needs 3 working
+    # rounds (4 with slack); the stale-plan bug's alternating
+    # 2-then-0 pattern needs at least 5.
+    assert sched._round_id <= 4, (
+        f"took {sched._round_id} rounds for 6 sub-round jobs on 2 slots"
+    )
+
+
 def test_preemption_resumes_across_rounds(cluster):
     sched, worker, tmp_path = cluster
     # 3 jobs, 2 accelerators: someone must be preempted and resumed.
