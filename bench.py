@@ -6,24 +6,36 @@ CVXPY+GUROBI Eisenberg-Gale MILP (reference: scheduler/shockwave.py:400-411,
 15 s TimeLimit / 24 threads in the replication configs) with an on-chip
 solver at >= 20x lower wall-clock.
 
-Baseline here: the SAME formulation the reference hands GUROBI (boolean
+Baseline: the SAME formulation the reference hands GUROBI (boolean
 breakpoint-boundary encoding) solved by HiGHS on the host
 (solve_eg_milp_reference_formulation). Ours: the jitted level-set solver
-(solve_eg_level — the production device path: one batched grid of
-candidate makespan levels with closed-form mandatory grants and a
-sort-once threshold welfare fill), warm-cache, on whatever accelerator
-JAX sees. Note the measured time includes the host<->device transfer of
-each solve's inputs/results; on tunneled single-chip hosts that
-round-trip is most of the number.
+(solve_eg_level — the production device path), warm-cache, on whatever
+accelerator JAX sees.
+
+Measurement discipline (round 4, after the r02->r03 2x swing went
+unexplained): the headline is the MEDIAN of ``RUNS`` warm end-to-end
+solves of ``RUNS`` DIFFERENT same-shape problems (distinct inputs defeat
+any dispatch-level caching in the tunneled single-chip path), with the
+IQR, the cold (compile-inclusive) first solve, and a device-vs-host
+split (jitted counts solve + fetch vs. host-side exchange polish +
+placement) all reported. Every timed schedule is audited for
+feasibility — boolean entries, per-round gang capacity, no grants to
+too-wide gangs — so the number is backed by a feasibility proof at
+stress scale, not only the scalar objective. Each run appends its full
+record to results/bench_history.json for round-over-round tracking.
 
 Config: the stress shape from BASELINE.json ("1000 synthetic jobs x 256
-workers x 50 rounds"), deterministic seed. Prints ONE JSON line.
+workers x 50 rounds"), deterministic seeds. Prints ONE JSON line.
 """
 
 import json
+import os
+import statistics
 import time
 
 import numpy as np
+
+RUNS = 5
 
 
 def make_problem(num_jobs, future_rounds, num_gpus, seed=0, regularizer=10.0):
@@ -49,21 +61,35 @@ def make_problem(num_jobs, future_rounds, num_gpus, seed=0, regularizer=10.0):
 
 
 def main():
-    from shockwave_tpu.solver.eg_jax import solve_eg_level
+    from shockwave_tpu.solver.eg_jax import (
+        counts_to_schedule,
+        solve_eg_level,
+        solve_level_counts,
+    )
     from shockwave_tpu.solver.eg_milp import solve_eg_milp_reference_formulation
 
-    problem = make_problem(num_jobs=1000, future_rounds=50, num_gpus=256)
+    problems = [
+        make_problem(num_jobs=1000, future_rounds=50, num_gpus=256, seed=s)
+        for s in range(RUNS)
+    ]
+    problem = problems[0]
 
-    # Ours: warm-cache solve (the simulator reuses the compiled plan step
-    # every window; first-compile cost is paid once per trace). The
-    # tunneled remote-compile endpoint on single-chip bench hosts fails
-    # transiently (~HTTP 500) under load; retry the warmup rather than
-    # lose the round's benchmark artifact to one hiccup.
+    # Cold solve (includes compile) on a seed OUTSIDE the timed set, so
+    # the first warm sample is not a dispatch-cacheable repeat of the
+    # warmup inputs. The tunneled remote-compile endpoint on single-chip
+    # bench hosts fails transiently (~HTTP 500) under load; retry rather
+    # than lose the round's benchmark artifact to one hiccup.
     import sys
 
+    warmup_problem = make_problem(
+        num_jobs=1000, future_rounds=50, num_gpus=256, seed=RUNS
+    )
+    cold_s = None
     for attempt in range(3):
         try:
-            solve_eg_level(problem)
+            t0 = time.time()
+            solve_eg_level(warmup_problem)
+            cold_s = time.time() - t0
             break
         except Exception as e:
             if attempt == 2:
@@ -74,33 +100,87 @@ def main():
                 file=sys.stderr,
             )
             time.sleep(10)
-    runs = 3
-    t0 = time.time()
-    for _ in range(runs):
-        Y_tpu = solve_eg_level(problem)
-    tpu_s = (time.time() - t0) / runs
 
-    # Baseline: reference-formulation MILP on host CPU.
+    # Warm end-to-end solves, one per distinct problem; audit every
+    # schedule (feasibility proof at stress scale) outside the timed
+    # region.
+    warm, schedules = [], []
+    for p in problems:
+        t0 = time.time()
+        Y = solve_eg_level(p)
+        warm.append(time.time() - t0)
+        schedules.append(Y)
+    for p, Y in zip(problems, schedules):
+        p.audit_schedule(Y)
+    warm_median = statistics.median(warm)
+    q1, q3 = np.percentile(warm, [25, 75])
+
+    # Device vs host attribution: the jitted level solve + counts fetch
+    # vs. the host tail (exchange polish + placement + fallback check).
+    device_t, host_t = [], []
+    for p in problems:
+        t0 = time.time()
+        counts, _ = solve_level_counts(p)
+        t1 = time.time()
+        Y = counts_to_schedule(counts, p)
+        t2 = time.time()
+        device_t.append(t1 - t0)
+        host_t.append(t2 - t1)
+        p.audit_schedule(Y)
+
+    # Baseline: reference-formulation MILP on host CPU (seed-0 problem).
     t0 = time.time()
     Y_milp = solve_eg_milp_reference_formulation(
         problem, rel_gap=1e-3, time_limit=120
     )
     milp_s = time.time() - t0
 
-    print(
-        json.dumps(
-            {
-                "metric": "shockwave_plan_solve_wall_clock",
-                "value": round(tpu_s, 4),
-                "unit": "s",
-                "vs_baseline": round(milp_s / tpu_s, 1),
-                "baseline_s": round(milp_s, 3),
-                "objective_tpu": round(problem.objective_value(Y_tpu), 4),
-                "objective_baseline": round(problem.objective_value(Y_milp), 4),
-                "config": "1000 jobs x 256 gpus x 50 rounds",
-            }
-        )
+    record = {
+        "metric": "shockwave_plan_solve_wall_clock",
+        "value": round(warm_median, 4),
+        "unit": "s",
+        "vs_baseline": round(milp_s / warm_median, 1),
+        "baseline_s": round(milp_s, 3),
+        "warm_iqr_s": [round(float(q1), 4), round(float(q3), 4)],
+        "warm_all_s": [round(t, 4) for t in warm],
+        "cold_s": round(cold_s, 2),
+        "device_median_s": round(statistics.median(device_t), 4),
+        "host_median_s": round(statistics.median(host_t), 4),
+        "runs": RUNS,
+        "schedule_audit": "ok",
+        "objective_tpu": round(problem.objective_value(schedules[0]), 4),
+        "objective_baseline": round(problem.objective_value(Y_milp), 4),
+        "config": "1000 jobs x 256 gpus x 50 rounds",
+    }
+
+    # Round-over-round history (VERDICT r03: a single-shot number with no
+    # committed variance/attribution history is not a defensible headline).
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "unknown"
+    hist_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "results", "bench_history.json",
     )
+    history = []
+    if os.path.exists(hist_path):
+        with open(hist_path) as f:
+            history = json.load(f)
+    history.append(
+        {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "platform": platform,
+            **{k: v for k, v in record.items() if k != "metric"},
+        }
+    )
+    os.makedirs(os.path.dirname(hist_path), exist_ok=True)
+    with open(hist_path, "w") as f:
+        json.dump(history, f, indent=2)
+
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -319,6 +319,19 @@ class PhysicalScheduler(Scheduler):
                     ),
                     default=None,
                 )
+                # INVARIANT (this second _schedule_jobs_on_workers call
+                # has side effects: it re-runs _update_priorities,
+                # advances _worker_type_shuffler, and on the shockwave
+                # path overwrites _current_round_scheduled_jobs /
+                # may trigger a planner replan — the replan is the
+                # point, it is what admits jobs the stale plan missed):
+                # _current_round_scheduled_jobs overwritten here is
+                # ALWAYS refreshed by the mid-round planning pass below
+                # before _shockwave_scheduler_update reads it at the
+                # next round boundary. The only gap — every job
+                # completing mid-round so the mid-round pass is skipped
+                # — leaves entries that the update routes through the
+                # benign mark_complete path.
                 if min_unassigned_sf is not None and min_unassigned_sf <= idle:
                     for key, ids in self._schedule_jobs_on_workers().items():
                         if key in assignments:

@@ -1188,8 +1188,11 @@ class Scheduler:
         admitted; a later ``simulate`` call on a fresh Scheduler with an
         existing ``checkpoint_file`` resumes from that point instead of
         replaying the prefix (used to fast-forward long continuous-trace
-        sweeps). Not supported for the Shockwave policies, whose planner
-        state lives outside the checkpointed fields.
+        sweeps). Shockwave runs checkpoint their planner state too (plan
+        cache, predictor metadata, finish-time history — see
+        ShockwavePlanner.state_dict), so fast-forward works with the
+        flagship policy; a resumed run's metrics match an unbroken one
+        (tests/test_simulator.py::test_checkpoint_resume_shockwave).
         """
         import os as _os
 
@@ -1210,9 +1213,6 @@ class Scheduler:
                 self.register_worker(worker_type, num_gpus=num_gpus)
 
         if checkpoint_file is not None and _os.path.exists(checkpoint_file):
-            assert self._shockwave is None, (
-                "simulator checkpointing does not cover Shockwave planner state"
-            )
             extra = self.load_checkpoint(checkpoint_file)
             queued_jobs = extra["queued_jobs"]
             running_jobs = extra["running_jobs"]
@@ -1227,6 +1227,33 @@ class Scheduler:
             self._current_timestamp = arrival_times[0]
 
         while True:
+            # Checkpoint at the loop TOP — the exact control point resume
+            # re-enters — so saved state and resumed state are equivalent
+            # by construction. (Saving mid-iteration, as the reference
+            # does after admissions (reference scheduler.py:1759-1775),
+            # diverges on resume: the loop-top clock advance jumps to the
+            # next arrival past the round the continuing run schedules at
+            # the saved timestamp.)
+            if (
+                checkpoint_threshold is not None
+                and checkpoint_file is not None
+                and not checkpoint_saved
+                and self._job_id_counter >= checkpoint_threshold
+            ):
+                self.save_checkpoint(
+                    checkpoint_file,
+                    extra=dict(
+                        queued_jobs=queued_jobs,
+                        running_jobs=running_jobs,
+                        remaining_jobs=remaining_jobs,
+                        consecutive_idle_rounds=consecutive_idle_rounds,
+                    ),
+                )
+                checkpoint_saved = True
+                self._logger.info(
+                    "Saved checkpoint to %s after job %d",
+                    checkpoint_file, self._job_id_counter - 1,
+                )
             if jobs_to_complete is not None and jobs_to_complete.issubset(
                 self._completed_jobs
             ):
@@ -1305,31 +1332,6 @@ class Scheduler:
             while queued_jobs and queued_jobs[0][0] <= self._current_timestamp:
                 arrival_time, job = queued_jobs.pop(0)
                 self.add_job(job, timestamp=arrival_time)
-
-            if (
-                checkpoint_threshold is not None
-                and checkpoint_file is not None
-                and not checkpoint_saved
-                and self._job_id_counter >= checkpoint_threshold
-            ):
-                assert self._shockwave is None, (
-                    "simulator checkpointing does not cover Shockwave "
-                    "planner state"
-                )
-                self.save_checkpoint(
-                    checkpoint_file,
-                    extra=dict(
-                        queued_jobs=queued_jobs,
-                        running_jobs=running_jobs,
-                        remaining_jobs=remaining_jobs,
-                        consecutive_idle_rounds=consecutive_idle_rounds,
-                    ),
-                )
-                checkpoint_saved = True
-                self._logger.info(
-                    "Saved checkpoint to %s after job %d",
-                    checkpoint_file, self._job_id_counter - 1,
-                )
 
             if len(self._jobs) == 0:
                 if not queued_jobs:
@@ -1439,26 +1441,73 @@ class Scheduler:
         "_round_log",
         "_current_worker_assignments",
         "_available_worker_ids",
+        # Loop-coupled state the checkpointed running_jobs heap depends
+        # on: _done_callback credits steps only for singles present in
+        # _running_jobs, so restored in-flight micro-tasks would complete
+        # uncredited (and re-dispatch) without it; the allocation dirty
+        # flag likewise steers the first post-resume round.
+        "_running_jobs",
+        "_need_to_update_allocation",
+        # Shockwave round bridge: _shockwave_scheduler_update reads this
+        # on the first post-resume round, so it must travel with the
+        # planner state.
+        "_current_round_scheduled_jobs",
     ]
 
     def save_checkpoint(self, path: str, extra: Optional[dict] = None) -> None:
         """Pickle scheduler state plus ``extra`` (the simulate-loop locals
         — queued/running jobs — mirroring reference scheduler.py:1214-1245
-        which checkpoints those alongside the 24 scheduler fields)."""
+        which checkpoints those alongside the 24 scheduler fields).
+
+        Unlike the reference — whose checkpoint silently OMITS its
+        Shockwave planner state (reference scheduler.py:1214-1294), so a
+        resumed Shockwave run would replan from amnesia — the planner
+        (round cursor, plan cache, predictor metadata, finish-time
+        history) is serialized alongside, as plain dicts/arrays via
+        ShockwavePlanner.state_dict()."""
         import pickle
 
         state = {f: getattr(self, f) for f in self._CHECKPOINT_FIELDS}
+        shockwave_state = (
+            self._shockwave.state_dict() if self._shockwave is not None else None
+        )
         with open(path, "wb") as f:
-            pickle.dump({"fields": state, "extra": extra or {}}, f)
+            pickle.dump(
+                {
+                    "fields": state,
+                    "extra": extra or {},
+                    "shockwave": shockwave_state,
+                },
+                f,
+            )
 
     def load_checkpoint(self, path: str) -> dict:
-        """Restore scheduler fields; returns the ``extra`` dict."""
+        """Restore scheduler fields (and planner state, if the checkpoint
+        carries any); returns the ``extra`` dict."""
         import pickle
+
+        from shockwave_tpu.policies.shockwave import ShockwavePlanner
 
         with open(path, "rb") as f:
             state = pickle.load(f)
         for field, value in state["fields"].items():
             setattr(self, field, value)
+        shockwave_state = state.get("shockwave")
+        if shockwave_state is not None:
+            assert self._shockwave is not None, (
+                "checkpoint carries Shockwave planner state but the "
+                "resuming scheduler's policy is not Shockwave"
+            )
+            self._shockwave = ShockwavePlanner.from_state(shockwave_state)
+        else:
+            # The converse must fail loudly too: resuming a Shockwave run
+            # from a planner-less checkpoint (pre-round-4 format, or one
+            # saved by a different policy) would silently drive an
+            # amnesiac planner.
+            assert self._shockwave is None, (
+                "Shockwave scheduler resuming from a checkpoint without "
+                "planner state"
+            )
         return state["extra"]
 
     def save_round_log(self, path: str) -> None:

@@ -103,7 +103,13 @@ def token_xent(logits, targets):
     gather whose backward is a scatter — measured 58 ms fwd+bwd on a
     v5e at [16384, 8192] f32 vs 4.3 ms for this formulation (iota
     compare + select + reduce fuses into the logsumexp passes; exact
-    to float tolerance)."""
+    to float tolerance).
+
+    CONTRACT: every target must lie in [0, vocab). Unlike
+    take_along_axis (which clamps), an out-of-range target here selects
+    nothing — the loss silently degrades to mean(lse) for that token.
+    There is no -100-style ignore index; mask padding tokens out of the
+    mean yourself before calling."""
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     idx = jax.lax.broadcasted_iota(
         jnp.int32, logits.shape, logits.ndim - 1

@@ -262,6 +262,10 @@ def _flash_bwd_flat(q, k, v, out, lse, g, block_q, block_k, interpret):
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )
     delta = jnp.broadcast_to(delta[..., None], (BH, S, _LANES))
+    # Cotangent in the input dtype: for bf16 models the p/ds matmul
+    # operands are bf16 with f32 accumulation — standard flash practice,
+    # a deliberate precision/bandwidth tradeoff vs keeping g in f32
+    # (guarded by test_bf16_gradients_match_dense).
     g = g.astype(q.dtype)
 
     qspec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))

@@ -102,6 +102,48 @@ class ShockwavePlanner:
     def num_jobs(self) -> int:
         return len(self.job_metadata)
 
+    # -- serialization (simulator checkpoint fast-forward) --------------
+    def state_dict(self) -> dict:
+        """Plain dicts/arrays snapshot of the full planner state: config,
+        round cursor, plan cache, per-job predictor metadata, and
+        finish-time history. Nothing jitted is captured — solver functions
+        are module-level, so a restored planner re-uses the process's
+        compiled solvers untouched."""
+        return {
+            "config": dict(self.config),
+            "backend": self.backend,
+            "round_index": self.round_index,
+            "recompute_flag": self.recompute_flag,
+            "schedules": OrderedDict(
+                (r, list(s)) for r, s in self.schedules.items()
+            ),
+            "job_metadata": OrderedDict(
+                (j, md.state_dict()) for j, md in self.job_metadata.items()
+            ),
+            "finish_time_estimates": {
+                j: list(h) for j, h in self.finish_time_estimates.items()
+            },
+            "solve_times": list(self.solve_times),
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "ShockwavePlanner":
+        planner = cls(state["config"], backend=state["backend"])
+        planner.round_index = int(state["round_index"])
+        planner.recompute_flag = bool(state["recompute_flag"])
+        planner.schedules = OrderedDict(
+            (r, list(s)) for r, s in state["schedules"].items()
+        )
+        planner.job_metadata = OrderedDict(
+            (j, JobMetadata.from_state(md))
+            for j, md in state["job_metadata"].items()
+        )
+        planner.finish_time_estimates = {
+            j: list(h) for j, h in state["finish_time_estimates"].items()
+        }
+        planner.solve_times = list(state["solve_times"])
+        return planner
+
     def current_round_schedule(self) -> list:
         """This round's job list, from the plan cache or a fresh solve
         (reference: shockwave.py:77-91).

@@ -159,6 +159,7 @@ class WaterFillingAlgorithm:
         lower_bounds = np.zeros(m)
         finalized: Dict = {}
         x = None
+        prev_level = None
         A_sat = np.vstack([A_base, -coeff_rows])
         for _ in range(m + 1):
             weights_dict = self._compute_priority_weights(
@@ -186,6 +187,13 @@ class WaterFillingAlgorithm:
             if x_new is None:
                 break
             x = x_new
+            # A stalled level (no increase over the previous iteration)
+            # means SOMETHING is stuck even if every binding row drew a
+            # zero dual at a degenerate optimum; widen the probe to the
+            # skipped set below rather than deferring detection (which
+            # the m+1 iteration cap cannot always absorb).
+            stalled = prev_level is not None and level - prev_level <= 1e-9
+            prev_level = level
             nets = coeff_rows @ x
             for i in np.where(unsaturated)[0]:
                 lower_bounds[i] = nets[i]
@@ -202,10 +210,11 @@ class WaterFillingAlgorithm:
                     i, A_sat, b_base, coeff_rows, lower_bounds, zero_mask
                 ):
                     newly_saturated.append(i)
-            if not newly_saturated:
+            if not newly_saturated or stalled:
                 # A degenerate optimum can leave a genuinely stuck job
                 # with a zero dual on its binding row; before concluding
-                # nothing is stuck, probe the jobs the filter skipped.
+                # nothing is stuck (or when the level has stopped rising),
+                # probe the jobs the filter skipped.
                 for i in skipped:
                     if self._is_saturated(
                         i, A_sat, b_base, coeff_rows, lower_bounds, zero_mask

@@ -74,6 +74,19 @@ class JobMetadata:
         self._rescale_key: Optional[int] = None
         self._bs_durations_cache: Optional[Dict[int, float]] = None
 
+    # -- serialization --------------------------------------------------
+    def state_dict(self) -> dict:
+        """Plain dicts/arrays snapshot for simulator checkpointing. Every
+        field is host-side numpy/python state (no jitted objects), so the
+        snapshot round-trips losslessly."""
+        return dict(self.__dict__)
+
+    @classmethod
+    def from_state(cls, state: dict) -> "JobMetadata":
+        obj = cls.__new__(cls)
+        obj.__dict__.update(state)
+        return obj
+
     # -- lifecycle ------------------------------------------------------
     def submit(self, time: float) -> None:
         if self.submit_time is None:

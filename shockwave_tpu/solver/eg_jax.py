@@ -507,11 +507,19 @@ def solve_eg_level(problem: EGProblem, polish: bool = True) -> np.ndarray:
     solve and is therefore packable by construction — is solved too and
     the better schedule by true objective wins.
     """
-    from shockwave_tpu.solver.rounding import order_schedule, refine_counts
+    counts, _ = solve_level_counts(problem)
+    return counts_to_schedule(counts, problem, polish=polish)
 
+
+def solve_level_counts(problem: EGProblem) -> Tuple[np.ndarray, float]:
+    """Device head of the single-chip level-set solve: pad, dispatch the
+    jitted :func:`solve_level`, fetch counts. The symmetric counterpart of
+    :func:`counts_to_schedule` (host tail); bench.py's device/host
+    attribution and the sharded solver's cross-checks all measure THIS
+    path, so they cannot drift from the production solve_eg_level."""
     slots = num_slots_for(problem.num_jobs)
     packed = pad_problem(problem, slots)
-    counts, _ = solve_level(
+    counts, obj = solve_level(
         packed["active"],
         packed["priorities"],
         packed["completed"],
@@ -527,6 +535,18 @@ def solve_eg_level(problem: EGProblem, polish: bool = True) -> np.ndarray:
         regularizer=float(problem.regularizer),
     )
     counts = np.asarray(counts)[: problem.num_jobs].astype(np.int64)
+    return counts, float(obj)
+
+
+def counts_to_schedule(
+    counts: np.ndarray, problem: EGProblem, polish: bool = True
+) -> np.ndarray:
+    """Host tail shared by every counts-producing device solve (single-chip
+    :func:`solve_eg_level`, sharded
+    :func:`shockwave_tpu.solver.eg_sharded.solve_eg_level_sharded`):
+    exchange polish, per-round placement, greedy fallback."""
+    from shockwave_tpu.solver.rounding import order_schedule, refine_counts
+
     if polish:
         counts = refine_counts(counts, problem)
     Y = order_schedule(

@@ -46,6 +46,37 @@ def test_gradients_match_dense():
         )
 
 
+def test_bf16_gradients_match_dense():
+    """bfloat16 path: the Pallas backward casts the incoming cotangent to
+    the input dtype (bf16 p/ds matmul operands, f32 accumulation —
+    standard flash practice), so bf16 grads must stay within bf16
+    chord-rounding tolerance of the dense-reference grads computed in the
+    same dtype (ADVICE r03: this path was previously untested)."""
+    rng = np.random.default_rng(3)
+    q, k, v = (x.astype(jnp.bfloat16) for x in _qkv(rng, 1, 128, 2, 16))
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, block_q=64, block_k=64)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def loss_dense(q, k, v):
+        out = dense_causal_attention(q, k, v)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_flash, g_dense):
+        assert gf.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(gf, dtype=np.float32),
+            np.asarray(gd, dtype=np.float32),
+            rtol=0.0,
+            atol=0.15,
+        )
+        # Tolerances alone can hide a dead backward: demand real signal.
+        assert float(jnp.max(jnp.abs(gf.astype(jnp.float32)))) > 0.5
+
+
 def test_causality():
     """Future tokens must not influence earlier outputs."""
     rng = np.random.default_rng(2)
