@@ -1,0 +1,138 @@
+#!/usr/bin/env python3
+"""Physical space-sharing demonstration -> results/physical/packing/.
+
+Runs the packed-pair scenario of
+tests/test_runtime.py::test_packed_pair_shares_accelerator as a
+committed artifact: a real localhost cluster (gRPC scheduler + 1-slot
+worker), first one compute-bound spinner alone (isolated baseline),
+then TWO jobs under ``max_min_fairness_packed`` — the policy packs them
+into one pair assignment, the dispatcher launches both subprocesses
+concurrently on the single accelerator slot (the reference's CUDA-MPS
+space sharing, dispatcher.py:122-161,447-525), their Done reports merge,
+and each job's measured step rate drops to ~half the isolated rate
+(fixed CPU work per step + every spinner pinned to the same core = the
+co-location slowdown, on any host).
+
+Writes summary.json with the isolated rate, each packed round's
+per-job rates, and the pair rounds from the scheduler's round log.
+Run/checkpoint scratch lives in a temp dir, not the artifact tree.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+from shockwave_tpu.runtime.testing import (  # noqa: E402
+    make_synthetic_job,
+    parse_round_rates,
+    start_local_cluster,
+)
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+RATE = 50.0
+
+
+def run_cluster(policy_name, jobs, run_dir, ckpt_dir, max_rounds):
+    sched = start_local_cluster(
+        policy_name, 1, run_dir=run_dir, checkpoint_dir=ckpt_dir
+    )
+    try:
+        job_ids = [sched.add_job(j) for j in jobs]
+        runner = threading.Thread(
+            target=sched.run, kwargs={"max_rounds": max_rounds}
+        )
+        runner.start()
+        runner.join(timeout=60 * max_rounds)
+        assert not runner.is_alive(), "round loop wedged"
+        for job_id in job_ids:
+            assert sched._job_completion_times.get(job_id) is not None, (
+                f"job {job_id} did not complete"
+            )
+        return sched
+    finally:
+        sched.shutdown()
+
+
+def main():
+    out_dir = os.path.join(REPO, "results", "physical", "packing")
+    os.makedirs(out_dir, exist_ok=True)
+    scratch = tempfile.mkdtemp(prefix="packing_demo_")
+
+    def spin_job(total_steps):
+        return make_synthetic_job(
+            total_steps, steps_per_sec=RATE, extra_args=" --spin"
+        )
+
+    base_run = os.path.join(scratch, "base_run")
+    run_cluster(
+        "fifo", [spin_job(200)], base_run,
+        os.path.join(scratch, "base_ckpt"), max_rounds=8,
+    )
+    base = parse_round_rates(base_run)
+    isolated = max(r for rr in base.values() for r in rr.values())
+
+    # Whether round 0 packs depends on dispatch timing vs the first
+    # allocation compute; retry a fresh cluster until a pair round with
+    # progress from both jobs is observed.
+    for attempt in range(3):
+        packed_run = os.path.join(scratch, f"packed_run_{attempt}")
+        sched = run_cluster(
+            "max_min_fairness_packed", [spin_job(300), spin_job(300)],
+            packed_run, os.path.join(scratch, f"packed_ckpt_{attempt}"),
+            max_rounds=14,
+        )
+        packed = parse_round_rates(packed_run)
+        pair_rounds = [
+            e for e in sched._round_log
+            if e["event"] == "round" and any("," in k for k in e["jobs"])
+        ]
+        shared = {r: v for r, v in packed.items() if len(v) == 2}
+        if pair_rounds and shared:
+            break
+        print(
+            f"attempt {attempt}: pair_rounds={len(pair_rounds)} "
+            f"shared={len(shared)}; retrying", file=sys.stderr,
+        )
+    assert pair_rounds and shared, "no packed pair round observed"
+    worst_shared = max(r for rr in shared.values() for r in rr.values())
+
+    summary = {
+        "generated": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "round_duration_s": 3.0,
+        "spin_steps_per_sec_target": RATE,
+        "isolated_rate_steps_per_sec": round(isolated, 2),
+        "packed_rates_by_round": {
+            str(r): {str(j): round(v, 2) for j, v in rr.items()}
+            for r, rr in sorted(packed.items())
+        },
+        "pair_assignment_rounds": [
+            {"round": e["round"], "jobs": e["jobs"]} for e in pair_rounds
+        ],
+        "max_shared_round_rate": round(worst_shared, 2),
+        "slowdown_vs_isolated": round(worst_shared / isolated, 3),
+        "interpretation": (
+            "both packed processes ran concurrently on the single "
+            "accelerator slot: with fixed CPU work per step and every "
+            "spinner pinned to one core, each job's rate in shared "
+            "rounds is ~half the isolated rate (serialized execution "
+            "would show full rate)"
+        ),
+    }
+    with open(os.path.join(out_dir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps(summary, indent=2)[:600])
+    print(f"wrote {out_dir}/summary.json (scratch in {scratch})")
+
+
+if __name__ == "__main__":
+    main()

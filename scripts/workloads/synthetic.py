@@ -54,6 +54,14 @@ def main():
         action="store_true",
         help="Never step and never exit (exercises the straggler kill)",
     )
+    parser.add_argument(
+        "--spin",
+        action="store_true",
+        help="Busy-wait the step budget instead of sleeping: the workload "
+        "becomes compute-bound, so space-shared co-location on a shared "
+        "core shows up as a measurable per-process rate drop (the packed "
+        "runtime test's co-location evidence)",
+    )
     args = parser.parse_args()
 
     ckpt_path = os.path.join(args.checkpoint_dir, "state.json")
@@ -93,9 +101,32 @@ def main():
         loader, args.checkpoint_dir, load_checkpoint, save_checkpoint
     )
 
+    step_budget = 1.0 / args.steps_per_sec
+
+    if args.spin and hasattr(os, "sched_setaffinity"):
+        # Every spinner shares core 0, so co-located processes contend
+        # even on multi-core hosts — the packed test's slowdown evidence
+        # does not depend on the machine happening to have one CPU.
+        try:
+            os.sched_setaffinity(0, {0})
+        except OSError:
+            pass
+
+    def pace():
+        if args.spin:
+            # Burn step_budget of CPU time (not wall time): under
+            # co-location the process's CPU share drops, so the step
+            # takes proportionally longer wall-clock — fixed work per
+            # step, like a real compute-bound trainer.
+            deadline = time.process_time() + step_budget
+            while time.process_time() < deadline:
+                pass
+        else:
+            time.sleep(step_budget)
+
     steps_this_task = 0
     for _ in iterator:
-        time.sleep(1.0 / args.steps_per_sec)
+        pace()
         steps_this_task += 1
         state["steps"] += 1
         if steps_this_task >= args.num_steps:

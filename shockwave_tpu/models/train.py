@@ -235,6 +235,13 @@ def main(argv=None):
     parser.add_argument("--distributed_addr", type=str, default=None)
     parser.add_argument("--num_workers", type=int, default=1)
     parser.add_argument("--worker_rank", type=int, default=0)
+    parser.add_argument(
+        "--distributed_timeout", type=float, default=None,
+        help="Gang rendezvous timeout in seconds: fail fast (nonzero "
+        "exit -> a zero-progress Done report -> the scheduler's "
+        "micro-task failure/retry path) instead of blocking on the "
+        "coordinator when a peer host never arrives",
+    )
     args = parser.parse_args(argv)
 
     import jax
@@ -247,10 +254,20 @@ def main(argv=None):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     if args.distributed_addr and args.num_workers > 1:
+        import math
+
+        init_kwargs = {}
+        if args.distributed_timeout is not None:
+            # Round UP to whole seconds: int() would turn a sub-second
+            # request into timeout=0 (fail-on-arrival).
+            init_kwargs["initialization_timeout"] = max(
+                1, math.ceil(args.distributed_timeout)
+            )
         jax.distributed.initialize(
             coordinator_address=args.distributed_addr,
             num_processes=args.num_workers,
             process_id=args.worker_rank,
+            **init_kwargs,
         )
 
     from shockwave_tpu.parallel.mesh import factorize_gang, make_mesh

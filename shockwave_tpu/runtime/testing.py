@@ -1,0 +1,124 @@
+"""Shared helpers for driving a real localhost cluster.
+
+Used by the wall-clock test tiers (tests/test_runtime.py,
+tests/test_multihost.py) and the committed physical demos
+(scripts/replicate/physical_packing_demo.py) so the synthetic-workload
+Job contract, the dispatcher progress-line parsing, and the
+scheduler+worker bring-up exist exactly once.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict
+
+from shockwave_tpu.core.job import Job
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+SYNTHETIC_WORKLOAD = os.path.join(
+    REPO, "scripts", "workloads", "synthetic.py"
+)
+# Must match the dispatcher's structured progress format
+# (shockwave_tpu/runtime/dispatcher.py:_PROGRESS_RE).
+PROGRESS_RE = re.compile(r"steps=(\d+) duration=([0-9.]+)")
+
+
+def make_synthetic_job(
+    total_steps: int,
+    steps_per_sec: float = 200,
+    scale_factor: int = 1,
+    extra_args: str = "",
+) -> Job:
+    """A Job whose payload is the synthetic training workload."""
+    return Job(
+        job_type="ResNet-18 (batch size 32)",
+        command=(
+            f"{sys.executable} {SYNTHETIC_WORKLOAD}"
+            f" --steps_per_sec {steps_per_sec} --batch_size 32{extra_args}"
+        ),
+        num_steps_arg="-n",
+        total_steps=total_steps,
+        scale_factor=scale_factor,
+        mode="static",
+    )
+
+
+def start_local_cluster(
+    policy_name: str,
+    num_accelerators: int,
+    run_dir: str,
+    checkpoint_dir: str,
+    round_duration: float = 3.0,
+    **sched_kwargs,
+):
+    """One PhysicalScheduler + one registered localhost worker; returns
+    the scheduler (the worker object lives in daemon threads)."""
+    from shockwave_tpu.core.physical import PhysicalScheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.policies import get_policy
+    from shockwave_tpu.runtime.worker import Worker
+    from shockwave_tpu.utils.hostenv import free_port
+
+    sched_port, worker_port = free_port(), free_port()
+    sched = PhysicalScheduler(
+        get_policy(policy_name),
+        port=sched_port,
+        throughputs=sched_kwargs.pop("throughputs", generate_oracle()),
+        time_per_iteration=round_duration,
+        completion_buffer_seconds=sched_kwargs.pop(
+            "completion_buffer_seconds", 6.0
+        ),
+        minimum_time_between_allocation_resets=sched_kwargs.pop(
+            "minimum_time_between_allocation_resets", 0.0
+        ),
+        **sched_kwargs,
+    )
+    Worker(
+        "v100",
+        num_accelerators,
+        "127.0.0.1",
+        sched_port,
+        worker_port,
+        run_dir=run_dir,
+        checkpoint_dir=checkpoint_dir,
+    )
+    sched.wait_for_workers(num_accelerators, timeout=30)
+    return sched
+
+
+def parse_round_rates(run_dir: str) -> Dict[int, Dict[int, float]]:
+    """{round_id: {job_id: steps_per_sec}} from the dispatcher's per-round
+    iterator logs. Progress lines are cumulative per log; the LAST line's
+    (steps, duration) pair is that round's totals — steps and durations
+    from different logs are never mixed."""
+    per_round: Dict[int, Dict[int, float]] = {}
+    for name in os.listdir(run_dir):
+        m = re.match(r"job=(\d+)_worker=\d+_round=(\d+)\.log$", name)
+        if not m:
+            continue
+        with open(os.path.join(run_dir, name)) as f:
+            matches = PROGRESS_RE.findall(f.read())
+        if matches:
+            steps, dur = matches[-1]
+            if float(dur) > 0:
+                per_round.setdefault(int(m.group(2)), {})[
+                    int(m.group(1))
+                ] = int(steps) / float(dur)
+    return per_round
+
+
+def distinct_rounds_launched(run_dir, job_integer: int) -> set:
+    """Round ids for which the dispatcher launched this job at least once
+    (any log or stdout file). The durable witness for retries — unlike
+    the synthetic workload's attempts.txt, whose truncate-and-rewrite
+    counter loses increments when gang ranks race it."""
+    rounds = set()
+    for name in os.listdir(str(run_dir)):
+        m = re.match(rf"job={job_integer}_worker=\d+_round=(\d+)\.", name)
+        if m:
+            rounds.add(int(m.group(1)))
+    return rounds
