@@ -1,0 +1,101 @@
+#!/usr/bin/env python3
+"""Measure the greedy solver's ``grant_batch`` wall-clock/quality tradeoff.
+
+``grant_batch_for`` (shockwave_tpu/solver/eg_jax.py) picks how many
+grants the jitted exact-marginal greedy lands per scan step: batch 1 is
+exact-marginal, larger batches amortize the gain computation over B
+grants with marginals going stale only within a batch. The constant was
+host-calibrated folklore (VERDICT r03 weak #6); this sweep backs it with
+data: grant_batch in {1, 4, 16, 64} x grant budgets {1k, 4k, 16k}
+(budget = num_gpus x future_rounds), timing the warm jitted solve and
+recording each batch's objective gap vs the exact batch-1 solve.
+
+Merges a "grant_batch_sweep" section into
+results/plan_solve_runtimes.json.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+# (num_gpus, future_rounds, num_jobs): budget = gpus * rounds grants.
+CONFIGS = [
+    (50, 20, 256),    # 1k grants
+    (200, 20, 1024),  # 4k grants
+    (800, 20, 4096),  # 16k grants
+]
+BATCHES = [1, 4, 16, 64]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="results/plan_solve_runtimes.json")
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+
+    import bench
+    from shockwave_tpu.solver.eg_jax import solve_eg_greedy
+
+    results = {}
+    for gpus, rounds, jobs in CONFIGS:
+        grants = gpus * rounds
+        p = bench.make_problem(
+            num_jobs=jobs, future_rounds=rounds, num_gpus=gpus
+        )
+        row = {}
+        obj_exact = None
+        for batch in BATCHES:
+            solve_eg_greedy(p, grant_batch=batch)  # warm/compile
+            t0 = time.time()
+            for _ in range(args.reps):
+                Y = solve_eg_greedy(p, grant_batch=batch)
+            wall = (time.time() - t0) / args.reps
+            obj = p.objective_value(Y)
+            if batch == 1:
+                obj_exact = obj
+            row[str(batch)] = {
+                "wall_s": round(wall, 4),
+                "objective_gap_vs_batch1": (
+                    round((obj_exact - obj) / abs(obj_exact), 6)
+                    if obj_exact
+                    else 0.0
+                ),
+            }
+            print(f"grants={grants} batch={batch}: {wall:.3f}s gap="
+                  f"{row[str(batch)]['objective_gap_vs_batch1']}")
+        results[str(grants)] = row
+
+    out = {}
+    if os.path.exists(args.out):
+        with open(args.out) as f:
+            out = json.load(f)
+    out["grant_batch_sweep"] = {
+        "note": (
+            "grant_batch x grant budget (num_gpus * future_rounds); "
+            "wall_s = warm jitted solve incl. host<->device round-trip; "
+            "gap = (batch1_objective - batch_objective) / |batch1| on "
+            "the piecewise objective. Basis for grant_batch_for()."
+        ),
+        "platform": jax.devices()[0].platform,
+        "results": results,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"merged grant_batch_sweep into {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -206,6 +206,79 @@ def main(argv=None):
     }
     print(rows["full_step"], flush=True)
 
+    # Where the rest of the step goes: gradients without the optimizer,
+    # and the optimizer update alone (reads p/m/v/g, writes p/m/v —
+    # pure HBM traffic, the roofline floor for any AdamW).
+    @jax.jit
+    def grad_only(variables):
+        _, grads = jax.value_and_grad(
+            lambda v: lm_loss(model, v, tokens)
+        )(variables)
+        return grads
+
+    t = slope(grad_only, variables, 64)
+    rows["fwd_bwd_no_opt"] = {"ms": round(t * 1e3, 1)}
+    print(rows["fwd_bwd_no_opt"], flush=True)
+
+    grads0 = grad_only(variables)
+
+    @jax.jit
+    def opt_only(state):
+        variables, opt_state, grads = state
+        upd, opt2 = tx.update(grads, opt_state, variables)
+        new_vars = optax.apply_updates(variables, upd)
+        # Chain: feed updated params back so repeated dispatches are
+        # not collapsible by the tunnel.
+        return (new_vars, opt2, grads)
+
+    # The fused single-pass AdamW (shockwave_tpu/ops/fused_adamw.py) —
+    # the optimizer models/train.py actually runs — vs the optax chain
+    # it replaced. The host's run-to-run dispatch variance exceeds the
+    # gap between the two, so they are measured as ordered A/B pairs
+    # (optax, fused, optax, fused) and each row keeps its best pass.
+    from shockwave_tpu.ops.fused_adamw import FusedAdamW
+
+    ftx = FusedAdamW(1e-4)
+    fstate = ftx.init(variables)
+
+    @jax.jit
+    def fused_opt_only(state):
+        variables, opt_state, grads = state
+        new_vars, opt2 = ftx.apply_gradients(grads, opt_state, variables)
+        return (new_vars, opt2, grads)
+
+    hbm_bytes = 7 * 4 * nparams  # 4 f32 reads + 3 f32 writes per param
+    t_optax, t_fused = [], []
+    for _ in range(2):
+        t_optax.append(slope(opt_only, (variables, opt_state, grads0), 64))
+        t_fused.append(slope(fused_opt_only, (variables, fstate, grads0), 64))
+    for name, ts in (("adamw_update", t_optax),
+                     ("fused_adamw_update", t_fused)):
+        t = min(ts)
+        rows[name] = {
+            "ms": round(t * 1e3, 2),
+            "all_passes_ms": [round(x * 1e3, 2) for x in ts],
+            "hbm_gb_per_s": round(hbm_bytes / t / 1e9, 1),
+        }
+        print({name: rows[name]}, flush=True)
+
+    # Full train step with the fused optimizer.
+    @jax.jit
+    def fused_train_step(state):
+        variables, opt_state = state
+        loss, grads = jax.value_and_grad(
+            lambda v: lm_loss(model, v, tokens)
+        )(variables)
+        return ftx.apply_gradients(grads, opt_state, variables)
+
+    t = slope(fused_train_step, (variables, fstate), 64)
+    rows["full_step_fused_adamw"] = {
+        "ms": round(t * 1e3, 1),
+        "steps_per_s": round(1 / t, 2),
+        "mfu_at_197tf": round(flops / t / 197e12, 4),
+    }
+    print(rows["full_step_fused_adamw"], flush=True)
+
     if args.output:
         with open(args.output, "w") as f:
             json.dump(

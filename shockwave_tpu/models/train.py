@@ -35,6 +35,7 @@ def build_family(name, args, mesh):
     import optax
 
     from shockwave_tpu.models import small_models as sm
+    from shockwave_tpu.ops.fused_adamw import FusedAdamW
     from shockwave_tpu.models.resnet import ResNet18, ResNet50
     from shockwave_tpu.models.transformer import (
         TransformerConfig,
@@ -44,7 +45,11 @@ def build_family(name, args, mesh):
 
     rng = jax.random.PRNGKey(args.seed)
     bs = args.batch_size
-    tx = optax.adamw(args.learning_rate)
+    # Fused single-pass AdamW (shockwave_tpu/ops/fused_adamw.py): same
+    # math as optax.adamw, one parameter traversal per step instead of
+    # updates-tree + apply; full-step A/B equal-or-faster at the 110M
+    # tier (see the module docstring for the honest measurement story).
+    tx = FusedAdamW(args.learning_rate)
 
     if name in ("ResNet-18", "ResNet-50"):
         model = (ResNet18 if name == "ResNet-18" else ResNet50)()
@@ -69,11 +74,9 @@ def build_family(name, args, mesh):
             (loss, updates), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(variables, batch)
-            params_grads = grads["params"]
-            update, opt_state = tx.update(
-                params_grads, opt_state, variables["params"]
+            params, opt_state = tx.apply_gradients(
+                grads["params"], opt_state, variables["params"]
             )
-            params = optax.apply_updates(variables["params"], update)
             variables = {
                 "params": params,
                 "batch_stats": updates["batch_stats"],
@@ -193,8 +196,9 @@ def build_family(name, args, mesh):
 
     def step_fn(variables, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(variables, batch)
-        update, opt_state = tx.update(grads, opt_state, variables)
-        variables = optax.apply_updates(variables, update)
+        variables, opt_state = tx.apply_gradients(
+            grads, opt_state, variables
+        )
         return variables, opt_state, loss
 
     opt_state = tx.init(variables)
@@ -281,6 +285,23 @@ def main(argv=None):
         args.model, args, mesh
     )
 
+    def restore_legacy_optax_state(restore_fn):
+        """Migrate a checkpoint written when the optimizer was
+        optax.adamw: restore against the optax state template, then
+        repack (count, mu, nu) into FusedAdamWState. Jobs preempted
+        before the fused-AdamW switch resume losslessly instead of
+        failing every retry on a template mismatch."""
+        import optax
+
+        from shockwave_tpu.ops.fused_adamw import FusedAdamWState
+
+        legacy_template = optax.adamw(args.learning_rate).init(variables)
+        restored_vars, legacy = restore_fn(legacy_template)
+        adam = legacy[0]  # ScaleByAdamState(count, mu, nu)
+        return restored_vars, FusedAdamWState(
+            count=adam.count, m=adam.mu, v=adam.nu
+        )
+
     # Restore from a previous round's checkpoint. Two backends:
     # msgpack (flax.serialization, one file, host-memory bound) and
     # orbax (directory tree, sharded/async-capable — the idiomatic TPU
@@ -295,10 +316,20 @@ def main(argv=None):
         )
         checkpointer = ocp.StandardCheckpointer()
         if orbax_dir and os.path.exists(orbax_dir):
-            restored = checkpointer.restore(
-                orbax_dir, {"variables": variables, "opt": opt_state}
-            )
-            variables, opt_state = restored["variables"], restored["opt"]
+            try:
+                restored = checkpointer.restore(
+                    orbax_dir, {"variables": variables, "opt": opt_state}
+                )
+                variables, opt_state = restored["variables"], restored["opt"]
+            except Exception:
+
+                def _restore(template):
+                    r = checkpointer.restore(
+                        orbax_dir, {"variables": variables, "opt": template}
+                    )
+                    return r["variables"], r["opt"]
+
+                variables, opt_state = restore_legacy_optax_state(_restore)
 
         def save_checkpoint():
             if not orbax_dir:
@@ -320,9 +351,19 @@ def main(argv=None):
         )
         if ckpt_path and os.path.exists(ckpt_path):
             with open(ckpt_path, "rb") as f:
+                blob = f.read()
+            try:
                 variables, opt_state = serialization.from_bytes(
-                    (variables, opt_state), f.read()
+                    (variables, opt_state), blob
                 )
+            except ValueError:
+
+                def _restore(template):
+                    return serialization.from_bytes(
+                        (variables, template), blob
+                    )
+
+                variables, opt_state = restore_legacy_optax_state(_restore)
 
         def save_checkpoint():
             if not ckpt_path:

@@ -1,0 +1,97 @@
+"""Single-pass AdamW: the optimizer as one fused traversal.
+
+``optax.adamw`` materializes an ``updates`` tree (scale_by_adam ->
+add_decayed_weights -> scale) which ``optax.apply_updates`` then adds in
+a second traversal — an extra parameter-sized HBM pass per step. Here
+each leaf's new (m, v, p) is computed in ONE jit-fused expression — no
+updates tree, no second pass. The math matches optax.adamw's (same
+defaults, same bias correction; parity test: tests/test_fused_adamw.py).
+
+Measured on the flagship 110M tree (v5e through the tunnel): the
+standalone optimizer micro-benchmark is NOT resolvable on this host —
+ordered A/B pairs flipped sign between processes (6.9-vs-6.1 ms one
+run, 10.9-vs-18.2 another; see all_passes_ms in
+results/flagship_profile.json). The FULL train step, the number that
+matters, came out equal-or-faster with the fused path in every
+profiler run (140.2/140.9 ms vs 141.5/142.9 ms). Kept as the default
+on the structural argument — one fewer parameter-sized HBM pass is
+never more work — with exact optax parity
+(results/flagship_profile_breakdown.md, round-4 section).
+
+API: ``init`` / ``update`` are optax-compatible (``update`` falls back
+to returning an updates tree, for callers that need the two-step shape);
+``apply_gradients`` is the fused path train loops should call.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class FusedAdamWState(NamedTuple):
+    count: jnp.ndarray  # int32 step counter
+    m: object  # first-moment tree
+    v: object  # second-moment tree
+
+
+class FusedAdamW:
+    """Drop-in AdamW with a fused apply_gradients path."""
+
+    def __init__(
+        self,
+        learning_rate: float,
+        b1: float = 0.9,
+        b2: float = 0.999,
+        eps: float = 1e-8,
+        weight_decay: float = 1e-4,
+    ):
+        self.learning_rate = learning_rate
+        self.b1, self.b2, self.eps = b1, b2, eps
+        self.weight_decay = weight_decay
+
+    def init(self, params) -> FusedAdamWState:
+        zeros = lambda p: jnp.zeros_like(p)  # noqa: E731
+        return FusedAdamWState(
+            count=jnp.zeros((), jnp.int32),
+            m=jax.tree_util.tree_map(zeros, params),
+            v=jax.tree_util.tree_map(zeros, params),
+        )
+
+    def _moments(self, g, m, v):
+        m2 = self.b1 * m + (1.0 - self.b1) * g
+        v2 = self.b2 * v + (1.0 - self.b2) * jnp.square(g)
+        return m2, v2
+
+    def apply_gradients(self, grads, state: FusedAdamWState, params):
+        """(new_params, new_state) in one traversal — the fused path."""
+        count = state.count + 1
+        c1 = 1.0 - self.b1 ** count.astype(jnp.float32)
+        c2 = 1.0 - self.b2 ** count.astype(jnp.float32)
+
+        def leaf(p, g, m, v):
+            m2, v2 = self._moments(g, m, v)
+            step = (m2 / c1) / (jnp.sqrt(v2 / c2) + self.eps)
+            new_p = p - self.learning_rate * (step + self.weight_decay * p)
+            return new_p.astype(p.dtype), m2, v2
+
+        out = jax.tree_util.tree_map(leaf, params, grads, state.m, state.v)
+        treedef = jax.tree_util.tree_structure(params)
+        flat = jax.tree_util.tree_leaves(
+            out, is_leaf=lambda x: isinstance(x, tuple)
+        )
+        new_p = jax.tree_util.tree_unflatten(treedef, [t[0] for t in flat])
+        new_m = jax.tree_util.tree_unflatten(treedef, [t[1] for t in flat])
+        new_v = jax.tree_util.tree_unflatten(treedef, [t[2] for t in flat])
+        return new_p, FusedAdamWState(count=count, m=new_m, v=new_v)
+
+    def update(self, grads, state: FusedAdamWState, params):
+        """optax-compatible two-step shape: (updates, new_state). Costs
+        the extra updates-tree pass — prefer apply_gradients."""
+        new_params, new_state = self.apply_gradients(grads, state, params)
+        updates = jax.tree_util.tree_map(
+            lambda n, p: n - p, new_params, params
+        )
+        return updates, new_state

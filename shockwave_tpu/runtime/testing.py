@@ -15,6 +15,7 @@ import sys
 from typing import Dict
 
 from shockwave_tpu.core.job import Job
+from shockwave_tpu.runtime.dispatcher import _PROGRESS_RE as PROGRESS_RE
 
 REPO = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -22,9 +23,6 @@ REPO = os.path.dirname(
 SYNTHETIC_WORKLOAD = os.path.join(
     REPO, "scripts", "workloads", "synthetic.py"
 )
-# Must match the dispatcher's structured progress format
-# (shockwave_tpu/runtime/dispatcher.py:_PROGRESS_RE).
-PROGRESS_RE = re.compile(r"steps=(\d+) duration=([0-9.]+)")
 
 
 def make_synthetic_job(

@@ -636,10 +636,15 @@ def solve_eg_jax(problem: EGProblem, num_steps: int = 256) -> np.ndarray:
 
 
 def grant_batch_for(num_grants: int) -> int:
-    """Adaptive batch: exact single-grant marginals at planner scale
-    (<= 4096 grants covers every committed trace config); batch of 16 at
-    stress scale where the scan is latency-bound (measured ~2x wall-clock
-    with an objective match to 4 decimal places at 1000x256x50)."""
+    """Adaptive batch, derived from the committed sweep
+    (results/plan_solve_runtimes.json "grant_batch_sweep", built by
+    scripts/microbenchmarks/sweep_grant_batch.py on the v5e host):
+    exact single-grant marginals at planner scale — at <= 4096 grants
+    the batch sizes are within dispatch-latency noise of each other
+    (0.16-0.32 s) and batch 64 already costs a 1.4% objective gap at a
+    1k budget — and batch 16 at stress scale, where the scan is
+    latency-bound (16k grants: 0.695 s at batch 1 -> 0.246 s at batch
+    16, zero objective gap; batch 64 is slower again at 0.354 s)."""
     return 16 if num_grants > 4096 else 1
 
 

@@ -132,6 +132,55 @@ def test_checkpoint_roundtrip(tmp_path):
     chex.assert_trees_all_close(variables, variables2)
 
 
+def test_legacy_optax_checkpoint_migrates(tmp_path):
+    """A checkpoint written when the optimizer was optax.adamw (state
+    keys count/mu/nu inside a 3-chain) must still resume after the
+    fused-AdamW switch: the CLI's restore falls back to the legacy
+    template and repacks it into FusedAdamWState instead of failing
+    every relaunch on a template mismatch."""
+    import optax
+    from flax import serialization
+
+    from shockwave_tpu.ops.fused_adamw import FusedAdamW
+
+    cmd = [
+        sys.executable, "-m", "shockwave_tpu.models.train",
+        "--model", "Recommendation", "--batch_size", "8", "-n", "2",
+        "--checkpoint_dir", str(tmp_path),
+    ]
+    env = {**__import__("os").environ, "JAX_PLATFORMS": "cpu"}
+    out1 = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=180, env=env
+    )
+    assert out1.returncode == 0, out1.stderr
+
+    # Rewrite the checkpoint in the LEGACY optax format.
+    ckpt = tmp_path / "train_state.msgpack"
+    mesh = make_mesh((1, 1, 1), devices=jax.devices()[:1])
+    args = tiny_args(
+        "Recommendation", batch_size=8, checkpoint_dir=str(tmp_path)
+    )
+    variables, _, _, _ = build_family("Recommendation", args, mesh)
+    fused_template = FusedAdamW(args.learning_rate).init(variables)
+    saved_vars, saved_state = serialization.from_bytes(
+        (variables, fused_template), ckpt.read_bytes()
+    )
+    legacy = optax.adamw(args.learning_rate).init(saved_vars)
+    legacy = (
+        legacy[0]._replace(
+            count=saved_state.count, mu=saved_state.m, nu=saved_state.v
+        ),
+    ) + tuple(legacy[1:])
+    ckpt.write_bytes(serialization.to_bytes((saved_vars, legacy)))
+
+    # Resume from the legacy-format checkpoint: must migrate, not crash.
+    out2 = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=180, env=env
+    )
+    assert out2.returncode == 0, out2.stderr
+    assert "steps=2" in out2.stdout
+
+
 @pytest.mark.parametrize("attention", ["dense", "flash", "ulysses"])
 def test_transformer_bfloat16_mixed_precision(attention):
     """bfloat16 activations (float32 params / softmax / layernorm) must

@@ -19,57 +19,31 @@ import pytest
 pytestmark = pytest.mark.slow
 
 from shockwave_tpu.core.job import Job
-from shockwave_tpu.utils.hostenv import cpu_compile_cache_dir, free_port
 from shockwave_tpu.core.physical import PhysicalScheduler
 from shockwave_tpu.data.default_oracle import generate_oracle
 from shockwave_tpu.policies import get_policy
+from shockwave_tpu.runtime.testing import (
+    make_synthetic_job as make_job,
+    start_local_cluster,
+)
+from shockwave_tpu.utils.hostenv import cpu_compile_cache_dir, free_port
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKLOAD = os.path.join(REPO, "scripts", "workloads", "synthetic.py")
 
 
-def make_job(total_steps, steps_per_sec=200, scale_factor=1, extra_args=""):
-    return Job(
-        job_type="ResNet-18 (batch size 32)",
-        command=(
-            f"{os.sys.executable} {WORKLOAD}"
-            f" --steps_per_sec {steps_per_sec} --batch_size 32{extra_args}"
-        ),
-        num_steps_arg="-n",
-        total_steps=total_steps,
-        scale_factor=scale_factor,
-        mode="static",
-    )
-
-
 @pytest.fixture
 def cluster(tmp_path):
-    """One scheduler + one 2-accelerator worker on localhost."""
-    from shockwave_tpu.runtime.worker import Worker
-
-    sched_port = free_port()
-    worker_port = free_port()
-    sched = PhysicalScheduler(
-        get_policy("fifo"),
-        port=sched_port,
-        throughputs=generate_oracle(),
-        time_per_iteration=3.0,
-        completion_buffer_seconds=6.0,
-        # The production default (1920s) is tuned for 360s rounds; with 3s
-        # test rounds it would starve late jobs of allocation recomputes.
-        minimum_time_between_allocation_resets=0.0,
-    )
-    worker = Worker(
-        "v100",
-        2,
-        "127.0.0.1",
-        sched_port,
-        worker_port,
+    """One scheduler + one 2-accelerator worker on localhost.
+    (minimum_time_between_allocation_resets=0: the production default
+    of 1920s is tuned for 360s rounds and would starve late jobs of
+    allocation recomputes at 3s test rounds.)"""
+    sched = start_local_cluster(
+        "fifo", 2,
         run_dir=str(tmp_path / "run"),
         checkpoint_dir=str(tmp_path / "ckpt"),
     )
-    sched.wait_for_workers(2, timeout=30)
-    yield sched, worker, tmp_path
+    yield sched, None, tmp_path
     sched.shutdown()
 
 
