@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""Curated evaluation figure panel from the committed scale pickles.
+
+The repo-native equivalent of the reference's evaluation notebook
+pipeline (reference: scheduler/notebooks/figures/evaluation/
+{makespan,cluster_sweep,continuous_jobs*}.ipynb): one command reads
+EVERY committed scale tier (results/scale, scale460, scale900,
+scale2048, scale_tpu) and renders the full Figure-9-style panel —
+metric rows x trace-tier columns, one line per policy vs cluster size —
+so the whole evaluation story is reproducible from committed artifacts
+without notebook state.
+
+Usage:
+  python scripts/analysis/figures.py                 # all tiers found
+  python scripts/analysis/figures.py --out results/evaluation_panel.png
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+from scripts.replicate.plot_scale_experiment import (  # noqa: E402
+    METRICS,
+    POLICY_COLOR,
+    POLICY_LABEL,
+    POLICY_ORDER,
+)
+
+TIER_ORDER = ["scale", "scale460", "scale900", "scale2048", "scale_tpu"]
+TIER_LABEL = {
+    "scale": "220 jobs, v100 oracle",
+    "scale460": "460 jobs, v100 oracle",
+    "scale900": "900 jobs, v100 oracle",
+    "scale2048": "2048 jobs, v100 oracle",
+    "scale_tpu": "220 jobs, measured TPU v5e oracle",
+}
+# Secondary (non-color) encoding for the two policies that can run
+# coincident with the LAS line (water-filling reduces to LAS exactly on
+# one worker type; FTF nearly so at over-provisioned sizes): dashes keep
+# the covered line visible.
+POLICY_STYLE = {
+    "finish_time_fairness": ":",
+    "max_min_fairness_water_filling": "--",
+    # The exact MILP coincides with shockwave_tpu wherever the two
+    # backends agree (the parity story); dashes keep both visible.
+    "shockwave": (0, (4, 2)),
+}
+
+
+def load_tiers(results_dir):
+    tiers = {}
+    for name in TIER_ORDER:
+        path = os.path.join(results_dir, name, "summary.json")
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            summary = json.load(f)["results"]
+        per_size = {}
+        for cell in summary.values():
+            per_size.setdefault(int(cell["num_gpus"]), {})[
+                cell["policy"]
+            ] = cell
+        tiers[name] = per_size
+    return tiers
+
+
+def plot(tiers, out_path):
+    nrows, ncols = len(METRICS), len(tiers)
+    fig, axes = plt.subplots(
+        nrows, ncols, figsize=(3.4 * ncols, 2.7 * nrows), squeeze=False
+    )
+    for col, (tier, per_size) in enumerate(tiers.items()):
+        sizes = sorted(per_size)
+        for row, (metric, label) in enumerate(METRICS):
+            ax = axes[row][col]
+            for policy in POLICY_ORDER:
+                ys = [
+                    per_size[s].get(policy, {}).get(metric) for s in sizes
+                ]
+                if all(y is None for y in ys):
+                    continue
+                ax.plot(
+                    sizes,
+                    ys,
+                    marker="o",
+                    markersize=4,
+                    linewidth=2,
+                    linestyle=POLICY_STYLE.get(policy, "-"),
+                    label=POLICY_LABEL.get(policy, policy),
+                    color=POLICY_COLOR.get(policy, "#777777"),
+                )
+            ax.set_xscale("log", base=2)
+            ax.set_xticks(sizes)
+            ax.set_xticklabels([str(s) for s in sizes], fontsize=8)
+            ax.grid(color="#e3e3e3", linewidth=0.6)
+            for spine in ("top", "right"):
+                ax.spines[spine].set_visible(False)
+            ax.tick_params(labelsize=8)
+            if row == 0:
+                ax.set_title(TIER_LABEL[tier], fontsize=10)
+            if row == nrows - 1:
+                ax.set_xlabel("cluster size (accelerators)", fontsize=9)
+            if col == 0:
+                ax.set_ylabel(label, fontsize=9)
+    # Legend in the FIXED policy order, regardless of which axis a
+    # policy first appeared on.
+    seen = {}
+    for row in axes:
+        for ax in row:
+            for h, l in zip(*ax.get_legend_handles_labels()):
+                seen.setdefault(l, h)
+    handles, labels = [], []
+    for policy in POLICY_ORDER:
+        label = POLICY_LABEL.get(policy, policy)
+        if label in seen:
+            handles.append(seen[label])
+            labels.append(label)
+    fig.legend(
+        handles,
+        labels,
+        loc="upper center",
+        bbox_to_anchor=(0.5, 1.0),
+        ncol=min(5, len(labels)),
+        fontsize=9,
+        frameon=False,
+    )
+    fig.suptitle(
+        "Shockwave-TPU evaluation: every committed scale tier",
+        fontsize=13,
+        y=1.035,
+    )
+    fig.tight_layout(rect=(0, 0, 1, 0.965))
+    fig.savefig(out_path, dpi=150, bbox_inches="tight")
+    print(f"Wrote {out_path}")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--results_dir", default="results")
+    ap.add_argument("--out", default="results/evaluation_panel.png")
+    args = ap.parse_args()
+    tiers = load_tiers(args.results_dir)
+    if not tiers:
+        raise SystemExit("no results/scale*/summary.json found")
+    plot(tiers, args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -27,15 +27,26 @@ METRICS = [
 ]
 
 # Fixed policy order and categorical hues (identity follows the policy,
-# never its rank within a panel).
-POLICY_ORDER = ["max_min_fairness", "shockwave", "shockwave_tpu"]
+# never its rank within a panel; hues CVD-checked in OKLab — adjacent
+# pairs >= 8, every pair >= 15 normal-vision).
+POLICY_ORDER = [
+    "max_min_fairness",
+    "finish_time_fairness",
+    "max_min_fairness_water_filling",
+    "shockwave",
+    "shockwave_tpu",
+]
 POLICY_LABEL = {
     "max_min_fairness": "max-min fairness (Gavel)",
+    "finish_time_fairness": "finish-time fairness (Themis)",
+    "max_min_fairness_water_filling": "water-filling max-min",
     "shockwave": "shockwave (exact MILP)",
     "shockwave_tpu": "shockwave_tpu (ours)",
 }
 POLICY_COLOR = {
     "max_min_fairness": "#2a78d6",
+    "finish_time_fairness": "#8f7a00",
+    "max_min_fairness_water_filling": "#c2408f",
     "shockwave": "#eb6834",
     "shockwave_tpu": "#1baf7a",
 }

@@ -290,12 +290,18 @@ def main(argv=None):
         optax.adamw: restore against the optax state template, then
         repack (count, mu, nu) into FusedAdamWState. Jobs preempted
         before the fused-AdamW switch resume losslessly instead of
-        failing every retry on a template mismatch."""
+        failing every retry on a template mismatch.
+
+        The legacy template is built over the fused state's own moment
+        tree — NOT over ``variables`` — because families differ in what
+        they hand the optimizer (ResNet inits it over
+        variables["params"] only); opt_state.m always has exactly that
+        structure."""
         import optax
 
         from shockwave_tpu.ops.fused_adamw import FusedAdamWState
 
-        legacy_template = optax.adamw(args.learning_rate).init(variables)
+        legacy_template = optax.adamw(args.learning_rate).init(opt_state.m)
         restored_vars, legacy = restore_fn(legacy_template)
         adam = legacy[0]  # ScaleByAdamState(count, mu, nu)
         return restored_vars, FusedAdamWState(
@@ -321,7 +327,7 @@ def main(argv=None):
                     orbax_dir, {"variables": variables, "opt": opt_state}
                 )
                 variables, opt_state = restored["variables"], restored["opt"]
-            except Exception:
+            except Exception as template_err:
 
                 def _restore(template):
                     r = checkpointer.restore(
@@ -329,7 +335,15 @@ def main(argv=None):
                     )
                     return r["variables"], r["opt"]
 
-                variables, opt_state = restore_legacy_optax_state(_restore)
+                try:
+                    variables, opt_state = restore_legacy_optax_state(
+                        _restore
+                    )
+                except Exception:
+                    # Not a legacy-format checkpoint either (e.g. a
+                    # truncated save): surface the ORIGINAL error, not
+                    # a bogus template-mismatch from the fallback.
+                    raise template_err
 
         def save_checkpoint():
             if not orbax_dir:
@@ -356,14 +370,19 @@ def main(argv=None):
                 variables, opt_state = serialization.from_bytes(
                     (variables, opt_state), blob
                 )
-            except ValueError:
+            except ValueError as template_err:
 
                 def _restore(template):
                     return serialization.from_bytes(
                         (variables, template), blob
                     )
 
-                variables, opt_state = restore_legacy_optax_state(_restore)
+                try:
+                    variables, opt_state = restore_legacy_optax_state(
+                        _restore
+                    )
+                except Exception:
+                    raise template_err
 
         def save_checkpoint():
             if not ckpt_path:

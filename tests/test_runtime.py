@@ -43,12 +43,12 @@ def cluster(tmp_path):
         run_dir=str(tmp_path / "run"),
         checkpoint_dir=str(tmp_path / "ckpt"),
     )
-    yield sched, None, tmp_path
+    yield sched, tmp_path
     sched.shutdown()
 
 
 def test_jobs_run_to_completion(cluster):
-    sched, worker, tmp_path = cluster
+    sched, tmp_path = cluster
     # ~1.5 rounds of work each at 200 steps/s and 3s rounds.
     job_ids = [sched.add_job(make_job(800)) for _ in range(2)]
     runner = threading.Thread(target=sched.run, kwargs={"max_rounds": 20})
@@ -65,7 +65,7 @@ def test_jobs_run_to_completion(cluster):
 
 
 def test_gang_job_merges_worker_reports(cluster):
-    sched, worker, tmp_path = cluster
+    sched, tmp_path = cluster
     # One 2-worker gang job: both members dispatch, both Done reports must
     # merge into one micro-task completion.
     job_id = sched.add_job(make_job(600, scale_factor=2))
@@ -83,7 +83,7 @@ def test_short_jobs_backfill_idle_workers(cluster):
     per round (each planned round contains a job that completed before
     the boundary). Six sub-round jobs on 2 slots must finish in ~3-4
     working rounds, not 6+."""
-    sched, worker, tmp_path = cluster
+    sched, tmp_path = cluster
     # ~1s of work each at 200 steps/s and 3s rounds.
     job_ids = [sched.add_job(make_job(200)) for _ in range(6)]
     runner = threading.Thread(target=sched.run, kwargs={"max_rounds": 8})
@@ -103,7 +103,7 @@ def test_short_jobs_backfill_idle_workers(cluster):
 
 
 def test_preemption_resumes_across_rounds(cluster):
-    sched, worker, tmp_path = cluster
+    sched, tmp_path = cluster
     # 3 jobs, 2 accelerators: someone must be preempted and resumed.
     job_ids = [sched.add_job(make_job(700)) for _ in range(3)]
     runner = threading.Thread(target=sched.run, kwargs={"max_rounds": 30})
@@ -126,7 +126,7 @@ def test_failed_attempts_drop_job_and_spare_healthy_one(cluster):
     MAX_FAILED_ATTEMPTS the job is dropped with completion_time=None
     (reference: scheduler.py:3359-3376, 649-651) while healthy jobs
     continue unharmed."""
-    sched, worker, tmp_path = cluster
+    sched, tmp_path = cluster
     crasher = sched.add_job(make_failing_job(400, crash_attempts=-1))
     healthy = sched.add_job(make_job(400))
     # Round budgets are headroom for loaded hosts; the loop exits as
@@ -145,7 +145,7 @@ def test_single_step_job_completes(cluster):
     __next__ interval, so complete() must account it — reporting
     duration 0 made the scheduler's physical-mode merge judge every
     attempt failed and drop the job."""
-    sched, worker, tmp_path = cluster
+    sched, tmp_path = cluster
     job_id = sched.add_job(make_job(1))
     runner = threading.Thread(target=sched.run, kwargs={"max_rounds": 15})
     runner.start()
@@ -160,7 +160,7 @@ def test_unspawnable_job_is_dropped_not_wedged(cluster):
     directory) must still produce a Done report per attempt so the
     failed-attempts logic drops it — a silently dead launcher thread
     used to leave the assignment outstanding and wedge the round loop."""
-    sched, worker, tmp_path = cluster
+    sched, tmp_path = cluster
     bad = make_job(400)
     bad.working_directory = str(tmp_path / "does-not-exist")
     bad_id = sched.add_job(bad)
@@ -176,7 +176,7 @@ def test_unspawnable_job_is_dropped_not_wedged(cluster):
 def test_transient_failures_are_retried_to_completion(cluster):
     """Two crash-on-launch attempts, then normal training: the scheduler
     must re-dispatch after each failure and the job must still finish."""
-    sched, worker, tmp_path = cluster
+    sched, tmp_path = cluster
     job_id = sched.add_job(make_failing_job(400, crash_attempts=2))
     runner = threading.Thread(target=sched.run, kwargs={"max_rounds": 40})
     runner.start()
@@ -192,7 +192,7 @@ def test_straggler_is_killed_and_eventually_dropped(cluster):
     """A hung workload never reports Done: the round loop must kill it at
     round end + buffer (reference: scheduler.py:3098-3170), count the
     failure, and after MAX_FAILED_ATTEMPTS drop the job."""
-    sched, worker, tmp_path = cluster
+    sched, tmp_path = cluster
     hung = sched.add_job(
         Job(
             job_type="ResNet-18 (batch size 32)",
@@ -215,7 +215,7 @@ def test_straggler_is_killed_and_eventually_dropped(cluster):
 def test_worker_reset_kills_running_jobs_and_job_recovers(cluster):
     """The Reset RPC wipes worker-side processes (reference:
     dispatcher.py:537-545); the preempted job is retried and completes."""
-    sched, worker, tmp_path = cluster
+    sched, tmp_path = cluster
     job_id = sched.add_job(make_job(900, steps_per_sec=100))
     runner = threading.Thread(target=sched.run, kwargs={"max_rounds": 45})
     runner.start()
