@@ -151,6 +151,10 @@ def get_policy(
         from shockwave_tpu.policies.shockwave import ShockwavePolicy
 
         return ShockwavePolicy(backend="relaxed")
+    if policy_name == "shockwave_tpu_sharded":
+        from shockwave_tpu.policies.shockwave import ShockwavePolicy
+
+        return ShockwavePolicy(backend="sharded")
     raise ValueError(f"Unknown policy: {policy_name!r}")
 
 
@@ -185,6 +189,7 @@ _ALL_POLICY_NAMES = [
     "shockwave_native",
     "shockwave_tpu_level",
     "shockwave_tpu_relaxed",
+    "shockwave_tpu_sharded",
 ]
 
 _POLICY_MODULES = {

@@ -275,6 +275,19 @@ class ShockwavePlanner:
             from shockwave_tpu.solver.eg_jax import solve_eg_level
 
             Y = solve_eg_level(problem)
+        elif self.backend == "sharded":
+            # Forced multi-chip solve: ONE planning problem's job
+            # dimension sharded over every visible device
+            # (shockwave_tpu/solver/eg_sharded.py). Bit-identical
+            # counts to the single-device level solve, so the schedule
+            # (and every downstream metric) matches the "level"
+            # backend exactly; the win is headroom past one chip's
+            # memory/latency at 10k+-job fleets.
+            from shockwave_tpu.solver.eg_sharded import (
+                solve_eg_level_sharded,
+            )
+
+            Y = solve_eg_level_sharded(problem)
         elif self.backend == "relaxed":
             # Projected-gradient ascent on the exact continuous relaxation,
             # then integer rounding + per-round placement on host.
@@ -303,12 +316,24 @@ class ShockwavePlanner:
             # in one batched launch. Both paths optimize the identical
             # objective and are cross-checked by tests.
             Y = None
+            if problem.num_jobs >= 8192:
+                # Fleet scale trumps the native fast path: shard the
+                # single solve over every chip (counts bit-identical
+                # to the single-device path).
+                import jax
+
+                if len(jax.devices()) > 1:
+                    from shockwave_tpu.solver.eg_sharded import (
+                        solve_eg_level_sharded,
+                    )
+
+                    Y = solve_eg_level_sharded(problem)
             work = (
                 float(problem.num_gpus)
                 * problem.future_rounds
                 * problem.num_jobs
             )
-            if work < 4e6:
+            if Y is None and work < 4e6:
                 from shockwave_tpu import native
 
                 if native.available():
@@ -371,6 +396,7 @@ class ShockwavePolicy(Policy):
             "native": "Shockwave_Native",
             "level": "Shockwave_TPU_Level",
             "relaxed": "Shockwave_TPU_Relaxed",
+            "sharded": "Shockwave_TPU_Sharded",
         }.get(backend, "Shockwave_TPU")
 
     def make_planner(self, config: dict) -> ShockwavePlanner:

@@ -108,3 +108,44 @@ def test_16k_jobs_cross_check():
     # Sanity on the schedule scale itself.
     assert int(c_sh.sum()) > 0
     assert float(np.sum(c_sh * p.nworkers)) <= p.num_gpus * p.future_rounds
+
+
+def test_sharded_backend_end_to_end_matches_level():
+    """shockwave_tpu_sharded is a first-class selectable backend whose
+    simulated trace metrics are identical to the single-device level
+    backend's (bit-identical counts -> identical schedules)."""
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.data.profiles import synthesize_profiles
+    from shockwave_tpu.policies import get_available_policies, get_policy
+    from tests.test_simulator import tiny_trace
+
+    assert "shockwave_tpu_sharded" in get_available_policies()
+
+    def run(policy_name):
+        jobs, arrivals = tiny_trace(num_jobs=5, epochs=2, arrival_gap=30.0)
+        oracle = generate_oracle()
+        profiles = synthesize_profiles(jobs, oracle)
+        sched = Scheduler(
+            get_policy(policy_name),
+            throughputs=oracle,
+            seed=0,
+            time_per_iteration=120,
+            profiles=profiles,
+            shockwave_config={
+                "num_gpus": 2,
+                "time_per_iteration": 120,
+                "future_rounds": 8,
+                "lambda": 5.0,
+                "k": 10.0,
+            },
+        )
+        makespan = sched.simulate({"v100": 2}, arrivals, jobs)
+        return sched, makespan
+
+    sharded, mk_sharded = run("shockwave_tpu_sharded")
+    level, mk_level = run("shockwave_tpu_level")
+    assert mk_sharded == pytest.approx(mk_level)
+    assert len(sharded._job_completion_times) == 5
+    for job_id, jct in level._job_completion_times.items():
+        assert sharded._job_completion_times[job_id] == pytest.approx(jct)
