@@ -8,7 +8,6 @@ fleets)."""
 
 import os
 import re
-import socket
 import subprocess
 import sys
 

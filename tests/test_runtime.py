@@ -8,7 +8,6 @@ checkpoint/resume across rounds, and shutdown.
 """
 
 import os
-import socket
 import threading
 import time
 
