@@ -5,7 +5,8 @@ The repo-native equivalent of the reference's evaluation notebook
 pipeline (reference: scheduler/notebooks/figures/evaluation/
 {makespan,cluster_sweep,continuous_jobs*}.ipynb): one command reads
 EVERY committed scale tier (results/scale, scale460, scale900,
-scale2048, scale_tpu) and renders the full Figure-9-style panel —
+scale2048, scale4096, scale_tpu) and renders the full Figure-9-style
+panel —
 metric rows x trace-tier columns, one line per policy vs cluster size —
 so the whole evaluation story is reproducible from committed artifacts
 without notebook state.
@@ -39,12 +40,15 @@ from scripts.replicate.plot_scale_experiment import (  # noqa: E402
     POLICY_ORDER,
 )
 
-TIER_ORDER = ["scale", "scale460", "scale900", "scale2048", "scale_tpu"]
+TIER_ORDER = [
+    "scale", "scale460", "scale900", "scale2048", "scale4096", "scale_tpu",
+]
 TIER_LABEL = {
     "scale": "220 jobs, v100 oracle",
     "scale460": "460 jobs, v100 oracle",
     "scale900": "900 jobs, v100 oracle",
     "scale2048": "2048 jobs, v100 oracle",
+    "scale4096": "4096 jobs, v100 oracle",
     "scale_tpu": "220 jobs, measured TPU v5e oracle",
 }
 # Secondary (non-color) encoding for the two policies that can run
