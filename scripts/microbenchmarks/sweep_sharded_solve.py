@@ -118,11 +118,12 @@ def main():
     if os.path.exists(args.out):
         with open(args.out) as f:
             out = json.load(f)
-    out[platform] = entry
+    key = platform if args.jobs == 16384 else f"{platform}_{args.jobs}"
+    out[key] = entry
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)
-    print(f"wrote {args.out} [{platform}]", file=sys.stderr)
+    print(f"wrote {args.out} [{key}]", file=sys.stderr)
 
 
 if __name__ == "__main__":
