@@ -31,6 +31,11 @@ from shockwave_tpu.solver.eg_problem import EGProblem
 
 DEFAULT_LOG_BASES = [0.0, 0.2, 0.4, 0.6, 0.8, 1.0]
 
+# Fleet scale at which the production backend routes one planning solve
+# to the multi-chip sharded path (when >1 device is visible) instead of
+# the single-device level solve / native greedy.
+SHARDED_DISPATCH_MIN_JOBS = 8192
+
 
 class ShockwavePlanner:
     """Plans a boolean (job x future-round) schedule each planning window.
@@ -316,7 +321,7 @@ class ShockwavePlanner:
             # in one batched launch. Both paths optimize the identical
             # objective and are cross-checked by tests.
             Y = None
-            if problem.num_jobs >= 8192:
+            if problem.num_jobs >= SHARDED_DISPATCH_MIN_JOBS:
                 # Fleet scale trumps the native fast path: shard the
                 # single solve over every chip (counts bit-identical
                 # to the single-device path).

@@ -149,3 +149,43 @@ def test_sharded_backend_end_to_end_matches_level():
     assert len(sharded._job_completion_times) == 5
     for job_id, jct in level._job_completion_times.items():
         assert sharded._job_completion_times[job_id] == pytest.approx(jct)
+
+
+def test_tpu_backend_auto_dispatches_to_sharded(monkeypatch):
+    """The production "tpu" backend routes fleet-scale problems
+    (>= SHARDED_DISPATCH_MIN_JOBS, > 1 visible device) to the sharded
+    solver BEFORE the native fast path; below the threshold it never
+    touches it."""
+    import shockwave_tpu.policies.shockwave as sw
+    from shockwave_tpu.policies.shockwave import ShockwavePlanner
+    from shockwave_tpu.solver import eg_sharded
+
+    calls = []
+    real = eg_sharded.solve_eg_level_sharded
+
+    def spy(problem, *a, **kw):
+        calls.append(problem.num_jobs)
+        return real(problem, *a, **kw)
+
+    monkeypatch.setattr(eg_sharded, "solve_eg_level_sharded", spy)
+    monkeypatch.setattr(sw, "SHARDED_DISPATCH_MIN_JOBS", 8)
+
+    planner = ShockwavePlanner(
+        {
+            "num_gpus": 8,
+            "time_per_iteration": 120,
+            "future_rounds": 6,
+            "lambda": 5.0,
+            "k": 10.0,
+        },
+        backend="tpu",
+    )
+    small = bench.make_problem(num_jobs=6, future_rounds=6, num_gpus=8)
+    planner._solve(small)
+    assert calls == [], "sub-threshold problem took the sharded path"
+
+    big = bench.make_problem(num_jobs=32, future_rounds=6, num_gpus=8)
+    Y = planner._solve(big)
+    assert calls == [32], "fleet-scale problem bypassed the sharded path"
+    assert Y.shape == (32, 6)
+    big.audit_schedule(np.asarray(Y))
