@@ -41,7 +41,8 @@ from scripts.replicate.plot_scale_experiment import (  # noqa: E402
 )
 
 TIER_ORDER = [
-    "scale", "scale460", "scale900", "scale2048", "scale4096", "scale_tpu",
+    "scale", "scale460", "scale900", "scale2048", "scale4096",
+    "scale_tpu", "scale4096_tpu",
 ]
 TIER_LABEL = {
     "scale": "220 jobs, v100 oracle",
@@ -50,6 +51,7 @@ TIER_LABEL = {
     "scale2048": "2048 jobs, v100 oracle",
     "scale4096": "4096 jobs, v100 oracle",
     "scale_tpu": "220 jobs, measured TPU v5e oracle",
+    "scale4096_tpu": "4096 jobs, measured TPU v5e oracle",
 }
 # Secondary (non-color) encoding for the two policies that can run
 # coincident with the LAS line (water-filling reduces to LAS exactly on
