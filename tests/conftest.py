@@ -1,10 +1,10 @@
 """Test configuration.
 
 Tiers (all green serially; wall-clock tests flake under parallel load):
-  pytest -m "not slow"                             # unit tier, ~2 min
+  pytest -m "not slow"                             # unit tier, ~4 min
   pytest -m slow --ignore=tests/test_runtime.py \
-         --ignore=tests/test_multihost.py          # compile-heavy, ~4.5 min
-  pytest tests/test_runtime.py tests/test_multihost.py  # wall-clock, ~6 min
+         --ignore=tests/test_multihost.py          # compile-heavy, ~5.5 min
+  pytest tests/test_runtime.py tests/test_multihost.py  # wall-clock, ~7 min
 Run the wall-clock tier on an otherwise idle machine: its tests use real
 rounds/leases and training subprocesses (see the slow marks).
 
