@@ -189,3 +189,55 @@ def test_tpu_backend_auto_dispatches_to_sharded(monkeypatch):
     assert calls == [32], "fleet-scale problem bypassed the sharded path"
     assert Y.shape == (32, 6)
     big.audit_schedule(np.asarray(Y))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_degenerate_problems_match_single_device(seed):
+    """Randomized cross-check including the kernel's edge cases: gangs
+    wider than the cluster (never schedulable), fully-completed jobs
+    (zero remaining work), and FULLY duplicated rows — priorities
+    included, so their marginal densities genuinely tie and the
+    cross-shard tie split is exercised. Counts must stay bit-identical
+    to the single-device solve on every draw.
+
+    J is pinned to one padding class (slots=128) and future_rounds /
+    regularizer to two values each so the 6 seeds share compiled
+    executables instead of re-jitting per draw."""
+    rng = np.random.default_rng(100 + seed)
+    J = int(rng.integers(70, 128))
+    priorities = rng.uniform(0.1, 40.0, J)
+    total = rng.integers(1, 60, J).astype(float)
+    completed = np.floor(total * rng.uniform(0, 1.0, J))
+    # A slice of jobs is fully complete (no remaining work).
+    done = rng.random(J) < 0.15
+    completed[done] = total[done]
+    epoch_dur = rng.uniform(30, 3000, J)
+    nworkers = rng.choice(
+        [1, 2, 4, 8, 64], J, p=[0.5, 0.2, 0.15, 0.1, 0.05]
+    ).astype(float)
+    num_gpus = int(rng.integers(8, 48))  # some 64-wide gangs can't fit
+    # Duplicate a block of FULL rows (priorities too): identical rows
+    # have identical densities, forcing ties that straddle shards.
+    dup = int(rng.integers(0, J - 20))
+    block = slice(dup, dup + 10)
+    for arr in (priorities, total, completed, epoch_dur, nworkers):
+        arr[block] = arr[dup]
+    p = EGProblem(
+        priorities=priorities,
+        completed_epochs=completed,
+        total_epochs=total,
+        epoch_duration=epoch_dur,
+        remaining_runtime=(total - completed) * epoch_dur,
+        nworkers=nworkers,
+        num_gpus=num_gpus,
+        round_duration=120.0,
+        future_rounds=int(rng.choice([10, 20])),
+        regularizer=float(rng.choice([0.0, 10.0])),
+        log_bases=np.array([0.0, 0.2, 0.4, 0.6, 0.8, 1.0]),
+    )
+    c_ref, obj_ref = solve_level_counts(p)
+    c_sh, obj_sh = solve_level_sharded(p)
+    np.testing.assert_array_equal(c_ref, c_sh)
+    assert obj_sh == pytest.approx(obj_ref, rel=1e-5, abs=1e-6)
+    # Too-wide gangs never receive rounds.
+    assert not np.any(c_sh[p.nworkers > p.num_gpus] > 0)
