@@ -306,3 +306,64 @@ def test_orbax_checkpoint_roundtrip(tmp_path):
 
     # Training continued from the restored state: loss kept dropping.
     assert loss_of(out2) < loss_of(out1)
+
+
+def test_legacy_optax_orbax_checkpoint_migrates(tmp_path):
+    """The orbax flavor of the legacy migration: an orbax checkpoint
+    whose optimizer state is in the optax.adamw layout must restore
+    through the fallback template and repack into FusedAdamWState."""
+    pytest.importorskip("orbax.checkpoint")
+    import os
+    import shutil
+    import subprocess
+
+    import optax
+    import orbax.checkpoint as ocp
+
+    from shockwave_tpu.ops.fused_adamw import FusedAdamW
+
+    cmd = [
+        sys.executable, "-m", "shockwave_tpu.models.train",
+        "--model", "Recommendation", "--batch_size", "8", "-n", "2",
+        "--checkpoint_dir", str(tmp_path), "--ckpt_backend", "orbax",
+    ]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    out1 = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=180, env=env
+    )
+    assert out1.returncode == 0, out1.stderr[-2000:]
+
+    # Rewrite the orbax tree in the LEGACY optax layout.
+    orbax_dir = tmp_path / "orbax_state"
+    mesh = make_mesh((1, 1, 1), devices=jax.devices()[:1])
+    args = tiny_args(
+        "Recommendation", batch_size=8, checkpoint_dir=str(tmp_path)
+    )
+    variables, _, _, _ = build_family("Recommendation", args, mesh)
+    fused_template = FusedAdamW(args.learning_rate).init(variables)
+    checkpointer = ocp.StandardCheckpointer()
+    restored = checkpointer.restore(
+        str(orbax_dir), {"variables": variables, "opt": fused_template}
+    )
+    legacy = optax.adamw(args.learning_rate).init(restored["variables"])
+    legacy = (
+        legacy[0]._replace(
+            count=restored["opt"].count,
+            mu=restored["opt"].m,
+            nu=restored["opt"].v,
+        ),
+    ) + tuple(legacy[1:])
+    shutil.rmtree(orbax_dir)
+    checkpointer.save(
+        str(orbax_dir),
+        {"variables": restored["variables"], "opt": legacy},
+        force=True,
+    )
+    checkpointer.wait_until_finished()
+
+    # Resume from the legacy-layout orbax checkpoint: migrate, not crash.
+    out2 = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=180, env=env
+    )
+    assert out2.returncode == 0, out2.stderr[-2000:]
+    assert "steps=2" in out2.stdout
