@@ -456,7 +456,10 @@ def solve_level(
     w_sorted = jnp.where(d_ok, w_cell[order], 0.0)
     k_sorted = (order % R).astype(jnp.float32)
     j_sorted = order // R
-    inv_order = jnp.argsort(order)
+    # Inverse permutation by scatter: O(cells), vs a second O(n log n)
+    # argsort.
+    cells = jnp.arange(J * R)
+    inv_order = jnp.zeros_like(cells).at[order].set(cells)
 
     def eval_level(t):
         t_eff = jnp.maximum(t, floor)

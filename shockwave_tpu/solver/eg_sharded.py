@@ -138,9 +138,10 @@ def _build_sharded_solver(
         w_sorted = jnp.where(d_ok, w_cell[order], 0.0)
         k_sorted = (order % R).astype(jnp.float32)
         j_sorted = order // R
-        inv_order = jnp.argsort(order)
-        neg_d = -d_sorted  # ascending keys for searchsorted
         pos_arr = jnp.arange(n_cells)
+        # Inverse permutation by scatter: O(cells), vs a second sort.
+        inv_order = jnp.zeros_like(pos_arr).at[order].set(pos_arr)
+        neg_d = -d_sorted  # ascending keys for searchsorted
         shard = jax.lax.axis_index(ax)
 
         def bits_to_float(b):
