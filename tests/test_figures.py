@@ -1,0 +1,55 @@
+"""The evaluation-panel pipeline (scripts/analysis/figures.py) renders
+from committed summary.json files alone — smoke-tested here against a
+synthetic results tree so the committed panel stays reproducible."""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from scripts.analysis.figures import TIER_ORDER, load_tiers, plot  # noqa: E402
+
+
+def _fake_tier(path, sizes, policies):
+    os.makedirs(path, exist_ok=True)
+    results = {}
+    for s in sizes:
+        for i, p in enumerate(policies):
+            results[f"{p}_{s}gpus"] = {
+                "policy": p,
+                "num_gpus": str(s),
+                "makespan": 1000.0 * (i + 1),
+                "avg_jct": 100.0 * (i + 1),
+                "worst_ftf": 1.0 + i,
+                "unfair_fraction": 5.0 * i,
+                "utilization": 0.5,
+                "rounds": 10,
+                "sim_wall_clock_s": 1.0,
+            }
+    with open(os.path.join(path, "summary.json"), "w") as f:
+        json.dump({"trace": "fake.trace", "results": results}, f)
+
+
+def test_panel_renders_from_summaries(tmp_path):
+    _fake_tier(
+        str(tmp_path / "scale"), [64, 128],
+        ["max_min_fairness", "shockwave_tpu"],
+    )
+    _fake_tier(
+        str(tmp_path / "scale_tpu"), [32],
+        ["max_min_fairness", "finish_time_fairness", "shockwave_tpu"],
+    )
+    tiers = load_tiers(str(tmp_path))
+    # Only the tiers present are loaded, in TIER_ORDER order.
+    assert list(tiers) == ["scale", "scale_tpu"]
+    assert all(name in TIER_ORDER for name in tiers)
+    out = str(tmp_path / "panel.png")
+    plot(tiers, out)
+    assert os.path.exists(out)
+    assert os.path.getsize(out) > 10_000  # a real rendered image
+
+
+def test_missing_results_dir_loads_nothing(tmp_path):
+    assert load_tiers(str(tmp_path / "nope")) == {}
