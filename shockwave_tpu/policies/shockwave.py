@@ -389,6 +389,158 @@ class ShockwavePlanner:
         return Y
 
 
+class PoolSetPlanner:
+    """Heterogeneous Shockwave: one independent EG plan per worker-type
+    pool.
+
+    BEYOND REFERENCE: the reference's Shockwave plans a single
+    homogeneous pool and leaves every other worker type idle (reference:
+    scheduler/scheduler.py:991-1014 filters scheduling to the planned
+    pool). Here a mixed cluster gets one child :class:`ShockwavePlanner`
+    per worker type; each job is assigned to a pool at admission (by the
+    scheduler, which owns the throughput oracle) with its profile
+    durations rescaled to that pool's speed, and every pool plans —
+    and runs — its own jobs each round.
+
+    Exposes the same interface the scheduler drives a single planner
+    with, routing per-job calls through the job->pool map, plus
+    ``current_round_schedule_by_pool`` for pool-aware dispatch and
+    ``pool_of`` for per-pool progress accounting.
+    """
+
+    def __init__(self, config: dict, backend: str, pools: Dict[str, int]):
+        self.config = dict(config)
+        self.backend = backend
+        self.pools = dict(pools)
+        self.children: "OrderedDict[str, ShockwavePlanner]" = OrderedDict(
+            (wt, ShockwavePlanner({**config, "num_gpus": n}, backend=backend))
+            for wt, n in sorted(pools.items())
+        )
+        self.job_pool: Dict[object, str] = {}
+        # Cumulative admissions per pool (observability; the live load
+        # used for balancing is pool_incomplete_jobs).
+        self.assignments: Dict[str, int] = {wt: 0 for wt in self.children}
+
+    # -- scheduler-facing interface (same vocabulary as ShockwavePlanner)
+    def add_job(
+        self, job_id, profile: dict, round_len: float, scale_factor: int,
+        submit_time: Optional[float] = None, pool: Optional[str] = None,
+        duration_scale: float = 1.0,
+    ) -> None:
+        pool = pool if pool in self.children else next(iter(self.children))
+        if duration_scale != 1.0:
+            profile = dict(profile)
+            profile["duration_every_epoch"] = [
+                d * duration_scale for d in profile["duration_every_epoch"]
+            ]
+        self.job_pool[job_id] = pool
+        self.assignments[pool] = self.assignments.get(pool, 0) + 1
+        self.children[pool].add_job(
+            job_id, profile, round_len, scale_factor, submit_time
+        )
+
+    def pool_incomplete_jobs(self, pool: str) -> int:
+        """Live count of the pool's incomplete jobs (the fair-share
+        population the scheduler's assignment estimate divides by)."""
+        child = self.children.get(pool)
+        if child is None:
+            return 0
+        return sum(
+            1
+            for md in child.job_metadata.values()
+            if md.completed_epochs < md.total_epochs
+        )
+
+    def _child_of(self, job_id) -> Optional[ShockwavePlanner]:
+        pool = self.job_pool.get(job_id)
+        return self.children.get(pool) if pool is not None else None
+
+    def pool_of(self, job_id) -> Optional[str]:
+        return self.job_pool.get(job_id)
+
+    def remove_job(self, job_id) -> None:
+        child = self._child_of(job_id)
+        if child is not None:
+            child.remove_job(job_id)
+        self.job_pool.pop(job_id, None)
+
+    def record_round_throughput(self, job_id, round_id, throughput, bs) -> None:
+        child = self._child_of(job_id)
+        if child is not None:
+            child.record_round_throughput(job_id, round_id, throughput, bs)
+
+    def mark_complete(self, job_id) -> None:
+        child = self._child_of(job_id)
+        if child is not None:
+            child.mark_complete(job_id)
+
+    def set_progress(self, job_id, num_epochs: int) -> None:
+        child = self._child_of(job_id)
+        if child is not None:
+            child.set_progress(job_id, num_epochs)
+
+    def increment_round(self) -> None:
+        for child in self.children.values():
+            child.increment_round()
+
+    def set_recompute_flag(self) -> None:
+        for child in self.children.values():
+            child.set_recompute_flag()
+
+    @property
+    def num_jobs(self) -> int:
+        return sum(c.num_jobs for c in self.children.values())
+
+    @property
+    def solve_times(self) -> List[float]:
+        return [t for c in self.children.values() for t in c.solve_times]
+
+    def current_round_schedule_by_pool(self) -> "OrderedDict[str, list]":
+        return OrderedDict(
+            (wt, child.current_round_schedule())
+            for wt, child in self.children.items()
+        )
+
+    def current_round_schedule(self) -> list:
+        return [
+            j
+            for schedule in self.current_round_schedule_by_pool().values()
+            for j in schedule
+        ]
+
+    # -- serialization --------------------------------------------------
+    def state_dict(self) -> dict:
+        return {
+            "kind": "pool_set",
+            "config": dict(self.config),
+            "backend": self.backend,
+            "pools": dict(self.pools),
+            "children": OrderedDict(
+                (wt, c.state_dict()) for wt, c in self.children.items()
+            ),
+            "job_pool": dict(self.job_pool),
+            "assignments": dict(self.assignments),
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "PoolSetPlanner":
+        planner = cls(state["config"], state["backend"], state["pools"])
+        planner.children = OrderedDict(
+            (wt, ShockwavePlanner.from_state(cs))
+            for wt, cs in state["children"].items()
+        )
+        planner.job_pool = dict(state["job_pool"])
+        planner.assignments = dict(state.get("assignments", {}))
+        return planner
+
+
+def planner_from_state(state: dict):
+    """Restore whichever planner kind a checkpoint carries."""
+    if state.get("kind") == "pool_set":
+        return PoolSetPlanner.from_state(state)
+    return ShockwavePlanner.from_state(state)
+
+
 class ShockwavePolicy(Policy):
     """Marker policy selecting the Shockwave mechanism path in the
     scheduler; carries the planner factory."""
