@@ -772,10 +772,11 @@ class Scheduler:
         scheduler.py:991-1014). On the same mixed cluster (120-job
         trace, 8xv100+4xp100+4xk80) the upgrade takes makespan 46,021
         -> 35,980 s (−22%), avg JCT −31%, unfair fraction 79% -> 33%,
-        utilization 0.55 -> 0.81; worst-case FTF degrades (2.3 -> 6.8:
+        utilization 0.47 -> 0.81; worst-case FTF degrades (4.5 -> 6.8:
         slow-pool jobs are charged against fast-chip isolated
-        baselines). Opt-in so golden single-pool metrics stay stable by
-        default and the FTF tradeoff is the operator's choice."""
+        baselines). Artifact: results/hetero/shockwave_pools.json.
+        Opt-in so golden single-pool metrics stay stable by default and
+        the FTF tradeoff is the operator's choice."""
         from shockwave_tpu.policies.shockwave import (
             PoolSetPlanner,
             ShockwavePlanner,
