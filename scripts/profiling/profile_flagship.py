@@ -81,7 +81,10 @@ def main(argv=None):
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--seq_len", type=int, default=2048)
     parser.add_argument("--d_model", type=int, default=1024)
-    parser.add_argument("--num_heads", type=int, default=16)
+    # Default head dim = 128 (d_model 1024 / 8): fills the MXU on the
+    # attention matmuls; the committed round-3 profiles used 16 heads
+    # (head dim 64), superseded by the round-4 head-dim redesign.
+    parser.add_argument("--num_heads", type=int, default=8)
     parser.add_argument("--num_layers", type=int, default=8)
     parser.add_argument("--vocab_size", type=int, default=8192)
     parser.add_argument("-o", "--output", default=None)

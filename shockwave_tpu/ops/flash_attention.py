@@ -11,10 +11,21 @@ public flash/blockwise-attention literature re-derived for Pallas.
 
 Forward: one pallas_call, grid (batch*heads, q_blocks, k_blocks) with
 the k dimension innermost ("arbitrary" semantics) accumulating into
-VMEM scratch; causally-dead k blocks are skipped via pl.when. The
-kernel emits the per-row log-sum-exp (lse = m + log l) — a single
-stats array from which the backward recomputes probabilities exactly
-(p = exp(s - lse)).
+VMEM scratch; causally-dead k blocks are skipped via pl.when, and only
+diagonal-straddling blocks pay the iota/compare mask arithmetic (fully
+live blocks take an unmasked branch). A measured ablation (see
+results/flagship_profile_breakdown.md) shows the kernels are
+MXU-bound — the matmul-only variant costs 43 of 49 ms at S=32k, D=64 —
+so the elementwise trims here (mask split, scale fold, bf16 p for the
+PV matmul) shave only the ~12% softmax share; the lever that actually
+moves wall-clock is head dim 128, which fills the 128-wide systolic
+array on both attention matmuls (QK^T contracts over D; PV emits D
+output lanes) and measures 1.5x fwd / 2x bwd over D=64. The 1/sqrt(D)
+score scale is folded into q once outside the kernels, and the PV
+matmul takes p cast to the input dtype so it runs at the MXU's bf16
+rate with f32 accumulation. The kernel emits the per-row log-sum-exp
+(lse = m + log l) — a single stats array from which the backward
+recomputes probabilities exactly (p = exp(s - lse)).
 
 Backward: two Pallas kernels, mirroring the forward's blocking.
   * dk/dv: grid (batch*heads, k_blocks, q_blocks), q innermost;
@@ -27,13 +38,17 @@ HBM traffic, no [S, S] materialization — wired through jax.custom_vjp.
 delta = rowsum(dout * out) is computed outside the kernels (XLA fuses
 it) and passed in lane-replicated like lse.
 
-Block sizes default to min(1024, S): on a v5e at [128 x 2048 x 64]
-bfloat16 the 1024-wide forward runs 3.7x faster than 256-wide blocks
-(fewer grid steps; the per-block softmax state updates and mask VPU
-work amortize over more MXU FLOPs). At S <= 1024 the whole row of
-scores lives in one VMEM block and the kernel degenerates to a
-dense-in-VMEM attention that never spills scores to HBM — strictly
-less HBM traffic than the XLA dense path.
+Block sizes default to min(1024, S) for head dims up to 128, scaled
+down proportionally for wider heads (the dkv backward's score-sized
+VMEM temporaries plus the operand blocks overflow the 16 MiB scoped
+budget at D=256 x 1024-wide blocks; the cap in flash_attention also
+overrides explicitly passed block sizes). On a v5e at
+[128 x 2048 x 64] bfloat16 the 1024-wide forward runs 3.7x faster
+than 256-wide blocks (fewer grid steps; the per-block softmax state
+updates and mask VPU work amortize over more MXU FLOPs). At S <= 1024
+the whole row of scores lives in one VMEM block and the kernel
+degenerates to a dense-in-VMEM attention that never spills scores to
+HBM — strictly less HBM traffic than the XLA dense path.
 
 Off-TPU (CPU tests) the kernels run in interpret mode; numerics match
 the dense reference to float tolerance either way
@@ -76,9 +91,29 @@ def _causal_mask_val(qi, ki, block_q, block_k, s):
     return jnp.where(cols > rows, _NEG_INF, s)
 
 
+def _causal_block_split(qi, ki, block_q, block_k, accumulate):
+    """Emit the shared three-way causal classification of a score block
+    as pl.when branches: strictly below the diagonal (fully live — call
+    ``accumulate(masked=False)``, no mask arithmetic), straddling it
+    (``accumulate(masked=True)``), strictly above (dead — no branch
+    taken). All three kernels classify blocks identically; keeping the
+    predicates in one place is what guarantees the gradients see the
+    same live set as the forward."""
+    first_row, last_row = qi * block_q, qi * block_q + block_q - 1
+    last_col = ki * block_k + block_k - 1
+
+    @pl.when(last_col <= first_row)
+    def _full():
+        accumulate(masked=False)
+
+    @pl.when((last_col > first_row) & (ki * block_k <= last_row))
+    def _straddle():
+        accumulate(masked=True)
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref,
-    acc_ref, m_ref, l_ref, *, block_q, block_k, scale,
+    acc_ref, m_ref, l_ref, *, block_q, block_k,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -90,17 +125,16 @@ def _fwd_kernel(
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # Causal: k block strictly above the diagonal contributes nothing.
-    @pl.when(ki * block_k <= qi * block_q + block_q - 1)
-    def _body():
-        q = q_ref[0]  # [block_q, D]
+    def _accumulate(masked):
+        q = q_ref[0]  # [block_q, D], pre-scaled by the caller
         k = k_ref[0]  # [block_k, D]
         v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [block_q, block_k]
-        s = _causal_mask_val(qi, ki, block_q, block_k, s)
+        )  # [block_q, block_k]
+        if masked:
+            s = _causal_mask_val(qi, ki, block_q, block_k, s)
 
         m_prev = m_ref[:, :1]  # [block_q, 1]
         l_prev = l_ref[:, :1]
@@ -109,12 +143,17 @@ def _fwd_kernel(
         p = jnp.exp(s - m_new)  # [block_q, block_k]
         correction = jnp.exp(m_prev - m_new)
         l_new = l_prev * correction + jnp.sum(p, axis=1, keepdims=True)
+        # p in the input dtype so the PV matmul runs at the MXU's bf16
+        # rate (f32 accumulation via preferred_element_type); an f32 p
+        # here ran the whole matmul at the much slower f32 rate.
         acc_ref[...] = acc_ref[...] * correction + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    _causal_block_split(qi, ki, block_q, block_k, _accumulate)
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -128,10 +167,18 @@ def _fwd_kernel(
 def _flash_fwd_flat(q, k, v, block_q, block_k, interpret):
     """q/k/v: [BH, S, D] -> (out [BH, S, D], lse [BH, S, LANES])."""
     BH, S, D = q.shape
+    # Fold the 1/sqrt(D) score scale into q once (O(S*D)) instead of
+    # multiplying the S^2 score matrix inside the kernel. The multiply
+    # runs in f32; casting back to a bf16 q costs at most one extra
+    # half-ulp rounding (exact when the scale is a power of two, i.e.
+    # power-of-4 head dims; for D=128 it is not) — bounded by bf16's
+    # own representation error and covered by the D=128 bf16-vs-dense
+    # test in tests/test_flash_attention.py.
     scale = 1.0 / float(np.sqrt(D))
+    q = (q.astype(jnp.float32) * scale).astype(q.dtype)
     grid = (BH, S // block_q, S // block_k)
     kernel = functools.partial(
-        _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale
+        _fwd_kernel, block_q=block_q, block_k=block_k
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -164,7 +211,7 @@ def _flash_fwd_flat(q, k, v, block_q, block_k, interpret):
 
 def _dkv_kernel(
     q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-    dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_k, scale,
+    dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_k,
 ):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -175,10 +222,9 @@ def _dkv_kernel(
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    # q blocks strictly above the diagonal see none of this k block.
-    @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
-    def _body():
-        q = q_ref[0]  # [block_q, D]
+    def _accumulate(masked):
+        q = q_ref[0]  # [block_q, D], pre-scaled by the caller; so
+        # dk = ds^T @ q here IS the true scale * ds^T @ q_orig.
         k = k_ref[0]  # [block_k, D]
         v = v_ref[0]
         g = g_ref[0]  # dout block, [block_q, D]
@@ -187,8 +233,9 @@ def _dkv_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale
-        s = _causal_mask_val(qi, ki, block_q, block_k, s)
+        )
+        if masked:
+            s = _causal_mask_val(qi, ki, block_q, block_k, s)
         p = jnp.exp(s - lse)  # [block_q, block_k]; dead entries -> 0
         pt = p.astype(g.dtype)
         dv_acc[...] += jax.lax.dot_general(
@@ -199,11 +246,13 @@ def _dkv_kernel(
             g, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k]
-        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        ds = (p * (dp - delta)).astype(q.dtype)
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # ds^T @ q -> [block_k, D]
+
+    _causal_block_split(qi, ki, block_q, block_k, _accumulate)
 
     @pl.when(qi == nq - 1)
     def _finish():
@@ -223,9 +272,8 @@ def _dq_kernel(
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    @pl.when(ki * block_k <= qi * block_q + block_q - 1)
-    def _body():
-        q = q_ref[0]
+    def _accumulate(masked):
+        q = q_ref[0]  # pre-scaled by the caller (for the s recompute)
         k = k_ref[0]
         v = v_ref[0]
         g = g_ref[0]
@@ -234,28 +282,39 @@ def _dq_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale
-        s = _causal_mask_val(qi, ki, block_q, block_k, s)
+        )
+        if masked:
+            s = _causal_mask_val(qi, ki, block_q, block_k, s)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             g, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = (p * (dp - delta) * scale).astype(k.dtype)
+        ds = (p * (dp - delta)).astype(k.dtype)
         dq_acc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # ds @ k -> [block_q, D]
 
+    _causal_block_split(qi, ki, block_q, block_k, _accumulate)
+
     @pl.when(ki == nk - 1)
     def _finish():
-        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+        # The kernel accumulates ds @ k with the unscaled ds; the
+        # 1/sqrt(D) lands here once per q block instead of on every
+        # S^2 score element.
+        dq_ref[0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_flat(q, k, v, out, lse, g, block_q, block_k, interpret):
     """Pallas flash backward; O(S * D) HBM traffic per head."""
     BH, S, D = q.shape
     scale = 1.0 / float(np.sqrt(D))
+    # Same fold as the forward: q carries the score scale, so the
+    # kernels' s recompute needs no S^2 multiply, dk = ds^T @ q_scaled
+    # is already the true gradient, and dq picks the scale up once at
+    # its accumulator finish.
+    q = (q.astype(jnp.float32) * scale).astype(q.dtype)
     # delta = rowsum(dout * out), lane-replicated like lse; XLA fuses
     # the product-reduce-broadcast into one cheap pass.
     delta = jnp.sum(
@@ -280,7 +339,7 @@ def _flash_bwd_flat(q, k, v, out, lse, g, block_q, block_k, interpret):
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, block_q=block_q, block_k=block_k, scale=scale
+            _dkv_kernel, block_q=block_q, block_k=block_k
         ),
         grid=(BH, S // block_k, S // block_q),
         in_specs=[
@@ -384,8 +443,14 @@ def flash_attention(
     the dense path otherwise — see models/transformer.py).
     """
     B, S, H, D = q.shape
-    block_q = _resolve_block(block_q, S)
-    block_k = _resolve_block(block_k, S)
+    # VMEM-aware cap: the dkv backward holds ~4 [block_q, block_k] f32
+    # score-sized temporaries plus the operand blocks, which at D=256
+    # and 1024-wide blocks overflows the 16 MiB scoped-VMEM budget (by
+    # 36 KiB, measured on v5e). Scale the default block ceiling down
+    # with the head dim; D <= 128 keeps the measured-fastest 1024.
+    cap = max(_LANES, 1024 * 128 // max(D, 128))
+    block_q = _resolve_block(min(block_q, cap), S)
+    block_k = _resolve_block(min(block_k, cap), S)
 
     def flat(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)

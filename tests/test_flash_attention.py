@@ -172,3 +172,49 @@ def test_small_requested_block_steps_up_not_div0():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
     )
+
+
+def test_bf16_d128_matches_dense():
+    """D=128 (the MXU-matched head dim all flagship configs now use) in
+    bfloat16: the 1/sqrt(128) score scale is NOT a power of two, so the
+    q pre-scale fold costs one extra bf16 rounding — the output must
+    still track the dense reference within bf16 tolerance."""
+    rng = np.random.default_rng(7)
+    q, k, v = (x.astype(jnp.bfloat16) for x in _qkv(rng, 1, 256, 2, 128))
+    out = flash_attention(q, k, v, block_q=128, block_k=128)
+    ref = dense_causal_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32),
+        np.asarray(ref, dtype=np.float32),
+        rtol=0.0,
+        atol=0.04,
+    )
+
+
+def test_wide_head_dim_vmem_cap():
+    """D=256 scales the default (and any explicitly passed) block
+    ceiling down to 512 so the backward's score-sized VMEM temporaries
+    fit the 16 MiB scoped budget on real chips; numerics must be
+    unaffected, forward and grad."""
+    rng = np.random.default_rng(8)
+    q, k, v = _qkv(rng, 1, 1024, 1, 256)
+    # Explicit 1024 blocks would OOM VMEM on hardware; the cap must
+    # override them, not defer to the caller.
+    out = flash_attention(q, k, v, block_q=1024, block_k=1024)
+    ref = dense_causal_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
+    )
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_causal_attention(q, k, v) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_flash, g_dense):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gd), rtol=5e-4, atol=5e-4
+        )
