@@ -91,14 +91,19 @@ def _causal_mask_val(qi, ki, block_q, block_k, s):
     return jnp.where(cols > rows, _NEG_INF, s)
 
 
-def _causal_block_split(qi, ki, block_q, block_k, accumulate):
+def _causal_block_split(qi, ki, block_q, block_k, causal, accumulate):
     """Emit the shared three-way causal classification of a score block
     as pl.when branches: strictly below the diagonal (fully live — call
     ``accumulate(masked=False)``, no mask arithmetic), straddling it
     (``accumulate(masked=True)``), strictly above (dead — no branch
-    taken). All three kernels classify blocks identically; keeping the
-    predicates in one place is what guarantees the gradients see the
-    same live set as the forward."""
+    taken). With ``causal=False`` (ring-attention hops where the whole
+    K block is in the past) every block is fully live. All three
+    kernels classify blocks identically; keeping the predicates in one
+    place is what guarantees the gradients see the same live set as
+    the forward."""
+    if not causal:
+        accumulate(masked=False)
+        return
     first_row, last_row = qi * block_q, qi * block_q + block_q - 1
     last_col = ki * block_k + block_k - 1
 
@@ -113,7 +118,7 @@ def _causal_block_split(qi, ki, block_q, block_k, accumulate):
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref,
-    acc_ref, m_ref, l_ref, *, block_q, block_k,
+    acc_ref, m_ref, l_ref, *, block_q, block_k, causal,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -153,7 +158,7 @@ def _fwd_kernel(
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    _causal_block_split(qi, ki, block_q, block_k, _accumulate)
+    _causal_block_split(qi, ki, block_q, block_k, causal, _accumulate)
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -164,9 +169,14 @@ def _fwd_kernel(
         lse_ref[0] = m_ref[...] + jnp.log(l_ref[...] + 1e-30)
 
 
-def _flash_fwd_flat(q, k, v, block_q, block_k, interpret):
-    """q/k/v: [BH, S, D] -> (out [BH, S, D], lse [BH, S, LANES])."""
-    BH, S, D = q.shape
+def _flash_fwd_flat(q, k, v, block_q, block_k, causal, interpret):
+    """q: [BH, Sq, D], k/v: [BH, Sk, D] ->
+    (out [BH, Sq, D], lse [BH, Sq, LANES]). causal requires Sq == Sk
+    (positions are global block offsets); non-causal attends q to the
+    whole K/V sequence (a ring hop whose K block is entirely in the
+    past)."""
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
     # Fold the 1/sqrt(D) score scale into q once (O(S*D)) instead of
     # multiplying the S^2 score matrix inside the kernel. The multiply
     # runs in f32; casting back to a bf16 q costs at most one extra
@@ -176,9 +186,9 @@ def _flash_fwd_flat(q, k, v, block_q, block_k, interpret):
     # test in tests/test_flash_attention.py.
     scale = 1.0 / float(np.sqrt(D))
     q = (q.astype(jnp.float32) * scale).astype(q.dtype)
-    grid = (BH, S // block_q, S // block_k)
+    grid = (BH, Sq // block_q, Sk // block_k)
     kernel = functools.partial(
-        _fwd_kernel, block_q=block_q, block_k=block_k
+        _fwd_kernel, block_q=block_q, block_k=block_k, causal=causal
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -193,8 +203,8 @@ def _flash_fwd_flat(q, k, v, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, S, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Sq, _LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -211,7 +221,7 @@ def _flash_fwd_flat(q, k, v, block_q, block_k, interpret):
 
 def _dkv_kernel(
     q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-    dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_k,
+    dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_k, causal,
 ):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -252,7 +262,7 @@ def _dkv_kernel(
             preferred_element_type=jnp.float32,
         )  # ds^T @ q -> [block_k, D]
 
-    _causal_block_split(qi, ki, block_q, block_k, _accumulate)
+    _causal_block_split(qi, ki, block_q, block_k, causal, _accumulate)
 
     @pl.when(qi == nq - 1)
     def _finish():
@@ -262,7 +272,7 @@ def _dkv_kernel(
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-    dq_ref, dq_acc, *, block_q, block_k, scale,
+    dq_ref, dq_acc, *, block_q, block_k, scale, causal,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -296,7 +306,7 @@ def _dq_kernel(
             preferred_element_type=jnp.float32,
         )  # ds @ k -> [block_q, D]
 
-    _causal_block_split(qi, ki, block_q, block_k, _accumulate)
+    _causal_block_split(qi, ki, block_q, block_k, causal, _accumulate)
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -306,9 +316,17 @@ def _dq_kernel(
         dq_ref[0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
 
 
-def _flash_bwd_flat(q, k, v, out, lse, g, block_q, block_k, interpret):
-    """Pallas flash backward; O(S * D) HBM traffic per head."""
-    BH, S, D = q.shape
+def _flash_bwd_flat(
+    q, k, v, out, lse, g, block_q, block_k, causal, interpret,
+    g_lse=None,
+):
+    """Pallas flash backward; O(S * D) HBM traffic per head. g_lse is
+    the optional cotangent of the returned lse (ring-attention merges
+    differentiate through it): d s = p * (dp - delta + g_lse) row-wise,
+    so it folds into the existing delta input as delta - g_lse — the
+    kernels need no extra operand."""
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
     scale = 1.0 / float(np.sqrt(D))
     # Same fold as the forward: q carries the score scale, so the
     # kernels' s recompute needs no S^2 multiply, dk = ds^T @ q_scaled
@@ -320,7 +338,12 @@ def _flash_bwd_flat(q, k, v, out, lse, g, block_q, block_k, interpret):
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )
-    delta = jnp.broadcast_to(delta[..., None], (BH, S, _LANES))
+    if g_lse is not None:
+        # Sum over the replicated lane dim: however the caller consumed
+        # the lane-replicated lse, the total row cotangent is the lane
+        # sum (a [:, :, 0] slice scatters it all into lane 0).
+        delta = delta - jnp.sum(g_lse.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (BH, Sq, _LANES))
     # Cotangent in the input dtype: for bf16 models the p/ds matmul
     # operands are bf16 with f32 accumulation — standard flash practice,
     # a deliberate precision/bandwidth tradeoff vs keeping g in f32
@@ -339,16 +362,16 @@ def _flash_bwd_flat(q, k, v, out, lse, g, block_q, block_k, interpret):
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, block_q=block_q, block_k=block_k
+            _dkv_kernel, block_q=block_q, block_k=block_k, causal=causal
         ),
-        grid=(BH, S // block_k, S // block_q),
+        grid=(BH, Sk // block_k, Sq // block_q),
         in_specs=[
             qspec_kv, kspec_kv, kspec_kv, qspec_kv, sspec_kv, sspec_kv
         ],
         out_specs=[kspec_kv, kspec_kv],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -363,12 +386,13 @@ def _flash_bwd_flat(q, k, v, out, lse, g, block_q, block_k, interpret):
     kspec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, block_q=block_q, block_k=block_k, scale=scale
+            _dq_kernel, block_q=block_q, block_k=block_k, scale=scale,
+            causal=causal,
         ),
-        grid=(BH, S // block_q, S // block_k),
+        grid=(BH, Sq // block_q, Sk // block_k),
         in_specs=[qspec, kspec, kspec, qspec, sspec, sspec],
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
@@ -378,26 +402,38 @@ def _flash_bwd_flat(q, k, v, out, lse, g, block_q, block_k, interpret):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_flat(q, k, v, block_q, block_k, interpret):
-    out, _ = _flash_fwd_flat(q, k, v, block_q, block_k, interpret)
-    return out
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_flat_lse(q, k, v, block_q, block_k, causal, interpret):
+    return _flash_fwd_flat(q, k, v, block_q, block_k, causal, interpret)
 
 
-def _flash_flat_fwd(q, k, v, block_q, block_k, interpret):
-    out, lse = _flash_fwd_flat(q, k, v, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_flat_lse_fwd(q, k, v, block_q, block_k, causal, interpret):
+    out, lse = _flash_fwd_flat(
+        q, k, v, block_q, block_k, causal, interpret
+    )
+    return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_flat_bwd(block_q, block_k, interpret, res, g):
+def _flash_flat_lse_bwd(block_q, block_k, causal, interpret, res, gs):
     q, k, v, out, lse = res
+    g_out, g_lse = gs
     dq, dk, dv = _flash_bwd_flat(
-        q, k, v, out, lse, g, block_q, block_k, interpret
+        q, k, v, out, lse, g_out, block_q, block_k, causal, interpret,
+        g_lse=g_lse,
     )
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-_flash_flat.defvjp(_flash_flat_fwd, _flash_flat_bwd)
+_flash_flat_lse.defvjp(_flash_flat_lse_fwd, _flash_flat_lse_bwd)
+
+
+def _block_cap(head_dim: int) -> int:
+    """VMEM-aware block ceiling: the dkv backward holds ~4
+    [block_q, block_k] f32 score-sized temporaries plus the operand
+    blocks, which at D=256 and 1024-wide blocks overflows the 16 MiB
+    scoped-VMEM budget (by 36 KiB, measured on v5e). Scale the ceiling
+    down with the head dim; D <= 128 keeps the measured-fastest 1024."""
+    return max(_LANES, 1024 * 128 // max(head_dim, 128))
 
 
 def _resolve_block(requested: int, seq_len: int) -> int:
@@ -443,19 +479,59 @@ def flash_attention(
     the dense path otherwise — see models/transformer.py).
     """
     B, S, H, D = q.shape
-    # VMEM-aware cap: the dkv backward holds ~4 [block_q, block_k] f32
-    # score-sized temporaries plus the operand blocks, which at D=256
-    # and 1024-wide blocks overflows the 16 MiB scoped-VMEM budget (by
-    # 36 KiB, measured on v5e). Scale the default block ceiling down
-    # with the head dim; D <= 128 keeps the measured-fastest 1024.
-    cap = max(_LANES, 1024 * 128 // max(D, 128))
+    # The cap also overrides explicitly passed block sizes (VMEM
+    # correctness beats caller preference).
+    cap = _block_cap(D)
     block_q = _resolve_block(min(block_q, cap), S)
     block_k = _resolve_block(min(block_k, cap), S)
 
     def flat(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
 
-    out = _flash_flat(
-        flat(q), flat(k), flat(v), block_q, block_k, _use_interpret()
+    # Single custom_vjp shared with flash_attention_lse (the discarded
+    # lse's zero cotangent folds into the backward's delta for free) —
+    # one backward implementation to keep correct, not two.
+    out, _ = _flash_flat_lse(
+        flat(q), flat(k), flat(v), block_q, block_k, True,
+        _use_interpret(),
     )
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def flash_attention_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    causal: bool = True,
+) -> tuple:
+    """Flash attention returning (out [B, Sq, H, D], lse [B, H, Sq]).
+
+    The per-row log-sum-exp lets callers merge partial attention
+    results over disjoint key sets exactly (the ring-attention hop
+    merge: out_total = sum_i out_i * exp(lse_i - logaddexp_i lse_i)) —
+    gradients flow through both outputs. causal=False attends every
+    query to the whole K/V sequence (a ring hop whose keys are all in
+    the past); it is also the only mode where Sk may differ from Sq.
+    """
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    if causal and Sq != Sk:
+        raise ValueError(
+            f"causal flash needs matching q/k lengths, got {Sq} vs {Sk}"
+        )
+    cap = _block_cap(D)
+    block_q = _resolve_block(min(block_q, cap), Sq)
+    block_k = _resolve_block(min(block_k, cap), Sk)
+
+    def flat(x, s):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, s, D)
+
+    out, lse = _flash_flat_lse(
+        flat(q, Sq), flat(k, Sk), flat(v, Sk), block_q, block_k, causal,
+        _use_interpret(),
+    )
+    out = out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+    lse = lse[:, :, 0].reshape(B, H, Sq)
+    return out, lse

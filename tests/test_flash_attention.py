@@ -218,3 +218,73 @@ def test_wide_head_dim_vmem_cap():
         np.testing.assert_allclose(
             np.asarray(gf), np.asarray(gd), rtol=5e-4, atol=5e-4
         )
+
+
+def test_lse_output_matches_dense_logsumexp():
+    """flash_attention_lse: out must equal the out-only path and lse the
+    dense per-row logsumexp of the scaled causal scores."""
+    from shockwave_tpu.ops.flash_attention import flash_attention_lse
+
+    rng = np.random.default_rng(9)
+    B, S, H, D = 2, 128, 2, 16
+    q, k, v = _qkv(rng, B, S, H, D)
+    out, lse = flash_attention_lse(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(flash_attention(q, k, v)),
+        rtol=0, atol=0,
+    )
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    mask = jnp.where(
+        jnp.arange(S)[None, :] > jnp.arange(S)[:, None], -jnp.inf, 0.0
+    )
+    ref = jax.scipy.special.logsumexp(scores + mask[None, None], axis=-1)
+    np.testing.assert_allclose(
+        np.asarray(lse), np.asarray(ref), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_noncausal_cross_length_and_lse_grad():
+    """causal=False with Sk != Sq (the ring-hop shape) must match the
+    dense full-attention reference — forward, and gradients through a
+    loss that consumes BOTH outputs (the lse cotangent folds into the
+    kernels' delta input)."""
+    from shockwave_tpu.ops.flash_attention import flash_attention_lse
+
+    rng = np.random.default_rng(10)
+    B, H, D = 1, 2, 16
+    Sq, Sk = 128, 256
+    q, _, _ = _qkv(rng, B, Sq, H, D)
+    _, k, v = _qkv(rng, B, Sk, H, D)
+
+    def dense_ref(q, k, v):
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+        out = jnp.einsum(
+            "bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1), v
+        )
+        lse = jax.scipy.special.logsumexp(scores, axis=-1)
+        return out, lse
+
+    out, lse = flash_attention_lse(q, k, v, causal=False)
+    ref_out, ref_lse = dense_ref(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref_out), rtol=2e-4, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(lse), np.asarray(ref_lse), rtol=2e-4, atol=2e-5
+    )
+
+    def loss(fn):
+        def go(q, k, v):
+            out, lse = fn(q, k, v)
+            return jnp.sum(out**2) + jnp.sum(jnp.sin(lse))
+        return go
+
+    g_flash = jax.grad(
+        loss(lambda q, k, v: flash_attention_lse(q, k, v, causal=False)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_dense = jax.grad(loss(dense_ref), argnums=(0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_flash, g_dense):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gd), rtol=5e-4, atol=5e-4
+        )
