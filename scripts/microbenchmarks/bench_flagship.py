@@ -49,7 +49,7 @@ def _enable_compile_cache():
 
 
 def build_step(seq_len, batch, dtype, attention, d_model, num_heads,
-               num_layers, vocab_size, remat=False):
+               num_layers, vocab_size, remat=False, window=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -72,6 +72,7 @@ def build_step(seq_len, batch, dtype, attention, d_model, num_heads,
         max_len=seq_len,
         dtype=dtype,
         attention=attention,
+        attention_window=window,
         remat=remat,
     )
     model = TransformerLM(cfg, mesh=mesh)
@@ -123,12 +124,24 @@ def measure(run, min_slope_s=1.0, start_n=4, max_n=4096):
         n *= 4
 
 
-def step_flops(params, batch, seq_len, d_model, num_layers):
-    """Approximate train-step model FLOPs: 6*N per token for the matmul
-    params (fwd+bwd, tied head included in N) + 12*S*d per token for
-    attention scores/values."""
+def step_flops(params, batch, seq_len, d_model, num_layers,
+               window=None):
+    """Approximate train-step model FLOPs: 6*N per token for the
+    MATMUL params + 12*S*d per token for attention scores/values (the
+    standard full-S convention). N excludes the learned positional
+    embedding table (seq_len x d_model, a pure lookup): at long
+    context that table dominates the raw parameter count (134M of
+    243M at S=131k) and crediting it 6 FLOPs/param inflated MFU by up
+    to 1.7x. The tied token embedding stays in N — its matrix does
+    real matmul work in the output head. With a sliding window each
+    token sees at most `window` keys, so the attention term uses
+    min(S, window) — otherwise windowed runs would be credited
+    quadratic FLOPs they never compute and "MFU" would exceed 1."""
     tokens = batch * seq_len
-    return 6 * params * tokens + 12 * num_layers * seq_len * d_model * tokens
+    matmul_params = params - seq_len * d_model
+    span = seq_len if window is None else min(seq_len, window)
+    return (6 * matmul_params * tokens
+            + 12 * num_layers * span * d_model * tokens)
 
 
 def main(argv=None):
@@ -145,6 +158,8 @@ def main(argv=None):
     parser.add_argument("--attentions", type=str, nargs="+",
                         default=["dense", "flash"])
     parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--window", type=int, default=None,
+                        help="sliding attention window (flash only)")
     parser.add_argument("-o", "--output", type=str, default=None)
     args = parser.parse_args(argv)
 
@@ -166,6 +181,7 @@ def main(argv=None):
             "num_layers": args.num_layers,
             "vocab_size": args.vocab_size,
             "remat": args.remat,
+            "window": args.window,
         },
         "runs": [],
     }
@@ -192,6 +208,7 @@ def main(argv=None):
                             seq_len, batch, dtype, attention, args.d_model,
                             args.num_heads, args.num_layers,
                             args.vocab_size, remat=args.remat,
+                            window=args.window,
                         )
                         rate = measure(run)
                         last_err = None
@@ -229,7 +246,8 @@ def main(argv=None):
                     print(json.dumps(row))
                     continue
                 flops = step_flops(
-                    params, batch, seq_len, args.d_model, args.num_layers
+                    params, batch, seq_len, args.d_model, args.num_layers,
+                    window=args.window,
                 )
                 row = {
                     "seq_len": seq_len,

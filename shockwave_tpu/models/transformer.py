@@ -44,6 +44,11 @@ class TransformerConfig:
     max_len: int = 512
     dtype: str = "float32"  # bfloat16 on real chips
     attention: str = "dense"  # "dense" | "ring" | "ulysses" | "flash"
+    # Sliding window for the "flash" path (None = full causal): each
+    # token attends its `attention_window` most recent positions, and
+    # the kernel's compute + K/V DMA become O(S * window) — linear
+    # long-context cost at a fixed window.
+    attention_window: Optional[int] = None
     num_experts: int = 0  # 0 = dense MLP; >0 = MoE over "model"
     # Rematerialize each block in the backward pass (jax.checkpoint):
     # activations are recomputed instead of stored, trading ~1/3 more
@@ -84,6 +89,14 @@ class Attention(nn.Module):
             return y.reshape(x.shape[:-1] + qkv_shape)
 
         q, k, v = proj("query"), proj("key"), proj("value")
+        if cfg.attention_window is not None and cfg.attention != "flash":
+            # Only the flash kernels implement the window; training
+            # quadratically while the config promises a window would be
+            # a silent semantics change.
+            raise ValueError(
+                "attention_window is only supported by attention='flash'"
+                f", got {cfg.attention!r}"
+            )
         if cfg.attention == "ring":
             if self.mesh is None:
                 raise ValueError("ring attention requires a mesh")
@@ -114,8 +127,14 @@ class Attention(nn.Module):
             # TPU tiling needs full kernel blocks; anything shorter or
             # non-aligned takes the dense path.
             if flash_tiles(x.shape[1]):
-                out = flash_attention(q, k, v)
+                out = flash_attention(q, k, v,
+                                      window=cfg.attention_window)
             else:
+                if cfg.attention_window is not None:
+                    raise ValueError(
+                        "attention_window needs a flash-tiling sequence "
+                        f"(multiple of 128), got {x.shape[1]}"
+                    )
                 out = dense_causal_attention(q, k, v)
         else:
             out = dense_causal_attention(q, k, v)

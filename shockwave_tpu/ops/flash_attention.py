@@ -80,24 +80,36 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _causal_mask_val(qi, ki, block_q, block_k, s):
-    """Mask the causally-dead upper-triangle entries of a score block."""
+def _causal_mask_val(qi, ki, block_q, block_k, s, window=None):
+    """Mask the causally-dead upper-triangle entries of a score block;
+    with ``window`` also the entries more than window-1 positions in
+    the past (row r attends cols (r-window, r])."""
     rows = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
     cols = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1
     )
-    return jnp.where(cols > rows, _NEG_INF, s)
+    dead = cols > rows
+    if window is not None:
+        dead = dead | (cols < rows - (window - 1))
+    return jnp.where(dead, _NEG_INF, s)
 
 
-def _causal_block_split(qi, ki, block_q, block_k, causal, accumulate):
-    """Emit the shared three-way causal classification of a score block
-    as pl.when branches: strictly below the diagonal (fully live — call
-    ``accumulate(masked=False)``, no mask arithmetic), straddling it
-    (``accumulate(masked=True)``), strictly above (dead — no branch
-    taken). With ``causal=False`` (ring-attention hops where the whole
-    K block is in the past) every block is fully live. All three
+def _causal_block_split(
+    qi, ki, block_q, block_k, causal, accumulate,
+    window=None, in_bounds=None,
+):
+    """Emit the shared three-way classification of a score block as
+    pl.when branches: fully live (call ``accumulate(masked=False)``, no
+    mask arithmetic), straddling a boundary (``accumulate(masked=True)``),
+    dead (no branch taken). Boundaries: the causal diagonal, and — when
+    ``window`` is set — the trailing window edge (row r attends cols
+    (r-window, r]). ``in_bounds`` ANDs in a validity predicate for
+    windowed grids whose shrunk index range can step outside the array
+    (the caller's index map clamps the DMA; the block must still be
+    skipped). With ``causal=False`` (ring-attention hops where the
+    whole K block is in the past) every block is fully live. All three
     kernels classify blocks identically; keeping the predicates in one
     place is what guarantees the gradients see the same live set as
     the forward."""
@@ -105,26 +117,42 @@ def _causal_block_split(qi, ki, block_q, block_k, causal, accumulate):
         accumulate(masked=False)
         return
     first_row, last_row = qi * block_q, qi * block_q + block_q - 1
+    first_col = ki * block_k
     last_col = ki * block_k + block_k - 1
 
-    @pl.when(last_col <= first_row)
+    full = last_col <= first_row
+    live = first_col <= last_row
+    if window is not None:
+        full = full & (first_col >= last_row - (window - 1))
+        live = live & (last_col >= first_row - (window - 1))
+    if in_bounds is not None:
+        full = full & in_bounds
+        live = live & in_bounds
+
+    @pl.when(full)
     def _full():
         accumulate(masked=False)
 
-    @pl.when((last_col > first_row) & (ki * block_k <= last_row))
+    @pl.when(live & jnp.logical_not(full))
     def _straddle():
         accumulate(masked=True)
 
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref,
-    acc_ref, m_ref, l_ref, *, block_q, block_k, causal,
+    acc_ref, m_ref, l_ref, *, block_q, block_k, causal, window,
 ):
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
+    j = pl.program_id(2)
     nk = pl.num_programs(2)
+    in_bounds = None
+    if window is None:
+        ki = j
+    else:
+        ki = _window_k_start(qi, block_q, block_k, nk, j)
+        in_bounds = ki >= 0
 
-    @pl.when(ki == 0)
+    @pl.when(j == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
@@ -139,7 +167,7 @@ def _fwd_kernel(
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k]
         if masked:
-            s = _causal_mask_val(qi, ki, block_q, block_k, s)
+            s = _causal_mask_val(qi, ki, block_q, block_k, s, window)
 
         m_prev = m_ref[:, :1]  # [block_q, 1]
         l_prev = l_ref[:, :1]
@@ -158,9 +186,10 @@ def _fwd_kernel(
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    _causal_block_split(qi, ki, block_q, block_k, causal, _accumulate)
+    _causal_block_split(qi, ki, block_q, block_k, causal, _accumulate,
+                        window=window, in_bounds=in_bounds)
 
-    @pl.when(ki == nk - 1)
+    @pl.when(j == nk - 1)
     def _finish():
         l = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
@@ -169,12 +198,39 @@ def _fwd_kernel(
         lse_ref[0] = m_ref[...] + jnp.log(l_ref[...] + 1e-30)
 
 
-def _flash_fwd_flat(q, k, v, block_q, block_k, causal, interpret):
+def _window_k_start(qi, block_q, block_k, n_j, j):
+    """Absolute k block visited at step j of the shrunk k walk for q
+    block qi: the walk's last step (j = n_j - 1) lands on the causal
+    diagonal block, earlier steps walk back through the window. May be
+    negative — kernels classify those dead; index maps clamp the DMA.
+    Used by BOTH the kernels and their BlockSpec index maps: the block
+    a kernel classifies must be the block its map fetched."""
+    return (qi * block_q + block_q - 1) // block_k - (n_j - 1) + j
+
+
+def _window_q_start(ki, block_q, block_k, j):
+    """Absolute q block visited at step j of the shrunk q walk for k
+    block ki: starts at the block containing the diagonal and walks
+    forward through the window's reach. May run past the sequence —
+    kernels classify those dead; index maps clamp the DMA."""
+    return (ki * block_k) // block_q + j
+
+
+def _window_blocks(window, block_a, block_b, n_b):
+    """Number of block_b-sized blocks a shrunk windowed grid must walk
+    per block_a-sized outer block: the span block_a + window - 1 plus
+    one block of alignment slop, clamped to the full range."""
+    return min(n_b, (block_a + window - 2) // block_b + 2)
+
+
+def _flash_fwd_flat(q, k, v, block_q, block_k, causal, window, interpret):
     """q: [BH, Sq, D], k/v: [BH, Sk, D] ->
     (out [BH, Sq, D], lse [BH, Sq, LANES]). causal requires Sq == Sk
     (positions are global block offsets); non-causal attends q to the
     whole K/V sequence (a ring hop whose K block is entirely in the
-    past)."""
+    past). ``window`` (causal only) shrinks the k grid to the blocks
+    the sliding window can reach — O(S * window) compute AND block DMA
+    (a pl.when skip alone would still fetch every K/V block)."""
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     # Fold the 1/sqrt(D) score scale into q once (O(S*D)) instead of
@@ -186,17 +242,29 @@ def _flash_fwd_flat(q, k, v, block_q, block_k, causal, interpret):
     # test in tests/test_flash_attention.py.
     scale = 1.0 / float(np.sqrt(D))
     q = (q.astype(jnp.float32) * scale).astype(q.dtype)
-    grid = (BH, Sq // block_q, Sk // block_k)
+    nk = Sk // block_k
+    if window is None:
+        nj = nk
+        kmap = lambda b, i, j: (b, j, 0)  # noqa: E731
+    else:
+        nj = _window_blocks(window, block_q, block_k, nk)
+
+        def kmap(b, i, j):
+            ki = _window_k_start(i, block_q, block_k, nj, j)
+            return (b, jnp.clip(ki, 0, nk - 1), 0)
+
+    grid = (BH, Sq // block_q, nj)
     kernel = functools.partial(
-        _fwd_kernel, block_q=block_q, block_k=block_k, causal=causal
+        _fwd_kernel, block_q=block_q, block_k=block_k, causal=causal,
+        window=window,
     )
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), kmap),
+            pl.BlockSpec((1, block_k, D), kmap),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -222,12 +290,19 @@ def _flash_fwd_flat(q, k, v, block_q, block_k, causal, interpret):
 def _dkv_kernel(
     q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_k, causal,
+    window, num_q_blocks,
 ):
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    j = pl.program_id(2)
     nq = pl.num_programs(2)
+    in_bounds = None
+    if window is None:
+        qi = j
+    else:
+        qi = _window_q_start(ki, block_q, block_k, j)
+        in_bounds = qi <= num_q_blocks - 1
 
-    @pl.when(qi == 0)
+    @pl.when(j == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
@@ -245,7 +320,7 @@ def _dkv_kernel(
             preferred_element_type=jnp.float32,
         )
         if masked:
-            s = _causal_mask_val(qi, ki, block_q, block_k, s)
+            s = _causal_mask_val(qi, ki, block_q, block_k, s, window)
         p = jnp.exp(s - lse)  # [block_q, block_k]; dead entries -> 0
         pt = p.astype(g.dtype)
         dv_acc[...] += jax.lax.dot_general(
@@ -262,9 +337,10 @@ def _dkv_kernel(
             preferred_element_type=jnp.float32,
         )  # ds^T @ q -> [block_k, D]
 
-    _causal_block_split(qi, ki, block_q, block_k, causal, _accumulate)
+    _causal_block_split(qi, ki, block_q, block_k, causal, _accumulate,
+                        window=window, in_bounds=in_bounds)
 
-    @pl.when(qi == nq - 1)
+    @pl.when(j == nq - 1)
     def _finish():
         dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
@@ -272,13 +348,19 @@ def _dkv_kernel(
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-    dq_ref, dq_acc, *, block_q, block_k, scale, causal,
+    dq_ref, dq_acc, *, block_q, block_k, scale, causal, window,
 ):
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
+    j = pl.program_id(2)
     nk = pl.num_programs(2)
+    in_bounds = None
+    if window is None:
+        ki = j
+    else:
+        ki = _window_k_start(qi, block_q, block_k, nk, j)
+        in_bounds = ki >= 0
 
-    @pl.when(ki == 0)
+    @pl.when(j == 0)
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
@@ -294,7 +376,7 @@ def _dq_kernel(
             preferred_element_type=jnp.float32,
         )
         if masked:
-            s = _causal_mask_val(qi, ki, block_q, block_k, s)
+            s = _causal_mask_val(qi, ki, block_q, block_k, s, window)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             g, v, (((1,), (1,)), ((), ())),
@@ -306,9 +388,10 @@ def _dq_kernel(
             preferred_element_type=jnp.float32,
         )  # ds @ k -> [block_q, D]
 
-    _causal_block_split(qi, ki, block_q, block_k, causal, _accumulate)
+    _causal_block_split(qi, ki, block_q, block_k, causal, _accumulate,
+                        window=window, in_bounds=in_bounds)
 
-    @pl.when(ki == nk - 1)
+    @pl.when(j == nk - 1)
     def _finish():
         # The kernel accumulates ds @ k with the unscaled ds; the
         # 1/sqrt(D) lands here once per q block instead of on every
@@ -317,7 +400,7 @@ def _dq_kernel(
 
 
 def _flash_bwd_flat(
-    q, k, v, out, lse, g, block_q, block_k, causal, interpret,
+    q, k, v, out, lse, g, block_q, block_k, causal, window, interpret,
     g_lse=None,
 ):
     """Pallas flash backward; O(S * D) HBM traffic per head. g_lse is
@@ -350,21 +433,34 @@ def _flash_bwd_flat(
     # (guarded by test_bf16_gradients_match_dense).
     g = g.astype(q.dtype)
 
+    nq = Sq // block_q
+    nk = Sk // block_k
     qspec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
     sspec = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
     # dkv grid: k outer, q inner -> q-indexed blocks vary with the
-    # *inner* index j, k-indexed with the outer i.
-    qspec_kv = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, j, 0))
-    sspec_kv = pl.BlockSpec(
-        (1, block_q, _LANES), lambda b, i, j: (b, j, 0)
-    )
+    # *inner* index j, k-indexed with the outer i. Windowed grids walk
+    # only the q blocks whose window reaches the k block (same shrink
+    # as the forward's k walk; index maps clamp, in_bounds skips).
+    if window is None:
+        njq = nq
+        qmap_kv = lambda b, i, j: (b, j, 0)  # noqa: E731
+    else:
+        njq = _window_blocks(window, block_k, block_q, nq)
+
+        def qmap_kv(b, i, j):
+            qi = _window_q_start(i, block_q, block_k, j)
+            return (b, jnp.clip(qi, 0, nq - 1), 0)
+
+    qspec_kv = pl.BlockSpec((1, block_q, D), qmap_kv)
+    sspec_kv = pl.BlockSpec((1, block_q, _LANES), qmap_kv)
     kspec_kv = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0))
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, block_q=block_q, block_k=block_k, causal=causal
+            _dkv_kernel, block_q=block_q, block_k=block_k, causal=causal,
+            window=window, num_q_blocks=nq,
         ),
-        grid=(BH, Sk // block_k, Sq // block_q),
+        grid=(BH, nk, njq),
         in_specs=[
             qspec_kv, kspec_kv, kspec_kv, qspec_kv, sspec_kv, sspec_kv
         ],
@@ -383,13 +479,23 @@ def _flash_bwd_flat(
         interpret=interpret,
     )(q, k, v, g, lse, delta)
 
-    kspec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
+    if window is None:
+        njk = nk
+        kmap = lambda b, i, j: (b, j, 0)  # noqa: E731
+    else:
+        njk = _window_blocks(window, block_q, block_k, nk)
+
+        def kmap(b, i, j):
+            ki = _window_k_start(i, block_q, block_k, njk, j)
+            return (b, jnp.clip(ki, 0, nk - 1), 0)
+
+    kspec = pl.BlockSpec((1, block_k, D), kmap)
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, block_q=block_q, block_k=block_k, scale=scale,
-            causal=causal,
+            causal=causal, window=window,
         ),
-        grid=(BH, Sq // block_q, Sk // block_k),
+        grid=(BH, Sq // block_q, njk),
         in_specs=[qspec, kspec, kspec, qspec, sspec, sspec],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
@@ -402,24 +508,28 @@ def _flash_bwd_flat(
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_flat_lse(q, k, v, block_q, block_k, causal, interpret):
-    return _flash_fwd_flat(q, k, v, block_q, block_k, causal, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_flat_lse(q, k, v, block_q, block_k, causal, window, interpret):
+    return _flash_fwd_flat(
+        q, k, v, block_q, block_k, causal, window, interpret
+    )
 
 
-def _flash_flat_lse_fwd(q, k, v, block_q, block_k, causal, interpret):
+def _flash_flat_lse_fwd(q, k, v, block_q, block_k, causal, window,
+                        interpret):
     out, lse = _flash_fwd_flat(
-        q, k, v, block_q, block_k, causal, interpret
+        q, k, v, block_q, block_k, causal, window, interpret
     )
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_flat_lse_bwd(block_q, block_k, causal, interpret, res, gs):
+def _flash_flat_lse_bwd(block_q, block_k, causal, window, interpret,
+                        res, gs):
     q, k, v, out, lse = res
     g_out, g_lse = gs
     dq, dk, dv = _flash_bwd_flat(
-        q, k, v, out, lse, g_out, block_q, block_k, causal, interpret,
-        g_lse=g_lse,
+        q, k, v, out, lse, g_out, block_q, block_k, causal, window,
+        interpret, g_lse=g_lse,
     )
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
@@ -470,6 +580,7 @@ def flash_attention(
     v: jnp.ndarray,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    window: int | None = None,
 ) -> jnp.ndarray:
     """Causal flash attention; [B, S, H, D] in and out, differentiable.
 
@@ -477,6 +588,12 @@ def flash_attention(
     :func:`shockwave_tpu.parallel.ring_attention.dense_causal_attention`.
     Sequence length must divide by the block sizes (callers fall back to
     the dense path otherwise — see models/transformer.py).
+
+    ``window`` restricts each token to its ``window`` most recent
+    positions (itself included — Mistral-style sliding-window
+    attention). The kernels walk a shrunk k grid, so compute and K/V
+    block DMA are O(S * window) instead of O(S^2): long-context cost
+    becomes linear in S at fixed window.
     """
     B, S, H, D = q.shape
     # The cap also overrides explicitly passed block sizes (VMEM
@@ -484,6 +601,7 @@ def flash_attention(
     cap = _block_cap(D)
     block_q = _resolve_block(min(block_q, cap), S)
     block_k = _resolve_block(min(block_k, cap), S)
+    window = _resolve_window(window, S)
 
     def flat(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
@@ -492,10 +610,21 @@ def flash_attention(
     # lse's zero cotangent folds into the backward's delta for free) —
     # one backward implementation to keep correct, not two.
     out, _ = _flash_flat_lse(
-        flat(q), flat(k), flat(v), block_q, block_k, True,
+        flat(q), flat(k), flat(v), block_q, block_k, True, window,
         _use_interpret(),
     )
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def _resolve_window(window, seq_len):
+    """Validate the sliding window; a window covering the whole
+    sequence is plain causal attention (and cheaper without the
+    shrunk-grid indexing)."""
+    if window is None:
+        return None
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    return None if window >= seq_len else int(window)
 
 
 def flash_attention_lse(
@@ -505,6 +634,7 @@ def flash_attention_lse(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     causal: bool = True,
+    window: int | None = None,
 ) -> tuple:
     """Flash attention returning (out [B, Sq, H, D], lse [B, H, Sq]).
 
@@ -514,6 +644,7 @@ def flash_attention_lse(
     gradients flow through both outputs. causal=False attends every
     query to the whole K/V sequence (a ring hop whose keys are all in
     the past); it is also the only mode where Sk may differ from Sq.
+    ``window`` as in :func:`flash_attention` (causal only).
     """
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
@@ -521,16 +652,19 @@ def flash_attention_lse(
         raise ValueError(
             f"causal flash needs matching q/k lengths, got {Sq} vs {Sk}"
         )
+    if window is not None and not causal:
+        raise ValueError("window requires causal attention")
     cap = _block_cap(D)
     block_q = _resolve_block(min(block_q, cap), Sq)
     block_k = _resolve_block(min(block_k, cap), Sk)
+    window = _resolve_window(window, Sq)
 
     def flat(x, s):
         return x.transpose(0, 2, 1, 3).reshape(B * H, s, D)
 
     out, lse = _flash_flat_lse(
         flat(q, Sq), flat(k, Sk), flat(v, Sk), block_q, block_k, causal,
-        _use_interpret(),
+        window, _use_interpret(),
     )
     out = out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
     lse = lse[:, :, 0].reshape(B, H, Sq)
