@@ -49,7 +49,8 @@ def _enable_compile_cache():
 
 
 def build_step(seq_len, batch, dtype, attention, d_model, num_heads,
-               num_layers, vocab_size, remat=False, window=None):
+               num_layers, vocab_size, remat=False, window=None,
+               num_kv_heads=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -73,6 +74,7 @@ def build_step(seq_len, batch, dtype, attention, d_model, num_heads,
         dtype=dtype,
         attention=attention,
         attention_window=window,
+        num_kv_heads=num_kv_heads,
         remat=remat,
     )
     model = TransformerLM(cfg, mesh=mesh)
@@ -160,6 +162,8 @@ def main(argv=None):
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--window", type=int, default=None,
                         help="sliding attention window (flash only)")
+    parser.add_argument("--num_kv_heads", type=int, default=None,
+                        help="grouped-query attention KV head count")
     parser.add_argument("-o", "--output", type=str, default=None)
     args = parser.parse_args(argv)
 
@@ -182,6 +186,7 @@ def main(argv=None):
             "vocab_size": args.vocab_size,
             "remat": args.remat,
             "window": args.window,
+            "num_kv_heads": args.num_kv_heads,
         },
         "runs": [],
     }
@@ -209,6 +214,7 @@ def main(argv=None):
                             args.num_heads, args.num_layers,
                             args.vocab_size, remat=args.remat,
                             window=args.window,
+                            num_kv_heads=args.num_kv_heads,
                         )
                         rate = measure(run)
                         last_err = None

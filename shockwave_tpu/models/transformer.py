@@ -49,6 +49,12 @@ class TransformerConfig:
     # the kernel's compute + K/V DMA become O(S * window) — linear
     # long-context cost at a fixed window.
     attention_window: Optional[int] = None
+    # Grouped-query attention (None = num_heads, i.e. plain MHA): K/V
+    # projections emit this many heads, shared across query-head groups
+    # of size num_heads // num_kv_heads. Cuts KV projection params and
+    # FLOPs by the group factor; the flash kernels resolve the sharing
+    # in their index maps (dense repeats KV; ring/ulysses reject it).
+    num_kv_heads: Optional[int] = None
     num_experts: int = 0  # 0 = dense MLP; >0 = MoE over "model"
     # Rematerialize each block in the backward pass (jax.checkpoint):
     # activations are recomputed instead of stored, trading ~1/3 more
@@ -81,14 +87,38 @@ class Attention(nn.Module):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         head_dim = cfg.d_model // cfg.num_heads
-        # QKV projections: heads sharded over "model" (tensor parallelism).
-        qkv_shape = (cfg.num_heads, head_dim)
+        kv_heads = (cfg.num_heads if cfg.num_kv_heads is None
+                    else cfg.num_kv_heads)
+        if kv_heads < 1 or cfg.num_heads % kv_heads:
+            raise ValueError(
+                f"num_kv_heads ({kv_heads}) must be >= 1 and divide "
+                f"num_heads ({cfg.num_heads})"
+            )
 
-        def proj(name):
-            y = _dense(cfg.d_model, name, (None, "model"), dtype)(x)
-            return y.reshape(x.shape[:-1] + qkv_shape)
+        def repeat_kv(k, v):
+            group = cfg.num_heads // kv_heads
+            return (jnp.repeat(k, group, axis=2),
+                    jnp.repeat(v, group, axis=2))
 
-        q, k, v = proj("query"), proj("key"), proj("value")
+        # QKV projections: heads sharded over "model" (tensor
+        # parallelism). K/V emit num_kv_heads (GQA when fewer than the
+        # query heads).
+        def proj(name, heads):
+            y = _dense(heads * head_dim, name, (None, "model"), dtype)(x)
+            return y.reshape(x.shape[:-1] + (heads, head_dim))
+
+        q = proj("query", cfg.num_heads)
+        k = proj("key", kv_heads)
+        v = proj("value", kv_heads)
+        if kv_heads != cfg.num_heads and cfg.attention in (
+            "ring", "ulysses",
+        ):
+            raise ValueError(
+                "num_kv_heads != num_heads is supported by the 'flash' "
+                f"and 'dense' paths only, got {cfg.attention!r}"
+            )
+        if kv_heads != cfg.num_heads and cfg.attention == "dense":
+            k, v = repeat_kv(k, v)
         if cfg.attention_window is not None and cfg.attention != "flash":
             # Only the flash kernels implement the window; training
             # quadratically while the config promises a window would be
@@ -135,6 +165,8 @@ class Attention(nn.Module):
                         "attention_window needs a flash-tiling sequence "
                         f"(multiple of 128), got {x.shape[1]}"
                     )
+                if kv_heads != cfg.num_heads:
+                    k, v = repeat_kv(k, v)
                 out = dense_causal_attention(q, k, v)
         else:
             out = dense_causal_attention(q, k, v)

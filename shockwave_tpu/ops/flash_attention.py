@@ -216,6 +216,17 @@ def _window_q_start(ki, block_q, block_k, j):
     return (ki * block_k) // block_q + j
 
 
+def _kv_row(b, num_q_heads, group):
+    """Flat KV row for flat q row ``b`` (batch-major, head-minor
+    [B * H] layout): query head h reads KV head h // group — grouped-
+    query attention resolved entirely in the BlockSpec index maps, so
+    shared KV heads are never materialized per query head in HBM."""
+    if group == 1:
+        return b
+    kv_heads = num_q_heads // group
+    return (b // num_q_heads) * kv_heads + (b % num_q_heads) // group
+
+
 def _window_blocks(window, block_a, block_b, n_b):
     """Number of block_b-sized blocks a shrunk windowed grid must walk
     per block_a-sized outer block: the span block_a + window - 1 plus
@@ -223,16 +234,20 @@ def _window_blocks(window, block_a, block_b, n_b):
     return min(n_b, (block_a + window - 2) // block_b + 2)
 
 
-def _flash_fwd_flat(q, k, v, block_q, block_k, causal, window, interpret):
-    """q: [BH, Sq, D], k/v: [BH, Sk, D] ->
-    (out [BH, Sq, D], lse [BH, Sq, LANES]). causal requires Sq == Sk
+def _flash_fwd_flat(q, k, v, block_q, block_k, causal, window,
+                    num_q_heads, interpret):
+    """q: [B*H, Sq, D], k/v: [B*Hkv, Sk, D] ->
+    (out [B*H, Sq, D], lse [B*H, Sq, LANES]). causal requires Sq == Sk
     (positions are global block offsets); non-causal attends q to the
     whole K/V sequence (a ring hop whose K block is entirely in the
     past). ``window`` (causal only) shrinks the k grid to the blocks
     the sliding window can reach — O(S * window) compute AND block DMA
-    (a pl.when skip alone would still fetch every K/V block)."""
+    (a pl.when skip alone would still fetch every K/V block). Hkv may
+    divide H (grouped-query attention); the KV row is resolved by the
+    index maps via _kv_row."""
     BH, Sq, D = q.shape
     Sk = k.shape[1]
+    group = BH // k.shape[0]
     # Fold the 1/sqrt(D) score scale into q once (O(S*D)) instead of
     # multiplying the S^2 score matrix inside the kernel. The multiply
     # runs in f32; casting back to a bf16 q costs at most one extra
@@ -245,13 +260,16 @@ def _flash_fwd_flat(q, k, v, block_q, block_k, causal, window, interpret):
     nk = Sk // block_k
     if window is None:
         nj = nk
-        kmap = lambda b, i, j: (b, j, 0)  # noqa: E731
+
+        def kmap(b, i, j):
+            return (_kv_row(b, num_q_heads, group), j, 0)
     else:
         nj = _window_blocks(window, block_q, block_k, nk)
 
         def kmap(b, i, j):
             ki = _window_k_start(i, block_q, block_k, nj, j)
-            return (b, jnp.clip(ki, 0, nk - 1), 0)
+            return (_kv_row(b, num_q_heads, group),
+                    jnp.clip(ki, 0, nk - 1), 0)
 
     grid = (BH, Sq // block_q, nj)
     kernel = functools.partial(
@@ -400,8 +418,8 @@ def _dq_kernel(
 
 
 def _flash_bwd_flat(
-    q, k, v, out, lse, g, block_q, block_k, causal, window, interpret,
-    g_lse=None,
+    q, k, v, out, lse, g, block_q, block_k, causal, window,
+    num_q_heads, interpret, g_lse=None,
 ):
     """Pallas flash backward; O(S * D) HBM traffic per head. g_lse is
     the optional cotangent of the returned lse (ring-attention merges
@@ -410,6 +428,7 @@ def _flash_bwd_flat(
     kernels need no extra operand."""
     BH, Sq, D = q.shape
     Sk = k.shape[1]
+    group = BH // k.shape[0]
     scale = 1.0 / float(np.sqrt(D))
     # Same fold as the forward: q carries the score scale, so the
     # kernels' s recompute needs no S^2 multiply, dk = ds^T @ q_scaled
@@ -453,7 +472,15 @@ def _flash_bwd_flat(
 
     qspec_kv = pl.BlockSpec((1, block_q, D), qmap_kv)
     sspec_kv = pl.BlockSpec((1, block_q, _LANES), qmap_kv)
-    kspec_kv = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0))
+    kspec_kv = pl.BlockSpec(
+        (1, block_k, D),
+        lambda b, i, j: (_kv_row(b, num_q_heads, group), i, 0),
+    )
+    # dk/dv are emitted per QUERY head (grid dim 0 runs over B*H, and
+    # the sequential-revisit ordering Pallas relies on would break if
+    # several q heads wrote the same KV row); the group reduction to
+    # [B*Hkv] happens below in plain XLA.
+    dspec_kv = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0))
 
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -464,7 +491,7 @@ def _flash_bwd_flat(
         in_specs=[
             qspec_kv, kspec_kv, kspec_kv, qspec_kv, sspec_kv, sspec_kv
         ],
-        out_specs=[kspec_kv, kspec_kv],
+        out_specs=[dspec_kv, dspec_kv],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
             jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
@@ -478,16 +505,33 @@ def _flash_bwd_flat(
         ),
         interpret=interpret,
     )(q, k, v, g, lse, delta)
+    if group > 1:
+        # Sum the per-q-head KV gradients over each group (f32 to keep
+        # the reduction exact, then back to the KV dtype). Heads are
+        # minor in the flat layout and groups are contiguous in h.
+        B = BH // num_q_heads
+        kv_heads = num_q_heads // group
+
+        def group_sum(d, dtype):
+            d = d.astype(jnp.float32)
+            d = d.reshape(B, kv_heads, group, Sk, D).sum(axis=2)
+            return d.reshape(B * kv_heads, Sk, D).astype(dtype)
+
+        dk = group_sum(dk, k.dtype)
+        dv = group_sum(dv, v.dtype)
 
     if window is None:
         njk = nk
-        kmap = lambda b, i, j: (b, j, 0)  # noqa: E731
+
+        def kmap(b, i, j):
+            return (_kv_row(b, num_q_heads, group), j, 0)
     else:
         njk = _window_blocks(window, block_q, block_k, nk)
 
         def kmap(b, i, j):
             ki = _window_k_start(i, block_q, block_k, njk, j)
-            return (b, jnp.clip(ki, 0, nk - 1), 0)
+            return (_kv_row(b, num_q_heads, group),
+                    jnp.clip(ki, 0, nk - 1), 0)
 
     kspec = pl.BlockSpec((1, block_k, D), kmap)
     dq = pl.pallas_call(
@@ -508,28 +552,29 @@ def _flash_bwd_flat(
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_flat_lse(q, k, v, block_q, block_k, causal, window, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_flat_lse(q, k, v, block_q, block_k, causal, window,
+                    num_q_heads, interpret):
     return _flash_fwd_flat(
-        q, k, v, block_q, block_k, causal, window, interpret
+        q, k, v, block_q, block_k, causal, window, num_q_heads, interpret
     )
 
 
 def _flash_flat_lse_fwd(q, k, v, block_q, block_k, causal, window,
-                        interpret):
+                        num_q_heads, interpret):
     out, lse = _flash_fwd_flat(
-        q, k, v, block_q, block_k, causal, window, interpret
+        q, k, v, block_q, block_k, causal, window, num_q_heads, interpret
     )
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_flat_lse_bwd(block_q, block_k, causal, window, interpret,
-                        res, gs):
+def _flash_flat_lse_bwd(block_q, block_k, causal, window, num_q_heads,
+                        interpret, res, gs):
     q, k, v, out, lse = res
     g_out, g_lse = gs
     dq, dk, dv = _flash_bwd_flat(
         q, k, v, out, lse, g_out, block_q, block_k, causal, window,
-        interpret, g_lse=g_lse,
+        num_q_heads, interpret, g_lse=g_lse,
     )
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
@@ -594,8 +639,14 @@ def flash_attention(
     attention). The kernels walk a shrunk k grid, so compute and K/V
     block DMA are O(S * window) instead of O(S^2): long-context cost
     becomes linear in S at fixed window.
+
+    k/v may carry FEWER heads than q (grouped-query attention): with
+    Hkv dividing H, query head h attends KV head h // (H // Hkv). The
+    sharing is resolved in the kernels' index maps — the KV tensors
+    are never repeated per query head in HBM.
     """
     B, S, H, D = q.shape
+    Hkv = _check_kv_heads(H, k.shape[2], v.shape[2])
     # The cap also overrides explicitly passed block sizes (VMEM
     # correctness beats caller preference).
     cap = _block_cap(D)
@@ -603,17 +654,30 @@ def flash_attention(
     block_k = _resolve_block(min(block_k, cap), S)
     window = _resolve_window(window, S)
 
-    def flat(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    def flat(x, h):
+        return x.transpose(0, 2, 1, 3).reshape(B * h, S, D)
 
     # Single custom_vjp shared with flash_attention_lse (the discarded
     # lse's zero cotangent folds into the backward's delta for free) —
     # one backward implementation to keep correct, not two.
     out, _ = _flash_flat_lse(
-        flat(q), flat(k), flat(v), block_q, block_k, True, window,
-        _use_interpret(),
+        flat(q, H), flat(k, Hkv), flat(v, Hkv), block_q, block_k, True,
+        window, H, _use_interpret(),
     )
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def _check_kv_heads(num_q_heads, k_heads, v_heads):
+    if k_heads != v_heads:
+        raise ValueError(
+            f"k and v head counts differ: {k_heads} vs {v_heads}"
+        )
+    if num_q_heads % k_heads:
+        raise ValueError(
+            f"q heads ({num_q_heads}) must be a multiple of kv heads "
+            f"({k_heads})"
+        )
+    return k_heads
 
 
 def _resolve_window(window, seq_len):
@@ -648,6 +712,7 @@ def flash_attention_lse(
     """
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
+    Hkv = _check_kv_heads(H, k.shape[2], v.shape[2])
     if causal and Sq != Sk:
         raise ValueError(
             f"causal flash needs matching q/k lengths, got {Sq} vs {Sk}"
@@ -659,12 +724,12 @@ def flash_attention_lse(
     block_k = _resolve_block(min(block_k, cap), Sk)
     window = _resolve_window(window, Sq)
 
-    def flat(x, s):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, s, D)
+    def flat(x, s, h):
+        return x.transpose(0, 2, 1, 3).reshape(B * h, s, D)
 
     out, lse = _flash_flat_lse(
-        flat(q, Sq), flat(k, Sk), flat(v, Sk), block_q, block_k, causal,
-        window, _use_interpret(),
+        flat(q, Sq, H), flat(k, Sk, Hkv), flat(v, Sk, Hkv), block_q,
+        block_k, causal, window, H, _use_interpret(),
     )
     out = out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
     lse = lse[:, :, 0].reshape(B, H, Sq)

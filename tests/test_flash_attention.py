@@ -352,3 +352,85 @@ def test_sliding_window_grad_matches_dense():
         np.testing.assert_allclose(
             np.asarray(gf), np.asarray(gd), rtol=5e-4, atol=5e-4
         )
+
+
+def _repeat_kv(x, group):
+    return jnp.repeat(x, group, axis=2)
+
+
+@pytest.mark.parametrize("kv_heads", [1, 2])
+def test_gqa_matches_repeated_kv(kv_heads):
+    """Grouped-query attention (KV heads shared across query-head
+    groups via the kernels' index maps) must equal materializing the
+    repeated KV and running plain flash."""
+    rng = np.random.default_rng(14)
+    B, S, H, D = 1, 256, 4, 16
+    q, _, _ = _qkv(rng, B, S, H, D)
+    _, k, v = _qkv(rng, B, S, kv_heads, D)
+    group = H // kv_heads
+    out = flash_attention(q, k, v, block_q=128, block_k=128)
+    ref = flash_attention(
+        q, _repeat_kv(k, group), _repeat_kv(v, group),
+        block_q=128, block_k=128,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_gqa_grads_match_repeated_kv():
+    """GQA gradients: dq per query head; dk/dv group-summed back to the
+    KV head count — must equal grads through the repeated-KV graph
+    (whose repeat transpose is exactly that sum)."""
+    rng = np.random.default_rng(15)
+    B, S, H, Dh, kv_heads = 1, 256, 4, 16, 2
+    group = H // kv_heads
+    q, _, _ = _qkv(rng, B, S, H, Dh)
+    _, k, v = _qkv(rng, B, S, kv_heads, Dh)
+
+    def loss_gqa(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, block_q=128, block_k=128) ** 2
+        )
+
+    def loss_rep(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, _repeat_kv(k, group), _repeat_kv(v, group),
+                block_q=128, block_k=128,
+            ) ** 2
+        )
+
+    g_gqa = jax.grad(loss_gqa, argnums=(0, 1, 2))(q, k, v)
+    g_rep = jax.grad(loss_rep, argnums=(0, 1, 2))(q, k, v)
+    for gg, gr in zip(g_gqa, g_rep):
+        assert gg.shape == gr.shape
+        np.testing.assert_allclose(
+            np.asarray(gg), np.asarray(gr), rtol=5e-4, atol=5e-4
+        )
+
+
+def test_gqa_composes_with_window():
+    rng = np.random.default_rng(16)
+    B, S, H, Dh, kv_heads, W = 1, 512, 4, 16, 2, 160
+    group = H // kv_heads
+    q, _, _ = _qkv(rng, B, S, H, Dh)
+    _, k, v = _qkv(rng, B, S, kv_heads, Dh)
+    out = flash_attention(q, k, v, block_q=128, block_k=128, window=W)
+    ref = _dense_windowed(
+        q, _repeat_kv(k, group), _repeat_kv(v, group), W
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_gqa_rejects_bad_head_counts():
+    rng = np.random.default_rng(17)
+    q, _, _ = _qkv(rng, 1, 128, 4, 16)
+    _, k3, v3 = _qkv(rng, 1, 128, 3, 16)
+    with pytest.raises(ValueError):
+        flash_attention(q, k3, v3)
+    _, k2, v2 = _qkv(rng, 1, 128, 2, 16)
+    with pytest.raises(ValueError):
+        flash_attention(q, k2, v3)  # k/v head mismatch
