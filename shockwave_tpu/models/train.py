@@ -96,6 +96,8 @@ def build_family(name, args, mesh):
             max_len=args.seq_len,
             dtype=getattr(args, "dtype", "float32"),
             attention=args.attention,
+            attention_window=getattr(args, "attention_window", None),
+            num_kv_heads=getattr(args, "num_kv_heads", None),
             num_experts=args.num_experts,
             remat=getattr(args, "remat", False),
         )
@@ -226,6 +228,12 @@ def main(argv=None):
     parser.add_argument("--seq_len", type=int, default=128)
     parser.add_argument("--attention", type=str, default="dense",
                         choices=["dense", "ring", "ulysses", "flash"])
+    parser.add_argument("--attention_window", type=int, default=None,
+                        help="sliding window (flash): each token attends"
+                             " its most recent N positions; O(S*N) cost")
+    parser.add_argument("--num_kv_heads", type=int, default=None,
+                        help="grouped-query attention KV head count "
+                             "(flash/dense/ring; ulysses rejects it)")
     parser.add_argument("--dtype", type=str, default="float32",
                         choices=["float32", "bfloat16"],
                         help="activation dtype (params stay float32)")

@@ -53,7 +53,8 @@ class TransformerConfig:
     # projections emit this many heads, shared across query-head groups
     # of size num_heads // num_kv_heads. Cuts KV projection params and
     # FLOPs by the group factor; the flash kernels resolve the sharing
-    # in their index maps (dense repeats KV; ring/ulysses reject it).
+    # in their index maps, and ring attention ppermutes the SMALL K/V
+    # tensors (ICI traffic / group). Dense repeats KV; ulysses rejects.
     num_kv_heads: Optional[int] = None
     num_experts: int = 0  # 0 = dense MLP; >0 = MoE over "model"
     # Rematerialize each block in the backward pass (jax.checkpoint):
@@ -110,12 +111,14 @@ class Attention(nn.Module):
         q = proj("query", cfg.num_heads)
         k = proj("key", kv_heads)
         v = proj("value", kv_heads)
-        if kv_heads != cfg.num_heads and cfg.attention in (
-            "ring", "ulysses",
-        ):
+        if kv_heads != cfg.num_heads and cfg.attention == "ulysses":
+            # Ulysses reshards the head dim in its all-to-alls; GQA
+            # there needs dedicated plumbing. Ring supports it natively
+            # (the flash hop body reads shared KV through its index
+            # maps, and the per-hop ppermute moves the small tensors).
             raise ValueError(
-                "num_kv_heads != num_heads is supported by the 'flash' "
-                f"and 'dense' paths only, got {cfg.attention!r}"
+                "num_kv_heads != num_heads is not supported by the "
+                "'ulysses' path; use 'flash', 'dense' or 'ring'"
             )
         if kv_heads != cfg.num_heads and cfg.attention == "dense":
             k, v = repeat_kv(k, v)

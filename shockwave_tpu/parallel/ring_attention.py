@@ -60,9 +60,14 @@ def _block_attention(q, k, v, scale, mask):
     return block_out, block_max, block_denom
 
 
-def _ring_attention_local(q, k, v, axis_name: str, all_axes: tuple):
-    """Per-shard body under shard_map: q/k/v are the local sequence block
-    [B, S_local, H, D]; returns the local attention output."""
+def _ring_attention_local(q, k, v, axis_name: str, all_axes: tuple,
+                          group: int = 1):
+    """Per-shard body under shard_map: q [B, S_local, H, D], k/v
+    [B, S_local, H // group, D]; returns the local attention output.
+    With group > 1 (grouped-query attention) the K/V blocks rotate
+    around the ring at their SMALL size — ICI traffic and carry HBM
+    stay divided by the group — and are repeated to the query head
+    count only transiently, per hop, for the einsum."""
     num_shards = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, S, H, D = q.shape
@@ -79,8 +84,10 @@ def _ring_attention_local(q, k, v, axis_name: str, all_axes: tuple):
     def step(i, carry):
         acc, m, l, k_blk, v_blk = carry
         src_idx = (my_idx - i) % num_shards
+        k_full = jnp.repeat(k_blk, group, axis=2) if group > 1 else k_blk
+        v_full = jnp.repeat(v_blk, group, axis=2) if group > 1 else v_blk
         blk_out, blk_max, blk_denom = _block_attention(
-            q, k_blk, v_blk, scale, causal_mask(src_idx)
+            q, k_full, v_full, scale, causal_mask(src_idx)
         )
         # Online softmax merge (running max m, normalizer l).
         new_m = jnp.maximum(m, blk_max)
@@ -207,13 +214,31 @@ def ring_attention(
     s_local = q.shape[1] // mesh.shape[seq_axis]
     if inner == "auto":
         inner = "flash" if flash_tiles(s_local) else "dense"
-    body = _ring_flash_local if inner == "flash" else _ring_attention_local
+    if k.shape[2] != q.shape[2]:
+        # Grouped-query attention: both bodies rotate the SMALL K/V
+        # tensors around the ring — ICI traffic and carry HBM divided
+        # by the group. The flash body reads shared heads through the
+        # kernel index maps; the dense einsum body repeats each block
+        # transiently, per hop.
+        if q.shape[2] % k.shape[2]:
+            raise ValueError(
+                f"q heads ({q.shape[2]}) must be a multiple of kv "
+                f"heads ({k.shape[2]})"
+            )
+        kv_group = q.shape[2] // k.shape[2]
+    else:
+        kv_group = 1
+    if inner == "flash":
+        body = functools.partial(
+            _ring_flash_local, axis_name=seq_axis, all_axes=vary_axes
+        )
+    else:
+        body = functools.partial(
+            _ring_attention_local, axis_name=seq_axis,
+            all_axes=vary_axes, group=kv_group,
+        )
     fn = jax.shard_map(
-        functools.partial(
-            body,
-            axis_name=seq_axis,
-            all_axes=vary_axes,
-        ),
+        body,
         mesh=mesh,
         in_specs=(io_spec, io_spec, io_spec),
         out_specs=io_spec,
