@@ -50,7 +50,8 @@ def _enable_compile_cache():
 
 def build_step(seq_len, batch, dtype, attention, d_model, num_heads,
                num_layers, vocab_size, remat=False, window=None,
-               num_kv_heads=None):
+               num_kv_heads=None, positional="learned",
+               logit_chunk=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -75,6 +76,7 @@ def build_step(seq_len, batch, dtype, attention, d_model, num_heads,
         attention=attention,
         attention_window=window,
         num_kv_heads=num_kv_heads,
+        positional=positional,
         remat=remat,
     )
     model = TransformerLM(cfg, mesh=mesh)
@@ -89,7 +91,7 @@ def build_step(seq_len, batch, dtype, attention, d_model, num_heads,
     @jax.jit
     def train_step(variables, opt_state, tokens):
         loss, grads = jax.value_and_grad(
-            lambda v: lm_loss(model, v, tokens)
+            lambda v: lm_loss(model, v, tokens, logit_chunk=logit_chunk)
         )(variables)
         update, opt_state = tx.update(grads, opt_state, variables)
         variables = optax.apply_updates(variables, update)
@@ -127,7 +129,7 @@ def measure(run, min_slope_s=1.0, start_n=4, max_n=4096):
 
 
 def step_flops(params, batch, seq_len, d_model, num_layers,
-               window=None):
+               window=None, positional="learned"):
     """Approximate train-step model FLOPs: 6*N per token for the
     MATMUL params + 12*S*d per token for attention scores/values (the
     standard full-S convention). N excludes the learned positional
@@ -140,7 +142,8 @@ def step_flops(params, batch, seq_len, d_model, num_layers,
     min(S, window) — otherwise windowed runs would be credited
     quadratic FLOPs they never compute and "MFU" would exceed 1."""
     tokens = batch * seq_len
-    matmul_params = params - seq_len * d_model
+    table = seq_len * d_model if positional == "learned" else 0
+    matmul_params = params - table
     span = seq_len if window is None else min(seq_len, window)
     return (6 * matmul_params * tokens
             + 12 * num_layers * span * d_model * tokens)
@@ -164,6 +167,11 @@ def main(argv=None):
                         help="sliding attention window (flash only)")
     parser.add_argument("--num_kv_heads", type=int, default=None,
                         help="grouped-query attention KV head count")
+    parser.add_argument("--positional", type=str, default="learned",
+                        choices=["learned", "rope"])
+    parser.add_argument("--logit_chunk", type=int, default=None,
+                        help="sequence-chunk the LM head+loss so full "
+                             "[S, vocab] logits never materialize")
     parser.add_argument("-o", "--output", type=str, default=None)
     args = parser.parse_args(argv)
 
@@ -187,6 +195,8 @@ def main(argv=None):
             "remat": args.remat,
             "window": args.window,
             "num_kv_heads": args.num_kv_heads,
+            "positional": args.positional,
+            "logit_chunk": args.logit_chunk,
         },
         "runs": [],
     }
@@ -215,6 +225,8 @@ def main(argv=None):
                             args.vocab_size, remat=args.remat,
                             window=args.window,
                             num_kv_heads=args.num_kv_heads,
+                            positional=args.positional,
+                            logit_chunk=args.logit_chunk,
                         )
                         rate = measure(run)
                         last_err = None
@@ -253,7 +265,7 @@ def main(argv=None):
                     continue
                 flops = step_flops(
                     params, batch, seq_len, args.d_model, args.num_layers,
-                    window=args.window,
+                    window=args.window, positional=args.positional,
                 )
                 row = {
                     "seq_len": seq_len,

@@ -96,6 +96,21 @@ class CycleGANDiscriminator(nn.Module):
 
 
 # -- losses -------------------------------------------------------------
+def token_xent_sum(logits, targets):
+    """Sum (not mean) form of :func:`token_xent` over a logits block —
+    shared by the full-logits loss and TransformerLM's sequence-chunked
+    head (which averages once over all chunks). Same CONTRACT: every
+    target must lie in [0, vocab)."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    idx = jax.lax.broadcasted_iota(
+        jnp.int32, logits.shape, logits.ndim - 1
+    )
+    picked = jnp.sum(
+        jnp.where(idx == targets[..., None], logits, 0.0), axis=-1
+    )
+    return jnp.sum(lse - picked)
+
+
 def token_xent(logits, targets):
     """Next-token cross entropy as logsumexp minus a select-reduce pick.
 
@@ -110,14 +125,7 @@ def token_xent(logits, targets):
     nothing — the loss silently degrades to mean(lse) for that token.
     There is no -100-style ignore index; mask padding tokens out of the
     mean yourself before calling."""
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    idx = jax.lax.broadcasted_iota(
-        jnp.int32, logits.shape, logits.ndim - 1
-    )
-    picked = jnp.sum(
-        jnp.where(idx == targets[..., None], logits, 0.0), axis=-1
-    )
-    return jnp.mean(lse - picked)
+    return token_xent_sum(logits, targets) / targets.size
 
 
 def a3c_loss(policy_logits, values, actions, returns):

@@ -98,6 +98,7 @@ def build_family(name, args, mesh):
             attention=args.attention,
             attention_window=getattr(args, "attention_window", None),
             num_kv_heads=getattr(args, "num_kv_heads", None),
+            positional=getattr(args, "positional", "learned"),
             num_experts=args.num_experts,
             remat=getattr(args, "remat", False),
         )
@@ -106,7 +107,10 @@ def build_family(name, args, mesh):
         variables = model.init(rng, example)
 
         def loss_fn(variables, batch):
-            return lm_loss(model, variables, batch)
+            return lm_loss(
+                model, variables, batch,
+                logit_chunk=getattr(args, "logit_chunk", None),
+            )
 
         def batch_fn(np_rng):
             return jnp.asarray(
@@ -234,6 +238,14 @@ def main(argv=None):
     parser.add_argument("--num_kv_heads", type=int, default=None,
                         help="grouped-query attention KV head count "
                              "(flash/dense/ring; ulysses rejects it)")
+    parser.add_argument("--logit_chunk", type=int, default=None,
+                        help="sequence-chunk the LM head+loss (full "
+                             "[S, vocab] logits never materialize)")
+    parser.add_argument("--positional", type=str, default="learned",
+                        choices=["learned", "rope"],
+                        help="position encoding: learned table or "
+                             "rotary (no table; the table is 134M "
+                             "params at 131k context)")
     parser.add_argument("--dtype", type=str, default="float32",
                         choices=["float32", "bfloat16"],
                         help="activation dtype (params stay float32)")

@@ -18,6 +18,7 @@ architecture here is what a TPU cluster would actually train.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Optional
 
 import flax.linen as nn
@@ -57,11 +58,41 @@ class TransformerConfig:
     # tensors (ICI traffic / group). Dense repeats KV; ulysses rejects.
     num_kv_heads: Optional[int] = None
     num_experts: int = 0  # 0 = dense MLP; >0 = MoE over "model"
+    # Position encoding: "learned" adds a (max_len, d_model) table to
+    # the token embedding; "rope" rotates q/k per head instead (no
+    # table — at 131k context the learned table is 134M parameters of
+    # pure lookup plus their optimizer state in HBM, and rotary's
+    # relative positions extrapolate; cos/sin are computed inline and
+    # fuse into the projections).
+    positional: str = "learned"  # "learned" | "rope"
+    rope_base: float = 10000.0
     # Rematerialize each block in the backward pass (jax.checkpoint):
     # activations are recomputed instead of stored, trading ~1/3 more
     # FLOPs for O(num_layers) less HBM — the knob that moves the
     # longest trainable context on a fixed-memory chip.
     remat: bool = False
+
+
+def apply_rope(x, base=10000.0):
+    """Rotary position embedding over [B, S, H, D] (D even): rotate
+    feature pairs (x_i, x_{i+D/2}) by pos * base^(-2i/D). Angles are
+    float32 regardless of activation dtype (bf16 loses whole positions
+    past ~8k context); the rotation is elementwise and fuses into the
+    surrounding projections under XLA."""
+    B, S, H, D = x.shape
+    half = D // 2
+    freqs = jnp.exp(
+        -jnp.arange(0, half, dtype=jnp.float32) * (2.0 * math.log(base) / D)
+    )
+    angles = jnp.arange(S, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]  # [1, S, 1, half]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(
+        jnp.float32
+    )
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
 
 
 def _dense(features, name, kernel_axes, dtype=None):
@@ -111,6 +142,9 @@ class Attention(nn.Module):
         q = proj("query", cfg.num_heads)
         k = proj("key", kv_heads)
         v = proj("value", kv_heads)
+        if cfg.positional == "rope":
+            q = apply_rope(q, cfg.rope_base)
+            k = apply_rope(k, cfg.rope_base)
         if kv_heads != cfg.num_heads and cfg.attention == "ulysses":
             # Ulysses reshards the head dim in its all-to-alls; GQA
             # there needs dedicated plumbing. Ring supports it natively
@@ -257,7 +291,16 @@ class TransformerLM(nn.Module):
     mesh: Optional[jax.sharding.Mesh] = None
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, targets=None, logit_chunk=None):
+        """Logits [B, S, V] for ``tokens`` [B, S]; or, when ``targets``
+        is given, the scalar next-token cross entropy with the head
+        evaluated in sequence chunks of ``logit_chunk`` tokens. The
+        chunked path never materializes the full [B, S, V] logits —
+        at 262k tokens x 8k vocab those are 8.6 GB in f32, more than
+        half the chip, and the thing that caps trainable context once
+        attention is windowed; each chunk's logits are recomputed in
+        the backward (jax.checkpoint), so the live footprint is
+        O(logit_chunk * V) in both passes."""
         cfg = self.config
         emb = self.param(
             "embedding",
@@ -266,31 +309,78 @@ class TransformerLM(nn.Module):
             ),
             (cfg.vocab_size, cfg.d_model),
         )
-        pos = self.param(
-            "positional",
-            nn.with_partitioning(nn.initializers.normal(0.02), (None, None)),
-            (cfg.max_len, cfg.d_model),
-        )
         dtype = jnp.dtype(cfg.dtype)
-        x = (
-            jnp.asarray(emb)[tokens] + jnp.asarray(pos)[: tokens.shape[1]]
-        ).astype(dtype)
+        if cfg.positional == "rope":
+            # Positions live in the attention rotations (apply_rope);
+            # no table, no per-context parameter growth.
+            x = jnp.asarray(emb)[tokens].astype(dtype)
+        elif cfg.positional == "learned":
+            pos = self.param(
+                "positional",
+                nn.with_partitioning(
+                    nn.initializers.normal(0.02), (None, None)
+                ),
+                (cfg.max_len, cfg.d_model),
+            )
+            x = (
+                jnp.asarray(emb)[tokens]
+                + jnp.asarray(pos)[: tokens.shape[1]]
+            ).astype(dtype)
+        else:
+            raise ValueError(
+                f"positional must be 'learned' or 'rope', got "
+                f"{cfg.positional!r}"
+            )
         block_cls = nn.remat(Block) if cfg.remat else Block
         for i in range(cfg.num_layers):
             x = block_cls(cfg, self.mesh, name=f"block_{i}")(x)
         x = nn.LayerNorm(name="ln_f", dtype=jnp.float32)(x)
         # Tied output head: vocab matmul in the activation dtype, logits
-        # accumulated and returned in float32 for the softmax loss.
-        return jnp.einsum(
-            "bsd,vd->bsv",
-            x.astype(dtype),
-            jnp.asarray(emb).astype(dtype),
-            preferred_element_type=jnp.float32,
+        # accumulated in float32 for the softmax loss.
+        head = jnp.asarray(emb).astype(dtype)
+
+        def logits_of(xc):
+            return jnp.einsum(
+                "bsd,vd->bsv", xc.astype(dtype), head,
+                preferred_element_type=jnp.float32,
+            )
+
+        if targets is None:
+            return logits_of(x)
+
+        B, S = targets.shape
+        chunk = S if logit_chunk is None else int(logit_chunk)
+        if chunk < 1 or S % chunk:
+            raise ValueError(
+                f"logit_chunk ({chunk}) must be >= 1 and divide the "
+                f"sequence ({S})"
+            )
+        # [B, S, ...] -> [n_chunks, B, chunk, ...] for the scan.
+        d = x.shape[-1]
+        xc = x.reshape(B, S // chunk, chunk, d).swapaxes(0, 1)
+        tc = targets.reshape(B, S // chunk, chunk).swapaxes(0, 1)
+
+        from shockwave_tpu.models.small_models import token_xent_sum
+
+        @jax.checkpoint
+        def body(total, xt):
+            xcb, tcb = xt
+            # token_xent's sum form; the mean is taken once over all
+            # chunks below.
+            return total + token_xent_sum(logits_of(xcb), tcb), None
+
+        total, _ = jax.lax.scan(body, jnp.float32(0.0), (xc, tc))
+        return total / (B * S)
+
+
+def lm_loss(model, params, tokens, logit_chunk=None):
+    """Next-token cross entropy over a [B, S+1] token batch. With
+    ``logit_chunk`` the head+loss run sequence-chunked (see
+    TransformerLM.__call__) so full logits never materialize."""
+    if logit_chunk is not None:
+        return model.apply(
+            params, tokens[:, :-1], tokens[:, 1:], logit_chunk
         )
-
-
-def lm_loss(model, params, tokens):
-    """Next-token cross entropy over a [B, S+1] token batch."""
     from shockwave_tpu.models.small_models import token_xent
 
     logits = model.apply(params, tokens[:, :-1])

@@ -171,13 +171,20 @@ class PipelinedLM:
                 jax.random.fold_in(r, 0), (cfg.vocab_size, d)
             )
             * 0.02,
-            "positional": jax.random.normal(
-                jax.random.fold_in(r, 1), (cfg.max_len, d)
-            )
-            * 0.02,
             "ln_f_scale": jnp.ones((d,)),
             "ln_f_bias": jnp.zeros((d,)),
         }
+        # Under rope the positions live inside each Block's Attention
+        # (apply_rope — correct here because GPipe microbatches split
+        # the BATCH dim, so every stage sees whole sequences); adding
+        # the learned table too would double-encode positions.
+        if cfg.positional == "learned":
+            params["positional"] = (
+                jax.random.normal(
+                    jax.random.fold_in(r, 1), (cfg.max_len, d)
+                )
+                * 0.02
+            )
         if self.mesh is not None:
             params = self.shard_params(params)
         return params
@@ -193,7 +200,8 @@ class PipelinedLM:
             lambda p: jax.device_put(p, pipe), params["blocks"]
         )
         for k in ("embedding", "positional", "ln_f_scale", "ln_f_bias"):
-            out[k] = jax.device_put(params[k], rep)
+            if k in params:
+                out[k] = jax.device_put(params[k], rep)
         return out
 
     # -- compute --------------------------------------------------------
@@ -205,7 +213,9 @@ class PipelinedLM:
 
     def _embed(self, params, tokens):
         x = params["embedding"][tokens]
-        return x + params["positional"][: tokens.shape[1]]
+        if "positional" in params:
+            x = x + params["positional"][: tokens.shape[1]]
+        return x
 
     def _head(self, params, x):
         mean = jnp.mean(x, axis=-1, keepdims=True)

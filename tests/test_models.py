@@ -418,3 +418,95 @@ def test_transformer_gqa_trains_and_matches_heads():
         TransformerLM(
             TransformerConfig(attention="ulysses", **kw)
         ).init(jax.random.PRNGKey(0), tokens[:, :-1])
+
+
+def test_transformer_rope():
+    """RoPE: no positional table in the param tree; flash and dense
+    agree on the same params; and rotated position-independent q/k
+    produce scores that depend only on the position DIFFERENCE (the
+    relative-position property that lets rotary extrapolate)."""
+    import jax
+    import jax.numpy as jnp
+
+    from shockwave_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+        apply_rope,
+        lm_loss,
+    )
+
+    rng = np.random.default_rng(22)
+    kw = dict(vocab_size=64, d_model=32, num_heads=2, num_layers=2,
+              d_ff=64, max_len=128, positional="rope")
+    cfg = TransformerConfig(attention="flash", **kw)
+    model = TransformerLM(cfg)
+    tokens = jnp.asarray(rng.integers(0, 64, (2, 129)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens[:, :-1])
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    assert not any("positional" in str(p) for p, _ in flat)
+    loss, grads = jax.value_and_grad(
+        lambda p: lm_loss(model, p, tokens)
+    )(params)
+    assert np.isfinite(float(loss))
+    for leaf in jax.tree_util.tree_leaves(grads):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+    logits_flash = model.apply(params, tokens[:, :-1])
+    dense = TransformerLM(TransformerConfig(attention="dense", **kw))
+    logits_dense = dense.apply(params, tokens[:, :-1])
+    np.testing.assert_allclose(
+        np.asarray(logits_flash), np.asarray(logits_dense), rtol=2e-3,
+        atol=2e-3,
+    )
+
+    # Relative-position property: broadcast one q vector and one k
+    # vector across all positions; after rotation, q_i . k_j must be a
+    # function of i - j alone (constant along diagonals).
+    qv = jnp.asarray(rng.normal(size=(1, 1, 1, 16)), jnp.float32)
+    kv = jnp.asarray(rng.normal(size=(1, 1, 1, 16)), jnp.float32)
+    S = 32
+    q = apply_rope(jnp.broadcast_to(qv, (1, S, 1, 16)))
+    k = apply_rope(jnp.broadcast_to(kv, (1, S, 1, 16)))
+    scores = np.asarray(jnp.einsum("bqhd,bkhd->bqk", q, k))[0]
+    for off in (-5, 0, 7):
+        diag = np.diagonal(scores, offset=off)
+        np.testing.assert_allclose(diag, diag[0], rtol=1e-5, atol=1e-5)
+
+
+def test_chunked_lm_loss_matches_full():
+    """The sequence-chunked head/loss (logits never fully materialized,
+    chunk logits recomputed in backward) must match the full-logits
+    path — value and gradients."""
+    import jax
+    import jax.numpy as jnp
+
+    from shockwave_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+        lm_loss,
+    )
+
+    rng = np.random.default_rng(23)
+    cfg = TransformerConfig(vocab_size=64, d_model=32, num_heads=2,
+                            num_layers=2, d_ff=64, max_len=64)
+    model = TransformerLM(cfg)
+    tokens = jnp.asarray(rng.integers(0, 64, (2, 65)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens[:, :-1])
+
+    full, g_full = jax.value_and_grad(
+        lambda p: lm_loss(model, p, tokens)
+    )(params)
+    for chunk in (16, 64):
+        ck, g_ck = jax.value_and_grad(
+            lambda p: lm_loss(model, p, tokens, logit_chunk=chunk)
+        )(params)
+        np.testing.assert_allclose(float(ck), float(full), rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(g_ck),
+                        jax.tree_util.tree_leaves(g_full)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            )
+    import pytest
+
+    with pytest.raises(ValueError):
+        lm_loss(model, params, tokens, logit_chunk=7)

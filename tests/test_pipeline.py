@@ -110,3 +110,36 @@ def test_pipelined_lm_matches_sequential_and_trains():
     assert np.isfinite(float(loss))
     for leaf in jax.tree_util.tree_leaves(grads):
         assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_pipelined_lm_rope_no_table():
+    """positional='rope' under the pipeline: positions come from each
+    Block's Attention rotation (microbatching splits the batch dim, so
+    stages see whole sequences); the learned table must not exist, and
+    the model must train."""
+    from shockwave_tpu.models.transformer import TransformerConfig
+
+    mesh = make_mesh((2, 1, 1, 4))
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=16, num_heads=2, num_layers=4, d_ff=32,
+        max_len=12, positional="rope",
+    )
+    model = PipelinedLM(cfg, num_stages=4, num_microbatches=2, mesh=mesh)
+    rng = np.random.default_rng(9)
+    tokens = jnp.asarray(rng.integers(0, 64, (4, 13)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    assert "positional" not in params
+
+    logits_pipe = model.logits(params, tokens[:, :-1])
+    logits_seq = model.logits_sequential(params, tokens[:, :-1])
+    np.testing.assert_allclose(
+        np.asarray(logits_pipe), np.asarray(logits_seq), rtol=2e-4,
+        atol=2e-4,
+    )
+    with mesh:
+        loss, grads = jax.jit(jax.value_and_grad(model.loss))(
+            params, tokens
+        )
+    assert np.isfinite(float(loss))
+    for leaf in jax.tree_util.tree_leaves(grads):
+        assert np.all(np.isfinite(np.asarray(leaf)))
