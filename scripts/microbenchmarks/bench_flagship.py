@@ -51,7 +51,7 @@ def _enable_compile_cache():
 def build_step(seq_len, batch, dtype, attention, d_model, num_heads,
                num_layers, vocab_size, remat=False, window=None,
                num_kv_heads=None, positional="learned",
-               logit_chunk=None):
+               logit_chunk=None, remat_group=1):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -78,6 +78,7 @@ def build_step(seq_len, batch, dtype, attention, d_model, num_heads,
         num_kv_heads=num_kv_heads,
         positional=positional,
         remat=remat,
+        remat_group=remat_group,
     )
     model = TransformerLM(cfg, mesh=mesh)
     tokens = jnp.asarray(
@@ -169,6 +170,8 @@ def main(argv=None):
                         help="grouped-query attention KV head count")
     parser.add_argument("--positional", type=str, default="learned",
                         choices=["learned", "rope"])
+    parser.add_argument("--remat_group", type=int, default=1,
+                        help="checkpoint every Nth block boundary")
     parser.add_argument("--logit_chunk", type=int, default=None,
                         help="sequence-chunk the LM head+loss so full "
                              "[S, vocab] logits never materialize")
@@ -197,6 +200,7 @@ def main(argv=None):
             "num_kv_heads": args.num_kv_heads,
             "positional": args.positional,
             "logit_chunk": args.logit_chunk,
+            "remat_group": args.remat_group,
         },
         "runs": [],
     }
@@ -227,6 +231,7 @@ def main(argv=None):
                             num_kv_heads=args.num_kv_heads,
                             positional=args.positional,
                             logit_chunk=args.logit_chunk,
+                            remat_group=args.remat_group,
                         )
                         rate = measure(run)
                         last_err = None

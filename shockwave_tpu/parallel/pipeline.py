@@ -174,6 +174,11 @@ class PipelinedLM:
             "ln_f_scale": jnp.ones((d,)),
             "ln_f_bias": jnp.zeros((d,)),
         }
+        if cfg.positional not in ("learned", "rope"):
+            raise ValueError(
+                f"positional must be 'learned' or 'rope', got "
+                f"{cfg.positional!r}"
+            )
         # Under rope the positions live inside each Block's Attention
         # (apply_rope — correct here because GPipe microbatches split
         # the BATCH dim, so every stage sees whole sequences); adding

@@ -510,3 +510,83 @@ def test_chunked_lm_loss_matches_full():
 
     with pytest.raises(ValueError):
         lm_loss(model, params, tokens, logit_chunk=7)
+
+
+def test_remat_group_matches_ungrouped():
+    """remat_group=2: half the checkpoint boundaries, identical math —
+    loss and grads must match the per-block remat model on the same
+    (renamed) params."""
+    import jax
+    import jax.numpy as jnp
+
+    from shockwave_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+        lm_loss,
+    )
+
+    rng = np.random.default_rng(24)
+    kw = dict(vocab_size=64, d_model=32, num_heads=2, num_layers=4,
+              d_ff=64, max_len=64)
+    tokens = jnp.asarray(rng.integers(0, 64, (2, 65)), jnp.int32)
+
+    base = TransformerLM(TransformerConfig(remat=True, **kw))
+    p_base = base.init(jax.random.PRNGKey(0), tokens[:, :-1])
+    grouped = TransformerLM(
+        TransformerConfig(remat=True, remat_group=2, **kw)
+    )
+    # Rename block_{2g+i} -> group_g/block_i.
+    pb = p_base["params"]
+    pg = {"params": {
+        "embedding": pb["embedding"],
+        "positional": pb["positional"],
+        "ln_f": pb["ln_f"],
+        **{
+            f"group_{g}": {
+                f"block_{i}": pb[f"block_{2 * g + i}"]
+                for i in range(2)
+            }
+            for g in range(2)
+        },
+    }}
+    l_base, g_base = jax.value_and_grad(
+        lambda p: lm_loss(base, p, tokens)
+    )(p_base)
+    l_grp, g_grp = jax.value_and_grad(
+        lambda p: lm_loss(grouped, p, tokens)
+    )(pg)
+    np.testing.assert_allclose(float(l_grp), float(l_base), rtol=1e-6)
+    # Exact leaf-by-leaf comparison through the same rename mapping the
+    # params used — a permuted gradient assignment must fail.
+    gb = g_base["params"]
+    gg = g_grp["params"]
+    remapped = {
+        "embedding": gb["embedding"],
+        "positional": gb["positional"],
+        "ln_f": gb["ln_f"],
+        **{
+            f"group_{g}": {
+                f"block_{i}": gb[f"block_{2 * g + i}"]
+                for i in range(2)
+            }
+            for g in range(2)
+        },
+    }
+    flat_a = jax.tree_util.tree_leaves_with_path(remapped)
+    flat_b = dict(
+        (jax.tree_util.keystr(p), v)
+        for p, v in jax.tree_util.tree_leaves_with_path(gg)
+    )
+    assert len(flat_a) == len(flat_b)
+    for path, leaf in flat_a:
+        np.testing.assert_allclose(
+            np.asarray(flat_b[jax.tree_util.keystr(path)]),
+            np.asarray(leaf), rtol=1e-4, atol=1e-6,
+        )
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        TransformerLM(
+            TransformerConfig(remat=True, remat_group=3, **kw)
+        ).init(jax.random.PRNGKey(0), tokens[:, :-1])
