@@ -135,6 +135,31 @@ def main():
     )
     milp_s = time.time() - t0
 
+    # Time-budgeted baseline: the budget the reference actually pays —
+    # a 15 s TimeLimit per round-plan solve (reference:
+    # scheduler/shockwave.py:400-411, shockwave_replicate/
+    # scale_64gpus.json). Two honest numbers fall out: the objective
+    # gap at EQUAL TIME (the budgeted incumbent vs this solver's plan,
+    # which lands in ~0.25 s) and the speedup at EQUAL QUALITY (the
+    # near-complete solve above matches this solver's objective to
+    # <1e-6 relative, so its wall-clock IS the time the baseline needs
+    # to reach equal quality — vs_baseline already reports that ratio).
+    t0 = time.time()
+    try:
+        Y_budget = solve_eg_milp_reference_formulation(
+            problem, rel_gap=1e-3, time_limit=15
+        )
+        budget_s = time.time() - t0
+        objective_budget = problem.objective_value(Y_budget)
+    except RuntimeError:
+        # The solver wrapper raises RuntimeError when HiGHS ends with
+        # no incumbent: the budgeted baseline produces NO feasible plan
+        # where this solver already has one. Any other exception is a
+        # real bug and must fail the benchmark, not masquerade as a
+        # baseline shortfall.
+        budget_s = time.time() - t0
+        objective_budget = None
+
     record = {
         "metric": "shockwave_plan_solve_wall_clock",
         "value": round(warm_median, 4),
@@ -150,6 +175,25 @@ def main():
         "schedule_audit": "ok",
         "objective_tpu": round(problem.objective_value(schedules[0]), 4),
         "objective_baseline": round(problem.objective_value(Y_milp), 4),
+        "baseline_budget15_s": round(budget_s, 3),
+        "baseline_budget15_status": (
+            "ok" if objective_budget is not None else "no_incumbent"
+        ),
+        "objective_baseline_budget15": (
+            round(objective_budget, 4)
+            if objective_budget is not None
+            else None
+        ),
+        "equal_time_objective_gap_pct": (
+            round(
+                100.0
+                * (problem.objective_value(schedules[0]) - objective_budget)
+                / abs(problem.objective_value(schedules[0])),
+                4,
+            )
+            if objective_budget is not None
+            else None
+        ),
         "config": "1000 jobs x 256 gpus x 50 rounds",
     }
 

@@ -1,0 +1,153 @@
+"""Shared run loop for the committed physical-cluster drivers.
+
+run_physical_localhost.py (CPU payloads) and run_physical_tpu.py
+(payloads on the real chip) differ only in worker type, payload
+localization, env, and extra summary fields; the scheduler+worker
+bring-up, the arrival-compressed submit thread, the round loop, and the
+artifact writing live here exactly once.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+from shockwave_tpu.core.physical import PhysicalScheduler
+from shockwave_tpu.policies import get_policy
+from shockwave_tpu.utils.hostenv import free_port
+
+
+def run_physical_cluster(
+    jobs,
+    arrivals,
+    oracle,
+    profiles,
+    policy_name: str,
+    out_dir: str,
+    worker_type: str,
+    worker_env: dict,
+    accelerators: int,
+    round_s: float,
+    time_scale: float,
+    max_rounds: int,
+    completion_buffer_s: float,
+    shockwave_config=None,
+    extra_summary=None,
+):
+    """Drive the full trace against a live localhost cluster; writes
+    <out_dir>/{summary.json,round_log.json,timelines.json} and returns
+    the summary dict. ``extra_summary(sched, run_dir)`` may contribute
+    additional summary fields."""
+    os.makedirs(out_dir, exist_ok=True)
+    run_dir = os.path.join(out_dir, "run")
+    ckpt_dir = os.path.join(out_dir, "ckpt")
+
+    sched_port, worker_port = free_port(), free_port()
+    sched = PhysicalScheduler(
+        get_policy(policy_name),
+        port=sched_port,
+        throughputs=oracle,
+        time_per_iteration=round_s,
+        completion_buffer_seconds=completion_buffer_s,
+        minimum_time_between_allocation_resets=0.0,
+        profiles=profiles,
+        shockwave_config=shockwave_config,
+    )
+    worker_proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "shockwave_tpu.runtime.worker",
+            "-t", worker_type, "-n", str(accelerators),
+            "-a", "127.0.0.1", "-s", str(sched_port),
+            "-p", str(worker_port),
+            "--run_dir", run_dir, "--checkpoint_dir", ckpt_dir,
+        ],
+        env=worker_env,
+    )
+    t_start = time.time()
+    try:
+        sched.wait_for_workers(accelerators, timeout=60)
+
+        submitted = []
+
+        def submit():
+            start = time.time()
+            for job, arrival in zip(jobs, arrivals):
+                delay = arrival * time_scale - (time.time() - start)
+                if delay > 0:
+                    time.sleep(delay)
+                submitted.append(sched.add_job(job))
+
+        sched.expect_jobs(len(jobs))
+        submitter = threading.Thread(target=submit, daemon=True)
+        submitter.start()
+        sched.run(max_rounds=max_rounds)
+        submitter.join(timeout=5)
+        if submitter.is_alive():
+            # The round loop hit max_rounds before the compressed
+            # arrival schedule drained; the summary must say so rather
+            # than silently undercount completions against total_jobs.
+            print(
+                f"WARNING: only {len(submitted)}/{len(jobs)} jobs were "
+                "submitted before the round budget ran out",
+                file=sys.stderr,
+            )
+
+        completed = {
+            str(j): t for j, t in sched._job_completion_times.items()
+        }
+        avg_jct = sched.get_average_jct()
+        summary = {
+            "policy": policy_name,
+            "worker_type": worker_type,
+            "accelerators": accelerators,
+            "round_s": round_s,
+            "wall_clock_s": round(time.time() - t_start, 1),
+            "makespan_s": round(sched.get_current_timestamp(), 1),
+            "avg_jct_s": (
+                round(avg_jct, 1) if avg_jct is not None else None
+            ),
+            "completed_jobs": sum(
+                1 for t in completed.values() if t is not None
+            ),
+            "total_jobs": len(jobs),
+            "submitted_jobs": len(submitted),
+            "lease_extensions": sched._num_lease_extensions,
+            "lease_extension_opportunities": (
+                sched._num_lease_extension_opportunities
+            ),
+            "steps_run": {
+                str(j): int(s) for j, s in sched._total_steps_run.items()
+            },
+            "job_completion_times_s": {
+                j: (round(t, 1) if t is not None else None)
+                for j, t in completed.items()
+            },
+        }
+        if extra_summary is not None:
+            summary.update(extra_summary(sched, run_dir))
+        with open(os.path.join(out_dir, "summary.json"), "w") as f:
+            json.dump(summary, f, indent=1)
+        with open(os.path.join(out_dir, "round_log.json"), "w") as f:
+            json.dump(sched._round_log, f, indent=1)
+        with open(os.path.join(out_dir, "timelines.json"), "w") as f:
+            json.dump(
+                {
+                    str(j): lines
+                    for j, lines in sched._job_timelines.items()
+                },
+                f,
+                indent=1,
+            )
+        print(json.dumps(summary, indent=1))
+        return summary
+    finally:
+        sched.shutdown()
+        worker_proc.terminate()
+        try:
+            worker_proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            worker_proc.kill()

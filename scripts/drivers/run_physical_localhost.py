@@ -11,7 +11,9 @@ torch workloads) are swapped for this repo's JAX training CLI with
 small step counts, arrivals are compressed, and rounds are seconds
 long. Everything else is the production path — gRPC registration,
 dispatch, the iterator lease protocol, preemption/checkpoint/resume,
-Done merging.
+Done merging. (The shared round-loop/teardown lives in
+physical_common.py; run_physical_tpu.py is the same loop with the
+payloads on the real chip.)
 
 Writes <out>/<policy>/{summary.json,round_log.json,timelines.json}.
 
@@ -21,12 +23,8 @@ Usage:
 """
 
 import argparse
-import json
 import os
-import subprocess
 import sys
-import threading
-import time
 
 sys.path.insert(
     0,
@@ -35,14 +33,12 @@ sys.path.insert(
     ),
 )
 
-from shockwave_tpu.core.physical import PhysicalScheduler  # noqa: E402
+from scripts.drivers.physical_common import run_physical_cluster  # noqa: E402
 from shockwave_tpu.data import parse_trace  # noqa: E402
 from shockwave_tpu.data.default_oracle import generate_oracle  # noqa: E402
 from shockwave_tpu.data.profiles import synthesize_profiles  # noqa: E402
-from shockwave_tpu.policies import get_policy  # noqa: E402
 from shockwave_tpu.utils.hostenv import (  # noqa: E402
     cpu_compile_cache_dir,
-    free_port,
 )
 from shockwave_tpu.utils.virtual_devices import (  # noqa: E402
     force_cpu_device_env,
@@ -129,22 +125,6 @@ def main(argv=None):
             "k": 10.0,
         }
 
-    out_dir = os.path.join(args.out, args.policy)
-    os.makedirs(out_dir, exist_ok=True)
-    run_dir = os.path.join(out_dir, "run")
-    ckpt_dir = os.path.join(out_dir, "ckpt")
-
-    sched_port, worker_port = free_port(), free_port()
-    sched = PhysicalScheduler(
-        get_policy(args.policy),
-        port=sched_port,
-        throughputs=oracle,
-        time_per_iteration=args.round_s,
-        completion_buffer_seconds=args.round_s,
-        minimum_time_between_allocation_resets=0.0,
-        profiles=profiles,
-        shockwave_config=shockwave_config,
-    )
     # Worker as a real subprocess (the deployment shape), payloads on
     # CPU so the run neither contends for nor requires the TPU.
     env = force_cpu_device_env(1, dict(os.environ))
@@ -152,93 +132,25 @@ def main(argv=None):
     # from scratch on every relaunch and can livelock against the round
     # length on slow-compiling families (ResNet-50 on CPU).
     env.setdefault("JAX_COMPILATION_CACHE_DIR", cpu_compile_cache_dir())
-    worker_proc = subprocess.Popen(
-        [
-            sys.executable, "-m", "shockwave_tpu.runtime.worker",
-            "-t", "v100", "-n", str(args.accelerators),
-            "-a", "127.0.0.1", "-s", str(sched_port),
-            "-p", str(worker_port),
-            "--run_dir", run_dir, "--checkpoint_dir", ckpt_dir,
-        ],
-        env=env,
+
+    summary = run_physical_cluster(
+        jobs,
+        arrivals,
+        oracle,
+        profiles,
+        args.policy,
+        os.path.join(args.out, args.policy),
+        "v100",
+        env,
+        args.accelerators,
+        args.round_s,
+        args.time_scale,
+        args.max_rounds,
+        completion_buffer_s=args.round_s,
+        shockwave_config=shockwave_config,
+        extra_summary=lambda sched, run_dir: {"trace": args.trace},
     )
-    t_start = time.time()
-    try:
-        sched.wait_for_workers(args.accelerators, timeout=60)
-
-        submitted = []
-
-        def submit():
-            start = time.time()
-            for job, arrival in zip(jobs, arrivals):
-                delay = arrival * args.time_scale - (time.time() - start)
-                if delay > 0:
-                    time.sleep(delay)
-                submitted.append(sched.add_job(job))
-
-        sched.expect_jobs(len(jobs))
-        submitter = threading.Thread(target=submit, daemon=True)
-        submitter.start()
-        sched.run(max_rounds=args.max_rounds)
-        submitter.join(timeout=5)
-        if submitter.is_alive():
-            # The round loop hit max_rounds before the compressed
-            # arrival schedule drained; the summary must say so rather
-            # than silently undercount completions against total_jobs.
-            print(
-                f"WARNING: only {len(submitted)}/{len(jobs)} jobs were "
-                "submitted before the round budget ran out",
-                file=sys.stderr,
-            )
-
-        completed = {
-            str(j): t for j, t in sched._job_completion_times.items()
-        }
-        avg_jct = sched.get_average_jct()
-        summary = {
-            "policy": args.policy,
-            "trace": args.trace,
-            "accelerators": args.accelerators,
-            "round_s": args.round_s,
-            "wall_clock_s": round(time.time() - t_start, 1),
-            "makespan_s": round(sched.get_current_timestamp(), 1),
-            "avg_jct_s": (
-                round(avg_jct, 1) if avg_jct is not None else None
-            ),
-            "completed_jobs": sum(
-                1 for t in completed.values() if t is not None
-            ),
-            "total_jobs": len(jobs),
-            "submitted_jobs": len(submitted),
-            "steps_run": {
-                str(j): int(s) for j, s in sched._total_steps_run.items()
-            },
-            "job_completion_times_s": {
-                j: (round(t, 1) if t is not None else None)
-                for j, t in completed.items()
-            },
-        }
-        with open(os.path.join(out_dir, "summary.json"), "w") as f:
-            json.dump(summary, f, indent=1)
-        with open(os.path.join(out_dir, "round_log.json"), "w") as f:
-            json.dump(sched._round_log, f, indent=1)
-        with open(os.path.join(out_dir, "timelines.json"), "w") as f:
-            json.dump(
-                {
-                    str(j): lines
-                    for j, lines in sched._job_timelines.items()
-                },
-                f,
-                indent=1,
-            )
-        print(json.dumps(summary, indent=1))
-    finally:
-        sched.shutdown()
-        worker_proc.terminate()
-        try:
-            worker_proc.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            worker_proc.kill()
+    return summary
 
 
 if __name__ == "__main__":

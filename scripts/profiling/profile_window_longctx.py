@@ -1,0 +1,255 @@
+#!/usr/bin/env python3
+"""Component profile of the sliding-window long-context train step.
+
+VERDICT r04 weak #4: the window=4096 runs sit at ~0.40 MFU
+(window-FLOPs-denominated) while dense flash at 131k reaches 0.72 —
+where do the cycles go? This harness splits the 196k-token
+window=4096 step (the committed long-context showcase,
+results/long_context_rope_window_tpu.json) into:
+
+  * the windowed flash attention kernel alone (fwd and fwd+bwd) vs its
+    span-FLOPs ideal — the shrunk per-q-block k-grid hypothesis;
+  * one transformer block fwd+bwd (the matmul budget at S=196k);
+  * the sequence-chunked LM head + loss;
+  * the remat recompute factor (with/without remat at a size that fits
+    unremateralized);
+  * the full step, reproducing the headline MFU.
+
+Uses the tunnel-proof measurement recipe of profile_flagship.py
+(args-not-closures, chained dispatches, slope timing). Writes a JSON
+artifact; the companion breakdown doc is
+results/window_profile_breakdown.md.
+
+Usage:
+  python scripts/profiling/profile_window_longctx.py \
+      -o results/window_profile.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+S = 196608
+BATCH = 1
+D_MODEL = 1024
+HEADS = 8
+LAYERS = 8
+VOCAB = 8192
+WINDOW = 4096
+LOGIT_CHUNK = 8192
+HEAD_DIM = D_MODEL // HEADS
+
+
+def fetch(tree):
+    leaf = jax.tree_util.tree_leaves(tree)[0]
+    return float(jnp.sum(leaf.astype(jnp.float32)))
+
+
+def slope(step, x0, min_diff_s=1.0):
+    """Per-iteration seconds via n-vs-2n chained runs."""
+    fetch(step(x0))  # compile + warm
+    n = 4
+    while True:
+        t0 = time.time()
+        x = x0
+        for _ in range(n):
+            x = step(x)
+        fetch(x)
+        t1 = time.time()
+        x = x0
+        for _ in range(2 * n):
+            x = step(x)
+        fetch(x)
+        t2 = time.time()
+        diff = (t2 - t1) - (t1 - t0)
+        if diff >= min_diff_s or n >= 256:
+            return diff / n
+        n *= 2
+
+
+def window_attention_flops(seq_len, window, heads, head_dim, batch):
+    """MACs*2 for causal sliding-window attention (QK^T + PV), the same
+    span accounting the bench's MFU denominator uses."""
+    span = min(seq_len, window)
+    return 2 * 2 * batch * heads * seq_len * span * head_dim
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("-o", "--output",
+                        default="results/window_profile.json")
+    args = parser.parse_args(argv)
+
+    from shockwave_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(0)
+    out = {
+        "device": str(jax.devices()[0]),
+        "config": {
+            "seq_len": S, "batch": BATCH, "d_model": D_MODEL,
+            "heads": HEADS, "layers": LAYERS, "vocab": VOCAB,
+            "window": WINDOW, "logit_chunk": LOGIT_CHUNK,
+            "dtype": "bfloat16", "positional": "rope", "remat": True,
+        },
+        "components": {},
+    }
+
+    def record(name, seconds, flops=None, note=None):
+        entry = {"seconds": round(seconds, 5)}
+        if flops is not None:
+            entry["tflops_per_s"] = round(flops / seconds / 1e12, 1)
+        if note:
+            entry["note"] = note
+        out["components"][name] = entry
+        print(f"{name}: {entry}", flush=True)
+
+    # -- 1. windowed flash attention kernel alone ----------------------
+    qkv = tuple(
+        jnp.asarray(
+            rng.normal(size=(BATCH, S, HEADS, HEAD_DIM)) * 0.1,
+            jnp.bfloat16,
+        )
+        for _ in range(3)
+    )
+    att_flops = window_attention_flops(S, WINDOW, HEADS, HEAD_DIM, BATCH)
+
+    @jax.jit
+    def att_fwd(q, k, v):
+        o = flash_attention(q, k, v, window=WINDOW)
+        # Chain: feed the output back as the next query so repeated
+        # dispatches cannot be collapsed.
+        return o, k, v
+
+    sec = slope(lambda x: att_fwd(*x), qkv)
+    record("window_attention_fwd", sec, att_flops,
+           "per layer; span-FLOPs accounting (S x min(S, window))")
+
+    @jax.jit
+    def att_grad(q, k, v):
+        g = jax.grad(
+            lambda q_, k_, v_: jnp.sum(
+                flash_attention(q_, k_, v_, window=WINDOW).astype(
+                    jnp.float32
+                )
+            )
+        )(q, k, v)
+        return g, k, v
+
+    sec = slope(lambda x: att_grad(*x), qkv)
+    record("window_attention_fwd_bwd", sec, 3 * att_flops,
+           "per layer (fwd + dkv + dq walks ~ 3x fwd FLOPs)")
+
+    # Dense flash at the same shape for the occupancy comparison: the
+    # same kernel with no window (full causal span).
+    dense_flops = 2 * 2 * BATCH * HEADS * S * (S / 2) * HEAD_DIM
+
+    @jax.jit
+    def att_fwd_dense(q, k, v):
+        o = flash_attention(q, k, v)
+        return o, k, v
+
+    sec = slope(lambda x: att_fwd_dense(*x), qkv)
+    record("dense_attention_fwd_same_shape", sec, dense_flops,
+           "full causal span (S^2/2) at the same [1,196k,8,128]")
+
+    # -- 2. one transformer block fwd+bwd ------------------------------
+    from shockwave_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from shockwave_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh((1, 1, 1), devices=jax.devices()[:1])
+
+    def build_model(num_layers, remat, seq_len, logit_chunk=None):
+        cfg = TransformerConfig(
+            vocab_size=VOCAB, d_model=D_MODEL, num_heads=HEADS,
+            num_layers=num_layers, d_ff=4 * D_MODEL, max_len=seq_len,
+            dtype="bfloat16", attention="flash",
+            attention_window=WINDOW, positional="rope", remat=remat,
+        )
+        return TransformerLM(cfg, mesh=mesh)
+
+    # Per-block cost: difference between 2-layer and 1-layer full
+    # forward+backward at S (subtraction cancels the embed/head).
+    from shockwave_tpu.models.transformer import lm_loss
+
+    tokens = jnp.asarray(
+        rng.integers(0, VOCAB, (BATCH, S + 1)), jnp.int32
+    )
+    secs_by_layers = {}
+    for L in (1, 2):
+        model = build_model(L, remat=True, seq_len=S)
+        variables = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                        tokens[:, :-1])
+
+        @jax.jit
+        def block_step(v, tokens):
+            return jax.grad(
+                lambda v_: lm_loss(model, v_, tokens,
+                                   logit_chunk=LOGIT_CHUNK)
+            )(v)
+
+        secs_by_layers[L] = slope(
+            lambda x: (block_step(x[0], x[1]), x[1]),
+            (variables, tokens),
+        )
+        del variables
+    block_sec = secs_by_layers[2] - secs_by_layers[1]
+    # Matmul budget per block fwd+bwd under remat: QKV+proj (4 d^2) +
+    # MLP (8 d^2) = 12 S d^2 MACs fwd; remat bwd ~ 2x fwd + recompute.
+    block_matmul_flops = 3 * (2 * 12 * BATCH * S * D_MODEL * D_MODEL)
+    record("block_fwd_bwd_remat", block_sec, block_matmul_flops,
+           "2-layer minus 1-layer full grad at S=196k (remat: "
+           "fwd recompute included); flops = matmul-only ideal x3")
+    record("embed_head_loss_chunked", secs_by_layers[1] - block_sec,
+           None, "1-layer grad minus one block: embedding + chunked "
+           "LM head + loss fwd+bwd")
+
+    # -- 3. remat factor at a size that fits unremateralized -----------
+    S_small = 32768
+    tokens_small = jnp.asarray(
+        rng.integers(0, VOCAB, (BATCH, S_small + 1)), jnp.int32
+    )
+    for remat in (True, False):
+        model = build_model(LAYERS, remat=remat, seq_len=S_small)
+        variables = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                        tokens_small[:, :-1])
+
+        @jax.jit
+        def full_grad(v, tokens):
+            return jax.grad(
+                lambda v_: lm_loss(model, v_, tokens,
+                                   logit_chunk=LOGIT_CHUNK)
+            )(v)
+
+        sec = slope(
+            lambda x: (full_grad(x[0], x[1]), x[1]),
+            (variables, tokens_small),
+        )
+        record(f"full_grad_8L_S32k_remat_{remat}", sec)
+        del variables
+
+    with open(args.output, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {args.output}")
+
+
+if __name__ == "__main__":
+    main()

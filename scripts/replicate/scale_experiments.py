@@ -64,7 +64,8 @@ SHOCKWAVE_CONFIG = {
 
 
 def run_cell(trace_file, policy_name, num_gpus, round_duration, seed=0,
-             worker_type="v100", throughputs_file=None, gpus_per_server=4):
+             worker_type="v100", throughputs_file=None, gpus_per_server=4,
+             shockwave_overrides=None):
     jobs, arrival_times = parse_trace(trace_file)
     if throughputs_file:
         from shockwave_tpu.data import read_throughputs
@@ -81,6 +82,8 @@ def run_cell(trace_file, policy_name, num_gpus, round_duration, seed=0,
     shockwave_config = None
     if policy_name.startswith("shockwave"):
         shockwave_config = dict(SHOCKWAVE_CONFIG)
+        if shockwave_overrides:
+            shockwave_config.update(shockwave_overrides)
         shockwave_config["time_per_iteration"] = round_duration
         shockwave_config["num_gpus"] = num_gpus
 

@@ -587,8 +587,12 @@ def _block_cap(head_dim: int) -> int:
     [block_q, block_k] f32 score-sized temporaries plus the operand
     blocks, which at D=256 and 1024-wide blocks overflows the 16 MiB
     scoped-VMEM budget (by 36 KiB, measured on v5e). Scale the ceiling
-    down with the head dim; D <= 128 keeps the measured-fastest 1024."""
-    return max(_LANES, 1024 * 128 // max(head_dim, 128))
+    down with the head dim; D <= 128 keeps the measured-fastest 1024.
+    Rounded down to a lane multiple so non-128-multiple head dims
+    (e.g. D=192) yield the largest lane-aligned block under the
+    budget rather than leaning on _resolve_block's step-down."""
+    cap = 1024 * 128 // max(head_dim, 128)
+    return max(_LANES, cap // _LANES * _LANES)
 
 
 def _resolve_block(requested: int, seq_len: int) -> int:

@@ -124,6 +124,14 @@ class PipelinedLM:
                 f"{config.num_layers} layers not divisible into "
                 f"{num_stages} stages"
             )
+        # Validated here, not only in init(): a PipelinedLM driven with
+        # externally constructed params would otherwise silently run
+        # with no position encoding (_embed just skips the table).
+        if config.positional not in ("learned", "rope"):
+            raise ValueError(
+                f"positional must be 'learned' or 'rope', got "
+                f"{config.positional!r}"
+            )
         self.config = config
         self.num_stages = num_stages
         self.num_microbatches = num_microbatches
@@ -174,11 +182,6 @@ class PipelinedLM:
             "ln_f_scale": jnp.ones((d,)),
             "ln_f_bias": jnp.zeros((d,)),
         }
-        if cfg.positional not in ("learned", "rope"):
-            raise ValueError(
-                f"positional must be 'learned' or 'rope', got "
-                f"{cfg.positional!r}"
-            )
         # Under rope the positions live inside each Block's Attention
         # (apply_rope — correct here because GPipe microbatches split
         # the BATCH dim, so every stage sees whole sequences); adding

@@ -228,6 +228,15 @@ def ring_attention(
         kv_group = q.shape[2] // k.shape[2]
     else:
         kv_group = 1
+    if head_axis is not None and k.shape[2] % mesh.shape[head_axis]:
+        # shard_map would fail with an opaque divisibility error at
+        # trace time; K/V heads shard over the head axis at their
+        # grouped (small) count, so that count bounds the usable mesh.
+        raise ValueError(
+            f"K/V head count ({k.shape[2]}) must be divisible by the "
+            f"mesh's {head_axis!r} axis size ({mesh.shape[head_axis]}): "
+            "grouped-query K/V rotate sharded over that axis"
+        )
     if inner == "flash":
         body = functools.partial(
             _ring_flash_local, axis_name=seq_axis, all_axes=vary_axes

@@ -425,6 +425,41 @@ def test_gqa_composes_with_window():
     )
 
 
+def test_gqa_window_grads_match_repeated_kv():
+    """The window+GQA composition in the backward pass (shrunk dkv
+    q-walk with the in-bounds skip, plus the per-group dk/dv sum) must
+    equal grads through the dense windowed graph over repeated KV."""
+    rng = np.random.default_rng(18)
+    B, S, H, Dh, kv_heads, W = 1, 512, 4, 16, 2, 160
+    group = H // kv_heads
+    q, _, _ = _qkv(rng, B, S, H, Dh)
+    _, k, v = _qkv(rng, B, S, kv_heads, Dh)
+
+    def loss_gqa(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, block_q=128, block_k=128, window=W
+            ) ** 2
+        )
+
+    def loss_dense(q, k, v):
+        return jnp.sum(
+            _dense_windowed(
+                q, _repeat_kv(k, group), _repeat_kv(v, group), W
+            ) ** 2
+        )
+
+    # The repeat lives inside loss_dense, so autodiff's repeat
+    # transpose already group-sums dk/dv back to the KV head count.
+    g_gqa = jax.grad(loss_gqa, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gg, gd in zip(g_gqa, g_dense):
+        assert gg.shape == gd.shape
+        np.testing.assert_allclose(
+            np.asarray(gg), np.asarray(gd), rtol=5e-4, atol=5e-4
+        )
+
+
 def test_gqa_rejects_bad_head_counts():
     rng = np.random.default_rng(17)
     q, _, _ = _qkv(rng, 1, 128, 4, 16)

@@ -14,7 +14,7 @@ import sys
 import pytest
 
 # Whole module spawns real multi-process jax.distributed training.
-pytestmark = pytest.mark.slow
+pytestmark = [pytest.mark.slow, pytest.mark.wallclock_retry]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

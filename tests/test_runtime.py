@@ -15,7 +15,7 @@ import pytest
 
 # Whole module: real gRPC cluster + wall-clock rounds + training
 # subprocesses - integration tier.
-pytestmark = pytest.mark.slow
+pytestmark = [pytest.mark.slow, pytest.mark.wallclock_retry]
 
 from shockwave_tpu.core.job import Job
 from shockwave_tpu.core.physical import PhysicalScheduler
