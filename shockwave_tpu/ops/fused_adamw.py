@@ -7,16 +7,20 @@ each leaf's new (m, v, p) is computed in ONE jit-fused expression — no
 updates tree, no second pass. The math matches optax.adamw's (same
 defaults, same bias correction; parity test: tests/test_fused_adamw.py).
 
-Measured on the flagship 110M tree (v5e through the tunnel): the
-standalone optimizer micro-benchmark is NOT resolvable on this host —
-ordered A/B pairs flipped sign between processes (6.9-vs-6.1 ms one
-run, 10.9-vs-18.2 another; see all_passes_ms in
-results/flagship_profile.json). The FULL train step, the number that
-matters, came out equal-or-faster with the fused path in every
-profiler run (140.2/140.9 ms vs 141.5/142.9 ms). Kept as the default
-on the structural argument — one fewer parameter-sized HBM pass is
-never more work — with exact optax parity
-(results/flagship_profile_breakdown.md, round-4 section).
+Measured on the flagship 110M tree (v5e through the tunnel). Round 4's
+cross-process A/B was unresolvable (ordered pairs flipped sign between
+processes); round 5 settled it with a paired IN-process experiment —
+both steps compiled once, then 8 interleaved A,B slope measurements
+(scripts/profiling/ab_fused_adamw.py ->
+results/fused_adamw_ab.json): full-step medians 135.79 ms (optax) vs
+135.88 ms (fused) — **a wash** (median delta -0.15 ms, fused ahead in
+2 of 8 pairs). XLA fuses optax's update chain into the step well
+enough that the hand-fused traversal saves nothing at this tier.
+Kept as the default because the numerics are optax-identical
+(tests/test_fused_adamw.py), there is no regression, and the one-pass
+shape remains the safer bet where XLA's cross-op fusion is weaker
+(very large trees, many small leaves) — but the honest claim is
+parity, not speedup.
 
 API: ``init`` / ``update`` are optax-compatible (``update`` falls back
 to returning an updates tree, for callers that need the two-step shape);

@@ -590,3 +590,43 @@ def test_remat_group_matches_ungrouped():
         TransformerLM(
             TransformerConfig(remat=True, remat_group=3, **kw)
         ).init(jax.random.PRNGKey(0), tokens[:, :-1])
+
+
+def test_checkpoint_save_is_atomic_and_corrupt_file_fails_loudly(tmp_path):
+    """A preemption kill can land mid-save; the save must go through a
+    temp file + os.replace so the previous good checkpoint survives a
+    torn write (observed live on the packed-pair chip demo: a torn
+    msgpack poisoned every retry). A genuinely corrupt checkpoint must
+    fail the attempt loudly (nonzero exit -> the scheduler's
+    failure/retry path), never silently train from zeros."""
+    import os
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    cmd = [
+        sys.executable, "-m", "shockwave_tpu.models.train",
+        "--model", "Recommendation", "--batch_size", "8", "-n", "2",
+        "--checkpoint_dir", str(tmp_path),
+    ]
+    out1 = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=180, env=env
+    )
+    assert out1.returncode == 0, out1.stderr
+    ckpt = tmp_path / "train_state.msgpack"
+    good = ckpt.read_bytes()
+
+    # A stale partial temp file (simulated mid-write kill) must not
+    # affect the resume: the final path still holds the good bytes.
+    (tmp_path / "train_state.msgpack.tmp").write_bytes(good[: len(good) // 3])
+    out2 = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=180, env=env
+    )
+    assert out2.returncode == 0, out2.stderr
+    # The completed run's save replaces the temp file atomically.
+    assert not (tmp_path / "train_state.msgpack.tmp").exists()
+
+    # Truncate the real checkpoint: the attempt must die loudly.
+    ckpt.write_bytes(good[: len(good) // 3])
+    out3 = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=180, env=env
+    )
+    assert out3.returncode != 0
