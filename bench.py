@@ -45,6 +45,13 @@ def make_problem(num_jobs, future_rounds, num_gpus, seed=0, regularizer=10.0):
     total = rng.integers(5, 60, num_jobs).astype(float)
     completed = np.floor(total * rng.uniform(0, 0.8, num_jobs))
     epoch_dur = rng.uniform(60, 2000, num_jobs)
+    # Preemption-aware extended objective: ~20% of the fleet holds
+    # workers when the plan is computed, each with a relaunch overhead in
+    # the measured physical-TPU range (results/physical_tpu/ phase
+    # report, 35-90 s), so the parity and speedup audits cover the
+    # switching-cost term at stress scale.
+    incumbent = (rng.random(num_jobs) < 0.2).astype(np.float64)
+    switch_cost = rng.uniform(35.0, 90.0, num_jobs) * incumbent
     return EGProblem(
         priorities=rng.uniform(0.5, 30.0, num_jobs),
         completed_epochs=completed,
@@ -57,6 +64,8 @@ def make_problem(num_jobs, future_rounds, num_gpus, seed=0, regularizer=10.0):
         future_rounds=future_rounds,
         regularizer=regularizer,
         log_bases=np.array([0.0, 0.2, 0.4, 0.6, 0.8, 1.0]),
+        switch_cost=switch_cost,
+        incumbent=incumbent,
     )
 
 
@@ -160,6 +169,23 @@ def main():
         budget_s = time.time() - t0
         objective_budget = None
 
+    objective_tpu = problem.objective_value(schedules[0])
+    # The equal-time gap as a percentage needs a denominator: the
+    # log-Nash-welfare objective can legitimately sit near (or cross)
+    # zero, where the ratio explodes into noise. Report the absolute
+    # delta always, and the percentage only when the denominator is
+    # meaningfully far from zero.
+    equal_time_delta = (
+        round(objective_tpu - objective_budget, 6)
+        if objective_budget is not None
+        else None
+    )
+    equal_time_pct = None
+    if objective_budget is not None and abs(objective_tpu) > 1e-6:
+        equal_time_pct = round(
+            100.0 * (objective_tpu - objective_budget) / abs(objective_tpu), 4
+        )
+
     record = {
         "metric": "shockwave_plan_solve_wall_clock",
         "value": round(warm_median, 4),
@@ -173,7 +199,7 @@ def main():
         "host_median_s": round(statistics.median(host_t), 4),
         "runs": RUNS,
         "schedule_audit": "ok",
-        "objective_tpu": round(problem.objective_value(schedules[0]), 4),
+        "objective_tpu": round(objective_tpu, 4),
         "objective_baseline": round(problem.objective_value(Y_milp), 4),
         "baseline_budget15_s": round(budget_s, 3),
         "baseline_budget15_status": (
@@ -184,16 +210,8 @@ def main():
             if objective_budget is not None
             else None
         ),
-        "equal_time_objective_gap_pct": (
-            round(
-                100.0
-                * (problem.objective_value(schedules[0]) - objective_budget)
-                / abs(problem.objective_value(schedules[0])),
-                4,
-            )
-            if objective_budget is not None
-            else None
-        ),
+        "equal_time_objective_gap_pct": equal_time_pct,
+        "equal_time_objective_delta": equal_time_delta,
         "config": "1000 jobs x 256 gpus x 50 rounds",
     }
 

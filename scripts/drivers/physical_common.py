@@ -20,6 +20,29 @@ from shockwave_tpu.core.physical import PhysicalScheduler
 from shockwave_tpu.policies import get_policy
 from shockwave_tpu.utils.hostenv import free_port
 
+# Phases a preempted job pays again on every relaunch (the `train`
+# phase is the useful work, not overhead; `rendezvous` only gangs pay,
+# but for them it IS part of the relaunch bill).
+_RELAUNCH_PHASES = (
+    "rendezvous", "build", "restore", "first_step_compile", "save",
+)
+
+
+def overheads_from_phase_report(report: dict) -> dict:
+    """Per-family relaunch overhead (seconds) from a committed
+    ``preemption_overhead_phases`` summary block: the sum of the mean
+    per-attempt relaunch phases. This is the measured table the planner's
+    switching-cost term and round auto-sizing consume."""
+    overheads = {}
+    for family, entry in report.items():
+        total = sum(
+            float(entry.get(f"{phase}_mean_s", 0.0))
+            for phase in _RELAUNCH_PHASES
+        )
+        if total > 0.0:
+            overheads[family] = round(total, 1)
+    return overheads
+
 
 def run_physical_cluster(
     jobs,
@@ -37,6 +60,8 @@ def run_physical_cluster(
     completion_buffer_s: float,
     shockwave_config=None,
     extra_summary=None,
+    preemption_overheads=None,
+    round_overhead_fraction=None,
 ):
     """Drive the full trace against a live localhost cluster; writes
     <out_dir>/{summary.json,round_log.json,timelines.json} and returns
@@ -56,6 +81,8 @@ def run_physical_cluster(
         minimum_time_between_allocation_resets=0.0,
         profiles=profiles,
         shockwave_config=shockwave_config,
+        preemption_overheads=preemption_overheads,
+        round_overhead_fraction=round_overhead_fraction,
     )
     worker_proc = subprocess.Popen(
         [
@@ -100,11 +127,18 @@ def run_physical_cluster(
             str(j): t for j, t in sched._job_completion_times.items()
         }
         avg_jct = sched.get_average_jct()
+        # Finish-time fairness — the metric the planner pays preemption
+        # overhead to win; every physical summary must report it, not
+        # only the simulator (sim getter: core/scheduler.py
+        # get_finish_time_fairness).
+        ftf_list, unfair_fraction = sched.get_finish_time_fairness()
         summary = {
             "policy": policy_name,
             "worker_type": worker_type,
             "accelerators": accelerators,
             "round_s": round_s,
+            "effective_round_s": sched._time_per_iteration,
+            "preemption_overheads": preemption_overheads,
             "wall_clock_s": round(time.time() - t_start, 1),
             "makespan_s": round(sched.get_current_timestamp(), 1),
             "avg_jct_s": (
@@ -118,6 +152,11 @@ def run_physical_cluster(
             "lease_extensions": sched._num_lease_extensions,
             "lease_extension_opportunities": (
                 sched._num_lease_extension_opportunities
+            ),
+            "num_preemptions": sched.get_num_preemptions(),
+            "worst_ftf": round(max(ftf_list), 3) if ftf_list else None,
+            "unfair_fraction": (
+                round(unfair_fraction, 1) if ftf_list else None
             ),
             "steps_run": {
                 str(j): int(s) for j, s in sched._total_steps_run.items()

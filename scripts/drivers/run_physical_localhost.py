@@ -106,6 +106,21 @@ def main(argv=None):
     parser.add_argument("--time_scale", type=float, default=0.002,
                         help="arrival-time compression")
     parser.add_argument("--max_rounds", type=int, default=90)
+    parser.add_argument(
+        "--overheads",
+        type=float,
+        default=None,
+        help="measured per-relaunch overhead (seconds, every family) fed "
+        "to the planner's switching-cost term; CPU payloads pay ~7 s of "
+        "process startup per relaunch on a warm compile cache",
+    )
+    parser.add_argument(
+        "--round_overhead_fraction",
+        type=float,
+        default=None,
+        help="auto-size the round so the relaunch overhead costs at most "
+        "this fraction of it",
+    )
     args = parser.parse_args(argv)
 
     jobs, arrivals = parse_trace(args.trace)
@@ -148,6 +163,8 @@ def main(argv=None):
         args.max_rounds,
         completion_buffer_s=args.round_s,
         shockwave_config=shockwave_config,
+        preemption_overheads=args.overheads,
+        round_overhead_fraction=args.round_overhead_fraction,
         extra_summary=lambda sched, run_dir: {"trace": args.trace},
     )
     return summary

@@ -47,7 +47,10 @@ sys.path.insert(
     ),
 )
 
-from scripts.drivers.physical_common import run_physical_cluster  # noqa: E402
+from scripts.drivers.physical_common import (  # noqa: E402
+    overheads_from_phase_report,
+    run_physical_cluster,
+)
 from shockwave_tpu.data import parse_trace, read_throughputs  # noqa: E402
 from shockwave_tpu.data.profiles import synthesize_profiles  # noqa: E402
 
@@ -78,13 +81,30 @@ def localize_jobs(jobs, oracle, train_s):
     CPU-sized (module docstring)."""
     for job in jobs:
         m = _BS_RE.match(job.job_type)
+        if m is None:
+            raise ValueError(
+                f"trace job_type {job.job_type!r} does not match the "
+                "'<family> (batch size <N>)' form this driver localizes"
+            )
         family, bs = m.group("family"), int(m.group("bs"))
         if job.scale_factor > 1:
+            if family not in GANG_CPU_BATCH:
+                raise ValueError(
+                    f"no CPU gang batch size for family {family!r} "
+                    f"(job_type {job.job_type!r}); add it to GANG_CPU_BATCH"
+                )
             bs = GANG_CPU_BATCH[family]
             prefix = "env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu "
             job.total_steps = GANG_STEPS
         else:
-            rate = oracle[WORKER_TYPE][(job.job_type, 1)]["null"]
+            try:
+                rate = oracle[WORKER_TYPE][(job.job_type, 1)]["null"]
+            except KeyError:
+                raise ValueError(
+                    f"measured oracle has no {WORKER_TYPE!r} rate for "
+                    f"job_type {job.job_type!r}; re-run the oracle "
+                    "microbenchmark or fix the trace"
+                ) from None
             prefix = ""
             # The in-process loop rate runs below the microbenchmark
             # oracle (per-step dispatch + batch upload latency over the
@@ -151,11 +171,38 @@ def main(argv=None):
     )
     parser.add_argument("--time_scale", type=float, default=0.002)
     parser.add_argument("--max_rounds", type=int, default=60)
+    parser.add_argument(
+        "--overheads_from",
+        default=None,
+        help="summary.json of a prior run; its per-family "
+        "preemption_overhead_phases seed the planner's switching-cost "
+        "term and round auto-sizing",
+    )
+    parser.add_argument(
+        "--round_overhead_fraction",
+        type=float,
+        default=None,
+        help="auto-size the round so the worst measured relaunch "
+        "overhead costs at most this fraction of it",
+    )
     args = parser.parse_args(argv)
 
     jobs, arrivals = parse_trace(args.trace)
     oracle = read_throughputs(args.oracle)
     jobs = localize_jobs(jobs, oracle, args.train_s)
+    preemption_overheads = None
+    if args.overheads_from:
+        import json
+
+        with open(args.overheads_from) as f:
+            prior = json.load(f)
+        report = prior.get("preemption_overhead_phases")
+        if not report:
+            raise ValueError(
+                f"{args.overheads_from} carries no "
+                "preemption_overhead_phases block to seed overheads from"
+            )
+        preemption_overheads = overheads_from_phase_report(report)
     profiles = synthesize_profiles(jobs, oracle, worker_type=WORKER_TYPE)
     for i, job in enumerate(jobs):
         job.duration = sum(profiles[i]["duration_every_epoch"])
@@ -190,6 +237,8 @@ def main(argv=None):
         args.max_rounds,
         completion_buffer_s=1.5 * args.round_s,
         shockwave_config=shockwave_config,
+        preemption_overheads=preemption_overheads,
+        round_overhead_fraction=args.round_overhead_fraction,
         extra_summary=lambda sched, run_dir: {
             "trace": args.trace,
             "preemption_overhead_phases": collect_phase_report(run_dir),

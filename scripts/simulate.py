@@ -94,6 +94,22 @@ def main(args):
             "v100", sum(cluster_spec.values())
         )
 
+    preemption_overheads = None
+    if args.preemption_overheads:
+        # A JSON literal (scalar seconds, or {family: seconds}) or a
+        # path to a JSON file holding one.
+        if os.path.exists(args.preemption_overheads):
+            with open(args.preemption_overheads) as f:
+                preemption_overheads = json.load(f)
+        else:
+            try:
+                preemption_overheads = json.loads(args.preemption_overheads)
+            except json.JSONDecodeError:
+                raise SystemExit(
+                    f"--preemption_overheads {args.preemption_overheads!r} "
+                    "is neither an existing file nor a JSON literal"
+                ) from None
+
     policy = get_policy(args.policy, solver=args.solver, seed=args.seed)
     sched = Scheduler(
         policy,
@@ -105,6 +121,8 @@ def main(args):
         shockwave_config=shockwave_config,
         profiling_percentage=args.profiling_percentage,
         num_reference_models=args.num_reference_models,
+        preemption_overheads=preemption_overheads,
+        round_overhead_fraction=args.round_overhead_fraction,
     )
 
     jobs_to_complete = None
@@ -140,6 +158,12 @@ def main(args):
     if ftf_list:
         print(f"Worst FTF: {max(ftf_list):.3f}")
         print(f"Unfair job fraction: {unfair_fraction:.1f}%")
+    print(f"Preemptions: {sched.get_num_preemptions()}")
+    if sched._time_per_iteration != args.time_per_iteration:
+        print(
+            f"Round auto-sized: {args.time_per_iteration} s -> "
+            f"{sched._time_per_iteration:.0f} s"
+        )
     print(f"Rounds: {sched._num_completed_rounds}; sim wall-clock: {wall:.1f} s")
 
     if args.round_log:
@@ -158,6 +182,8 @@ def main(args):
             "avg_jct": avg_jct,
             "worst_ftf": max(ftf_list) if ftf_list else None,
             "unfair_fraction": unfair_fraction,
+            "num_preemptions": sched.get_num_preemptions(),
+            "effective_round_s": sched._time_per_iteration,
         }
         os.makedirs(os.path.dirname(args.output_pickle) or ".", exist_ok=True)
         with open(args.output_pickle, "wb") as f:
@@ -197,6 +223,21 @@ if __name__ == "__main__":
         "consumed by scripts/analysis/postprocess_log.py",
     )
     parser.add_argument("--no_profile_cache", action="store_true")
+    parser.add_argument(
+        "--preemption_overheads",
+        type=str,
+        default=None,
+        help="measured relaunch overhead feeding the planner's "
+        "switching-cost term: a JSON literal (scalar seconds or "
+        '{"family": seconds}) or a path to a JSON file holding one',
+    )
+    parser.add_argument(
+        "--round_overhead_fraction",
+        type=float,
+        default=None,
+        help="auto-size the round so the worst relaunch overhead costs "
+        "at most this fraction of it (never shrinks the round)",
+    )
     parser.add_argument(
         "--profiling_percentage",
         type=float,

@@ -46,11 +46,16 @@ class PhysicalScheduler(Scheduler):
         # The reference's fixed 1920s reset throttle assumes 360s rounds
         # (scheduler.py:100); scale it with the round length so short-round
         # deployments do not starve late arrivals of allocation updates.
-        if "minimum_time_between_allocation_resets" not in kwargs:
-            kwargs["minimum_time_between_allocation_resets"] = (
-                1920.0 / 360.0
-            ) * float(kwargs.get("time_per_iteration", 360.0))
+        # Computed AFTER the base init so overhead-aware round auto-sizing
+        # (round_overhead_fraction) is reflected in the throttle too.
+        explicit_reset = "minimum_time_between_allocation_resets" in kwargs
+        if not explicit_reset:
+            kwargs["minimum_time_between_allocation_resets"] = 0.0
         super().__init__(policy, simulate=False, **kwargs)
+        if not explicit_reset:
+            self._min_reset_interval = (
+                1920.0 / 360.0
+            ) * self._time_per_iteration
         self._port = port
         self._completion_buffer = completion_buffer_seconds
         self._start_time = time.time()
@@ -346,6 +351,13 @@ class PhysicalScheduler(Scheduler):
                         assignments[key] = ids
                         assigned_singles.update(key.singletons())
                         occupied.update(ids)
+                for key, prev_ids in self._current_worker_assignments.items():
+                    if not any(s in self._jobs for s in key.singletons()):
+                        continue
+                    if key not in assignments or set(
+                        assignments[key]
+                    ) != set(prev_ids):
+                        self._num_preemptions += 1
                 self._current_worker_assignments = assignments
                 self._round_log.append(
                     {

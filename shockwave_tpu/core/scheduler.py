@@ -50,6 +50,65 @@ BS_BIG = 0
 BS_SMALL = 1
 
 
+def resolve_preemption_overhead(overheads, job_type: str) -> float:
+    """Per-job relaunch overhead (seconds) from an overhead table.
+
+    ``overheads`` is either a scalar (every family pays the same), or a
+    dict keyed by family name — the part of ``job_type`` before the
+    " (batch size N)" suffix — with an optional "default" entry. Absent
+    families cost 0 (overhead-blind), matching the measured reports,
+    which only cover families that actually relaunched.
+    """
+    if overheads is None:
+        return 0.0
+    if isinstance(overheads, (int, float)):
+        return float(overheads)
+    family = job_type.split(" (")[0]
+    return float(overheads.get(family, overheads.get("default", 0.0)))
+
+
+def autosize_round_duration(
+    overheads,
+    base_round_s: float,
+    max_overhead_fraction: float = 0.25,
+    max_round_s: Optional[float] = None,
+) -> float:
+    """Overhead-aware round length: long enough that the WORST measured
+    per-family relaunch overhead costs at most ``max_overhead_fraction``
+    of one round (the Shockwave paper amortizes with a fixed 360 s round,
+    reference scheduler.py:100; with measured overheads the round can be
+    sized instead of guessed). Never shrinks below ``base_round_s``;
+    ``max_round_s`` caps the stretch so one pathological measurement
+    cannot push rounds toward infinity.
+    """
+    if not 0.0 < max_overhead_fraction <= 1.0:
+        raise ValueError(
+            f"max_overhead_fraction must be in (0, 1], got "
+            f"{max_overhead_fraction}"
+        )
+    if overheads is None:
+        worst = 0.0
+    elif isinstance(overheads, (int, float)):
+        worst = float(overheads)
+    elif isinstance(overheads, dict):
+        worst = max(
+            (float(v) for k, v in overheads.items() if k != "default"),
+            default=0.0,
+        )
+        worst = max(worst, float(overheads.get("default", 0.0)))
+    else:
+        # Same contract as resolve_preemption_overhead: anything else
+        # would pass sizing here and then crash at the first add_job.
+        raise TypeError(
+            "preemption overheads must be None, a scalar, or a "
+            f"{{family: seconds}} dict, got {type(overheads).__name__}"
+        )
+    sized = max(float(base_round_s), worst / max_overhead_fraction)
+    if max_round_s is not None:
+        sized = min(sized, float(max_round_s))
+    return max(sized, float(base_round_s))
+
+
 class Scheduler:
     def __init__(
         self,
@@ -67,11 +126,34 @@ class Scheduler:
         log_level=None,
         profiling_percentage: float = 1.0,
         num_reference_models: Optional[int] = None,
+        preemption_overheads=None,
+        round_overhead_fraction: Optional[float] = None,
     ):
         self._policy = policy
         self._simulate = simulate
         self._oracle_throughputs = throughputs
         self._time_per_iteration = float(time_per_iteration)
+        # Preemption awareness: per-family relaunch overheads (seconds;
+        # scalar or {family: seconds}) feed the Shockwave planner's
+        # switching-cost term, and — when round_overhead_fraction is set
+        # — auto-size the round so the worst relaunch costs at most that
+        # fraction of it.
+        if preemption_overheads is None and shockwave_config is not None:
+            preemption_overheads = shockwave_config.get(
+                "preemption_overheads"
+            )
+        self._preemption_overheads = preemption_overheads
+        if round_overhead_fraction is not None:
+            sized = autosize_round_duration(
+                preemption_overheads,
+                self._time_per_iteration,
+                max_overhead_fraction=round_overhead_fraction,
+            )
+            if sized != self._time_per_iteration:
+                self._time_per_iteration = sized
+                if shockwave_config is not None:
+                    shockwave_config = dict(shockwave_config)
+                    shockwave_config["time_per_iteration"] = sized
         self._profiles = profiles or {}
         self._max_rounds = max_rounds
         self._min_reset_interval = minimum_time_between_allocation_resets
@@ -153,6 +235,11 @@ class Scheduler:
         self._current_round_scheduled_jobs: List[JobId] = []
         self._num_lease_extensions = 0
         self._num_lease_extension_opportunities = 0
+        # Preemptions: a still-active job that held workers last round
+        # and this round is either unscheduled or moved to a different
+        # worker set (each one pays a checkpoint/relaunch in physical
+        # mode; the planner's switching-cost term exists to reduce this).
+        self._num_preemptions = 0
 
         self._logger = make_logger(
             "scheduler", lambda: self._current_timestamp,
@@ -310,6 +397,9 @@ class Scheduler:
                 self._time_per_iteration,
                 job.scale_factor,
                 submit_time=self.get_current_timestamp(),
+                overhead_s=resolve_preemption_overhead(
+                    self._preemption_overheads, job.job_type
+                ),
                 **pool_kwargs,
             )
         if timestamp is None:
@@ -1507,6 +1597,11 @@ class Scheduler:
             for job_id in self._current_worker_assignments:
                 if any(s in self._jobs for s in job_id.singletons()):
                     self._num_lease_extension_opportunities += 1
+                    kept = job_id in scheduled_jobs and set(
+                        self._current_worker_assignments[job_id]
+                    ) == set(scheduled_jobs[job_id])
+                    if not kept:
+                        self._num_preemptions += 1
             for job_id in scheduled_jobs:
                 if job_id in self._current_worker_assignments and set(
                     self._current_worker_assignments[job_id]
@@ -1585,6 +1680,7 @@ class Scheduler:
         "_cumulative_worker_time_so_far",
         "_num_lease_extensions",
         "_num_lease_extension_opportunities",
+        "_num_preemptions",
         "_completed_jobs",
         "_slos",
         "_in_progress_updates",
@@ -1755,6 +1851,12 @@ class Scheduler:
         if job_ids is None:
             job_ids = sorted(self._total_steps_run.keys())
         return {j: self._total_steps_run[j] for j in job_ids if j in self._total_steps_run}
+
+    def get_num_preemptions(self):
+        """Count of round transitions where a still-active job lost its
+        workers (unscheduled or moved) — each one is a checkpoint/relaunch
+        in physical mode."""
+        return self._num_preemptions
 
     def get_num_lease_extensions(self):
         """(reference: scheduler.py:2248-2265)"""

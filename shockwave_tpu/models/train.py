@@ -446,8 +446,19 @@ def main(argv=None):
 
         ckpt_path = resume_from
         if resuming:
-            with open(ckpt_path, "rb") as f:
-                blob = f.read()
+            try:
+                with open(ckpt_path, "rb") as f:
+                    blob = f.read()
+            except FileNotFoundError:
+                # Same race the post-restore check below guards: the
+                # scheduler's cleanup (or a competing attempt) removed
+                # the checkpoint between resume detection and restore.
+                # Route it into the identical loud RuntimeError so both
+                # backends report the race the same way.
+                raise RuntimeError(
+                    f"checkpoint at {resume_from} disappeared between "
+                    "resume detection and restore"
+                ) from None
             try:
                 variables, opt_state = serialization.from_bytes(
                     (variables, opt_state), blob
@@ -496,6 +507,16 @@ def main(argv=None):
             f"checkpoint at {resume_from} disappeared between resume "
             "detection and restore"
         )
+    if restored and jax.process_count() == 1:
+        # from_bytes / orbax restore leave host-side numpy leaves;
+        # donated host buffers are unusable, so the first jit_step —
+        # the largest (compile-inclusive) step — would copy the whole
+        # state and warn "donated buffers were not usable" into the
+        # phase-timing scrape. Upload once here instead, charged to the
+        # restore phase where the transfer belongs. (Multi-process runs
+        # go through host_local_array_to_global_array below, which does
+        # its own placement.)
+        variables, opt_state = jax.device_put((variables, opt_state))
     mark_phase("restore")
     jit_step = jax.jit(step_fn, donate_argnums=(0, 1))
     # Each gang member generates ITS OWN data shard (distinct rng per

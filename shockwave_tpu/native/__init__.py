@@ -76,7 +76,7 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.eg_greedy_solve.argtypes = [
         ctypes.c_int,  # num_jobs
         ctypes.c_int,  # future_rounds
-        d, d, d, d, d, d,  # priorities..nworkers
+        d, d, d, d, d, d, d,  # priorities..nworkers, switch_bonus
         ctypes.c_double,  # num_gpus
         d, d,  # log_bases, log_vals
         ctypes.c_int,  # num_bases
@@ -111,6 +111,7 @@ def solve_eg_greedy_native(problem) -> np.ndarray:
         problem.epoch_duration,
         problem.remaining_runtime,
         problem.nworkers,
+        problem.switch_bonus(),
     ):
         a, p = arr(field)
         keep.append(a)

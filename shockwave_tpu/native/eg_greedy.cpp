@@ -29,7 +29,10 @@ double interp(double x, const double* xs, const double* ys, int n) {
 extern "C" {
 
 // All job arrays have length num_jobs; Y is (num_jobs x future_rounds)
-// row-major int8, zero-initialized by the caller.
+// row-major int8, zero-initialized by the caller. switch_bonus is the
+// per-job keep-incumbent bonus (regularizer * relaunch overhead for
+// jobs holding workers, 0 otherwise) credited to a job's first granted
+// round — the switching-cost term of the extended EG objective.
 void eg_greedy_solve(
     int num_jobs,
     int future_rounds,
@@ -39,6 +42,7 @@ void eg_greedy_solve(
     const double* epoch_dur,
     const double* remaining,
     const double* nworkers,
+    const double* switch_bonus,
     double num_gpus,
     const double* log_bases,
     const double* log_vals,
@@ -64,8 +68,10 @@ void eg_greedy_solve(
   };
   auto utility = [&](int j, double nj) {
     const double progress = (completed[j] + planned_epochs(j, nj)) / total[j];
+    const double bonus = (nj >= 0.5) ? switch_bonus[j] : 0.0;
     return priorities[j] * interp(progress, log_bases, log_vals, num_bases) /
-           norm;
+               norm +
+           bonus;
   };
   auto lateness = [&](int j, double nj) {
     return std::max(0.0, remaining[j] - dur[j] * planned_epochs(j, nj));

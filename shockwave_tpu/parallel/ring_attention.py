@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from shockwave_tpu.utils.compat import pcast_varying, shard_map
+
 from shockwave_tpu.ops.flash_attention import (
     flash_attention_lse,
     flash_tiles,
@@ -117,9 +119,9 @@ def _ring_attention_local(q, k, v, axis_name: str, all_axes: tuple,
     l0 = jnp.zeros((B, H, S), dtype=jnp.float32)
     # Mark the fresh carries as device-varying so the loop carry type
     # matches the per-shard outputs (shard_map vma tracking).
-    acc0 = jax.lax.pcast(acc0, all_axes, to="varying")
-    m0 = jax.lax.pcast(m0, all_axes, to="varying")
-    l0 = jax.lax.pcast(l0, all_axes, to="varying")
+    acc0 = pcast_varying(acc0, all_axes)
+    m0 = pcast_varying(m0, all_axes)
+    l0 = pcast_varying(l0, all_axes)
     acc, m, l, _, _ = jax.lax.fori_loop(
         0, num_shards, step, (acc0, m0, l0, k, v)
     )
@@ -246,7 +248,7 @@ def ring_attention(
             _ring_attention_local, axis_name=seq_axis,
             all_axes=vary_axes, group=kv_group,
         )
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(io_spec, io_spec, io_spec),

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from shockwave_tpu.utils.compat import shard_map
+
 from shockwave_tpu.parallel.ring_attention import dense_causal_attention
 
 
@@ -97,7 +99,7 @@ def ulysses_attention(
         if not flash_tiles(q.shape[1]):
             local_attention = "dense"
     io_spec = P(batch_axis, seq_axis, head_axis, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _ulysses_local,
             axis_name=seq_axis,

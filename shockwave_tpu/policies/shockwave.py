@@ -59,28 +59,40 @@ class ShockwavePlanner:
         self.solver_rel_gap = float(config.get("solver_rel_gap", 1e-3))
         self.solver_timeout = float(config.get("solver_timeout", 15.0))
         self.solver_num_steps = int(config.get("solver_num_steps", 256))
+        # Preemption-aware planning: scale on the per-job measured
+        # relaunch overheads the scheduler threads through add_job. 0
+        # disables the switching-cost term even when overheads are known.
+        self.switch_cost_weight = float(config.get("switch_cost_weight", 1.0))
 
         self.round_index = 0
         self.recompute_flag = False
         self.schedules: "OrderedDict[int, list]" = OrderedDict()
         self.job_metadata: "OrderedDict[object, JobMetadata]" = OrderedDict()
         self.finish_time_estimates: Dict[object, list] = {}
+        # Per-job measured relaunch overhead (seconds), from the
+        # scheduler's per-family table; 0.0 = overhead-blind.
+        self.job_overheads: Dict[object, float] = {}
+        # Jobs scheduled in the round that just executed — the incumbent
+        # placements a replan is charged for dropping.
+        self.last_round_jobs: List[object] = []
         # Wall-clock seconds of each plan solve (consumed by bench.py).
         self.solve_times: List[float] = []
 
     # -- scheduler-facing interface -------------------------------------
     def add_job(
         self, job_id, profile: dict, round_len: float, scale_factor: int,
-        submit_time: Optional[float] = None,
+        submit_time: Optional[float] = None, overhead_s: float = 0.0,
     ) -> None:
         md = JobMetadata(profile, round_len, scale_factor)
         if submit_time is not None:
             md.submit(submit_time)
         self.job_metadata[job_id] = md
+        self.job_overheads[job_id] = float(overhead_s)
 
     def remove_job(self, job_id) -> None:
         self.job_metadata.pop(job_id, None)
         self.finish_time_estimates.pop(job_id, None)
+        self.job_overheads.pop(job_id, None)
 
     def record_round_throughput(self, job_id, round_id, throughput, bs) -> None:
         md = self.job_metadata.get(job_id)
@@ -98,6 +110,9 @@ class ShockwavePlanner:
             md.complete(min(int(num_epochs), md.total_epochs))
 
     def increment_round(self) -> None:
+        # The round at the cursor has just executed: its jobs are the
+        # incumbents the next replan's switching-cost term protects.
+        self.last_round_jobs = list(self.schedules.get(self.round_index, []))
         self.round_index += 1
 
     def set_recompute_flag(self) -> None:
@@ -128,6 +143,8 @@ class ShockwavePlanner:
             "finish_time_estimates": {
                 j: list(h) for j, h in self.finish_time_estimates.items()
             },
+            "job_overheads": dict(self.job_overheads),
+            "last_round_jobs": list(self.last_round_jobs),
             "solve_times": list(self.solve_times),
         }
 
@@ -146,6 +163,8 @@ class ShockwavePlanner:
         planner.finish_time_estimates = {
             j: list(h) for j, h in state["finish_time_estimates"].items()
         }
+        planner.job_overheads = dict(state.get("job_overheads", {}))
+        planner.last_round_jobs = list(state.get("last_round_jobs", []))
         planner.solve_times = list(state["solve_times"])
         return planner
 
@@ -221,6 +240,20 @@ class ShockwavePlanner:
             history.append((self.round_index, predicted_finish))
             ftf = predicted_jct / self._interpolated_finish_time(job_id)
             priorities[i] = ftf ** self.priority_power
+        # Switching-cost inputs: measured relaunch overhead per job and
+        # the incumbent mask (who held workers in the round that just
+        # ran). All-zero overheads leave the problem bit-identical to
+        # the overhead-blind formulation.
+        incumbent_set = set(self.last_round_jobs)
+        switch_cost = np.array(
+            [
+                self.switch_cost_weight * self.job_overheads.get(j, 0.0)
+                for j in job_ids
+            ]
+        )
+        incumbent = np.array(
+            [1.0 if j in incumbent_set else 0.0 for j in job_ids]
+        )
         problem = EGProblem(
             priorities=priorities,
             completed_epochs=completed,
@@ -233,6 +266,8 @@ class ShockwavePlanner:
             future_rounds=self.future_rounds,
             regularizer=self.regularizer,
             log_bases=np.asarray(self.log_bases, dtype=np.float64),
+            switch_cost=switch_cost,
+            incumbent=incumbent,
         )
         return problem, job_ids
 
@@ -363,11 +398,83 @@ class ShockwavePlanner:
         start = time.time()
         Y = self._solve(problem)
         self.solve_times.append(time.time() - start)
+        Y = self._apply_stickiness(Y, problem)
         Y = self._backfill(Y, problem)
         for r in range(self.future_rounds):
             self.schedules[self.round_index + r] = [
                 job_ids[j] for j in range(len(job_ids)) if Y[j, r]
             ]
+
+    def _apply_stickiness(self, Y: np.ndarray, problem: EGProblem) -> np.ndarray:
+        """Lease stickiness: pull granted incumbents into the plan's first
+        round so the scheduler's keep-previous-workers pass (and physical
+        mode's lease extension) can hold their placements.
+
+        The switching-cost term decides WHETHER an incumbent keeps any
+        rounds; this pass decides WHERE. All moves preserve per-job round
+        counts and per-round capacity, so utility and makespan are
+        untouched — only the (secondary) unfairness-reordering objective
+        can regress, and a swap is taken only when the avoided relaunch
+        delay beats that regression in the reorder program's own currency
+        (priority-rate x rounds): displacing job k from round 0 to round
+        r costs (rate_k - rate_j) * r, keeping incumbent j running saves
+        it a rate_j * overhead_j / round_duration re-launch delay.
+        """
+        bonus = problem.switch_bonus()
+        if not np.any(bonus > 0.0):
+            return Y
+        J, R = Y.shape
+        counts = Y.sum(axis=1)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            rate = np.where(
+                counts > 0, problem.priorities / np.maximum(counts, 1), 0.0
+            )
+        free0 = float(problem.num_gpus) - float(
+            np.sum(problem.nworkers * Y[:, 0])
+        )
+        # Largest overheads first: they have the strongest claim on the
+        # scarce round-0 capacity.
+        for j in np.argsort(-bonus):
+            if bonus[j] <= 0.0 or Y[j, 0] == 1 or counts[j] == 0:
+                continue
+            r_star = int(np.argmax(Y[j] == 1))
+            if problem.nworkers[j] <= free0:
+                # Free capacity in round 0: moving j earlier also
+                # improves the reordering objective. Always take it.
+                Y[j, 0], Y[j, r_star] = 1, 0
+                free0 -= problem.nworkers[j]
+                continue
+            # Swap with a round-0 occupant: never preempt another
+            # incumbent-with-overhead to save this one, keep both rounds
+            # within capacity, and require the relaunch delay avoided to
+            # beat the fairness-ordering regression.
+            delay_rounds = problem.switch_cost[j] / max(
+                problem.round_duration, 1e-9
+            )
+            load_r = float(np.sum(problem.nworkers * Y[:, r_star]))
+            best_k, best_delta = None, None
+            for k in range(J):
+                if k == j or Y[k, 0] == 0 or Y[k, r_star] == 1:
+                    continue
+                if bonus[k] > 0.0:
+                    continue
+                if problem.nworkers[j] - problem.nworkers[k] > free0:
+                    continue
+                if (
+                    load_r - problem.nworkers[j] + problem.nworkers[k]
+                    > problem.num_gpus
+                ):
+                    continue
+                delta = (rate[k] - rate[j]) * r_star  # reorder regression
+                if rate[j] * delay_rounds <= delta:
+                    continue
+                if best_delta is None or delta < best_delta:
+                    best_k, best_delta = k, delta
+            if best_k is not None:
+                Y[j, 0], Y[j, r_star] = 1, 0
+                Y[best_k, 0], Y[best_k, r_star] = 0, 1
+                free0 += problem.nworkers[best_k] - problem.nworkers[j]
+        return Y
 
     def _backfill(self, Y: np.ndarray, problem: EGProblem) -> np.ndarray:
         """Fill any round left completely idle while unfinished jobs exist
@@ -425,7 +532,7 @@ class PoolSetPlanner:
     def add_job(
         self, job_id, profile: dict, round_len: float, scale_factor: int,
         submit_time: Optional[float] = None, pool: Optional[str] = None,
-        duration_scale: float = 1.0,
+        duration_scale: float = 1.0, overhead_s: float = 0.0,
     ) -> None:
         pool = pool if pool in self.children else next(iter(self.children))
         if duration_scale != 1.0:
@@ -436,7 +543,8 @@ class PoolSetPlanner:
         self.job_pool[job_id] = pool
         self.assignments[pool] = self.assignments.get(pool, 0) + 1
         self.children[pool].add_job(
-            job_id, profile, round_len, scale_factor, submit_time
+            job_id, profile, round_len, scale_factor, submit_time,
+            overhead_s=overhead_s,
         )
 
     def pool_incomplete_jobs(self, pool: str) -> int:

@@ -83,6 +83,7 @@ def _objective(
     future_rounds: int,
     regularizer: float,
     tau: Optional[jnp.ndarray] = None,
+    switch_bonus: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     granted_sec = s * round_duration
     planned_epochs = jnp.minimum(
@@ -94,6 +95,13 @@ def _objective(
     welfare = jnp.sum(active * priorities * jnp.log(progress + _EPS)) / (
         jnp.maximum(num_active, 1.0) * future_rounds
     )
+    if switch_bonus is not None:
+        # Keep-incumbent bonus: min(s, 1) is the concave, piecewise-linear
+        # relaxation of 1[s >= 1] — exact at integers, subdifferentiable
+        # for the projected-gradient ascent.
+        welfare = welfare + jnp.sum(
+            active * switch_bonus * jnp.minimum(s, 1.0)
+        )
     lateness = active * jnp.maximum(
         0.0, remaining - epoch_dur * planned_epochs
     )
@@ -121,6 +129,7 @@ def solve_relaxed(
     future_rounds: int,
     regularizer: float,
     num_steps: int = 256,
+    switch_bonus: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Maximize the relaxed EG objective over s in the budget-box polytope.
 
@@ -148,6 +157,7 @@ def solve_relaxed(
         round_duration=round_duration,
         future_rounds=R,
         regularizer=regularizer,
+        switch_bonus=switch_bonus,
     )
     grad = jax.grad(lambda s, tau: obj(s, tau=tau), argnums=0)
     # Annealed smoothing temperature for the makespan term: starts at a
@@ -219,6 +229,7 @@ def solve_greedy(
     regularizer: float,
     num_grants: int,
     grant_batch: int = 1,
+    switch_bonus: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Exact-marginal, placement-aware greedy.
 
@@ -270,7 +281,13 @@ def solve_greedy(
         # interpolation of a concave function is concave, which is what
         # makes the greedy marginals valid.
         progress = (completed + planned_epochs(n)) / total
-        return priorities * jnp.interp(progress, log_bases, log_vals) / norm
+        u = priorities * jnp.interp(progress, log_bases, log_vals) / norm
+        if switch_bonus is not None:
+            # Keep-incumbent bonus lands on the first granted round; it
+            # only raises the 0 -> 1 marginal, so utility stays concave
+            # in n and the greedy's gain ordering remains valid.
+            u = u + jnp.where(n >= 0.5, switch_bonus, 0.0)
+        return u
 
     def lateness(n):
         return active * jnp.maximum(0.0, remaining - epoch_dur * planned_epochs(n))
@@ -380,6 +397,7 @@ def solve_level(
     future_rounds: int,
     regularizer: float,
     grid_size: int = 64,
+    switch_bonus: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Level-set solve of the EG program: parallel, latency-O(1).
 
@@ -434,6 +452,13 @@ def solve_level(
         * jnp.interp(progress, log_bases, log_vals)
         / norm
     )
+    if switch_bonus is not None:
+        # Keep-incumbent bonus: a constant added to U at every k >= 1
+        # boosts only the first marginal dU[:, 0], so within-job density
+        # order stays decreasing and the prefix fill remains valid.
+        U = U + jnp.where(
+            jnp.arange(R + 1)[None, :] >= 1, switch_bonus[:, None], 0.0
+        )
     L = active[:, None] * jnp.maximum(0.0, remaining[:, None] - planned_sec)
     dU = U[:, 1:] - U[:, :-1]  # [J, R]
     density = dU / nworkers[:, None]
@@ -536,6 +561,7 @@ def solve_level_counts(problem: EGProblem) -> Tuple[np.ndarray, float]:
         round_duration=float(problem.round_duration),
         future_rounds=int(problem.future_rounds),
         regularizer=float(problem.regularizer),
+        switch_bonus=packed.get("switch_bonus"),
     )
     counts = np.asarray(counts)[: problem.num_jobs].astype(np.int64)
     return counts, float(obj)
@@ -579,7 +605,12 @@ def counts_to_schedule(
 
 
 def pad_problem(problem: EGProblem, num_slots: int):
-    """Pack an EGProblem into fixed-size padded arrays (float32 on device)."""
+    """Pack an EGProblem into fixed-size padded arrays (float32 on device).
+
+    ``switch_bonus`` is included only when the problem carries a nonzero
+    bonus: overhead-blind callers keep the historical jit signature (and
+    its compiled cache entries) untouched.
+    """
     J = problem.num_jobs
     if J > num_slots:
         raise ValueError(f"{J} jobs > {num_slots} slots")
@@ -589,7 +620,7 @@ def pad_problem(problem: EGProblem, num_slots: int):
         out[:J] = x
         return jnp.asarray(out)
 
-    return dict(
+    packed = dict(
         active=pad(np.ones(J)),
         priorities=pad(problem.priorities),
         completed=pad(problem.completed_epochs),
@@ -599,6 +630,10 @@ def pad_problem(problem: EGProblem, num_slots: int):
         nworkers=pad(problem.nworkers, fill=1.0),
         num_gpus=jnp.asarray(float(problem.num_gpus)),
     )
+    bonus = problem.switch_bonus()
+    if np.any(bonus > 0.0):
+        packed["switch_bonus"] = pad(bonus)
+    return packed
 
 
 def num_slots_for(num_jobs: int, minimum: int = 64) -> int:
@@ -634,6 +669,7 @@ def solve_eg_jax(problem: EGProblem, num_steps: int = 256) -> np.ndarray:
         future_rounds=int(problem.future_rounds),
         regularizer=float(problem.regularizer),
         num_steps=num_steps,
+        switch_bonus=packed.get("switch_bonus"),
     )
     return np.asarray(s)[: problem.num_jobs].astype(np.float64)
 
@@ -677,5 +713,6 @@ def solve_eg_greedy(
         regularizer=float(problem.regularizer),
         num_grants=grants,
         grant_batch=int(grant_batch),
+        switch_bonus=packed.get("switch_bonus"),
     )
     return np.asarray(Y)[: problem.num_jobs].astype(np.int64)

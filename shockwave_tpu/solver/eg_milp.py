@@ -22,6 +22,14 @@ Two formulations share one constraint builder:
 In both, max(0, remaining - planned) per job and the max over jobs
 collapse into one epigraph variable M with M >= remaining_j - D_j * pe_j,
 M >= 0.
+
+Switching cost: when the problem carries a nonzero switch bonus
+(EGProblem.switch_bonus), each such job gets one CONTINUOUS variable
+z_j in [0, 1] with z_j <= sum_r Y[j, r] and objective weight +B_j.
+Because z_j only helps the (maximized) objective, its optimum is
+min(1, s_j) = 1[s_j >= 1] for integral Y — the keep-incumbent
+indicator — with no new integer variables. With zero bonus no z
+variables are added, so the zero-overhead program is unchanged.
 """
 
 from __future__ import annotations
@@ -55,15 +63,21 @@ def _solve_eg(
     bases = np.asarray(problem.log_bases, dtype=np.float64)
     log_vals = problem.log_base_values()
 
+    switch_bonus = problem.switch_bonus()
+    # Jobs whose dropped-incumbent penalty needs an indicator variable.
+    z_jobs = [j for j in range(J) if switch_bonus[j] > 0.0]
+
     n_y, n_pe, n_w = J * R, J, J * B
     n_b = J * B if sos2_booleans else 0
     n_a = J * (B - 1) if sos2_booleans else 0
-    n_var = n_y + n_pe + n_w + n_b + n_a + 1
+    n_z = len(z_jobs)
+    n_var = n_y + n_pe + n_w + n_b + n_a + n_z + 1
     iY = lambda j, r: j * R + r
     iPE = lambda j: n_y + j
     iW = lambda j, b: n_y + n_pe + j * B + b
     iB = lambda j, b: n_y + n_pe + n_w + j * B + b
     iA = lambda j, b: n_y + n_pe + n_w + n_b + j * (B - 1) + b
+    iZ = {j: n_y + n_pe + n_w + n_b + n_a + i for i, j in enumerate(z_jobs)}
     iM = n_var - 1
 
     rows, cols, vals, lo, hi = [], [], [], [], []
@@ -126,14 +140,24 @@ def _solve_eg(
             float(problem.remaining_runtime[j]),
             np.inf,
         )
+        # Keep-incumbent indicator: z_j <= sum_r Y[j, r].
+        if j in iZ:
+            add(
+                [(iZ[j], 1.0)] + [(iY(j, r), -1.0) for r in range(R)],
+                -np.inf,
+                0.0,
+            )
 
     A = sparse.csr_matrix((vals, (rows, cols)), shape=(row, n_var))
 
-    # Maximize sum_j p_j * u_j / (J*R) - k * M  (reference: shockwave.py:373-379).
+    # Maximize sum_j p_j * u_j / (J*R) - k * M + sum_j B_j z_j
+    # (reference: shockwave.py:373-379, plus the switching-cost term).
     c = np.zeros(n_var)
     for j in range(J):
         for b in range(B):
             c[iW(j, b)] = -problem.priorities[j] * log_vals[b] / (J * R)
+    for j in z_jobs:
+        c[iZ[j]] = -float(switch_bonus[j])
     c[iM] = problem.regularizer
 
     integrality = np.zeros(n_var)
@@ -143,6 +167,8 @@ def _solve_eg(
     ub = np.full(n_var, np.inf)
     ub[:n_y] = 1.0
     ub[n_y + n_pe + n_w : n_y + n_pe + n_w + n_b + n_a] = 1.0
+    for j in z_jobs:
+        ub[iZ[j]] = 1.0
 
     options = {"mip_rel_gap": rel_gap}
     if time_limit is not None:

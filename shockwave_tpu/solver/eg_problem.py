@@ -15,11 +15,23 @@ A structural fact both backends exploit: the objective depends on Y only
 through the per-job planned-round counts s_j = sum_r Y[j, r] (utility via
 planned runtime <= s_j * round_duration, makespan likewise); the rounds
 dimension only enters through the per-round capacity constraint.
+
+Switching cost (preemption-aware planning): with ``switch_cost`` (the
+job family's measured relaunch overhead, seconds) and ``incumbent`` (1
+for jobs holding workers when the plan is computed) set, the objective
+charges regularizer * switch_cost_j for every incumbent the plan drops
+entirely (s_j = 0) — i.e. dropping a running job is as bad as adding
+its relaunch overhead to the makespan. The term still depends on Y
+only through s_j (via the indicator 1[s_j >= 1]), and only ever RAISES
+the first round's marginal utility, so every backend's concavity
+argument survives. Both vectors default to None: the zero-overhead
+problem is bit-identical to the historical objective.
 """
 
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import numpy as np
 
@@ -41,9 +53,25 @@ class EGProblem:
     regularizer: float  # k: weight on the makespan term
     log_bases: np.ndarray  # piecewise-log breakpoints in [0, 1]
 
+    # Preemption awareness (optional; None == zero overhead).
+    switch_cost: Optional[np.ndarray] = None  # c_j: relaunch overhead, s
+    incumbent: Optional[np.ndarray] = None  # a_j in {0, 1}: running now
+
     @property
     def num_jobs(self) -> int:
         return len(self.priorities)
+
+    def switch_bonus(self) -> np.ndarray:
+        """B_j = regularizer * c_j * a_j: the objective bonus for keeping
+        incumbent j scheduled at all (equivalently, the penalty for
+        dropping it). Zeros when either vector is unset."""
+        if self.switch_cost is None or self.incumbent is None:
+            return np.zeros(self.num_jobs)
+        return (
+            self.regularizer
+            * np.asarray(self.switch_cost, dtype=np.float64)
+            * np.asarray(self.incumbent, dtype=np.float64)
+        )
 
     def log_base_values(self) -> np.ndarray:
         """log evaluated at the breakpoints, with log(0) -> log(1e-6)
@@ -85,7 +113,11 @@ class EGProblem:
                 )
             )
         )
-        return welfare - self.regularizer * makespan
+        # Preemption charge: every incumbent the plan drops entirely pays
+        # its relaunch overhead (regularizer-scaled seconds, the same
+        # rate the makespan term charges).
+        switch_penalty = float(np.sum(np.where(s < 0.5, self.switch_bonus(), 0.0)))
+        return welfare - self.regularizer * makespan - switch_penalty
 
     def audit_schedule(self, Y: np.ndarray) -> None:
         """Assert Y is a feasible boolean schedule for this problem:

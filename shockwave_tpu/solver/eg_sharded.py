@@ -52,6 +52,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from shockwave_tpu.utils.compat import shard_map
+
 from shockwave_tpu.solver.eg_jax import (
     _EPS,
     num_slots_for,
@@ -92,6 +94,7 @@ def _build_sharded_solver(
         epoch_dur,
         remaining,
         nworkers,
+        switch_bonus,
         num_gpus,
         log_bases,
         log_vals,
@@ -117,6 +120,12 @@ def _build_sharded_solver(
             * priorities[:, None]
             * jnp.interp(progress, log_bases, log_vals)
             / norm
+        )
+        # Keep-incumbent bonus at every k >= 1 (same construction as
+        # solve_level, so counts stay bit-identical; zeros when the
+        # problem is overhead-blind — adding 0.0 is exact).
+        U = U + jnp.where(
+            jnp.arange(R + 1)[None, :] >= 1, switch_bonus[:, None], 0.0
         )
         L = active[:, None] * jnp.maximum(0.0, remaining[:, None] - planned_sec)
         dU = U[:, 1:] - U[:, :-1]
@@ -229,12 +238,14 @@ def _build_sharded_solver(
 
     spec_j = P(axis_name)
     spec_rep = P()
-    fn = jax.shard_map(
-        kernel,
-        mesh=mesh,
-        in_specs=(spec_j,) * 7 + (spec_rep,) * 3,
+    specs = dict(
+        in_specs=(spec_j,) * 8 + (spec_rep,) * 3,
         out_specs=(spec_j, spec_rep),
     )
+    # The replication check mis-infers the bisection loop's carry (a
+    # psum-reduced scalar) on some jax versions and rejects the program;
+    # the check is advisory, the collectives themselves are correct.
+    fn = shard_map(kernel, mesh=mesh, check_vma=False, **specs)
     return jax.jit(fn)
 
 
@@ -274,6 +285,8 @@ def solve_level_sharded(
     )
     shard_j = NamedSharding(mesh, P(axis_name))
     rep = NamedSharding(mesh, P())
+    if "switch_bonus" not in packed:
+        packed["switch_bonus"] = jnp.zeros(slots, jnp.float32)
     job_keys = (
         "active",
         "priorities",
@@ -282,6 +295,7 @@ def solve_level_sharded(
         "epoch_dur",
         "remaining",
         "nworkers",
+        "switch_bonus",
     )
     args = [jax.device_put(packed[k], shard_j) for k in job_keys]
     args.append(jax.device_put(packed["num_gpus"], rep))

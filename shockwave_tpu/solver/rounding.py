@@ -208,12 +208,17 @@ def refine_counts(counts: np.ndarray, problem, max_moves: int = 2000) -> np.ndar
     budget = float(p.num_gpus) * R
     need_sec = np.maximum(p.total_epochs - p.completed_epochs, 0.0) * p.epoch_duration
     log_vals = p.log_base_values()
+    switch_bonus = p.switch_bonus()
 
     def welfare(n):
         planned_sec = np.minimum(n * p.round_duration, need_sec)
         progress = (p.completed_epochs + planned_sec / p.epoch_duration) / p.total_epochs
         util = np.interp(np.clip(progress, 0, 1), p.log_bases, log_vals)
-        return p.priorities * util / (p.num_jobs * p.future_rounds)
+        base = p.priorities * util / (p.num_jobs * p.future_rounds)
+        # Keep-incumbent bonus on the first granted round, so the
+        # exchange moves optimize the same extended objective the
+        # device solvers and the MILP do.
+        return base + np.where(n >= 0.5, switch_bonus, 0.0)
 
     def lateness(n):
         planned_sec = np.minimum(n * p.round_duration, need_sec)

@@ -1,0 +1,46 @@
+"""Version-compatibility shims for the installed jax.
+
+jax moved ``shard_map`` from ``jax.experimental.shard_map`` to the top
+level and renamed its replication-check knob ``check_rep`` ->
+``check_vma`` along the way. Every in-repo caller goes through
+:func:`shard_map` here so kernels are written once against the new
+API and still run on older installs.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.5 exports shard_map at the top level
+    _shard_map_impl = jax.shard_map
+except AttributeError:  # older jax ships it under experimental
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+
+def pcast_varying(x, axes):
+    """``jax.lax.pcast(x, axes, to="varying")`` where available.
+
+    Older jax has neither ``pcast`` nor the vma typing it exists to
+    satisfy (its shard_map tracks replication with ``check_rep``
+    instead), so the identity is the correct fallback there.
+    """
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, axes, to="varying")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` with the new keyword names on any jax.
+
+    ``check_vma=None`` keeps the install's default check behavior;
+    True/False forwards to ``check_vma`` (new jax) or ``check_rep``
+    (old jax), whichever this install accepts.
+    """
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if check_vma is None:
+        return _shard_map_impl(f, **kwargs)
+    try:
+        return _shard_map_impl(f, check_vma=check_vma, **kwargs)
+    except TypeError:
+        return _shard_map_impl(f, check_rep=check_vma, **kwargs)

@@ -47,3 +47,21 @@ def test_planner_native_backend_end_to_end():
     sched, makespan = run_shockwave("native", jobs, arrivals)
     assert len(sched._job_completion_times) == len(jobs)
     assert makespan > 0
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_switch_cost_matches_jax_greedy_quality(seed):
+    """The C++ greedy optimizes the same preemption-aware extended
+    objective as the JAX greedy (keep-incumbent bonus on the first
+    granted round)."""
+    from tests.test_shockwave_solver import TestSwitchingCost
+
+    problem = TestSwitchingCost().switchy_problem(seed, J=8, R=5, num_gpus=4)
+    from shockwave_tpu.solver.eg_jax import solve_eg_greedy
+
+    Y_native = native.solve_eg_greedy_native(problem)
+    Y_jax = solve_eg_greedy(problem)
+    assert np.all(problem.nworkers @ Y_native <= problem.num_gpus + 1e-9)
+    obj_native = problem.objective_value(Y_native)
+    obj_jax = problem.objective_value(Y_jax)
+    assert obj_native >= obj_jax - 0.02 * max(1.0, abs(obj_jax))

@@ -486,3 +486,141 @@ class TestScheduleAudit:
         o_relaxed = p.objective_value(Y)
         o_level = p.objective_value(solve_eg_level(p))
         assert o_relaxed >= o_level - 0.01 * abs(o_level)
+
+
+class TestSwitchingCost:
+    """The preemption-aware extended objective: dropping an incumbent
+    (granting it zero rounds) charges its measured relaunch overhead,
+    regularizer-scaled — the same currency as the makespan term. Every
+    backend must optimize the SAME extended objective, and zero overhead
+    must reproduce the historical plans bit-identically."""
+
+    def switchy_problem(self, seed, J=4, R=3, num_gpus=3):
+        import dataclasses
+
+        rng = np.random.default_rng(seed)
+        p = random_problem(rng, J=J, R=R, num_gpus=num_gpus)
+        incumbent = (rng.random(J) < 0.5).astype(np.float64)
+        if not incumbent.any():
+            incumbent[int(rng.integers(J))] = 1.0
+        # Costs sized so the bonus (regularizer 1e-4 x cost) lands in the
+        # same decade as the welfare terms: the term must actually bind.
+        switch_cost = rng.uniform(200.0, 3000.0, J) * incumbent
+        return dataclasses.replace(
+            p, switch_cost=switch_cost, incumbent=incumbent
+        )
+
+    def test_switch_bonus_and_objective_charge(self):
+        """objective_value charges exactly regularizer * cost for every
+        incumbent a schedule grants zero rounds, relative to the
+        overhead-blind objective on the same schedule."""
+        import dataclasses
+
+        p = self.switchy_problem(0)
+        bonus = p.switch_bonus()
+        np.testing.assert_allclose(
+            bonus, p.regularizer * p.switch_cost * p.incumbent
+        )
+        p_blind = dataclasses.replace(p, switch_cost=None, incumbent=None)
+        j = int(np.argmax(bonus))
+        Y_keep = np.zeros((p.num_jobs, p.future_rounds), dtype=int)
+        Y_keep[j, 0] = 1
+        Y_drop = np.zeros_like(Y_keep)
+        total_bonus = float(np.sum(bonus))
+        assert p.objective_value(Y_drop) == pytest.approx(
+            p_blind.objective_value(Y_drop) - total_bonus
+        )
+        assert p.objective_value(Y_keep) == pytest.approx(
+            p_blind.objective_value(Y_keep) - (total_bonus - bonus[j])
+        )
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_milp_matches_brute_force_with_switch_cost(self, seed):
+        p = self.switchy_problem(seed)
+        best, _ = brute_force_best(p)
+        Y = solve_eg_milp(p, rel_gap=1e-9, time_limit=30)
+        assert np.all(p.nworkers @ Y <= p.num_gpus + 1e-9)
+        assert p.objective_value(Y) == pytest.approx(best, abs=1e-6)
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_level_and_greedy_near_milp_with_switch_cost(self, seed):
+        from shockwave_tpu.solver.eg_jax import solve_eg_level
+
+        p = self.switchy_problem(100 + seed, J=6, R=4)
+        Y_milp = solve_eg_milp(p, rel_gap=1e-6, time_limit=30)
+        obj_milp = p.objective_value(Y_milp)
+        scale = max(1.0, abs(obj_milp))
+        for Y in (
+            solve_eg_level(p),
+            reorder_columns(solve_eg_greedy(p), p.priorities),
+        ):
+            assert np.all(p.nworkers @ Y <= p.num_gpus + 1e-9)
+            assert p.objective_value(Y) >= obj_milp - 0.08 * scale
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_zero_overhead_reproduces_plans_bit_identically(self, seed):
+        """switch_cost=0 (or incumbent empty) must leave every backend's
+        plan EXACTLY as the historical overhead-blind formulation —
+        including the jit cache path (pad_problem omits the bonus)."""
+        import dataclasses
+
+        from shockwave_tpu.solver.eg_jax import solve_eg_level
+
+        rng = np.random.default_rng(200 + seed)
+        p_blind = random_problem(rng, J=5, R=3)
+        p_zero = dataclasses.replace(
+            p_blind,
+            switch_cost=np.zeros(p_blind.num_jobs),
+            incumbent=np.ones(p_blind.num_jobs),
+        )
+        np.testing.assert_array_equal(
+            solve_eg_level(p_blind), solve_eg_level(p_zero)
+        )
+        np.testing.assert_array_equal(
+            solve_eg_greedy(p_blind), solve_eg_greedy(p_zero)
+        )
+        np.testing.assert_array_equal(
+            solve_eg_milp(p_blind, rel_gap=1e-9, time_limit=30),
+            solve_eg_milp(p_zero, rel_gap=1e-9, time_limit=30),
+        )
+
+    def test_large_overhead_keeps_incumbent_scheduled(self):
+        """One slot, one round, two jobs: the challenger wins the
+        overhead-blind program; a relaunch overhead larger than the
+        utility gap flips the grant to the incumbent on every backend."""
+        import dataclasses
+
+        from shockwave_tpu.solver.eg_jax import solve_eg_level
+
+        # Both jobs half done, so each marginal utility is a modest
+        # log-slope step (a job at zero progress sits on the log(1e-6)
+        # floor, whose ~12-nat first-grant marginal would dwarf any
+        # realistic relaunch bonus).
+        base = make_problem(
+            priorities=[5.0, 1.0],
+            completed=[2, 2],
+            total=[4, 4],
+            epoch_dur=[100.0, 100.0],
+            remaining=[200.0, 200.0],
+            nworkers=[1.0, 1.0],
+            num_gpus=1,
+            round_duration=100.0,
+            future_rounds=1,
+            regularizer=1e-3,
+        )
+        sticky = dataclasses.replace(
+            base,
+            switch_cost=np.array([0.0, 5000.0]),
+            incumbent=np.array([0.0, 1.0]),
+        )
+        for solver in (
+            lambda q: solve_eg_milp(q, rel_gap=1e-9, time_limit=30),
+            solve_eg_level,
+            solve_eg_greedy,
+        ):
+            Y_blind = np.asarray(solver(base))
+            assert Y_blind[0].sum() == 1 and Y_blind[1].sum() == 0
+            Y_sticky = np.asarray(solver(sticky))
+            assert Y_sticky[1].sum() == 1, (
+                "incumbent with dominant relaunch overhead was dropped"
+            )
