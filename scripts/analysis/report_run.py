@@ -1,0 +1,322 @@
+#!/usr/bin/env python3
+"""Turn a telemetry dump (metrics + optional trace) into run summary
+tables.
+
+The tables `docs/RESULTS.md` assembles by hand — outcome metrics,
+solver wall/phase time per backend, preemption/lease churn, RPC
+latency — generated from the artifacts any instrumented run already
+writes (`--metrics-out` / `--trace-out` on scripts/simulate.py and the
+physical drivers). Markdown out, stdout or a file.
+
+Usage:
+  python scripts/analysis/report_run.py results/run/metrics.json \
+      [--trace results/run/trace.json] [-o report.md]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+from shockwave_tpu.obs.metrics import SCHEMA  # noqa: E402
+
+
+def _fmt(value, digits=3):
+    if value is None:
+        return "—"
+    if isinstance(value, float):
+        if value == int(value) and abs(value) < 1e15:
+            return str(int(value))
+        return f"{value:.{digits}f}"
+    return str(value)
+
+
+def _table(headers, rows):
+    lines = [
+        "| " + " | ".join(headers) + " |",
+        "| " + " | ".join("---" for _ in headers) + " |",
+    ]
+    for row in rows:
+        lines.append("| " + " | ".join(_fmt(c) for c in row) + " |")
+    return "\n".join(lines)
+
+
+class Metrics:
+    """Typed access into a shockwave-metrics-v1 snapshot."""
+
+    def __init__(self, snapshot: dict):
+        if snapshot.get("schema") != SCHEMA:
+            raise ValueError(
+                f"not a {SCHEMA} dump: schema={snapshot.get('schema')!r}"
+            )
+        self.metrics = snapshot["metrics"]
+
+    def value(self, name, default=None, **labels):
+        metric = self.metrics.get(name)
+        if metric is None:
+            return default
+        for series in metric["series"]:
+            if series["labels"] == {str(k): str(v) for k, v in labels.items()}:
+                return series.get("value")
+        return default
+
+    def series(self, name):
+        metric = self.metrics.get(name)
+        return metric["series"] if metric else []
+
+
+def overview_rows(m: Metrics):
+    rows = []
+
+    def add(label, name, unit="", digits=3):
+        value = m.value(name)
+        if value is not None:
+            rows.append((label, f"{_fmt(value, digits)}{unit}"))
+
+    add("Makespan", "run_makespan_seconds", " s", 1)
+    add("Average JCT", "run_avg_jct_seconds", " s", 1)
+    add("Utilization", "run_utilization")
+    add("Worst FTF", "run_worst_ftf")
+    add("Unfair fraction", "run_unfair_fraction_pct", " %", 1)
+    add("Rounds", "scheduler_rounds_total")
+    add("Jobs admitted", "scheduler_jobs_admitted_total")
+    add("Jobs completed", "scheduler_jobs_completed_total")
+    add("Jobs failed", "scheduler_jobs_failed_total")
+    add("Preemptions", "scheduler_preemptions_total")
+    add("Lease extensions", "scheduler_lease_extensions_total")
+    add("Kills", "scheduler_kills_total")
+    add("Dispatches", "scheduler_dispatches_total")
+    return rows
+
+
+def histogram_rows(m: Metrics, name, label_keys):
+    """One row per label series: labels..., count, total, mean, min, max."""
+    rows = []
+    for series in sorted(
+        m.series(name), key=lambda s: tuple(sorted(s["labels"].items()))
+    ):
+        count = series["count"]
+        mean = series["sum"] / count if count else None
+        rows.append(
+            tuple(series["labels"].get(k, "—") for k in label_keys)
+            + (count, series["sum"], mean, series["min"], series["max"])
+        )
+    return rows
+
+
+def histogram_summary_rows(m: Metrics, names):
+    """Label-less histograms condensed to one row each."""
+    rows = []
+    for name in names:
+        for series in m.series(name):
+            if series["labels"]:
+                continue
+            count = series["count"]
+            rows.append(
+                (
+                    name,
+                    count,
+                    series["sum"],
+                    series["sum"] / count if count else None,
+                    series["min"],
+                    series["max"],
+                )
+            )
+    return rows
+
+
+def trace_sections(trace: dict):
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError("not a Chrome trace dump: no traceEvents list")
+    # Resolve track names from the M metadata events.
+    pid_names, tid_names = {}, {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            pid_names[e["pid"]] = e["args"]["name"]
+        elif e.get("name") == "thread_name":
+            tid_names[(e["pid"], e["tid"])] = e["args"]["name"]
+
+    spans = [e for e in events if e.get("ph") == "X"]
+    instants = [e for e in events if e.get("ph") == "i"]
+    # Synthesize X-like spans from B/E pairs (physical rounds trace as
+    # live begin/end events): LIFO matching per track, per Chrome rules.
+    open_stacks = {}
+    for e in events:
+        if e.get("ph") == "B":
+            open_stacks.setdefault((e["pid"], e.get("tid")), []).append(e)
+        elif e.get("ph") == "E":
+            stack = open_stacks.get((e["pid"], e.get("tid")))
+            if stack:
+                b = stack.pop()
+                spans.append(
+                    {
+                        "name": b["name"],
+                        "ph": "X",
+                        "pid": b["pid"],
+                        "tid": b.get("tid"),
+                        "ts": b["ts"],
+                        "dur": max(e["ts"] - b["ts"], 0.0),
+                        "args": b.get("args", {}),
+                    }
+                )
+    per_track = {}
+    t_min, t_max = None, None
+    for e in spans + instants:
+        key = (e["pid"], e.get("tid"))
+        track = "{}/{}".format(
+            pid_names.get(e["pid"], e["pid"]),
+            tid_names.get(key, e.get("tid")),
+        )
+        stats = per_track.setdefault(track, {"spans": 0, "instants": 0, "busy_us": 0.0})
+        stats["spans" if e["ph"] == "X" else "instants"] += 1
+        stats["busy_us"] += e.get("dur", 0.0)
+        end = e["ts"] + e.get("dur", 0.0)
+        t_min = e["ts"] if t_min is None else min(t_min, e["ts"])
+        t_max = end if t_max is None else max(t_max, end)
+
+    lines = ["## Timeline (from the trace dump)", ""]
+    if t_min is not None:
+        lines.append(
+            f"- events: {len(spans)} spans, {len(instants)} instants over "
+            f"{(t_max - t_min) / 1e6:.1f} s of run time"
+        )
+        lines.append(
+            "- load the trace file in https://ui.perfetto.dev (or "
+            "chrome://tracing) for the interactive view"
+        )
+    lines.append("")
+    rows = [
+        (
+            track,
+            stats["spans"],
+            stats["instants"],
+            stats["busy_us"] / 1e6,
+        )
+        for track, stats in sorted(per_track.items())
+    ]
+    lines.append(
+        _table(["track", "spans", "instants", "busy s"], rows)
+    )
+    top = sorted(spans, key=lambda e: -e.get("dur", 0.0))[:5]
+    if top:
+        lines += ["", "### Longest spans", ""]
+        lines.append(
+            _table(
+                ["name", "start s", "duration s"],
+                [
+                    (e["name"], e["ts"] / 1e6, e.get("dur", 0.0) / 1e6)
+                    for e in top
+                ],
+            )
+        )
+    return "\n".join(lines)
+
+
+def build_report(metrics_path, trace_path=None):
+    with open(metrics_path) as f:
+        m = Metrics(json.load(f))
+
+    out = [f"# Run report — `{os.path.basename(metrics_path)}`", ""]
+    out += ["## Outcome", ""]
+    out.append(_table(["metric", "value"], overview_rows(m)))
+
+    solver = histogram_rows(m, "shockwave_solve_seconds", ["backend", "ok"])
+    if solver:
+        out += ["", "## Plan solves (per backend)", ""]
+        out.append(
+            _table(
+                ["backend", "ok", "solves", "total s", "mean s", "min s",
+                 "max s"],
+                solver,
+            )
+        )
+    phases = histogram_rows(m, "shockwave_plan_phase_seconds", ["phase"])
+    if phases:
+        out += ["", "## Planning phases", ""]
+        out.append(
+            _table(
+                ["phase", "calls", "total s", "mean s", "min s", "max s"],
+                phases,
+            )
+        )
+    backend_phases = histogram_rows(
+        m, "solver_backend_phase_seconds", ["backend", "phase"]
+    )
+    if backend_phases:
+        out += ["", "## Solver backend phases (device vs host)", ""]
+        out.append(
+            _table(
+                ["backend", "phase", "calls", "total s", "mean s", "min s",
+                 "max s"],
+                backend_phases,
+            )
+        )
+    rpc = histogram_rows(m, "rpc_handler_seconds", ["method"]) + [
+        ("client:" + r[0],) + r[1:]
+        for r in histogram_rows(m, "rpc_client_seconds", ["method"])
+    ]
+    if rpc:
+        out += ["", "## RPC latency", ""]
+        out.append(
+            _table(
+                ["method", "calls", "total s", "mean s", "min s", "max s"],
+                rpc,
+            )
+        )
+    runtime = histogram_summary_rows(
+        m,
+        [
+            "scheduler_round_duration_seconds",
+            "scheduler_job_jct_seconds",
+            "scheduler_job_ftf",
+            "dispatch_latency_seconds",
+            "worker_job_seconds",
+            "worker_relaunch_overhead_seconds",
+        ],
+    )
+    if runtime:
+        out += ["", "## Distributions", ""]
+        out.append(
+            _table(
+                ["series", "count", "total", "mean", "min", "max"],
+                runtime,
+            )
+        )
+
+    if trace_path:
+        with open(trace_path) as f:
+            trace = json.load(f)
+        out += ["", trace_sections(trace)]
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("metrics", help="metrics snapshot JSON (--metrics-out)")
+    parser.add_argument(
+        "--trace", default=None, help="trace-event JSON (--trace-out)"
+    )
+    parser.add_argument("-o", "--output", default=None, help="write here "
+                        "instead of stdout")
+    args = parser.parse_args(argv)
+    report = build_report(args.metrics, args.trace)
+    if args.output:
+        from shockwave_tpu.utils.fileio import atomic_write_text
+
+        atomic_write_text(args.output, report)
+        print(f"Wrote {args.output}")
+    else:
+        print(report)
+    return report
+
+
+if __name__ == "__main__":
+    main()

@@ -16,8 +16,10 @@ import sys
 import threading
 import time
 
+from shockwave_tpu import obs
 from shockwave_tpu.core.physical import PhysicalScheduler
 from shockwave_tpu.policies import get_policy
+from shockwave_tpu.utils.fileio import atomic_write_text
 from shockwave_tpu.utils.hostenv import free_port
 
 # Phases a preempted job pays again on every relaunch (the `train`
@@ -62,14 +64,39 @@ def run_physical_cluster(
     extra_summary=None,
     preemption_overheads=None,
     round_overhead_fraction=None,
+    metrics_out=None,
+    trace_out=None,
 ):
     """Drive the full trace against a live localhost cluster; writes
     <out_dir>/{summary.json,round_log.json,timelines.json} and returns
     the summary dict. ``extra_summary(sched, run_dir)`` may contribute
-    additional summary fields."""
+    additional summary fields.
+
+    ``metrics_out``/``trace_out`` enable the telemetry layer and export
+    the scheduler's metrics snapshot / Perfetto-loadable timeline there;
+    the worker subprocess gets the matching env contract and drops
+    ``worker_metrics.json``/``worker_trace.json`` next to them at
+    shutdown."""
     os.makedirs(out_dir, exist_ok=True)
     run_dir = os.path.join(out_dir, "run")
     ckpt_dir = os.path.join(out_dir, "ckpt")
+
+    # Telemetry: enable BEFORE the scheduler exists so the tracer adopts
+    # its wall-since-start clock and the registry catches registration.
+    if metrics_out:
+        obs.configure(metrics=True)
+    if trace_out:
+        obs.configure(trace=True)
+    worker_env = dict(worker_env)
+    if metrics_out:
+        worker_env["SHOCKWAVE_METRICS_OUT"] = os.path.join(
+            os.path.dirname(os.path.abspath(metrics_out)),
+            "worker_metrics.json",
+        )
+    if trace_out:
+        worker_env["SHOCKWAVE_TRACE_OUT"] = os.path.join(
+            os.path.dirname(os.path.abspath(trace_out)), "worker_trace.json"
+        )
 
     sched_port, worker_port = free_port(), free_port()
     sched = PhysicalScheduler(
@@ -168,25 +195,43 @@ def run_physical_cluster(
         }
         if extra_summary is not None:
             summary.update(extra_summary(sched, run_dir))
-        with open(os.path.join(out_dir, "summary.json"), "w") as f:
-            json.dump(summary, f, indent=1)
-        with open(os.path.join(out_dir, "round_log.json"), "w") as f:
-            json.dump(sched._round_log, f, indent=1)
-        with open(os.path.join(out_dir, "timelines.json"), "w") as f:
-            json.dump(
-                {
-                    str(j): lines
-                    for j, lines in sched._job_timelines.items()
-                },
-                f,
+        obs.export_run_summary(
+            metrics_out=metrics_out,
+            trace_out=trace_out,
+            makespan=summary["makespan_s"],
+            avg_jct=avg_jct,
+            ftf_list=ftf_list,
+            unfair_fraction=unfair_fraction,
+        )
+        # Atomic (temp + rename), like every other run artifact: a run
+        # killed during teardown must not leave truncated JSON behind.
+        atomic_write_text(
+            os.path.join(out_dir, "summary.json"),
+            json.dumps(summary, indent=1),
+        )
+        atomic_write_text(
+            os.path.join(out_dir, "round_log.json"),
+            json.dumps(sched._round_log, indent=1),
+        )
+        atomic_write_text(
+            os.path.join(out_dir, "timelines.json"),
+            json.dumps(
+                {str(j): lines for j, lines in sched._job_timelines.items()},
                 indent=1,
-            )
+            ),
+        )
         print(json.dumps(summary, indent=1))
         return summary
     finally:
         sched.shutdown()
-        worker_proc.terminate()
         try:
-            worker_proc.wait(timeout=10)
+            # The shutdown RPC lets the worker exit on its own — it may
+            # still be writing its telemetry dumps; SIGTERM here would
+            # race the export.
+            worker_proc.wait(timeout=15)
         except subprocess.TimeoutExpired:
-            worker_proc.kill()
+            worker_proc.terminate()
+            try:
+                worker_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                worker_proc.kill()

@@ -34,6 +34,7 @@ sys.path.insert(
 )
 
 from scripts.drivers.physical_common import run_physical_cluster  # noqa: E402
+from shockwave_tpu import obs  # noqa: E402
 from shockwave_tpu.data import parse_trace  # noqa: E402
 from shockwave_tpu.data.default_oracle import generate_oracle  # noqa: E402
 from shockwave_tpu.data.profiles import synthesize_profiles  # noqa: E402
@@ -121,6 +122,7 @@ def main(argv=None):
         help="auto-size the round so the relaunch overhead costs at most "
         "this fraction of it",
     )
+    obs.add_telemetry_args(parser)
     args = parser.parse_args(argv)
 
     jobs, arrivals = parse_trace(args.trace)
@@ -165,6 +167,8 @@ def main(argv=None):
         shockwave_config=shockwave_config,
         preemption_overheads=args.overheads,
         round_overhead_fraction=args.round_overhead_fraction,
+        metrics_out=args.metrics_out,
+        trace_out=args.trace_out,
         extra_summary=lambda sched, run_dir: {"trace": args.trace},
     )
     return summary

@@ -51,6 +51,7 @@ from scripts.drivers.physical_common import (  # noqa: E402
     overheads_from_phase_report,
     run_physical_cluster,
 )
+from shockwave_tpu import obs  # noqa: E402
 from shockwave_tpu.data import parse_trace, read_throughputs  # noqa: E402
 from shockwave_tpu.data.profiles import synthesize_profiles  # noqa: E402
 
@@ -185,6 +186,7 @@ def main(argv=None):
         help="auto-size the round so the worst measured relaunch "
         "overhead costs at most this fraction of it",
     )
+    obs.add_telemetry_args(parser)
     args = parser.parse_args(argv)
 
     jobs, arrivals = parse_trace(args.trace)
@@ -239,6 +241,8 @@ def main(argv=None):
         shockwave_config=shockwave_config,
         preemption_overheads=preemption_overheads,
         round_overhead_fraction=args.round_overhead_fraction,
+        metrics_out=args.metrics_out,
+        trace_out=args.trace_out,
         extra_summary=lambda sched, run_dir: {
             "trace": args.trace,
             "preemption_overhead_phases": collect_phase_report(run_dir),

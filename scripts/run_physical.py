@@ -17,6 +17,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from shockwave_tpu import obs
 from shockwave_tpu.core.physical import PhysicalScheduler
 from shockwave_tpu.data import (
     load_or_synthesize_profiles,
@@ -28,6 +29,10 @@ from shockwave_tpu.policies import get_available_policies, get_policy
 
 
 def main(args):
+    if args.metrics_out:
+        obs.configure(metrics=True)
+    if args.trace_out:
+        obs.configure(trace=True)
     jobs, arrival_times = parse_trace(args.trace_file)
     throughputs = (
         read_throughputs(args.throughputs_file)
@@ -79,6 +84,12 @@ def main(args):
     print(f"Makespan: {makespan:.1f}s")
     if avg_jct:
         print(f"Average JCT: {avg_jct:.1f}s")
+    obs.export_run_summary(
+        metrics_out=args.metrics_out,
+        trace_out=args.trace_out,
+        makespan=makespan,
+        avg_jct=avg_jct,
+    )
 
 
 if __name__ == "__main__":
@@ -100,4 +111,5 @@ if __name__ == "__main__":
     )
     parser.add_argument("--seed", type=int, default=None)
     parser.add_argument("--config", type=str, default=None)
+    obs.add_telemetry_args(parser)
     main(parser.parse_args())

@@ -18,6 +18,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from shockwave_tpu import obs
 from shockwave_tpu.core.scheduler import Scheduler
 from shockwave_tpu.data import (
     load_or_synthesize_profiles,
@@ -110,6 +111,13 @@ def main(args):
                     "is neither an existing file nor a JSON literal"
                 ) from None
 
+    # Telemetry: enabling must precede Scheduler construction so the
+    # tracer adopts the simulator's virtual clock.
+    if args.metrics_out:
+        obs.configure(metrics=True)
+    if args.trace_out:
+        obs.configure(trace=True)
+
     policy = get_policy(args.policy, solver=args.solver, seed=args.seed)
     sched = Scheduler(
         policy,
@@ -171,6 +179,16 @@ def main(args):
         sched.save_round_log(args.round_log)
         print(f"Wrote {args.round_log}")
 
+    obs.export_run_summary(
+        metrics_out=args.metrics_out,
+        trace_out=args.trace_out,
+        makespan=makespan,
+        avg_jct=avg_jct,
+        utilization=utilization,
+        ftf_list=ftf_list,
+        unfair_fraction=unfair_fraction,
+    )
+
     if args.output_pickle:
         result = {
             "trace_file": args.trace_file,
@@ -222,6 +240,7 @@ if __name__ == "__main__":
         help="write the structured per-round event log (JSONL) here; "
         "consumed by scripts/analysis/postprocess_log.py",
     )
+    obs.add_telemetry_args(parser)
     parser.add_argument("--no_profile_cache", action="store_true")
     parser.add_argument(
         "--preemption_overheads",

@@ -24,6 +24,7 @@ import time
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
+from shockwave_tpu import obs
 from shockwave_tpu.core.ids import JobId
 from shockwave_tpu.core.scheduler import Scheduler
 from shockwave_tpu.data.workload_info import steps_per_epoch
@@ -97,6 +98,10 @@ class PhysicalScheduler(Scheduler):
                 "done": self._done_rpc,
                 "init_job": self._init_job_rpc,
                 "update_lease": self._update_lease_rpc,
+                # /metrics-style text dump: any client (or grpcurl-style
+                # tooling speaking the hand-rolled wire contract) can
+                # scrape the scheduler's live registry.
+                "dump_metrics": obs.render_prometheus,
             },
         )
 
@@ -120,8 +125,15 @@ class PhysicalScheduler(Scheduler):
             self._cv.notify_all()
         return worker_ids, self._time_per_iteration
 
+    def _observe_rpc(self, method: str, start: float) -> None:
+        obs.histogram(
+            "rpc_handler_seconds",
+            "scheduler-side RPC handler latency (lock wait included)",
+        ).observe(time.perf_counter() - start, method=method)
+
     def _done_rpc(self, worker_id, job_ids, num_steps, execution_times, logs):
         """(reference: scheduler_server.py:62-95 -> _done_callback)"""
+        rpc_start = time.perf_counter()
         with self._cv:
             if len(job_ids) == 1:
                 key = JobId(job_ids[0])
@@ -147,22 +159,40 @@ class PhysicalScheduler(Scheduler):
                 self._jobs_with_extended_lease.discard(key)
             self._done_callback(key, worker_id, steps_list, times_list)
             self._cv.notify_all()
+        self._observe_rpc("Done", rpc_start)
 
     def _init_job_rpc(self, job_id):
         """First lease of a micro-task: run until the round ends
         (reference: scheduler.py:2942-3029)."""
+        rpc_start = time.perf_counter()
         with self._cv:
             key = JobId(int(job_id))
             now = self.get_current_timestamp()
             self._dispatch_times.setdefault(key, now)
             self._last_lease_contact[key] = now
             remaining = max(self._round_end_time - now, 1.0)
+            obs.instant(
+                "init_job", cat="lease", tid="leases",
+                args={"job_id": str(key)},
+            )
+            self._observe_rpc("InitJob", rpc_start)
             return INFINITY, remaining, 0.0
 
     def _update_lease_rpc(
         self, job_id, worker_id, steps, duration, max_steps, max_duration
     ):
         """(reference: scheduler.py:3031-3096)"""
+        rpc_start = time.perf_counter()
+        try:
+            return self._update_lease_locked(
+                job_id, worker_id, steps, duration, max_steps, max_duration
+            )
+        finally:
+            self._observe_rpc("UpdateLease", rpc_start)
+
+    def _update_lease_locked(
+        self, job_id, worker_id, steps, duration, max_steps, max_duration
+    ):
         with self._cv:
             key = JobId(int(job_id))
             self._last_lease_contact[key] = self.get_current_timestamp()
@@ -170,6 +200,10 @@ class PhysicalScheduler(Scheduler):
                 # The job keeps the same workers next round: extend through
                 # the next round's end (reference: scheduler.py:1868-1891).
                 extra = self._time_per_iteration
+                obs.instant(
+                    "lease_extended", cat="lease", tid="leases",
+                    args={"job_id": str(key), "extra_s": extra},
+                )
                 return max_steps or INFINITY, max_duration, extra
             if steps == 0 or duration < LEASE_UPDATE_FRACTION * max_duration:
                 return max_steps or INFINITY, max_duration, 0.0
@@ -228,20 +262,39 @@ class PhysicalScheduler(Scheduler):
             # jobs (reference marks them at dispatch, scheduler.py:1935).
             self._running_jobs.add(single)
             self._per_job_latest_timestamps[single] = self.get_current_timestamp()
-        for rank, worker_id in enumerate(worker_ids):
-            descriptions = []
-            for single in key.singletons():
-                job = self._jobs[single]
-                remaining = self._get_remaining_steps(single)
-                descriptions.append(
-                    self._job_description(
-                        job, max(remaining, 1), rank, scale_factor, lead_addr
+        dispatch_start = time.perf_counter()
+        with obs.span(
+            "dispatch", cat="rpc", tid="dispatch",
+            args={"job_id": str(key), "workers": scale_factor,
+                  "round": self._round_id},
+        ):
+            for rank, worker_id in enumerate(worker_ids):
+                descriptions = []
+                for single in key.singletons():
+                    job = self._jobs[single]
+                    remaining = self._get_remaining_steps(single)
+                    descriptions.append(
+                        self._job_description(
+                            job, max(remaining, 1), rank, scale_factor,
+                            lead_addr
+                        )
                     )
+                self._outstanding.add((key, worker_id))
+                rpc_start = time.perf_counter()
+                self._worker_connections[worker_id].run_job(
+                    descriptions, worker_id, self._round_id
                 )
-            self._outstanding.add((key, worker_id))
-            self._worker_connections[worker_id].run_job(
-                descriptions, worker_id, self._round_id
-            )
+                obs.histogram(
+                    "rpc_client_seconds",
+                    "scheduler-to-worker RPC round-trip latency",
+                ).observe(time.perf_counter() - rpc_start, method="RunJob")
+        obs.counter(
+            "scheduler_dispatches_total", "micro-task dispatches (relaunches)"
+        ).inc()
+        obs.histogram(
+            "dispatch_latency_seconds",
+            "wall time to dispatch one micro-task to its full gang",
+        ).observe(time.perf_counter() - dispatch_start)
 
     # -- the round loop -------------------------------------------------
     def wait_for_workers(self, count: int, timeout: float = 120.0) -> None:
@@ -358,6 +411,15 @@ class PhysicalScheduler(Scheduler):
                         assignments[key]
                     ) != set(prev_ids):
                         self._num_preemptions += 1
+                        obs.counter(
+                            "scheduler_preemptions_total",
+                            "still-active jobs that lost their workers "
+                            "at a round boundary",
+                        ).inc()
+                        obs.instant(
+                            "preemption", cat="sched", tid="rounds",
+                            args={"job_id": str(key)},
+                        )
                 self._current_worker_assignments = assignments
                 self._round_log.append(
                     {
@@ -370,6 +432,27 @@ class PhysicalScheduler(Scheduler):
                         },
                     }
                 )
+                obs.counter(
+                    "scheduler_rounds_total", "scheduling rounds started"
+                ).inc()
+                # Physical rounds trace as B/E pairs emitted live (an X
+                # span backdated at round end would append out of ts
+                # order on the rounds track).
+                obs.get_tracer().begin(
+                    f"round {self._round_id}", cat="sched", tid="rounds",
+                    args={
+                        "round": self._round_id,
+                        "scheduled_jobs": len(assignments),
+                        "active_jobs": len(self._jobs),
+                    },
+                )
+                obs.gauge(
+                    "scheduler_queue_depth", "active (incomplete) jobs"
+                ).set(len(self._jobs))
+                obs.gauge(
+                    "scheduler_scheduled_jobs",
+                    "jobs granted workers this round",
+                ).set(len(assignments))
                 for key, worker_ids in assignments.items():
                     if key in extended:
                         continue  # still running under an extended lease
@@ -398,6 +481,11 @@ class PhysicalScheduler(Scheduler):
                         ):
                             self._jobs_with_extended_lease.add(key)
                             self._num_lease_extensions += 1
+                            obs.counter(
+                                "scheduler_lease_extensions_total",
+                                "round transitions where a job kept its "
+                                "exact worker set",
+                            ).inc()
                         self._num_lease_extension_opportunities += 1
 
             # End of round: wait for completions, then kill stragglers
@@ -440,6 +528,14 @@ class PhysicalScheduler(Scheduler):
                         self._jobs_with_extended_lease.discard(key)
             for key in stragglers:
                 self._kill_job(key)
+            round_wall = self.get_current_timestamp() - round_start
+            obs.histogram(
+                "scheduler_round_duration_seconds",
+                "round length (simulated time in sim mode)",
+            ).observe(round_wall)
+            obs.get_tracer().end(
+                f"round {self._round_id}", cat="sched", tid="rounds"
+            )
             self._round_id += 1
             self._num_completed_rounds += 1
 
@@ -449,6 +545,15 @@ class PhysicalScheduler(Scheduler):
         """Kill an unresponsive micro-task and synthesize zero-progress
         completions so bookkeeping converges
         (reference: scheduler.py:3098-3170)."""
+        obs.counter(
+            "scheduler_kills_total", "straggler/unresponsive job kills"
+        ).inc()
+        with obs.span(
+            "kill", cat="sched", tid="dispatch", args={"job_id": str(key)}
+        ):
+            self._kill_job_inner(key)
+
+    def _kill_job_inner(self, key: JobId) -> None:
         with self._cv:
             worker_ids = list(
                 self._dispatched_worker_ids.get(key)

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from shockwave_tpu import obs
 from shockwave_tpu.core.ids import JobId
 from shockwave_tpu.core.job import Job
 from shockwave_tpu.data.workload_info import (
@@ -242,9 +243,31 @@ class Scheduler:
         self._num_preemptions = 0
 
         self._logger = make_logger(
-            "scheduler", lambda: self._current_timestamp,
-            **({"level": log_level} if log_level is not None else {}),
+            "scheduler", lambda: self._current_timestamp, level=log_level
         )
+
+        # Telemetry (shockwave_tpu.obs): disabled by default, in which
+        # case every call below is a no-op flag check. With tracing on,
+        # trace timestamps follow this scheduler's clock — virtual time
+        # in simulation, wall-since-start in physical mode — so the
+        # exported timeline is laid out in the run's own time base.
+        # Weakref: the tracer is process-global, so a bound method here
+        # would pin every finished Scheduler (jobs, logs, timelines)
+        # alive across a multi-run process.
+        if obs.trace_enabled():
+            import weakref
+
+            self_ref = weakref.ref(self)
+
+            def _trace_clock():
+                sched = self_ref()
+                return (
+                    sched.get_current_timestamp()
+                    if sched is not None
+                    else 0.0
+                )
+
+            obs.set_trace_clock(_trace_clock)
 
         # Shockwave planner (attached when the policy is a Shockwave
         # variant; see shockwave_tpu.policies.shockwave).
@@ -423,6 +446,20 @@ class Scheduler:
                 "duration": job.duration,
             }
         )
+        obs.counter(
+            "scheduler_jobs_admitted_total", "jobs admitted from the trace"
+        ).inc()
+        obs.gauge(
+            "scheduler_queue_depth", "active (incomplete) jobs"
+        ).set(len(self._jobs))
+        # ts is the (monotone) scheduler clock, not the arrival stamp: a
+        # backlogged admission would otherwise time-travel the track.
+        obs.instant(
+            "job_admitted", cat="job", tid="jobs",
+            ts_s=self.get_current_timestamp(),
+            args={"job_id": job_id.integer, "job_type": job.job_type,
+                  "scale_factor": job.scale_factor, "arrival_s": timestamp},
+        )
         self._logger.info("[Job dispatched]\tJob ID: %s", job_id)
         return job_id
 
@@ -449,6 +486,10 @@ class Scheduler:
                 "duration": self._job_completion_times[job_id],
             }
         )
+        if obs.enabled():
+            self._record_completion_telemetry(
+                job_id, self._job_completion_times[job_id]
+            )
         job_type_key = self._job_id_to_job_type[job_id]
         self._job_type_to_job_ids[job_type_key].discard(job_id)
         del self._steps_run_so_far[job_id]
@@ -474,6 +515,39 @@ class Scheduler:
         self._remove_from_priorities(job_id)
         self._need_to_update_allocation = True
         self._logger.info("Remaining active jobs: %d", len(self._jobs))
+
+    def _record_completion_telemetry(self, job_id: JobId, duration) -> None:
+        """Per-job completion series: JCT and finish-time fairness (rho =
+        JCT / (isolated duration x contention), the live-run counterpart
+        of get_finish_time_fairness, using the population seen so far)."""
+        now = self.get_current_timestamp()
+        obs.counter(
+            "scheduler_jobs_completed_total", "jobs run to completion"
+        ).inc()
+        obs.gauge(
+            "scheduler_queue_depth", "active (incomplete) jobs"
+        ).set(len(self._jobs))
+        args = {"job_id": job_id.integer}
+        if duration is not None:
+            obs.histogram(
+                "scheduler_job_jct_seconds", "per-job completion time"
+            ).observe(duration)
+            args["jct_s"] = round(duration, 3)
+            ftf = self._finish_time_rho(job_id, duration)
+            if ftf is not None:
+                obs.histogram(
+                    "scheduler_job_ftf",
+                    "finish-time fairness rho at completion",
+                ).observe(ftf)
+                args["ftf"] = round(ftf, 3)
+        else:
+            obs.counter(
+                "scheduler_jobs_failed_total",
+                "jobs dropped after MAX_FAILED_ATTEMPTS",
+            ).inc()
+        obs.instant(
+            "job_complete", cat="job", tid="jobs", ts_s=now, args=args
+        )
 
     # ------------------------------------------------------------------
     # Throughputs.
@@ -1602,11 +1676,25 @@ class Scheduler:
                     ) == set(scheduled_jobs[job_id])
                     if not kept:
                         self._num_preemptions += 1
+                        obs.counter(
+                            "scheduler_preemptions_total",
+                            "still-active jobs that lost their workers "
+                            "at a round boundary",
+                        ).inc()
+                        obs.instant(
+                            "preemption", cat="sched", tid="rounds",
+                            args={"job_id": str(job_id)},
+                        )
             for job_id in scheduled_jobs:
                 if job_id in self._current_worker_assignments and set(
                     self._current_worker_assignments[job_id]
                 ) == set(scheduled_jobs[job_id]):
                     self._num_lease_extensions += 1
+                    obs.counter(
+                        "scheduler_lease_extensions_total",
+                        "round transitions where a job kept its exact "
+                        "worker set",
+                    ).inc()
             self._current_worker_assignments = scheduled_jobs
             self._round_log.append(
                 {
@@ -1619,6 +1707,31 @@ class Scheduler:
                     },
                 }
             )
+            obs.counter(
+                "scheduler_rounds_total", "scheduling rounds started"
+            ).inc()
+            obs.histogram(
+                "scheduler_round_duration_seconds",
+                "round length (simulated time in sim mode)",
+            ).observe(self._time_per_iteration)
+            obs.gauge(
+                "scheduler_queue_depth", "active (incomplete) jobs"
+            ).set(len(self._jobs))
+            obs.gauge(
+                "scheduler_scheduled_jobs", "jobs granted workers this round"
+            ).set(len(scheduled_jobs))
+            obs.complete(
+                f"round {self._num_completed_rounds}",
+                ts_s=self._current_timestamp,
+                dur_s=self._time_per_iteration,
+                cat="sched",
+                tid="rounds",
+                args={
+                    "round": self._num_completed_rounds,
+                    "scheduled_jobs": len(scheduled_jobs),
+                    "active_jobs": len(self._jobs),
+                },
+            )
 
             for job_id, worker_ids in scheduled_jobs.items():
                 worker_type = self._worker_id_to_worker_type[worker_ids[0]]
@@ -1626,6 +1739,19 @@ class Scheduler:
                     self._available_worker_ids.discard(wid)
                 all_num_steps, max_finish_time = self._get_job_steps_and_finish_times(
                     job_id, worker_type
+                )
+                obs.complete(
+                    f"run job {job_id}",
+                    ts_s=self._current_timestamp,
+                    dur_s=max_finish_time - self._current_timestamp,
+                    cat="job",
+                    pid="cluster",
+                    tid=f"job {job_id}",
+                    args={
+                        "round": self._num_completed_rounds,
+                        "workers": len(worker_ids),
+                        "worker_type": worker_type,
+                    },
                 )
                 heapq.heappush(
                     running_jobs,
@@ -1759,26 +1885,35 @@ class Scheduler:
 
     def save_round_log(self, path: str) -> None:
         """Write the structured event log (job / round / complete events)
-        as JSON lines, for scripts/analysis/postprocess_log.py."""
+        as JSON lines, for scripts/analysis/postprocess_log.py. Written
+        atomically (temp file + rename) so a killed run can't leave a
+        truncated log behind."""
         import json
 
-        with open(path, "w") as f:
-            for record in self._round_log:
-                f.write(json.dumps(record) + "\n")
+        from shockwave_tpu.utils.fileio import atomic_write_text
+
+        atomic_write_text(
+            path,
+            "".join(json.dumps(record) + "\n" for record in self._round_log),
+        )
 
     def save_job_timelines(self, directory: str) -> None:
-        """One per-job file of structured iterator log excerpts
-        (reference: scheduler.py:2267-2284)."""
+        """One per-job file of structured iterator log excerpts, each
+        written atomically (reference: scheduler.py:2267-2284)."""
         import os
+
+        from shockwave_tpu.utils.fileio import atomic_write_text
 
         os.makedirs(directory, exist_ok=True)
         for job_id, timelines in self._job_timelines.items():
-            with open(
-                os.path.join(directory, f"job_{job_id.integer}.log"), "w"
-            ) as f:
-                for rank, lines in enumerate(timelines):
-                    for line in lines:
-                        f.write(f"[rank {rank}] {line}\n")
+            atomic_write_text(
+                os.path.join(directory, f"job_{job_id.integer}.log"),
+                "".join(
+                    f"[rank {rank}] {line}\n"
+                    for rank, lines in enumerate(timelines)
+                    for line in lines
+                ),
+            )
 
     # ------------------------------------------------------------------
     # Metrics.
@@ -1821,27 +1956,40 @@ class Scheduler:
             return None
         return float(np.mean(utilizations))
 
+    def _finish_time_rho(self, job_id: JobId, jct: float):
+        """rho = JCT / (isolated duration x contention factor) — THE
+        finish-time-fairness definition, shared by the summary getter
+        and the live completion telemetry so the two can never drift.
+        None when the job has no profile (no isolated baseline)."""
+        profile = self._profiles.get(job_id.integer)
+        if profile is None:
+            return None
+        isolated = sum(profile["duration_every_epoch"])
+        if isolated <= 0:
+            return None
+        contention = max(
+            1.0, self._num_jobs_in_trace / max(1, len(self._worker_ids))
+        )
+        return jct / (isolated * contention)
+
     def get_finish_time_fairness(self, job_ids=None):
-        """rho = JCT / (isolated duration x contention factor); also the
-        fraction of jobs with rho > 1.1 (reference: scheduler.py:3627-3655).
-        ``job_ids`` restricts to a measurement window (continuous sweeps
-        exclude the warmup/tail jobs from every metric, not just JCT)."""
-        num_gpus = len(self._worker_ids)
+        """rho per completed job; also the fraction of jobs with
+        rho > 1.1 (reference: scheduler.py:3627-3655). ``job_ids``
+        restricts to a measurement window (continuous sweeps exclude the
+        warmup/tail jobs from every metric, not just JCT)."""
         if len(self._job_completion_times) == 0:
             return [], 0.0
         ftf_list = []
-        contention = max(1.0, self._num_jobs_in_trace / max(1, num_gpus))
         for job_id in sorted(self._job_completion_times.keys()):
             if job_ids is not None and job_id not in job_ids:
                 continue
             jct = self._job_completion_times[job_id]
             if jct is None:
                 continue
-            profile = self._profiles.get(job_id.integer)
-            if profile is None:
+            rho = self._finish_time_rho(job_id, jct)
+            if rho is None:
                 continue
-            isolated = sum(profile["duration_every_epoch"])
-            ftf_list.append(round(jct / (isolated * contention), 3))
+            ftf_list.append(round(rho, 3))
         if not ftf_list:
             return [], 0.0
         unfair_fraction = 100.0 * sum(f > 1.1 for f in ftf_list) / len(ftf_list)

@@ -1,0 +1,333 @@
+"""Unified telemetry layer: metrics registry + structured event tracing.
+
+One process-local :class:`~shockwave_tpu.obs.metrics.MetricsRegistry`
+and one :class:`~shockwave_tpu.obs.trace.EventTracer` serve the whole
+process — scheduler core, policies, solver backends, dispatcher,
+workers, RPC servers all publish into them through the module-level
+helpers here, so no component needs a handle threaded through its
+constructor.
+
+Telemetry is DISABLED by default and must stay near-free that way:
+``counter()``/``gauge()``/``histogram()`` return a shared null
+instrument and ``span()`` a shared null context manager after a single
+flag check, so instrumented code paths change no benchmark result and
+no jit cache key. Enable with :func:`configure` (what the
+``--metrics-out`` / ``--trace-out`` driver flags do), or with the
+``SHOCKWAVE_METRICS_OUT`` / ``SHOCKWAVE_TRACE_OUT`` environment
+variables for subprocesses (worker agents export on shutdown; see
+:func:`configure_from_env`).
+
+Core series every run publishes (the contract
+``scripts/analysis/report_run.py`` and the golden tests rely on):
+
+============================================  =========  ==============
+name                                          type       labels
+============================================  =========  ==============
+``scheduler_rounds_total``                    counter    —
+``scheduler_round_duration_seconds``          histogram  —
+``scheduler_jobs_admitted_total``             counter    —
+``scheduler_jobs_completed_total``            counter    —
+``scheduler_preemptions_total``               counter    —
+``scheduler_lease_extensions_total``          counter    —
+``scheduler_queue_depth``                     gauge      —
+``scheduler_job_jct_seconds``                 histogram  —
+``scheduler_job_ftf``                         histogram  —
+``shockwave_solve_seconds``                   histogram  backend, ok
+``shockwave_plan_phase_seconds``              histogram  phase
+``solver_backend_seconds``                    histogram  backend
+============================================  =========  ==============
+
+Physical runs add ``rpc_handler_seconds{method}``,
+``rpc_client_seconds{method}``, ``dispatch_latency_seconds``,
+``scheduler_kills_total``, and the worker-side
+``worker_launches_total`` / ``worker_job_seconds`` /
+``worker_kills_total`` families.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from shockwave_tpu.obs.metrics import (  # noqa: F401 (re-exported API)
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    SCHEMA,
+)
+from shockwave_tpu.obs.trace import EventTracer
+
+_registry = MetricsRegistry(enabled=False)
+_tracer = EventTracer(enabled=False)
+
+
+class _NullInstrument:
+    """No-op counter/gauge/histogram handed out while disabled."""
+
+    __slots__ = ()
+
+    def inc(self, amount=1.0, **labels):
+        pass
+
+    def set(self, value, **labels):
+        pass
+
+    def observe(self, value, **labels):
+        pass
+
+
+_NULL = _NullInstrument()
+
+
+# -- configuration ------------------------------------------------------
+def configure(
+    metrics: Optional[bool] = None, trace: Optional[bool] = None
+) -> None:
+    """Enable/disable the process's telemetry planes; ``None`` leaves a
+    plane unchanged."""
+    if metrics is not None:
+        _registry.enabled = bool(metrics)
+    if trace is not None:
+        _tracer.enabled = bool(trace)
+
+
+def configure_from_env(env=None) -> dict:
+    """Subprocess contract: SHOCKWAVE_METRICS_OUT / SHOCKWAVE_TRACE_OUT
+    name export paths and switch the matching plane on. Returns the
+    {"metrics": path|None, "trace": path|None} it found (the caller
+    exports there on shutdown)."""
+    env = os.environ if env is None else env
+    metrics_out = env.get("SHOCKWAVE_METRICS_OUT") or None
+    trace_out = env.get("SHOCKWAVE_TRACE_OUT") or None
+    configure(
+        metrics=True if metrics_out else None,
+        trace=True if trace_out else None,
+    )
+    return {"metrics": metrics_out, "trace": trace_out}
+
+
+def metrics_enabled() -> bool:
+    return _registry.enabled
+
+
+def trace_enabled() -> bool:
+    return _tracer.enabled
+
+
+def enabled() -> bool:
+    return _registry.enabled or _tracer.enabled
+
+
+def get_registry() -> MetricsRegistry:
+    return _registry
+
+
+def get_tracer() -> EventTracer:
+    return _tracer
+
+
+def reset() -> None:
+    """Tests only: drop all recorded state and disable both planes."""
+    _registry.reset()
+    _registry.enabled = False
+    _tracer.reset()
+    _tracer.enabled = False
+    _tracer.set_clock(None)
+
+
+# -- instrument accessors (fetch-by-name; null when disabled) -----------
+def counter(name: str, help: str = ""):
+    if not _registry.enabled:
+        return _NULL
+    return _registry.counter(name, help)
+
+
+def gauge(name: str, help: str = ""):
+    if not _registry.enabled:
+        return _NULL
+    return _registry.gauge(name, help)
+
+
+def histogram(name: str, help: str = ""):
+    if not _registry.enabled:
+        return _NULL
+    return _registry.histogram(name, help)
+
+
+# -- tracing shortcuts --------------------------------------------------
+def span(name, cat="", pid="scheduler", tid="main", args=None):
+    return _tracer.span(name, cat=cat, pid=pid, tid=tid, args=args)
+
+
+def complete(name, ts_s, dur_s, cat="", pid="scheduler", tid="main", args=None):
+    _tracer.complete(
+        name, ts_s, dur_s, cat=cat, pid=pid, tid=tid, args=args
+    )
+
+
+def instant(name, cat="", pid="scheduler", tid="main", args=None, ts_s=None):
+    _tracer.instant(name, cat=cat, pid=pid, tid=tid, args=args, ts_s=ts_s)
+
+
+def set_trace_clock(clock) -> None:
+    _tracer.set_clock(clock)
+
+
+# -- solver backend timing ----------------------------------------------
+_BACKEND_PHASE_HELP = (
+    "per-backend phase wall time (device solve vs host polish/placement "
+    "tail)"
+)
+_BACKEND_TOTAL_HELP = "end-to-end backend solve wall time"
+
+
+class _NullBackendPhases:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def phase(self, name):
+        pass
+
+
+_NULL_BACKEND_PHASES = _NullBackendPhases()
+
+
+class _BackendPhases:
+    """One timed backend solve: a trace span on the backend's track, a
+    ``solver_backend_phase_seconds{backend, phase}`` observation per
+    ``phase()`` checkpoint (the delta since the previous checkpoint),
+    and — unless ``total=False`` — the end-to-end
+    ``solver_backend_seconds{backend}`` observation on exit."""
+
+    __slots__ = ("_backend", "_num_jobs", "_total", "_span", "_t0", "_last")
+
+    def __init__(self, backend, num_jobs, total):
+        self._backend = backend
+        self._num_jobs = num_jobs
+        self._total = total
+
+    def __enter__(self):
+        import time
+
+        self._span = _tracer.span(
+            f"solve:{self._backend}", cat="solver", pid="solver",
+            tid=self._backend, args={"num_jobs": self._num_jobs},
+        )
+        self._span.__enter__()
+        self._t0 = self._last = time.perf_counter()
+        return self
+
+    def phase(self, name):
+        import time
+
+        now = time.perf_counter()
+        histogram("solver_backend_phase_seconds", _BACKEND_PHASE_HELP).observe(
+            now - self._last, backend=self._backend, phase=name
+        )
+        self._last = now
+
+    def __exit__(self, *exc):
+        import time
+
+        self._span.__exit__(*exc)
+        if self._total:
+            histogram("solver_backend_seconds", _BACKEND_TOTAL_HELP).observe(
+                time.perf_counter() - self._t0, backend=self._backend
+            )
+        return False
+
+
+def backend_phases(backend: str, num_jobs: int, total: bool = True):
+    """Context manager the solver backends wrap their entry points in;
+    the shared no-op instance when telemetry is off."""
+    if not enabled():
+        return _NULL_BACKEND_PHASES
+    return _BackendPhases(backend, num_jobs, total)
+
+
+# -- CLI contract -------------------------------------------------------
+def add_telemetry_args(parser) -> None:
+    """The shared --trace-out/--metrics-out argparse pair every driver
+    exposes (underscore spellings accepted as aliases)."""
+    parser.add_argument(
+        "--trace-out",
+        "--trace_out",
+        dest="trace_out",
+        type=str,
+        default=None,
+        help="write a Chrome trace-event JSON timeline of the run here "
+        "(loadable in Perfetto / chrome://tracing)",
+    )
+    parser.add_argument(
+        "--metrics-out",
+        "--metrics_out",
+        dest="metrics_out",
+        type=str,
+        default=None,
+        help="write the metrics-registry snapshot (JSON) here; feed it "
+        "to scripts/analysis/report_run.py",
+    )
+
+
+def export_run_summary(
+    metrics_out=None,
+    trace_out=None,
+    makespan=None,
+    avg_jct=None,
+    utilization=None,
+    ftf_list=None,
+    unfair_fraction=None,
+) -> None:
+    """Publish run-level outcome gauges (so the metrics dump alone
+    carries the summary table scripts/analysis/report_run.py prints) and
+    export to the requested paths. One implementation for every driver —
+    the gauges cannot drift per entry point."""
+    if not (metrics_out or trace_out):
+        return
+    if makespan is not None:
+        gauge("run_makespan_seconds", "trace makespan").set(makespan)
+    if avg_jct is not None:
+        gauge("run_avg_jct_seconds", "average JCT").set(avg_jct)
+    if utilization is not None:
+        gauge("run_utilization", "mean worker utilization").set(utilization)
+    if ftf_list:
+        gauge("run_worst_ftf", "worst finish-time fairness").set(
+            max(ftf_list)
+        )
+        if unfair_fraction is not None:
+            gauge(
+                "run_unfair_fraction_pct", "% jobs with FTF > 1.1"
+            ).set(unfair_fraction)
+    if metrics_out:
+        export_metrics(metrics_out)
+        print(f"Wrote {metrics_out}")
+    if trace_out:
+        export_trace(trace_out)
+        print(f"Wrote {trace_out} (load in https://ui.perfetto.dev)")
+
+
+# -- export -------------------------------------------------------------
+def render_prometheus() -> str:
+    if not _registry.enabled:
+        return "# telemetry disabled (enable with --metrics-out)\n"
+    return _registry.render_text()
+
+
+def export_metrics(path: str) -> None:
+    """Atomic JSON dump of the metrics snapshot."""
+    import json
+
+    from shockwave_tpu.utils.fileio import atomic_write_text
+
+    atomic_write_text(path, json.dumps(_registry.snapshot(), indent=1))
+
+
+def export_trace(path: str) -> None:
+    """Atomic Chrome trace-event JSON dump (Perfetto-loadable)."""
+    _tracer.export(path)

@@ -21,10 +21,11 @@ from __future__ import annotations
 
 import time
 from collections import OrderedDict
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from shockwave_tpu import obs
 from shockwave_tpu.policies.base import Policy
 from shockwave_tpu.predictor import JobMetadata
 from shockwave_tpu.solver.eg_problem import EGProblem
@@ -76,7 +77,15 @@ class ShockwavePlanner:
         # placements a replan is charged for dropping.
         self.last_round_jobs: List[object] = []
         # Wall-clock seconds of each plan solve (consumed by bench.py).
+        # Failed/timed-out solves are recorded too — an exception path
+        # that vanishes from the timing series hides exactly the solves
+        # an operator must see.
         self.solve_times: List[float] = []
+        # One record per solve attempt: {"backend": the backend that
+        # actually produced (or failed) the solve — "tpu" dispatches to
+        # sharded/native/level per problem size — "seconds", "ok",
+        # "round", "num_jobs", and "error" on failures}.
+        self.solve_records: List[dict] = []
 
     # -- scheduler-facing interface -------------------------------------
     def add_job(
@@ -146,6 +155,7 @@ class ShockwavePlanner:
             "job_overheads": dict(self.job_overheads),
             "last_round_jobs": list(self.last_round_jobs),
             "solve_times": list(self.solve_times),
+            "solve_records": [dict(r) for r in self.solve_records],
         }
 
     @classmethod
@@ -166,6 +176,9 @@ class ShockwavePlanner:
         planner.job_overheads = dict(state.get("job_overheads", {}))
         planner.last_round_jobs = list(state.get("last_round_jobs", []))
         planner.solve_times = list(state["solve_times"])
+        planner.solve_records = [
+            dict(r) for r in state.get("solve_records", [])
+        ]
         return planner
 
     def current_round_schedule(self) -> list:
@@ -286,7 +299,14 @@ class ShockwavePlanner:
         avg = float(np.dot(weights, finish_times))
         return max(1e-6, alpha * avg + (1 - alpha) * history[-1][1])
 
-    def _solve(self, problem: EGProblem) -> np.ndarray:
+    def _solve(self, problem: EGProblem) -> "Tuple[np.ndarray, str]":
+        """Returns (schedule, backend_used) — ``backend_used`` is the
+        backend that actually produced the solve, which for the "tpu"
+        latency-aware dispatch differs per problem size.
+        ``_attempted_backend`` tracks the in-flight choice so a raising
+        solver is attributed to the backend that actually raised, not
+        the configured dispatch name."""
+        self._attempted_backend = self.backend
         if self.backend == "reference":
             from shockwave_tpu.solver.eg_milp import (
                 reorder_unfair_jobs_milp,
@@ -298,14 +318,18 @@ class ShockwavePlanner:
                 rel_gap=self.solver_rel_gap,
                 time_limit=self.solver_timeout,
             )
-            return reorder_unfair_jobs_milp(
-                Y,
-                problem,
-                rel_gap=self.solver_rel_gap,
-                time_limit=self.solver_timeout,
+            return (
+                reorder_unfair_jobs_milp(
+                    Y,
+                    problem,
+                    rel_gap=self.solver_rel_gap,
+                    time_limit=self.solver_timeout,
+                ),
+                "reference",
             )
         from shockwave_tpu.solver.rounding import reorder_rounds
 
+        used = self.backend
         if self.backend == "native":
             from shockwave_tpu.native import solve_eg_greedy_native
 
@@ -367,7 +391,9 @@ class ShockwavePlanner:
                         solve_eg_level_sharded,
                     )
 
+                    self._attempted_backend = "sharded"
                     Y = solve_eg_level_sharded(problem)
+                    used = "sharded"
             work = (
                 float(problem.num_gpus)
                 * problem.future_rounds
@@ -377,33 +403,102 @@ class ShockwavePlanner:
                 from shockwave_tpu import native
 
                 if native.available():
+                    self._attempted_backend = "native"
                     Y = native.solve_eg_greedy_native(problem)
+                    used = "native"
             if Y is None:
                 from shockwave_tpu.solver.eg_jax import solve_eg_level
 
+                self._attempted_backend = "level"
                 Y = solve_eg_level(problem)
-        return reorder_rounds(
-            Y, problem.priorities, problem.nworkers, problem.num_gpus
+                used = "level"
+        return (
+            reorder_rounds(
+                Y, problem.priorities, problem.nworkers, problem.num_gpus
+            ),
+            used,
         )
+
+    def _record_solve(
+        self, seconds: float, backend: str, num_jobs: int,
+        ok: bool, error: Optional[str] = None,
+    ) -> None:
+        """Every solve attempt lands in the timing series — including
+        failed/timed-out solves, which are precisely the ones a
+        debugging operator needs to see — tagged with the backend that
+        produced it."""
+        self.solve_times.append(seconds)
+        record = {
+            "backend": backend,
+            "seconds": seconds,
+            "ok": ok,
+            "round": self.round_index,
+            "num_jobs": num_jobs,
+        }
+        if error is not None:
+            record["error"] = error
+        self.solve_records.append(record)
+        obs.histogram(
+            "shockwave_solve_seconds",
+            "plan-solve wall time per backend (ok=False: failed solves)",
+        ).observe(seconds, backend=backend, ok=str(ok))
+        if not ok:
+            obs.counter(
+                "shockwave_solve_failures_total",
+                "plan solves that raised or timed out",
+            ).inc(backend=backend)
 
     def _replan(self) -> None:
         # Past rounds are never read again; keep the cache bounded.
         for r in [r for r in self.schedules if r < self.round_index]:
             del self.schedules[r]
-        problem, job_ids = self._build_problem()
-        if problem is None:
-            for i in range(self.future_rounds):
-                self.schedules[self.round_index + i] = []
-            return
-        start = time.time()
-        Y = self._solve(problem)
-        self.solve_times.append(time.time() - start)
-        Y = self._apply_stickiness(Y, problem)
-        Y = self._backfill(Y, problem)
-        for r in range(self.future_rounds):
-            self.schedules[self.round_index + r] = [
-                job_ids[j] for j in range(len(job_ids)) if Y[j, r]
-            ]
+        phase_h = obs.histogram(
+            "shockwave_plan_phase_seconds",
+            "wall time of each planning phase (build/solve/stickiness/"
+            "backfill)",
+        )
+        with obs.span(
+            "replan", cat="plan", pid="solver", tid="planner",
+            args={"round": self.round_index, "backend": self.backend},
+        ):
+            start = time.time()
+            problem, job_ids = self._build_problem()
+            phase_h.observe(time.time() - start, phase="build")
+            if problem is None:
+                for i in range(self.future_rounds):
+                    self.schedules[self.round_index + i] = []
+                return
+            start = time.time()
+            try:
+                with obs.span(
+                    "solve", cat="plan", pid="solver", tid="planner",
+                    args={"num_jobs": problem.num_jobs},
+                ):
+                    Y, backend_used = self._solve(problem)
+            except Exception as e:
+                elapsed = time.time() - start
+                phase_h.observe(elapsed, phase="solve")
+                self._record_solve(
+                    elapsed,
+                    getattr(self, "_attempted_backend", self.backend),
+                    problem.num_jobs,
+                    ok=False,
+                    error=type(e).__name__,
+                )
+                raise
+            elapsed = time.time() - start
+            phase_h.observe(elapsed, phase="solve")
+            self._record_solve(elapsed, backend_used, problem.num_jobs, ok=True)
+            start = time.time()
+            Y = self._apply_stickiness(Y, problem)
+            phase_h.observe(time.time() - start, phase="stickiness")
+            start = time.time()
+            Y = self._backfill(Y, problem)
+            phase_h.observe(time.time() - start, phase="backfill")
+            for r in range(self.future_rounds):
+                self.schedules[self.round_index + r] = [
+                    job_ids[j] for j in range(len(job_ids)) if Y[j, r]
+                ]
 
     def _apply_stickiness(self, Y: np.ndarray, problem: EGProblem) -> np.ndarray:
         """Lease stickiness: pull granted incumbents into the plan's first
@@ -602,6 +697,14 @@ class PoolSetPlanner:
     @property
     def solve_times(self) -> List[float]:
         return [t for c in self.children.values() for t in c.solve_times]
+
+    @property
+    def solve_records(self) -> List[dict]:
+        return [
+            {**r, "pool": wt}
+            for wt, c in self.children.items()
+            for r in c.solve_records
+        ]
 
     def current_round_schedule_by_pool(self) -> "OrderedDict[str, list]":
         return OrderedDict(

@@ -21,6 +21,8 @@ import threading
 import time
 from typing import Dict, List
 
+from shockwave_tpu import obs
+
 LOG = logging.getLogger("runtime.dispatcher")
 
 _PROGRESS_RE = re.compile(r"steps=(\d+) duration=([0-9.]+)")
@@ -164,26 +166,37 @@ class Dispatcher:
             }
         )
         stdout_path = log_file + ".stdout"
+        obs.counter(
+            "worker_launches_total", "training subprocesses launched"
+        ).inc()
         start = time.time()
-        with open(stdout_path, "w") as out:
-            proc = subprocess.Popen(
-                command,
-                shell=True,
-                cwd=job.get("working_directory") or None,
-                env=env,
-                stdout=out,
-                stderr=subprocess.STDOUT,
-                start_new_session=True,
-            )
-            with self._lock:
-                self._procs[(job_id, worker_id)] = proc
-            proc.wait()
+        with obs.span(
+            "run_job", cat="worker", pid="worker", tid=f"accel {accel_id}",
+            args={"job_id": job_id, "round": round_id},
+        ):
+            with open(stdout_path, "w") as out:
+                proc = subprocess.Popen(
+                    command,
+                    shell=True,
+                    cwd=job.get("working_directory") or None,
+                    env=env,
+                    stdout=out,
+                    stderr=subprocess.STDOUT,
+                    start_new_session=True,
+                )
+                with self._lock:
+                    self._procs[(job_id, worker_id)] = proc
+                proc.wait()
         with self._lock:
             self._procs.pop((job_id, worker_id), None)
             killed = job_id in self._kill_requested
             if not any(jid == job_id for jid, _ in self._procs):
                 self._kill_requested.discard(job_id)
         elapsed = time.time() - start
+        obs.histogram(
+            "worker_job_seconds",
+            "training subprocess lifetime (launch to exit)",
+        ).observe(elapsed)
         n, d, log_text = self._get_steps_and_execution_time(log_file)
         if n is None:
             if killed:
@@ -194,7 +207,19 @@ class Dispatcher:
                 LOG.error(
                     "Job %d reported no progress (see %s)", job_id, stdout_path
                 )
+                obs.counter(
+                    "worker_no_progress_total",
+                    "subprocesses that exited without a parseable "
+                    "progress line",
+                ).inc()
                 n, d = 0, 0.0
+        if n is not None and d is not None and d > 0:
+            # Relaunch overhead as the worker sees it: process lifetime
+            # minus the useful training time the iterator reported.
+            obs.histogram(
+                "worker_relaunch_overhead_seconds",
+                "subprocess lifetime minus reported training time",
+            ).observe(max(elapsed - d, 0.0))
         return n, d, log_text
 
     def _get_steps_and_execution_time(self, log_file: str):
@@ -219,6 +244,10 @@ class Dispatcher:
             procs = [p for (jid, _), p in self._procs.items() if jid == job_id]
             if procs:
                 self._kill_requested.add(job_id)
+                obs.counter(
+                    "worker_kills_total", "kill requests that hit a live "
+                    "training subprocess"
+                ).inc()
         for proc in procs:
             try:
                 os.killpg(os.getpgid(proc.pid), signal.SIGTERM)

@@ -63,10 +63,18 @@ def _worker_to_scheduler_handlers(callbacks):
         )
         return common_pb2.Empty()
 
+    def DumpMetrics(request, context):
+        from shockwave_tpu.runtime.protobuf import telemetry_pb2
+
+        cb = callbacks.get("dump_metrics")
+        text = cb() if cb is not None else "# no metrics callback wired\n"
+        return telemetry_pb2.MetricsDump(text=text)
+
     return {
         "RegisterWorker": RegisterWorker,
         "SendHeartbeat": SendHeartbeat,
         "Done": Done,
+        "DumpMetrics": DumpMetrics,
     }
 
 

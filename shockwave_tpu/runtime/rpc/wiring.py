@@ -18,6 +18,7 @@ from shockwave_tpu.runtime.protobuf import (
     common_pb2,
     iterator_to_scheduler_pb2 as it_pb2,
     scheduler_to_worker_pb2 as s2w_pb2,
+    telemetry_pb2,
     worker_to_scheduler_pb2 as w2s_pb2,
 )
 
@@ -31,6 +32,9 @@ SERVICES = {
         ),
         "SendHeartbeat": (w2s_pb2.Heartbeat, common_pb2.Empty),
         "Done": (w2s_pb2.DoneRequest, common_pb2.Empty),
+        # Observability: scrape the scheduler's metrics registry as
+        # Prometheus exposition text (see obs.render_prometheus).
+        "DumpMetrics": (common_pb2.Empty, telemetry_pb2.MetricsDump),
     },
     "SchedulerToWorker": {
         "RunJob": (s2w_pb2.RunJobRequest, common_pb2.Empty),

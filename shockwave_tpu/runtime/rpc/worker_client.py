@@ -39,6 +39,15 @@ class WorkerRpcClient:
                 w2s_pb2.Heartbeat(worker_id=worker_id)
             )
 
+    def dump_metrics(self) -> str:
+        """Scrape the scheduler's metrics registry (Prometheus
+        exposition text; the /metrics-style dump RPC)."""
+        from shockwave_tpu.runtime.protobuf import common_pb2
+
+        with grpc.insecure_channel(self._addr) as channel:
+            response = self._stubs(channel).DumpMetrics(common_pb2.Empty())
+        return response.text
+
     def notify_scheduler(
         self, worker_id, job_ids, num_steps, execution_times, iterator_logs
     ) -> None:

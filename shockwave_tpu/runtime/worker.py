@@ -98,6 +98,8 @@ class Worker:
 
 
 def main():
+    from shockwave_tpu import obs
+
     parser = argparse.ArgumentParser(description="shockwave_tpu worker agent")
     parser.add_argument("-t", "--worker_type", type=str, required=True)
     parser.add_argument("-n", "--num_accelerators", type=int, default=1)
@@ -110,6 +112,11 @@ def main():
     )
     parser.add_argument("--use_numactl", action="store_true")
     args = parser.parse_args()
+    # Worker agents are subprocesses, so telemetry rides the env contract
+    # (SHOCKWAVE_METRICS_OUT / SHOCKWAVE_TRACE_OUT name export paths) —
+    # the physical drivers set it when their --metrics-out/--trace-out
+    # flags are given; dumps land at shutdown.
+    telemetry_out = obs.configure_from_env()
     worker = Worker(
         args.worker_type,
         args.num_accelerators,
@@ -121,6 +128,10 @@ def main():
         use_numactl=args.use_numactl,
     )
     worker.join()
+    if telemetry_out["metrics"]:
+        obs.export_metrics(telemetry_out["metrics"])
+    if telemetry_out["trace"]:
+        obs.export_trace(telemetry_out["trace"])
 
 
 if __name__ == "__main__":

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from shockwave_tpu import obs
 from shockwave_tpu.solver.eg_problem import EGProblem
 
 _EPS = 1e-6
@@ -535,8 +536,12 @@ def solve_eg_level(problem: EGProblem, polish: bool = True) -> np.ndarray:
     solve and is therefore packable by construction — is solved too and
     the better schedule by true objective wins.
     """
-    counts, _ = solve_level_counts(problem)
-    return counts_to_schedule(counts, problem, polish=polish)
+    with obs.backend_phases("level", problem.num_jobs) as bp:
+        counts, _ = solve_level_counts(problem)
+        bp.phase("device")
+        Y = counts_to_schedule(counts, problem, polish=polish)
+        bp.phase("host")
+    return Y
 
 
 def solve_level_counts(problem: EGProblem) -> Tuple[np.ndarray, float]:
@@ -654,6 +659,11 @@ def num_grants_for(problem: EGProblem, num_slots: int) -> int:
 
 def solve_eg_jax(problem: EGProblem, num_steps: int = 256) -> np.ndarray:
     """End-to-end relaxed solve for one problem; returns s (float, [J])."""
+    with obs.backend_phases("relaxed", problem.num_jobs):
+        return _solve_eg_jax_inner(problem, num_steps)
+
+
+def _solve_eg_jax_inner(problem: EGProblem, num_steps: int) -> np.ndarray:
     slots = num_slots_for(problem.num_jobs)
     packed = pad_problem(problem, slots)
     s, _ = solve_relaxed(

@@ -40,6 +40,7 @@ import numpy as np
 from scipy import sparse
 from scipy.optimize import Bounds, LinearConstraint, milp
 
+from shockwave_tpu import obs
 from shockwave_tpu.solver.eg_problem import EGProblem
 
 
@@ -191,7 +192,10 @@ def solve_eg_milp(
     time_limit: Optional[float] = 15.0,
 ) -> np.ndarray:
     """Tightened formulation (only Y integer); the production exact backend."""
-    return _solve_eg(problem, False, rel_gap, time_limit)
+    with obs.backend_phases("milp", problem.num_jobs) as bp:
+        Y = _solve_eg(problem, False, rel_gap, time_limit)
+        bp.phase("milp")
+    return Y
 
 
 def solve_eg_milp_reference_formulation(
@@ -214,6 +218,18 @@ def reorder_unfair_jobs_milp(
     earliest: minimize sum_j priority_j * mean-round-index_j
     (reference: shockwave.py:281-328, paper Appendix G.2).
     """
+    with obs.backend_phases("milp", Y.shape[0], total=False) as bp:
+        Y_out = _reorder_unfair_jobs_milp_inner(Y, problem, rel_gap, time_limit)
+        bp.phase("reorder")
+    return Y_out
+
+
+def _reorder_unfair_jobs_milp_inner(
+    Y: np.ndarray,
+    problem: EGProblem,
+    rel_gap: float,
+    time_limit: Optional[float],
+) -> np.ndarray:
     J, R = Y.shape
     counts = Y.sum(axis=1)
     if counts.sum() == 0:

@@ -322,7 +322,14 @@ def solve_eg_level_sharded(
     schedule Y ([J, R]). Multi-chip counterpart of
     :func:`shockwave_tpu.solver.eg_jax.solve_eg_level` — same host-side
     polish/placement tail, sharded device solve."""
+    from shockwave_tpu import obs
     from shockwave_tpu.solver.eg_jax import counts_to_schedule
 
-    counts, _ = solve_level_sharded(problem, mesh=mesh, axis_name=axis_name)
-    return counts_to_schedule(counts, problem, polish=polish)
+    with obs.backend_phases("sharded", problem.num_jobs) as bp:
+        counts, _ = solve_level_sharded(
+            problem, mesh=mesh, axis_name=axis_name
+        )
+        bp.phase("device")
+        Y = counts_to_schedule(counts, problem, polish=polish)
+        bp.phase("host")
+    return Y

@@ -1,0 +1,33 @@
+"""Atomic file writes: temp file in the target directory + rename.
+
+A run killed mid-write (preemption, ctrl-C between rounds, OOM) must
+never leave a truncated artifact behind — a half-written JSONL round
+log or metrics dump poisons every downstream analysis silently. rename
+within one filesystem is atomic, so readers observe either the previous
+complete file or the new complete file, never a prefix.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp_path = tempfile.mkstemp(
+        dir=directory, prefix=os.path.basename(path) + ".", suffix=".tmp"
+    )
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp_path, path)
+    except BaseException:
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+        raise

@@ -1,0 +1,42 @@
+"""make_logger level semantics: level=None must preserve a level that a
+previous call (or the operator) already configured."""
+
+import logging
+
+from shockwave_tpu.utils.logging import make_logger
+
+
+def test_default_sets_warning_on_fresh_logger():
+    name = "test_logging_fresh"
+    logging.getLogger(name).setLevel(logging.NOTSET)
+    make_logger(name, lambda: 0.0)
+    assert logging.getLogger(name).level == logging.WARNING
+
+
+def test_none_preserves_existing_level():
+    name = "test_logging_preserve"
+    make_logger(name, lambda: 0.0, level=logging.DEBUG)
+    assert logging.getLogger(name).level == logging.DEBUG
+    # A second caller without an explicit level must not reset it.
+    make_logger(name, lambda: 0.0)
+    assert logging.getLogger(name).level == logging.DEBUG
+
+
+def test_explicit_level_still_overrides():
+    name = "test_logging_override"
+    make_logger(name, lambda: 0.0, level=logging.DEBUG)
+    make_logger(name, lambda: 0.0, level=logging.ERROR)
+    assert logging.getLogger(name).level == logging.ERROR
+
+
+def test_handler_added_once():
+    name = "test_logging_handlers"
+    make_logger(name, lambda: 0.0)
+    make_logger(name, lambda: 0.0)
+    assert len(logging.getLogger(name).handlers) == 1
+
+
+def test_timestamp_prefix_uses_clock():
+    adapter = make_logger("test_logging_clock", lambda: 42.5)
+    msg, _ = adapter.process("hello", {})
+    assert msg == "[42.50] hello"

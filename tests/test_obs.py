@@ -1,0 +1,441 @@
+"""Telemetry layer tests: metrics registry, event tracer, scheduler
+wiring, Chrome-trace schema validity, disabled-path parity, and the
+report CLI on the committed fixture dump."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from shockwave_tpu import obs
+from shockwave_tpu.core.job import Job
+from shockwave_tpu.core.scheduler import Scheduler
+from shockwave_tpu.data.default_oracle import generate_oracle
+from shockwave_tpu.data.profiles import synthesize_profiles
+from shockwave_tpu.data.workload_info import steps_per_epoch
+from shockwave_tpu.obs.metrics import SCHEMA, MetricsRegistry
+from shockwave_tpu.obs.trace import EventTracer
+from shockwave_tpu.policies import get_policy
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The documented contract (obs/__init__.py): every instrumented sim run
+# must publish these.
+CORE_SIM_SERIES = [
+    "scheduler_rounds_total",
+    "scheduler_round_duration_seconds",
+    "scheduler_jobs_admitted_total",
+    "scheduler_jobs_completed_total",
+    "scheduler_queue_depth",
+    "scheduler_job_jct_seconds",
+    "scheduler_job_ftf",
+    "shockwave_solve_seconds",
+    "shockwave_plan_phase_seconds",
+]
+
+
+@pytest.fixture(autouse=True)
+def clean_obs():
+    """The obs singletons are process-global: reset around every test so
+    enabling telemetry here can't leak into the rest of the suite."""
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# ----------------------------------------------------------------------
+# Metrics registry.
+# ----------------------------------------------------------------------
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram_semantics(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.counter("c").inc()
+        reg.counter("c").inc(2.5)
+        reg.gauge("g").set(7)
+        reg.gauge("g").inc(3)
+        for v in (0.5, 1.5, 1.0):
+            reg.histogram("h").observe(v)
+        snap = reg.snapshot()["metrics"]
+        assert snap["c"]["series"][0]["value"] == 3.5
+        assert snap["g"]["series"][0]["value"] == 10.0
+        h = snap["h"]["series"][0]
+        assert (h["count"], h["sum"], h["min"], h["max"]) == (3, 3.0, 0.5, 1.5)
+
+    def test_labels_create_independent_series(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.counter("c").inc(backend="level")
+        reg.counter("c").inc(backend="milp")
+        reg.counter("c").inc(backend="level")
+        series = {
+            s["labels"].get("backend"): s["value"]
+            for s in reg.snapshot()["metrics"]["c"]["series"]
+        }
+        assert series == {"level": 2.0, "milp": 1.0}
+
+    def test_disabled_registry_records_nothing(self):
+        reg = MetricsRegistry(enabled=False)
+        reg.counter("c").inc()
+        reg.histogram("h").observe(1.0)
+        metrics = reg.snapshot()["metrics"]
+        assert metrics["c"]["series"] == []
+        assert metrics["h"]["series"] == []
+
+    def test_kind_conflict_raises(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+    def test_render_text_prometheus_shape(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.counter("a_total", "help text").inc(2, kind="x")
+        reg.histogram("lat_seconds").observe(0.25)
+        text = reg.render_text()
+        assert "# HELP a_total help text" in text
+        assert "# TYPE a_total counter" in text
+        assert 'a_total{kind="x"} 2.0' in text
+        assert "lat_seconds_count 1" in text
+        assert "lat_seconds_sum 0.25" in text
+
+    def test_module_helpers_null_when_disabled(self):
+        # Disabled module-level accessors hand back the null instrument:
+        # no state accumulates even if the handle is retained.
+        handle = obs.counter("leak_total")
+        obs.configure(metrics=True)
+        handle.inc()
+        assert "leak_total" not in obs.get_registry().snapshot()["metrics"]
+
+
+# ----------------------------------------------------------------------
+# Event tracer.
+# ----------------------------------------------------------------------
+class TestEventTracer:
+    def test_span_and_instant_events(self):
+        tr = EventTracer(enabled=True)
+        with tr.span("work", tid="t1"):
+            pass
+        tr.instant("marker", tid="t1")
+        events = tr.export_dict()["traceEvents"]
+        phases = [e["ph"] for e in events]
+        assert phases.count("M") == 2  # process_name + thread_name
+        assert "X" in phases and "i" in phases
+        x = next(e for e in events if e["ph"] == "X")
+        assert x["name"] == "work" and x["dur"] >= 0
+
+    def test_custom_clock_lays_out_virtual_time(self):
+        tr = EventTracer(enabled=True)
+        now = {"t": 100.0}
+        tr.set_clock(lambda: now["t"])
+        tr.complete("round 0", ts_s=now["t"], dur_s=60.0, tid="rounds")
+        x = next(
+            e for e in tr.export_dict()["traceEvents"] if e["ph"] == "X"
+        )
+        assert x["ts"] == 100.0 * 1e6 and x["dur"] == 60.0 * 1e6
+
+    def test_disabled_tracer_is_null(self):
+        tr = EventTracer(enabled=False)
+        with tr.span("x"):
+            pass
+        tr.instant("y")
+        assert tr.export_dict()["traceEvents"] == []
+
+    def test_export_is_valid_json_file(self, tmp_path):
+        tr = EventTracer(enabled=True)
+        with tr.span("s"):
+            pass
+        path = str(tmp_path / "trace.json")
+        tr.export(path)
+        data = json.load(open(path))
+        assert isinstance(data["traceEvents"], list)
+
+
+# ----------------------------------------------------------------------
+# Atomic writes.
+# ----------------------------------------------------------------------
+def test_atomic_write_replaces_and_leaves_no_temp(tmp_path):
+    from shockwave_tpu.utils.fileio import atomic_write_text
+
+    path = str(tmp_path / "out.jsonl")
+    atomic_write_text(path, "one\n")
+    atomic_write_text(path, "two\n")
+    assert open(path).read() == "two\n"
+    assert os.listdir(str(tmp_path)) == ["out.jsonl"]
+
+
+def test_save_round_log_is_atomic_and_parseable(tmp_path):
+    jobs, arrivals = _tiny_trace(2)
+    sched, _ = _run_sim("fifo", jobs, arrivals)
+    path = str(tmp_path / "round_log.jsonl")
+    sched.save_round_log(path)
+    records = [json.loads(line) for line in open(path)]
+    assert any(r["event"] == "round" for r in records)
+    assert os.listdir(str(tmp_path)) == ["round_log.jsonl"]
+
+
+# ----------------------------------------------------------------------
+# Golden end-to-end: a short sim run's exports validate structurally.
+# ----------------------------------------------------------------------
+def _tiny_trace(num_jobs=3, epochs=2):
+    jobs, arrivals = [], []
+    for i in range(num_jobs):
+        jobs.append(
+            Job(
+                job_type="ResNet-18 (batch size 32)",
+                command="python3 main.py --data_dir=%s/cifar10 --batch_size 32",
+                num_steps_arg="--num_steps",
+                total_steps=steps_per_epoch("ResNet-18", 32) * epochs,
+                scale_factor=1,
+                mode="static",
+            )
+        )
+        arrivals.append(0.0)
+    return jobs, arrivals
+
+
+def _run_sim(policy_name, jobs, arrivals, num_gpus=2):
+    oracle = generate_oracle()
+    profiles = synthesize_profiles(jobs, oracle)
+    config = None
+    if policy_name.startswith("shockwave"):
+        config = {
+            "num_gpus": num_gpus,
+            "time_per_iteration": 120,
+            "future_rounds": 6,
+            "lambda": 2.0,
+            "k": 1e-3,
+        }
+    sched = Scheduler(
+        get_policy(policy_name),
+        throughputs=oracle,
+        seed=0,
+        time_per_iteration=120,
+        profiles=profiles,
+        shockwave_config=config,
+    )
+    makespan = sched.simulate({"v100": num_gpus}, list(arrivals), list(jobs))
+    return sched, makespan
+
+
+def assert_valid_chrome_trace(trace: dict):
+    """Structural schema check: the keys Perfetto's JSON importer
+    requires, and per-track monotonic timestamps."""
+    assert isinstance(trace["traceEvents"], list) and trace["traceEvents"]
+    last_ts = {}
+    for event in trace["traceEvents"]:
+        assert isinstance(event["name"], str) and event["name"]
+        assert event["ph"] in ("B", "E", "X", "i", "M")
+        assert isinstance(event["pid"], int)
+        assert isinstance(event["tid"], int)
+        if event["ph"] == "M":
+            assert event["name"] in ("process_name", "thread_name")
+            assert "name" in event["args"]
+            continue
+        assert isinstance(event["ts"], (int, float)) and event["ts"] >= 0
+        if event["ph"] == "X":
+            assert event["dur"] >= 0
+        if event["ph"] == "i":
+            assert event["s"] in ("t", "p", "g")
+        key = (event["pid"], event["tid"])
+        assert event["ts"] >= last_ts.get(key, 0.0) - 1e-6, (
+            f"non-monotonic ts on track {key}"
+        )
+        last_ts[key] = event["ts"]
+
+
+def test_sim_run_trace_and_metrics_exports_validate(tmp_path):
+    obs.configure(metrics=True, trace=True)
+    jobs, arrivals = _tiny_trace(3)
+    sched, makespan = _run_sim("shockwave_tpu", jobs, arrivals)
+    assert makespan > 0
+
+    trace_path = str(tmp_path / "trace.json")
+    metrics_path = str(tmp_path / "metrics.json")
+    obs.export_trace(trace_path)
+    obs.export_metrics(metrics_path)
+
+    trace = json.load(open(trace_path))
+    assert_valid_chrome_trace(trace)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert any(n.startswith("round ") for n in names)
+    assert any(n.startswith("run job ") for n in names)
+    assert "job_admitted" in names and "job_complete" in names
+    assert "replan" in names and "solve" in names
+
+    snapshot = json.load(open(metrics_path))
+    assert snapshot["schema"] == SCHEMA
+    for series in CORE_SIM_SERIES:
+        assert series in snapshot["metrics"], f"missing core series {series}"
+        assert snapshot["metrics"][series]["series"], (
+            f"core series {series} is empty"
+        )
+    solve = snapshot["metrics"]["shockwave_solve_seconds"]["series"]
+    assert all(s["labels"].get("backend") for s in solve)
+    rounds = snapshot["metrics"]["scheduler_rounds_total"]["series"][0]
+    assert rounds["value"] == sched._num_completed_rounds
+
+
+def test_disabled_telemetry_is_inert_and_result_identical():
+    """With obs off (the default), instrumented code paths must neither
+    record anything nor change scheduling outcomes."""
+    jobs1, arrivals = _tiny_trace(4)
+    _, mk_default = _run_sim("shockwave_tpu", jobs1, arrivals)
+    assert obs.get_registry().snapshot()["metrics"] == {}
+    assert obs.get_tracer().export_dict()["traceEvents"] == []
+
+    obs.configure(metrics=True, trace=True)
+    jobs2, _ = _tiny_trace(4)
+    _, mk_instrumented = _run_sim("shockwave_tpu", jobs2, arrivals)
+    assert mk_instrumented == mk_default
+
+
+# ----------------------------------------------------------------------
+# Planner solve records (satellite: failures are recorded and tagged).
+# ----------------------------------------------------------------------
+def test_solve_records_tag_backend_and_survive_failures():
+    obs.configure(metrics=True)
+    jobs, arrivals = _tiny_trace(3)
+    sched, _ = _run_sim("shockwave_tpu", jobs, arrivals)
+    planner = sched._shockwave
+    assert planner.solve_records, "no solves recorded"
+    assert len(planner.solve_records) == len(planner.solve_times)
+    for record, seconds in zip(planner.solve_records, planner.solve_times):
+        assert record["ok"] is True
+        assert record["seconds"] == seconds
+        # "tpu" dispatches per problem size; whatever ran must be named.
+        assert record["backend"] in ("native", "level", "sharded")
+        assert record["num_jobs"] >= 1
+
+
+def test_failed_solve_is_recorded_with_backend_tag():
+    from shockwave_tpu.policies.shockwave import ShockwavePlanner
+
+    planner = ShockwavePlanner(
+        {"num_gpus": 2, "time_per_iteration": 120, "future_rounds": 4},
+        backend="tpu",
+    )
+    profile = {
+        "num_epochs": 2,
+        "num_samples_per_epoch": 100,
+        "bs_every_epoch": [32, 32],
+        "duration_every_epoch": [10.0, 10.0],
+    }
+    planner.add_job("job-0", profile, 120, 1, submit_time=0.0)
+
+    def boom(problem):
+        raise RuntimeError("solver exploded")
+
+    planner._solve = boom
+    with pytest.raises(RuntimeError):
+        planner._replan()
+    assert len(planner.solve_times) == 1
+    record = planner.solve_records[-1]
+    assert record["ok"] is False
+    assert record["backend"] == "tpu"
+    assert record["error"] == "RuntimeError"
+    assert record["seconds"] == planner.solve_times[-1]
+
+
+def test_solve_records_roundtrip_through_state_dict():
+    from shockwave_tpu.policies.shockwave import ShockwavePlanner
+
+    planner = ShockwavePlanner(
+        {"num_gpus": 2, "time_per_iteration": 120, "future_rounds": 4},
+        backend="tpu",
+    )
+    planner.solve_times.append(0.5)
+    planner.solve_records.append(
+        {"backend": "native", "seconds": 0.5, "ok": True, "round": 0,
+         "num_jobs": 3}
+    )
+    restored = ShockwavePlanner.from_state(planner.state_dict())
+    assert restored.solve_records == planner.solve_records
+    # Pre-telemetry checkpoints (no solve_records key) must still load.
+    state = planner.state_dict()
+    del state["solve_records"]
+    assert ShockwavePlanner.from_state(state).solve_records == []
+
+
+# ----------------------------------------------------------------------
+# The /metrics dump message (hand-rolled proto3 wire format).
+# ----------------------------------------------------------------------
+def test_metrics_dump_wire_roundtrip():
+    from shockwave_tpu.runtime.protobuf.telemetry_pb2 import MetricsDump
+
+    for text in ("", "a", "metric{x=\"y\"} 1\n" * 100, "uniçode ☃"):
+        data = MetricsDump(text).SerializeToString()
+        assert MetricsDump.FromString(data).text == text
+    # proto3 canonical bytes for string field 1 = "hi".
+    assert MetricsDump("hi").SerializeToString() == b"\x0a\x02hi"
+    # Unknown varint field (field 2, wire type 0) is skipped.
+    assert MetricsDump.FromString(b"\x10\x05\x0a\x02hi").text == "hi"
+
+
+def test_dump_metrics_rpc_round_trip():
+    """The /metrics-style RPC: a live scheduler server serves the
+    registry's Prometheus text to a real gRPC client."""
+    from shockwave_tpu.runtime.rpc import scheduler_server
+    from shockwave_tpu.runtime.rpc.worker_client import WorkerRpcClient
+    from shockwave_tpu.utils.hostenv import free_port
+
+    obs.configure(metrics=True)
+    obs.counter("scheduler_rounds_total", "rounds").inc(3)
+    port = free_port()
+    server = scheduler_server.serve(
+        port, {"dump_metrics": obs.render_prometheus}
+    )
+    try:
+        text = WorkerRpcClient("127.0.0.1", port).dump_metrics()
+    finally:
+        server.stop(grace=0)
+    assert "scheduler_rounds_total 3.0" in text
+    assert "# TYPE scheduler_rounds_total counter" in text
+
+
+# ----------------------------------------------------------------------
+# report_run.py on the committed fixture (tier-1 smoke: the CLI cannot
+# silently rot against the dumps real runs produce).
+# ----------------------------------------------------------------------
+FIXTURE_DIR = os.path.join(REPO_ROOT, "results", "preemption_aware", "telemetry")
+
+
+def test_report_run_cli_on_committed_fixture(tmp_path):
+    out = str(tmp_path / "report.md")
+    result = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "analysis", "report_run.py"),
+            os.path.join(FIXTURE_DIR, "metrics.json"),
+            "--trace",
+            os.path.join(FIXTURE_DIR, "trace.json"),
+            "-o",
+            out,
+        ],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert result.returncode == 0, result.stderr
+    report = open(out).read()
+    for heading in (
+        "## Outcome",
+        "## Plan solves (per backend)",
+        "## Planning phases",
+        "## Timeline (from the trace dump)",
+    ):
+        assert heading in report
+    assert "| Makespan | 25273.9 s |" in report
+    assert "| Preemptions | 148 |" in report
+
+
+def test_committed_fixture_trace_is_valid_chrome_trace():
+    trace = json.load(open(os.path.join(FIXTURE_DIR, "trace.json")))
+    assert_valid_chrome_trace(trace)
+
+
+def test_committed_fixture_metrics_carry_core_series():
+    snapshot = json.load(open(os.path.join(FIXTURE_DIR, "metrics.json")))
+    assert snapshot["schema"] == SCHEMA
+    for series in CORE_SIM_SERIES:
+        assert series in snapshot["metrics"]

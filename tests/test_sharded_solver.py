@@ -185,8 +185,9 @@ def test_tpu_backend_auto_dispatches_to_sharded(monkeypatch):
     assert calls == [], "sub-threshold problem took the sharded path"
 
     big = bench.make_problem(num_jobs=32, future_rounds=6, num_gpus=8)
-    Y = planner._solve(big)
+    Y, backend_used = planner._solve(big)
     assert calls == [32], "fleet-scale problem bypassed the sharded path"
+    assert backend_used == "sharded"
     assert Y.shape == (32, 6)
     big.audit_schedule(np.asarray(Y))
 
