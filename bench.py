@@ -170,6 +170,59 @@ def main():
         objective_budget = None
 
     objective_tpu = problem.objective_value(schedules[0])
+
+    # Time-to-quality curve for the MILP baseline (VERDICT r05 #8): the
+    # two citable numbers between "no incumbent at the reference's 15 s
+    # budget" and "parity at full solve" are (a) the budget at which
+    # HiGHS first returns ANY feasible plan and (b) the budget at which
+    # its incumbent is within 0.1% of this solver's objective. Swept
+    # over increasing TimeLimits (each point is an independent
+    # fresh-start solve, like the reference's per-round invocation);
+    # the sweep stops at quality or at a wall-clock cap so the bench
+    # round stays bounded.
+    budget_points = []
+    first_feasible_s = None
+    within_tenth_pct_s = None
+    sweep_t0 = time.time()
+    for budget in (2.0, 5.0, 10.0, 15.0, 20.0, 30.0, 45.0, 60.0, 90.0,
+                   120.0, 180.0):
+        if within_tenth_pct_s is not None:
+            break
+        if time.time() - sweep_t0 > 420.0:
+            break
+        t0 = time.time()
+        try:
+            Y_b = solve_eg_milp_reference_formulation(
+                problem, rel_gap=1e-3, time_limit=budget
+            )
+            obj_b = problem.objective_value(Y_b)
+        except RuntimeError:
+            obj_b = None
+        solve_s = round(time.time() - t0, 3)
+        point = {
+            "budget_s": budget,
+            "solve_s": solve_s,
+            "objective": round(obj_b, 4) if obj_b is not None else None,
+        }
+        if obj_b is not None:
+            gap = objective_tpu - obj_b
+            point["gap_vs_tpu_pct"] = (
+                round(100.0 * gap / abs(objective_tpu), 4)
+                if abs(objective_tpu) > 1e-6 else None
+            )
+            # Record the MEASURED solve time of the succeeding point
+            # (HiGHS often finishes under its TimeLimit), not the
+            # coarse budget-grid value — the grid only decides where
+            # to sample.
+            if first_feasible_s is None:
+                first_feasible_s = solve_s
+            # Absolute floor on the quality tolerance: the log-Nash-
+            # welfare objective can legitimately sit near zero, where a
+            # pure-relative bar becomes unreachable and the sweep would
+            # burn its whole wall-clock cap to report None.
+            if gap <= max(0.001 * abs(objective_tpu), 1e-3):
+                within_tenth_pct_s = solve_s
+        budget_points.append(point)
     # The equal-time gap as a percentage needs a denominator: the
     # log-Nash-welfare objective can legitimately sit near (or cross)
     # zero, where the ratio explodes into noise. Report the absolute
@@ -212,6 +265,18 @@ def main():
         ),
         "equal_time_objective_gap_pct": equal_time_pct,
         "equal_time_objective_delta": equal_time_delta,
+        # The curve behind the headline: speedup-at-equal-quality is
+        # baseline_time_to_within_0.1pct_s / value; at any budget below
+        # baseline_first_feasible_s the speedup is unbounded (the
+        # baseline has NO plan while this solver's landed).
+        "baseline_budget_sweep": budget_points,
+        "baseline_first_feasible_s": first_feasible_s,
+        "baseline_time_to_within_0.1pct_s": within_tenth_pct_s,
+        "vs_baseline_equal_quality": (
+            round(within_tenth_pct_s / warm_median, 1)
+            if within_tenth_pct_s is not None
+            else None
+        ),
         "config": "1000 jobs x 256 gpus x 50 rounds",
     }
 

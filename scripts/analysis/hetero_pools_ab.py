@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""Heterogeneous-pools A/B on a 120-job dynamic trace: reference-parity
+single-pool planning (v100 only, other types idle) vs the PoolSetPlanner
+(every pool planned), with finish-time fairness computed against
+PER-POOL isolated baselines (VERDICT r05 #5 — previously slow-pool jobs
+were judged against fast-chip isolated durations, so the pool upgrade
+read as an FTF regression that was purely a measurement artifact).
+
+Writes results/hetero/shockwave_pools.json (v2 schema).
+
+Usage:
+  python scripts/analysis/hetero_pools_ab.py \
+      [-t traces/generated_120_dynamic.trace] \
+      [-o results/hetero/shockwave_pools.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+CLUSTER = {"v100": 8, "p100": 4, "k80": 4}
+
+
+def run(trace, hetero_pools):
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data import (
+        load_or_synthesize_profiles,
+        parse_trace,
+    )
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.policies import get_policy
+
+    jobs, arrivals = parse_trace(trace)
+    oracle = generate_oracle()
+    profiles = load_or_synthesize_profiles(trace, jobs, oracle, cache=False)
+    for i, job in enumerate(jobs):
+        job.duration = sum(profiles[i]["duration_every_epoch"])
+    sched = Scheduler(
+        get_policy("shockwave_tpu"),
+        throughputs=oracle,
+        seed=0,
+        time_per_iteration=120,
+        profiles=profiles,
+        shockwave_config={
+            "num_gpus": (
+                sum(CLUSTER.values()) if hetero_pools else CLUSTER["v100"]
+            ),
+            "time_per_iteration": 120,
+            "future_rounds": 20,
+            "lambda": 5.0,
+            "k": 10.0,
+            "hetero_pools": hetero_pools,
+        },
+    )
+    t0 = time.time()
+    makespan = sched.simulate(dict(CLUSTER), list(arrivals), list(jobs))
+    wall = time.time() - t0
+    ftf, unfair = sched.get_finish_time_fairness()
+    return {
+        "Policy": "shockwave_tpu",
+        "Makespan": f"{makespan:.3f} s ({makespan / 3600.0:.2f} h)",
+        "Average JCT": (
+            f"{sched.get_average_jct():.3f} s "
+            f"({sched.get_average_jct() / 3600.0:.2f} h)"
+        ),
+        "Cluster utilization": f"{sched.get_cluster_utilization():.3f}",
+        "Worst FTF": f"{max(ftf):.3f}" if ftf else None,
+        "Unfair job fraction": f"{unfair:.1f}%",
+        "Rounds": (
+            f"{sched._num_completed_rounds}; sim wall-clock: {wall:.1f} s"
+        ),
+    }
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("-t", "--trace",
+                        default="traces/generated_120_dynamic.trace")
+    parser.add_argument("-o", "--output",
+                        default="results/hetero/shockwave_pools.json")
+    args = parser.parse_args(argv)
+
+    parity = run(args.trace, hetero_pools=False)
+    pools = run(args.trace, hetero_pools=True)
+    out = {
+        "trace": os.path.basename(args.trace),
+        "cluster": "8x v100 + 4x p100 + 4x k80, 120 s rounds",
+        "ftf_baseline": (
+            "per-pool isolated baselines: a job's rho denominator is "
+            "its isolated duration AT ITS POOL'S SPEED (the same "
+            "rescale its planner profile got), so slow-pool jobs are "
+            "not judged against fast-chip throughput"
+        ),
+        "reference_parity_hetero_pools_false": parity,
+        "pool_set_hetero_pools_true": pools,
+        "note": (
+            "reference behavior plans the v100 pool only (p100/k80 "
+            "idle); the pool-set planner plans every pool with "
+            "fair-share admission assignment."
+        ),
+    }
+    os.makedirs(os.path.dirname(args.output), exist_ok=True)
+    with open(args.output, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out, indent=2))
+    print(f"wrote {args.output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""Fresh-process solver cold start, with and without the warm-start
+cache (VERDICT r05 #7).
+
+Three subprocess measurements at the stress shape (1000 jobs x 256
+workers x 50 rounds, the BENCH headline config):
+
+  1. **cold**: a fresh process with NO warm-start cache times its first
+     ``solve_level_counts`` — the full XLA compile every CLI invocation
+     used to pay (20.6 s on the TPU bench host, BENCH_r05 ``cold_s``).
+  2. **warm()**: one ``python -m shockwave_tpu.solver.warm_start`` run
+     that compiles and persists the serialized executables.
+  3. **warmed**: another fresh process times its first solve again —
+     now a deserialize + run — and cross-checks counts/objective
+     bit-identical to the cold process's.
+
+Writes one JSON artifact (-o, default results/solver_cold_start.json).
+Run on the host whose CLI invocations you want to accelerate; the
+cache is keyed to that machine's backend.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+sys.path.insert(0, REPO)
+
+_CHILD = r"""
+import json, sys, time
+t_import0 = time.time()
+from bench import make_problem
+from shockwave_tpu.solver.eg_jax import solve_level_counts
+p = make_problem(num_jobs=1000, future_rounds=50, num_gpus=256, seed=3)
+t0 = time.time()
+counts, obj = solve_level_counts(p)
+dt = time.time() - t0
+print(json.dumps({
+    "first_solve_s": round(dt, 3),
+    "import_and_problem_s": round(t0 - t_import0, 3),
+    "objective": obj,
+    "counts_sum": int(counts.sum()),
+    "counts_head": [int(c) for c in counts[:32]],
+}))
+"""
+
+
+def run_child(cache_dir):
+    env = dict(os.environ, SHOCKWAVE_SOLVER_CACHE_DIR=cache_dir)
+    out = subprocess.run(
+        [sys.executable, "-c", _CHILD], capture_output=True, text=True,
+        cwd=REPO, env=env, timeout=900,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"child failed:\n{out.stderr[-2000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("-o", "--output",
+                        default="results/solver_cold_start.json")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    with tempfile.TemporaryDirectory() as empty_cache:
+        cold = run_child(empty_cache)
+
+    cache_dir = os.path.join(
+        tempfile.mkdtemp(prefix="shockwave_warm_"), "solver"
+    )
+    t0 = time.time()
+    subprocess.run(
+        [sys.executable, "-m", "shockwave_tpu.solver.warm_start",
+         "--jobs", "1000", "--rounds", "50"],
+        check=True, cwd=REPO, timeout=900,
+        env=dict(os.environ, SHOCKWAVE_SOLVER_CACHE_DIR=cache_dir),
+    )
+    warm_s = time.time() - t0
+    warmed = run_child(cache_dir)
+
+    parity = (
+        warmed["objective"] == cold["objective"]
+        and warmed["counts_sum"] == cold["counts_sum"]
+        and warmed["counts_head"] == cold["counts_head"]
+    )
+    out = {
+        "device": str(jax.devices()[0]),
+        "config": "1000 jobs x 256 gpus x 50 rounds (stress shape)",
+        "fresh_process_first_solve_cold_s": cold["first_solve_s"],
+        "warm_start_compile_and_persist_s": round(warm_s, 2),
+        "fresh_process_first_solve_warmed_s": warmed["first_solve_s"],
+        "speedup": round(
+            cold["first_solve_s"] / max(warmed["first_solve_s"], 1e-9), 1
+        ),
+        "objective_bit_parity": parity,
+        "target_met_first_solve_under_2s": warmed["first_solve_s"] < 2.0,
+        "recipe": (
+            "python -m shockwave_tpu.solver.warm_start --jobs 1000 "
+            "--rounds 50  # once per host/backend; solve_level_counts "
+            "then auto-loads the serialized executable"
+        ),
+    }
+    assert parity, (cold, warmed)
+    with open(args.output, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+    print(f"wrote {args.output}")
+
+
+if __name__ == "__main__":
+    main()

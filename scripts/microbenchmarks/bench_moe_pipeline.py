@@ -1,22 +1,37 @@
 #!/usr/bin/env python3
-"""MoE and pipeline benchmarks on the real chip (VERDICT r04 #7).
+"""MoE and pipeline benchmarks (VERDICT r04 #7, r05 #2/#4).
 
-Both features were dryrun-correct on the virtual CPU mesh only; this
-harness measures them on actual hardware, single chip:
+Sections:
 
-  * **MoE vs dense at matched parameters**: token-choice top-1 MoE
-    (2 experts of d_ff/2 each = the dense MLP's parameter count, and
-    half its per-token MLP FLOPs) and at matched per-token FLOPs
-    (2 experts of the dense d_ff, 2x params). Reports steps/s, MFU
-    (FLOPs numerator per framing), and a trained-loss parity check on
-    identical data.
+  * **MoE vs dense at matched parameters/FLOPs**: token-choice top-1
+    MoE with the Switch-style balanced router and capacity-bucketed
+    grouped expert matmuls (the default dispatch), against the dense
+    MLP and against the legacy dense one-hot dispatch (which computes
+    EVERY expert's FFN for EVERY token — the A/B that shows what the
+    grouped path buys). Reports steps/s, MFU (FLOPs numerator per
+    active-expert framing), and a DENSE-RELATIVE trained-loss bar on
+    identical data: every MoE variant's 40-step loss must land within
+    2x of the dense model's (+0.05 noise floor) — the v1 gate accepted
+    anything < 2.0 from a 9.0 start, which let a diverging unbalanced
+    router pass (moe4: 1.30 vs dense 0.094).
   * **GPipe schedule overhead at 1 stage**: PipelinedLM with
     num_stages=1 and num_microbatches in {1, 4} against the plain
-    TransformerLM — the microbatch scan machinery's cost with zero
-    pipeline benefit (single chip), i.e. the overhead floor.
+    TransformerLM — the microbatch machinery's cost with zero pipeline
+    benefit, i.e. the overhead floor. Gate: < 10% (v1 measured 26.6%
+    at M=4 from the masked dynamic-update schedule since removed from
+    parallel/pipeline.py).
+  * **Multi-stage wall-clock** (--stages, runs on an 8-virtual-CPU-
+    device mesh; spawned automatically as a subprocess when the main
+    process sees fewer devices): 2- and 4-stage PipelinedLM steps with
+    the stage axis sharded over "pipe", per-tick cost from an M-vs-2M
+    slope, and the measured bubble fraction checked against the
+    analytic GPipe (S-1)/(S+M-1) bound.
 
 Writes one JSON artifact (-o). Uses the tunnel-proof slope-timing
-recipe of profile_flagship.py.
+recipe of profile_flagship.py. ``--preset cpu_smoke`` shrinks the
+shapes so the full harness (and its gates) runs on a CPU-only host;
+the committed TPU artifact is results/moe_pipeline_tpu.json, the CPU
+witness results/moe_pipeline_cpu_smoke.json.
 
 Usage:
   python scripts/microbenchmarks/bench_moe_pipeline.py \
@@ -27,6 +42,7 @@ import argparse
 import functools
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -44,13 +60,21 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
-BATCH = 8
-SEQ = 2048
-D_MODEL = 1024
-HEADS = 16
-LAYERS = 8
-VOCAB = 8192
-PEAK_TFLOPS = 197.0  # bf16 v5e
+PRESETS = {
+    # The flagship single-chip shape (110M-params tier on a v5e).
+    "tpu": dict(
+        batch=8, seq=2048, d_model=1024, heads=16, layers=8, vocab=8192,
+        dtype="bfloat16", attention="flash", peak_tflops=197.0,
+    ),
+    # Small enough that the WHOLE harness (incl. 40 training steps per
+    # variant) finishes on a 2-core CPU host; peak_tflops is a nominal
+    # CPU figure so "mfu" stays a comparable-within-run ratio, not an
+    # absolute claim.
+    "cpu_smoke": dict(
+        batch=4, seq=256, d_model=256, heads=4, layers=4, vocab=2048,
+        dtype="float32", attention="dense", peak_tflops=0.05,
+    ),
+}
 
 
 def fetch(tree):
@@ -88,18 +112,39 @@ def slope(step, x0, min_diff_s=1.0):
         n *= 2
 
 
-def step_flops(d_ff_active):
+def timed_loop(step, state, reps=6, rounds=2):
+    """Best-of blocked-loop seconds per step. Used for every
+    pipe-vs-plain RATIO: the slope chain's differenced estimate is
+    tunnel-proof for absolute MFU numbers but amplifies noise into
+    +-15% on ratio measurements (a single OS scheduling hiccup lands
+    entirely in one of the two differenced windows)."""
+    state = step(state)  # compile + warm
+    fetch(state)
+    best = float("inf")
+    for _ in range(rounds):
+        t0 = time.time()
+        for _ in range(reps):
+            state = step(state)
+        fetch(state)
+        best = min(best, (time.time() - t0) / reps)
+    return best, state
+
+
+def step_flops(shape, d_ff_active):
     """Train-step MACs*2*3 (fwd + ~2x bwd) per token framing:
     attention (QKV+proj + S/2 causal span) + active-expert MLP + head."""
-    att = 4 * D_MODEL * D_MODEL + 2 * (SEQ / 2) * D_MODEL
-    mlp = 2 * D_MODEL * d_ff_active
+    d, seq = shape["d_model"], shape["seq"]
+    att = 4 * d * d + 2 * (seq / 2) * d
+    mlp = 2 * d * d_ff_active
     per_token_layer = att + mlp
-    head = D_MODEL * VOCAB
-    macs = BATCH * SEQ * (LAYERS * per_token_layer + head)
+    head = d * shape["vocab"]
+    macs = shape["batch"] * seq * (
+        shape["layers"] * per_token_layer + head
+    )
     return 3 * 2 * macs
 
 
-def build_lm(num_experts, d_ff):
+def build_lm(shape, num_experts, d_ff, dispatch="grouped"):
     import optax
 
     from shockwave_tpu.models.transformer import (
@@ -111,13 +156,17 @@ def build_lm(num_experts, d_ff):
 
     mesh = make_mesh((1, 1, 1), devices=jax.devices()[:1])
     cfg = TransformerConfig(
-        vocab_size=VOCAB, d_model=D_MODEL, num_heads=HEADS,
-        num_layers=LAYERS, d_ff=d_ff, max_len=SEQ, dtype="bfloat16",
-        attention="flash", num_experts=num_experts,
+        vocab_size=shape["vocab"], d_model=shape["d_model"],
+        num_heads=shape["heads"], num_layers=shape["layers"], d_ff=d_ff,
+        max_len=shape["seq"], dtype=shape["dtype"],
+        attention=shape["attention"], num_experts=num_experts,
+        moe_dispatch=dispatch,
     )
     model = TransformerLM(cfg, mesh=mesh)
     tokens = jnp.asarray(
-        np.random.default_rng(0).integers(0, VOCAB, (BATCH, SEQ + 1)),
+        np.random.default_rng(0).integers(
+            0, shape["vocab"], (shape["batch"], shape["seq"] + 1)
+        ),
         jnp.int32,
     )
     variables = jax.jit(model.init)(jax.random.PRNGKey(0), tokens[:, :-1])
@@ -142,12 +191,13 @@ def build_lm(num_experts, d_ff):
     return train_step, variables, opt_state, tokens, params
 
 
-def bench_lm(name, num_experts, d_ff, d_ff_active, out, train_steps=40):
+def bench_lm(name, shape, num_experts, d_ff, d_ff_active, out,
+             dispatch="grouped", train_steps=40):
     import gc
 
     gc.collect()  # free the previous variant's device state first
     train_step, variables, opt_state, tokens, params = build_lm(
-        num_experts, d_ff
+        shape, num_experts, d_ff, dispatch
     )
 
     def chained(state):
@@ -156,7 +206,6 @@ def bench_lm(name, num_experts, d_ff, d_ff_active, out, train_steps=40):
         return (v, o)
 
     sec, state = slope(chained, (variables, opt_state))
-    flops = step_flops(d_ff_active)
     # Short training run for the loss-parity check (same data stream).
     # The original (variables, opt_state) buffers were donated into the
     # chain; continue from the chain's surviving state.
@@ -167,9 +216,13 @@ def bench_lm(name, num_experts, d_ff, d_ff_active, out, train_steps=40):
     final_loss = float(loss)
     entry = {
         "params": params,
+        "dispatch": dispatch if num_experts else None,
         "steps_per_s": round(1.0 / sec, 3),
-        "tokens_per_s": round(BATCH * SEQ / sec, 0),
-        "mfu": round(step_flops(d_ff_active) / sec / 1e12 / PEAK_TFLOPS, 4),
+        "tokens_per_s": round(shape["batch"] * shape["seq"] / sec, 0),
+        "mfu": round(
+            step_flops(shape, d_ff_active)
+            / sec / 1e12 / shape["peak_tflops"], 4
+        ),
         "flops_framing_d_ff_active": d_ff_active,
         f"loss_after_{train_steps}_steps_same_batch": round(final_loss, 4),
     }
@@ -178,7 +231,7 @@ def bench_lm(name, num_experts, d_ff, d_ff_active, out, train_steps=40):
     return entry
 
 
-def bench_pipeline(out):
+def bench_pipeline(out, shape):
     import gc
 
     import optax
@@ -190,8 +243,8 @@ def bench_pipeline(out):
     # The GPipe M=4 backward (per-tick activation stash across the
     # microbatch scan) does not fit beside a 110M state on the 16 GB
     # chip; the schedule-overhead metric is self-contained (pipe vs
-    # plain at the SAME config), so this section runs at 4 layers.
-    layers_p = LAYERS // 2
+    # plain at the SAME config), so this section runs at half depth.
+    layers_p = max(shape["layers"] // 2, 1)
 
     from shockwave_tpu.models.transformer import (
         TransformerConfig,
@@ -203,13 +256,16 @@ def bench_pipeline(out):
 
     mesh = make_mesh((1, 1, 1), devices=jax.devices()[:1])
     cfg = TransformerConfig(
-        vocab_size=VOCAB, d_model=D_MODEL, num_heads=HEADS,
-        num_layers=layers_p, d_ff=4 * D_MODEL, max_len=SEQ,
-        dtype="bfloat16", attention="flash",
+        vocab_size=shape["vocab"], d_model=shape["d_model"],
+        num_heads=shape["heads"], num_layers=layers_p,
+        d_ff=4 * shape["d_model"], max_len=shape["seq"],
+        dtype=shape["dtype"], attention=shape["attention"],
     )
     out["pipeline_overhead"]["num_layers"] = layers_p
     tokens = jnp.asarray(
-        np.random.default_rng(0).integers(0, VOCAB, (BATCH, SEQ + 1)),
+        np.random.default_rng(0).integers(
+            0, shape["vocab"], (shape["batch"], shape["seq"] + 1)
+        ),
         jnp.int32,
     )
     tx = optax.adamw(3e-4)
@@ -229,7 +285,7 @@ def bench_pipeline(out):
 
         return _o.apply_updates(v, upd), o, loss
 
-    sec_plain, _ = slope(
+    sec_plain, _ = timed_loop(
         lambda s: (plain_step(s[0], s[1], tokens)[:2]),
         (variables, opt_state),
     )
@@ -238,6 +294,7 @@ def bench_pipeline(out):
     )
 
     del variables, opt_state
+    worst = 0.0
     for M in (1, 4):
         jax.clear_caches()
         gc.collect()
@@ -256,64 +313,253 @@ def bench_pipeline(out):
 
             return _o.apply_updates(p, upd), o, loss
 
-        sec, _ = slope(
+        sec, _ = timed_loop(
             lambda s: (pipe_step(s[0], s[1], tokens)[:2]),
             (params, popt),
         )
+        overhead = 100.0 * (sec - sec_plain) / sec_plain
+        worst = max(worst, overhead)
         out["pipeline_overhead"][f"gpipe_1stage_{M}microbatch"] = {
             "steps_per_s": round(1.0 / sec, 3),
-            "overhead_vs_plain_pct": round(
-                100.0 * (sec - sec_plain) / sec_plain, 1
-            ),
+            "overhead_vs_plain_pct": round(overhead, 1),
         }
         print(f"gpipe M={M}:",
               out["pipeline_overhead"][f"gpipe_1stage_{M}microbatch"],
               flush=True)
         del params, popt
+    out["pipeline_overhead"]["single_stage_overhead_ok"] = bool(
+        worst < 10.0
+    )
+
+
+def bench_stages(shape, stages=(2, 4), microbatches=4):
+    """Multi-stage GPipe wall-clock on a real "pipe" mesh axis.
+
+    Per-tick cost from an M-vs-2M difference at fixed microbatch size
+    (the total batch doubles with M, so both runs share per-tick work
+    and differ by exactly M ticks); measured bubble fraction at M is
+    then (S-1) * per_tick / t(M), checked against the analytic GPipe
+    bound (S-1)/(S+M-1). Needs max(stages) devices — the
+    8-virtual-CPU-device recipe of tests/conftest.py when no
+    multi-chip platform is up. Timing is a best-of blocked loop, NOT
+    the slope chain: the slope's differenced estimate amplifies noise
+    on oversubscribed virtual devices (measured bubbles > 0.9 where
+    the loop reads 0.18 vs the 0.20 bound).
+    """
+    import gc
+
+    import optax
+
+    from shockwave_tpu.models.transformer import TransformerConfig
+    from shockwave_tpu.parallel.mesh import make_mesh
+    from shockwave_tpu.parallel.pipeline import PipelinedLM
+
+    results = {}
+    # Per-tick work must dominate the scan/permute machinery for the
+    # M-vs-2M slope to measure the SCHEDULE and not dispatch noise;
+    # keep microbatches at least 4 sequences wide.
+    mb_size = max(shape["batch"] // microbatches, 4)
+    for S in stages:
+        jax.clear_caches()
+        gc.collect()
+        mesh = make_mesh((1, 1, 1, S), devices=jax.devices()[:S])
+        layers = shape["layers"]
+        if layers % S:
+            layers = S * max(layers // S, 1)
+        cfg = TransformerConfig(
+            vocab_size=shape["vocab"], d_model=shape["d_model"],
+            num_heads=shape["heads"], num_layers=layers,
+            d_ff=4 * shape["d_model"], max_len=shape["seq"],
+            dtype=shape["dtype"], attention=shape["attention"],
+        )
+        tx = optax.adamw(3e-4)
+        times = {}
+        for M in (microbatches, 2 * microbatches):
+            plm = PipelinedLM(cfg, num_stages=S, num_microbatches=M,
+                              mesh=mesh)
+            tokens = jnp.asarray(
+                np.random.default_rng(0).integers(
+                    0, shape["vocab"], (M * mb_size, shape["seq"] + 1)
+                ),
+                jnp.int32,
+            )
+            params = plm.init(jax.random.PRNGKey(0), tokens)
+            popt = tx.init(params)
+
+            with mesh:
+                @functools.partial(jax.jit, donate_argnums=(0, 1))
+                def pipe_step(p, o, tokens):
+                    loss, grads = jax.value_and_grad(
+                        lambda p_: plm.loss(p_, tokens)
+                    )(p)
+                    upd, o = tx.update(grads, o, p)
+                    import optax as _o
+
+                    return _o.apply_updates(p, upd), o, loss
+
+                times[M], _ = timed_loop(
+                    lambda s: (pipe_step(s[0], s[1], tokens)[:2]),
+                    (params, popt),
+                )
+            del params, popt
+        M = microbatches
+        per_tick = max((times[2 * M] - times[M]) / M, 1e-12)
+        measured = (S - 1) * per_tick / times[M]
+        analytic = (S - 1) / (S + M - 1)
+        # On real multi-chip hardware non-tick overhead can only
+        # DEFLATE the measurement, so a tight one-sided tolerance
+        # holds; on oversubscribed virtual CPU devices the 2M run's
+        # larger working set inflates the differenced per-tick estimate
+        # (cache effects), so the bound check gets a wider allowance
+        # there. The clean schedule-only measurement is the
+        # single-device slow test in tests/test_pipeline.py.
+        virtual = jax.devices()[0].platform == "cpu"
+        tol = 0.25 if virtual else 0.05
+        results[f"stages_{S}"] = {
+            "num_layers": layers,
+            "microbatch_size": mb_size,
+            f"step_s_M{M}": round(times[M], 4),
+            f"step_s_M{2 * M}": round(times[2 * M], 4),
+            "per_tick_s": round(per_tick, 5),
+            "measured_bubble_fraction": round(measured, 4),
+            "analytic_bubble_fraction": round(analytic, 4),
+            "bound_gap": round(measured - analytic, 4),
+            "bound_tolerance": tol,
+            "within_analytic_bound": bool(measured <= analytic + tol),
+        }
+        print(f"stages S={S}:", results[f"stages_{S}"], flush=True)
+    return results
+
+
+def _stages_in_subprocess():
+    """Run the multi-stage section under the 8-virtual-CPU-device env
+    (tests/conftest.py recipe) in a child process and return its JSON.
+    The bubble fraction is a property of the SCHEDULE, not the model
+    scale, so the child always runs the cpu_smoke shape regardless of
+    the parent's preset."""
+    from shockwave_tpu.utils.virtual_devices import force_cpu_device_env
+
+    if os.environ.get("SHOCKWAVE_STAGES_CHILD"):
+        # We ARE the forced-CPU child and still see < 4 devices (some
+        # accelerator plugins override the platform env vars alone):
+        # fail loudly instead of spawning an unbounded process chain.
+        raise RuntimeError(
+            "--stages child still sees "
+            f"{len(jax.devices())} device(s) after the virtual-device "
+            "env; the platform plugin ignores JAX_PLATFORMS — run the "
+            "stages section on a host whose backend honors it"
+        )
+    env = force_cpu_device_env(8, dict(os.environ))
+    env["SHOCKWAVE_STAGES_CHILD"] = "1"
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--preset", "cpu_smoke", "--stages"],
+        capture_output=True, text=True, env=env, timeout=3600,
+    )
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"--stages subprocess failed:\n{res.stderr[-2000:]}"
+        )
+    return json.loads(res.stdout.strip().splitlines()[-1])
 
 
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("-o", "--output",
                         default="results/moe_pipeline_tpu.json")
+    parser.add_argument("--preset", default="tpu", choices=sorted(PRESETS))
+    parser.add_argument(
+        "--stages", action="store_true",
+        help="run ONLY the multi-stage section and print its JSON "
+        "(used by the self-spawned 8-virtual-device subprocess)",
+    )
     args = parser.parse_args(argv)
+    shape = PRESETS[args.preset]
+
+    if args.stages:
+        if len(jax.devices()) < 4:
+            # Invoked by hand without the virtual-device env: spawn it.
+            payload = _stages_in_subprocess()
+            print(json.dumps(payload))
+            return
+        print(json.dumps({"pipeline_stages": bench_stages(shape)}))
+        return
 
     out = {
         "device": str(jax.devices()[0]),
+        "preset": args.preset,
         "config": {
-            "batch": BATCH, "seq": SEQ, "d_model": D_MODEL,
-            "heads": HEADS, "layers": LAYERS, "vocab": VOCAB,
-            "dtype": "bfloat16", "attention": "flash",
-            "routing": "token-choice top-1",
+            **{k: v for k, v in shape.items() if k != "peak_tflops"},
+            "routing": "token-choice top-1, balanced "
+                       "(Switch aux loss, grouped dispatch)",
         },
         "moe_vs_dense": {},
         "pipeline_overhead": {},
     }
-    dense = bench_lm("dense_dff4096", 0, 4 * D_MODEL, 4 * D_MODEL, out)
-    matched_p = bench_lm(
-        "moe2_dff2048_matched_params", 2, 2 * D_MODEL, 2 * D_MODEL, out
-    )
-    matched_f = bench_lm(
-        "moe2_dff4096_matched_flops", 2, 4 * D_MODEL, 4 * D_MODEL, out
-    )
-    bench_lm("moe4_dff4096", 4, 4 * D_MODEL, 4 * D_MODEL, out)
-    # Loss parity: every variant must actually learn the repeated
-    # batch — from the ln(8192) ~ 9.0 starting loss down below 2.0.
-    # (Exact loss equality is not expected: top-1 routers memorize a
-    # single batch slower than a dense MLP, increasingly so with more
-    # experts; the per-variant losses are recorded for the reader.)
+    d_ff = 4 * shape["d_model"]
+    # Pipeline overhead first, in a clean process: measured AFTER five
+    # MoE variants' donated states and cleared jit caches, the same
+    # section read up to 6x noisier (heap churn skews the slope chain).
+    bench_pipeline(out, shape)
+    with open(args.output, "w") as f:
+        json.dump(out, f, indent=1)
+
+    dense = bench_lm("dense_dff%d" % d_ff, shape, 0, d_ff, d_ff, out)
+    bench_lm("moe2_dff%d_matched_params" % (d_ff // 2), shape, 2,
+             d_ff // 2, d_ff // 2, out)
+    bench_lm("moe2_dff%d_matched_flops" % d_ff, shape, 2, d_ff, d_ff, out)
+    bench_lm("moe4_dff%d" % d_ff, shape, 4, d_ff, d_ff, out)
+    # The legacy one-hot dispatch at the matched-FLOPs shape: the A/B
+    # that isolates what capacity-bucketed grouped matmuls buy.
+    bench_lm("moe2_dff%d_dense_dispatch" % d_ff, shape, 2, d_ff, d_ff,
+             out, dispatch="dense")
+
+    # Dense-relative loss bar: every variant trains on the identical
+    # repeated batch; an unbalanced router that fails to converge shows
+    # up as a multiple of the dense loss, not as "still under an
+    # absolute 2.0". The 0.05 floor absorbs step-level noise when the
+    # dense loss itself is near zero.
     key = "loss_after_40_steps_same_batch"
-    del dense, matched_p, matched_f
+    dense_loss = dense[key]
+    bar = 2.0 * dense_loss + 0.05
+    # The bar is dense-RELATIVE, so the dense baseline itself must
+    # demonstrably learn or a diverged dense run would inflate the bar
+    # until everything passes: require it at least halve the
+    # uniform-prediction starting loss ln(vocab).
+    import math
+
+    dense_learned_bar = 0.5 * math.log(shape["vocab"])
+    out["loss_parity"] = {
+        "dense_loss": dense_loss,
+        "dense_learned_bar_half_ln_vocab": round(dense_learned_bar, 4),
+        "dense_learned_ok": bool(0.0 < dense_loss < dense_learned_bar),
+        "bar_2x_dense_plus_noise": round(bar, 4),
+        "per_variant_ok": {
+            name: bool(0.0 < e[key] <= bar)
+            for name, e in out["moe_vs_dense"].items()
+            if name != "dense_dff%d" % d_ff
+        },
+    }
     out["loss_parity_ok"] = bool(
-        all(
-            0.0 < e[key] < 2.0
-            for e in out["moe_vs_dense"].values()
-        )
+        out["loss_parity"]["dense_learned_ok"]
+        and all(out["loss_parity"]["per_variant_ok"].values())
     )
 
     with open(args.output, "w") as f:
         json.dump(out, f, indent=1)
-    bench_pipeline(out)
+
+    # Multi-stage wall-clock needs >= 4 devices; re-exec on the
+    # 8-virtual-CPU-device recipe when this process can't see them
+    # (single-chip TPU hosts, plain CPU hosts).
+    if len(jax.devices()) >= 4:
+        out["pipeline_stages"] = bench_stages(shape)
+    else:
+        payload = _stages_in_subprocess()
+        out["pipeline_stages"] = payload["pipeline_stages"]
+        out["pipeline_stages"]["note"] = (
+            "measured on 8 virtual CPU devices (subprocess, cpu_smoke "
+            "shape), stage axis sharded over a real 'pipe' mesh axis"
+        )
 
     with open(args.output, "w") as f:
         json.dump(out, f, indent=1)

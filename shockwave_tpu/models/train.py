@@ -118,6 +118,9 @@ def build_family(name, args, mesh, abstract=False):
             num_kv_heads=getattr(args, "num_kv_heads", None),
             positional=getattr(args, "positional", "learned"),
             num_experts=args.num_experts,
+            moe_aux_weight=getattr(args, "moe_aux_weight", 1e-2),
+            moe_capacity_factor=getattr(args, "moe_capacity_factor", 1.25),
+            moe_dispatch=getattr(args, "moe_dispatch", "grouped"),
             remat=getattr(args, "remat", False),
         )
         model = TransformerLM(cfg, mesh=mesh)
@@ -275,6 +278,17 @@ def main(argv=None):
                         help="rematerialize transformer blocks in the "
                         "backward pass (less HBM, ~1/3 more FLOPs)")
     parser.add_argument("--num_experts", type=int, default=0)
+    parser.add_argument("--moe_aux_weight", type=float, default=1e-2,
+                        help="router load-balancing auxiliary loss "
+                        "weight (Switch-style; 0 disables)")
+    parser.add_argument("--moe_capacity_factor", type=float, default=1.25,
+                        help="per-expert token capacity factor for the "
+                        "grouped dispatch path")
+    parser.add_argument("--moe_dispatch", type=str, default="grouped",
+                        choices=["grouped", "dense"],
+                        help="grouped: capacity-bucketed expert "
+                        "matmuls; dense: one-hot dispatch einsum "
+                        "(every expert computed for every token)")
     parser.add_argument("--model_parallel", type=int, default=1)
     parser.add_argument("--seq_parallel", type=int, default=1)
     # Gang rendezvous (appended by the scheduler).

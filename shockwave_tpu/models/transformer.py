@@ -58,6 +58,22 @@ class TransformerConfig:
     # tensors (ICI traffic / group). Dense repeats KV; ulysses rejects.
     num_kv_heads: Optional[int] = None
     num_experts: int = 0  # 0 = dense MLP; >0 = MoE over "model"
+    # Switch-style load-balancing auxiliary loss weight for the MoE
+    # router: num_experts * sum_e(fraction_dispatched_e * mean_gate_
+    # prob_e), minimized (=1) at a uniform dispatch. Without it the
+    # top-1 router collapses onto one expert (measured: moe4's 40-step
+    # loss 14x dense, results/moe_pipeline_tpu.json v1). 0 disables.
+    moe_aux_weight: float = 1e-2
+    # Per-expert token capacity = ceil(capacity_factor * tokens /
+    # num_experts) for the grouped dispatch path; tokens routed past an
+    # expert's capacity are dropped (residual passes through), the
+    # standard Switch overflow semantics.
+    moe_capacity_factor: float = 1.25
+    # "grouped": capacity-bucketed grouped expert matmuls (compute is
+    # O(capacity_factor * tokens), the fast path). "dense": the one-hot
+    # dispatch einsum, which computes EVERY expert's FFN for EVERY
+    # token — O(num_experts * tokens) FLOPs, kept for A/B measurement.
+    moe_dispatch: str = "grouped"
     # Position encoding: "learned" adds a (max_len, d_model) table to
     # the token embedding; "rope" rotates q/k per head instead (no
     # table — at 131k context the learned table is 134M parameters of
@@ -219,21 +235,57 @@ class Attention(nn.Module):
 
 class MoEMlp(nn.Module):
     """Token-choice top-1 MoE; experts sharded over "model" (expert
-    parallelism). Dense dispatch einsum — compiler-friendly at these
-    expert counts."""
+    parallelism).
+
+    Dispatch is capacity-bucketed grouped expert matmuls by default:
+    each token is scattered into its expert's static-capacity bucket
+    (position-in-expert from a running per-expert count — the scatter is
+    the sort-by-expert, with static shapes), every expert runs ONE
+    [capacity, d_model] x [d_model, d_ff] matmul, and outputs gather
+    back to token order. Compute is O(capacity_factor * tokens) instead
+    of the dense one-hot einsum's O(num_experts * tokens); the dense
+    path is kept under ``moe_dispatch="dense"`` for A/B measurement.
+
+    The router carries the Switch-style load-balancing auxiliary loss
+    (fraction-dispatched x mean-gate-prob per expert, scaled by E),
+    sown into the "losses" collection; ``lm_loss`` adds it with weight
+    ``moe_aux_weight``.
+    """
 
     config: TransformerConfig
+    mesh: Optional[jax.sharding.Mesh] = None
 
     @nn.compact
     def __call__(self, x):
         cfg = self.config
         E = cfg.num_experts
+        if cfg.moe_dispatch not in ("grouped", "dense"):
+            raise ValueError(
+                f"moe_dispatch must be 'grouped' or 'dense', got "
+                f"{cfg.moe_dispatch!r}"
+            )
+        if cfg.moe_capacity_factor <= 0:
+            raise ValueError(
+                f"moe_capacity_factor must be > 0, got "
+                f"{cfg.moe_capacity_factor}"
+            )
         gates = nn.Dense(E, name="router", use_bias=False)(x)
         # Routing decisions in float32 regardless of activation dtype.
         weights = jax.nn.softmax(gates.astype(jnp.float32), axis=-1)
-        top = jnp.argmax(weights, axis=-1)
-        dispatch = jax.nn.one_hot(top, E, dtype=x.dtype)  # [B, S, E]
-        gate_scale = jnp.sum(weights * dispatch, axis=-1, keepdims=True)
+        top = jnp.argmax(weights, axis=-1)  # [B, S]
+        one_hot = jax.nn.one_hot(top, E, dtype=jnp.float32)  # [B, S, E]
+        gate_scale = jnp.sum(
+            weights * one_hot, axis=-1, keepdims=True
+        )
+
+        # Load-balancing auxiliary loss (Switch Transformers eq. 4):
+        # E * sum_e f_e * P_e with f_e the fraction of tokens dispatched
+        # to expert e and P_e its mean router probability. 1.0 at
+        # uniform; differentiable through P_e.
+        if not self.is_initializing():
+            frac = jnp.mean(one_hot, axis=(0, 1))
+            prob = jnp.mean(weights, axis=(0, 1))
+            self.sow("losses", "moe_aux", E * jnp.sum(frac * prob))
 
         w_in = self.param(
             "w_in",
@@ -249,15 +301,52 @@ class MoEMlp(nn.Module):
             ),
             (E, cfg.d_ff, cfg.d_model),
         )
-        # token -> its expert's FFN, via dense one-hot dispatch; expert
-        # weights cast to the activation dtype so the matmuls stay on
-        # the MXU's bfloat16 path under mixed precision.
+        # Expert weights cast to the activation dtype so the matmuls
+        # stay on the MXU's bfloat16 path under mixed precision.
         w_in = jnp.asarray(w_in).astype(x.dtype)
         w_out = jnp.asarray(w_out).astype(x.dtype)
-        hidden = jnp.einsum("bse,bsd,edf->bsf", dispatch, x, w_in)
-        hidden = nn.gelu(hidden)
-        out = jnp.einsum("bse,bsf,efd->bsd", dispatch, hidden, w_out)
-        return out * gate_scale.astype(x.dtype)
+
+        if cfg.moe_dispatch == "dense":
+            dispatch = one_hot.astype(x.dtype)
+            hidden = jnp.einsum("bse,bsd,edf->bsf", dispatch, x, w_in)
+            hidden = nn.gelu(hidden)
+            out = jnp.einsum("bse,bsf,efd->bsd", dispatch, hidden, w_out)
+            return out * gate_scale.astype(x.dtype)
+
+        B, S, d = x.shape
+        N = B * S
+        # Static per-expert capacity, padded to a multiple of 8 so the
+        # bucket tensor tiles cleanly on TPU.
+        C = int(math.ceil(cfg.moe_capacity_factor * N / E))
+        C = min(-(-C // 8) * 8, N) if N >= 8 else N
+        xf = x.reshape(N, d)
+        top_f = top.reshape(N)
+        oh = one_hot.reshape(N, E).astype(jnp.int32)
+        # Position-in-expert: running count of earlier tokens routed to
+        # the same expert (the static-shape equivalent of sorting tokens
+        # by expert id). Tokens at positions >= capacity overflow and
+        # are dropped — their slot index lands out of bounds, the
+        # scatter/gather modes below turn that into zero contribution.
+        pos = jnp.sum(jnp.cumsum(oh, axis=0) * oh, axis=-1) - 1  # [N]
+        slot = jnp.where(pos < C, top_f * C + pos, E * C)
+        buckets = (
+            jnp.zeros((E * C, d), x.dtype)
+            .at[slot]
+            .set(xf, mode="drop")
+            .reshape(E, C, d)
+        )
+        if self.mesh is not None and "model" in self.mesh.axis_names:
+            buckets = jax.lax.with_sharding_constraint(
+                buckets,
+                jax.sharding.NamedSharding(
+                    self.mesh,
+                    jax.sharding.PartitionSpec("model", None, None),
+                ),
+            )
+        hidden = nn.gelu(jnp.einsum("ecd,edf->ecf", buckets, w_in))
+        out = jnp.einsum("ecf,efd->ecd", hidden, w_out).reshape(E * C, d)
+        y = jnp.take(out, slot, axis=0, mode="fill", fill_value=0)
+        return y.reshape(B, S, d) * gate_scale.astype(x.dtype)
 
 
 class Mlp(nn.Module):
@@ -285,7 +374,7 @@ class Block(nn.Module):
         x = x + Attention(cfg, self.mesh, name="attention")(y)
         y = nn.LayerNorm(name="ln2", dtype=jnp.float32)(x)
         mlp = (
-            MoEMlp(cfg, name="moe")
+            MoEMlp(cfg, self.mesh, name="moe")
             if cfg.num_experts > 0
             else Mlp(cfg, name="mlp")
         )
@@ -413,15 +502,41 @@ class TransformerLM(nn.Module):
         return total / (B * S)
 
 
+def moe_aux_loss(mutated_vars) -> jnp.ndarray:
+    """Mean of the per-layer router balance losses sown into the
+    "losses" collection (one scalar per MoE layer)."""
+    sown = jax.tree_util.tree_leaves(mutated_vars.get("losses", {}))
+    if not sown:
+        return jnp.float32(0.0)
+    return sum(sown) / len(sown)
+
+
 def lm_loss(model, params, tokens, logit_chunk=None):
-    """Next-token cross entropy over a [B, S+1] token batch. With
+    """Next-token cross entropy over a [B, S+1] token batch, plus the
+    router load-balancing auxiliary loss (weight
+    ``config.moe_aux_weight``) when the model is MoE. With
     ``logit_chunk`` the head+loss run sequence-chunked (see
     TransformerLM.__call__) so full logits never materialize."""
+    cfg = model.config
+    with_aux = cfg.num_experts > 0 and cfg.moe_aux_weight > 0.0
     if logit_chunk is not None:
+        if with_aux:
+            loss, mutated = model.apply(
+                params, tokens[:, :-1], tokens[:, 1:], logit_chunk,
+                mutable=["losses"],
+            )
+            return loss + cfg.moe_aux_weight * moe_aux_loss(mutated)
         return model.apply(
             params, tokens[:, :-1], tokens[:, 1:], logit_chunk
         )
     from shockwave_tpu.models.small_models import token_xent
 
+    if with_aux:
+        logits, mutated = model.apply(
+            params, tokens[:, :-1], mutable=["losses"]
+        )
+        return token_xent(logits, tokens[:, 1:]) + (
+            cfg.moe_aux_weight * moe_aux_loss(mutated)
+        )
     logits = model.apply(params, tokens[:, :-1])
     return token_xent(logits, tokens[:, 1:])

@@ -19,8 +19,16 @@ Design — the XLA-native formulation (no hand-written send/recv loop):
     a collective-permute over ICI, which is how XLA lowers it; no
     explicit ppermute needed.
   * Microbatch t enters stage 0 at tick t and exits stage S-1 at tick
-    t + S - 1; injections and collections are masked dynamic updates, so
-    shapes stay static and the whole schedule jits into a single scan.
+    t + S - 1. Injection consumes the scan's xs input directly (the
+    microbatch array zero-padded by S-1 ticks, statically sliced per
+    iteration), and collection is the scan's stacked per-tick output
+    with a STATIC ys[S-1:] slice at the end — no masked dynamic
+    gathers/scatters and no output buffer in the carry. (The earlier
+    formulation carried the output array through the scan and
+    dynamic-update-scattered one microbatch per tick; that machinery
+    measured 26.6% single-stage overhead, results/moe_pipeline_tpu.json
+    v1.) S=1 short-circuits to an unrolled per-microbatch loop — the
+    schedule has no bubble and needs no stage buffer at all.
 
 The pipeline is differentiable end to end (scan + gather/scatter +
 roll), so the same function serves forward and backward; the backward
@@ -57,35 +65,47 @@ def gpipe_apply(
     """
     S = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
     M = microbatches.shape[0]
-    T = M + S - 1
     stage_apply = jax.vmap(stage_fn)
 
-    buf = jnp.zeros((S,) + microbatches.shape[1:], microbatches.dtype)
-    out = jnp.zeros_like(microbatches)
+    if S == 1:
+        # Degenerate pipeline: no bubble, no stage buffer — apply the
+        # bare stage function per microbatch. Unrolled rather than
+        # lax.map: at S=1 a scan buys no memory (the backward keeps
+        # every microbatch's residuals either way, stacked in the scan
+        # carry) but its per-iteration machinery measured ~25% of a
+        # train step on CPU vs ~2% unrolled; the scan stays as a
+        # fallback for microbatch counts where unrolling would bloat
+        # compile time.
+        params0 = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+        if M == 1:
+            return stage_fn(params0, microbatches[0])[None]
+        if M <= 32:
+            return jnp.stack(
+                [stage_fn(params0, microbatches[m]) for m in range(M)]
+            )
+        return jax.lax.map(lambda x: stage_fn(params0, x), microbatches)
 
-    def tick(carry, t):
-        buf, out = carry
-        x_t = jax.lax.dynamic_index_in_dim(
-            microbatches, jnp.minimum(t, M - 1), keepdims=False
-        )
-        buf = buf.at[0].set(jnp.where(t < M, x_t, buf[0]))
+    # Zero-pad the input stream by the drain ticks: tick t injects
+    # xs[t] (a static scan slice); the pad values flow into stage 0
+    # after the real microbatches and their outputs are never
+    # collected.
+    pad = jnp.zeros((S - 1,) + microbatches.shape[1:], microbatches.dtype)
+    xs = jnp.concatenate([microbatches, pad], axis=0)  # [T, mb, ...]
+    buf = jnp.zeros((S,) + microbatches.shape[1:], microbatches.dtype)
+
+    def tick(buf, x_t):
+        buf = buf.at[0].set(x_t)
         y = stage_apply(stage_params, buf)
-        idx = jnp.clip(t - (S - 1), 0, M - 1)
-        done = y[S - 1]
-        prev = jax.lax.dynamic_index_in_dim(out, idx, keepdims=False)
-        out = jax.lax.dynamic_update_index_in_dim(
-            out, jnp.where(t >= S - 1, done, prev), idx, axis=0
-        )
         # Stage s's output becomes stage s+1's input: a roll of the
         # stage axis, which XLA lowers to a collective-permute when the
-        # axis is sharded over "pipe".
-        buf = jnp.roll(y, 1, axis=0)
-        return (buf, out), None
+        # axis is sharded over "pipe". The last stage's output is the
+        # tick's collected (scan-stacked) result.
+        return jnp.roll(y, 1, axis=0), y[S - 1]
 
-    (_, out), _ = jax.lax.scan(
-        tick, (buf, out), jnp.arange(T, dtype=jnp.int32)
-    )
-    return out
+    _, ys = jax.lax.scan(tick, buf, xs)
+    # Microbatch t exits at tick t + S - 1: a static slice of the
+    # stacked outputs replaces the per-tick masked dynamic update.
+    return ys[S - 1:]
 
 
 def sequential_apply(
@@ -131,6 +151,18 @@ class PipelinedLM:
             raise ValueError(
                 f"positional must be 'learned' or 'rope', got "
                 f"{config.positional!r}"
+            )
+        if config.num_experts > 0 and config.moe_aux_weight > 0.0:
+            # The stage function applies blocks without a mutable
+            # "losses" collection, so the router's sown balance loss
+            # would be silently dropped — training an MoE here with the
+            # config promising an aux loss would quietly reproduce the
+            # v1 router collapse (same convention as attention_window
+            # on non-flash paths).
+            raise ValueError(
+                "PipelinedLM does not thread the MoE load-balancing "
+                "aux loss; set moe_aux_weight=0.0 to pipeline an MoE "
+                "explicitly unbalanced"
             )
         self.config = config
         self.num_stages = num_stages

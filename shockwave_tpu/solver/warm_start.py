@@ -1,0 +1,253 @@
+"""Fresh-process solver cold-start mitigation (VERDICT r05 #7).
+
+Every fresh CLI process pays the XLA compile of the stress-shape level
+solve before its first plan lands — 20.6 s on the TPU bench host
+(BENCH_r05 ``cold_s``), ~2.8 s on CPU. Two mechanisms cut that to
+sub-second:
+
+  * **Serialized executable (primary).** ``warm()`` lowers and compiles
+    :func:`shockwave_tpu.solver.eg_jax.solve_level` at a given padded
+    shape and persists the compiled XLA executable
+    (``jax.experimental.serialize_executable``) to a cache file keyed by
+    jax/jaxlib version, backend platform + device kind, the solver
+    source hash, and the static solve shape. A later process calls
+    ``load()`` and gets a ready-to-run executable: fresh-process first
+    solve 2.7 s -> 0.7 s on this host's CPU backend, counts
+    bit-identical to the jitted path (results/solver_cold_start.json).
+    The blob is executable-level, so it is only valid on the same
+    machine/backend — exactly the fresh-CLI-on-the-same-host case.
+  * **Persistent compilation cache (belt and braces).** ``warm()`` also
+    populates jax's persistent compilation cache when
+    ``JAX_COMPILATION_CACHE_DIR`` (or ``jax_compilation_cache_dir``) is
+    configured, which survives solver-source edits at the cost of a
+    per-process re-trace.
+
+``solve_level_counts`` consults ``load()`` transparently (memoized per
+process; any failure falls back to the jitted path), so the planner,
+bench.py, and every driver get the fast first solve with no call-site
+changes once ``python -m shockwave_tpu.solver.warm_start`` has run on
+the host.
+
+Known environment bound: the round-5 physical TPU host tunnels its chip
+through a remote-compile endpoint that DISCARDS persistent-cache writes
+(results/physical_tpu/README.md), and executables there live on the
+service side, so neither mechanism can persist across processes. On
+such hosts this module degrades cleanly to the compile-every-process
+status quo; the recipe works on any host whose backend compiles
+locally (CPU, local TPU/GPU).
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import os
+import pickle
+import tempfile
+from typing import Optional
+
+_CACHE_FORMAT = 1
+# key -> compiled executable, or None after a failed load (negative
+# cache: don't re-stat the filesystem on every solve).
+_LOADED: dict = {}
+
+
+def cache_dir() -> str:
+    return os.environ.get("SHOCKWAVE_SOLVER_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "shockwave_tpu", "solver"
+    )
+
+
+@functools.lru_cache(maxsize=1)
+def _solver_source_hash() -> str:
+    # lru_cache: cache_key runs on every solve_level_counts call (the
+    # planner's per-round hot path) and the module file cannot change
+    # within a process.
+    from shockwave_tpu.solver import eg_jax
+
+    with open(eg_jax.__file__, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
+def cache_key(
+    slots: int, future_rounds: int, grid_size: int, with_bonus: bool,
+    num_bases: int = 6,
+) -> str:
+    """Executable identity: backend + versions + solver source + the
+    static solve shape. Anything that can change the compiled program
+    must be in here — a stale executable would silently compute with
+    old solver semantics."""
+    import jax
+    import jaxlib
+
+    dev = jax.devices()[0]
+    parts = (
+        f"fmt{_CACHE_FORMAT}",
+        f"jax{jax.__version__}",
+        f"jaxlib{jaxlib.__version__}",
+        dev.platform,
+        getattr(dev, "device_kind", "unknown").replace(" ", "_"),
+        _solver_source_hash(),
+        f"s{slots}r{future_rounds}g{grid_size}b{int(with_bonus)}"
+        f"k{num_bases}",
+    )
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:24]
+
+
+def _blob_path(key: str) -> str:
+    return os.path.join(cache_dir(), f"solve_level_{key}.bin")
+
+
+def _dummy_call(
+    slots: int, future_rounds: int, with_bonus: bool, num_bases: int = 6,
+    grid_size: int = 64,
+):
+    """(args, kwargs) with the exact structure solve_level_counts uses,
+    on zero-filled arrays of the padded shape. Lowering and the runtime
+    call must agree on this structure or the compiled-call pytree check
+    rejects the executable."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    zeros = jnp.asarray(np.zeros(slots, np.float32))
+    ones = jnp.asarray(np.ones(slots, np.float32))
+    args = (
+        zeros,  # active
+        zeros,  # priorities
+        zeros,  # completed
+        ones,   # total
+        ones,   # epoch_dur
+        zeros,  # remaining
+        ones,   # nworkers
+        jnp.asarray(1.0),  # num_gpus
+        jnp.asarray(np.linspace(0.0, 1.0, num_bases), jnp.float32),
+        jnp.asarray(np.linspace(0.0, 1.0, num_bases), jnp.float32),
+    )
+    kwargs = dict(
+        round_duration=60.0,
+        future_rounds=int(future_rounds),
+        regularizer=1.0,
+        grid_size=int(grid_size),
+    )
+    if with_bonus:
+        kwargs["switch_bonus"] = zeros
+    return args, kwargs
+
+
+def warm(
+    slots: int = 1024,
+    future_rounds: int = 50,
+    grid_size: int = 64,
+    with_bonus: bool = True,
+    also_without_bonus: bool = True,
+    num_bases: int = 6,
+) -> list:
+    """Compile the level solve at the padded stress shape and persist
+    the serialized executable(s). Returns the written paths. The
+    default covers both jit signatures ``pad_problem`` can produce
+    (with and without the preemption switch-cost bonus)."""
+    from jax.experimental import serialize_executable
+
+    from shockwave_tpu.solver.eg_jax import solve_level
+
+    written = []
+    variants = [with_bonus] + ([not with_bonus] if also_without_bonus else [])
+    os.makedirs(cache_dir(), exist_ok=True)
+    for bonus in variants:
+        args, kwargs = _dummy_call(
+            slots, future_rounds, bonus, num_bases, grid_size
+        )
+        compiled = solve_level.lower(*args, **kwargs).compile()
+        payload = serialize_executable.serialize(compiled)
+        key = cache_key(slots, future_rounds, grid_size, bonus, num_bases)
+        path = _blob_path(key)
+        fd, tmp = tempfile.mkstemp(dir=cache_dir(), suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(payload, f)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        # A solve before the blob existed negatively caches the key in
+        # this process; drop it so warm()-then-solve takes the fast
+        # path without a restart.
+        _LOADED.pop(key, None)
+        written.append(path)
+    return written
+
+
+def load(
+    slots: int, future_rounds: int, grid_size: int, with_bonus: bool,
+    num_bases: int = 6,
+):
+    """The precompiled executable for this solve signature, or None.
+    Memoized per process; corrupt or incompatible blobs are removed and
+    negatively cached so the jitted fallback isn't retried per solve."""
+    key = cache_key(slots, future_rounds, grid_size, with_bonus, num_bases)
+    if key in _LOADED:
+        return _LOADED[key]
+    path = _blob_path(key)
+    compiled = None
+    if os.path.exists(path):
+        try:
+            from jax.experimental import serialize_executable
+
+            with open(path, "rb") as f:
+                payload = pickle.load(f)
+            compiled = serialize_executable.deserialize_and_load(*payload)
+        except Exception:
+            # Stale/corrupt blob (e.g. backend changed under the same
+            # key inputs): drop it; the jitted path still works.
+            compiled = None
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    _LOADED[key] = compiled
+    return compiled
+
+
+def invalidate(
+    slots: int, future_rounds: int, grid_size: int, with_bonus: bool,
+    num_bases: int = 6,
+) -> None:
+    """Negatively cache a signature for the rest of the process (used
+    when a loaded executable fails at call time) so the jitted path
+    runs without re-probing the blob on every solve."""
+    key = cache_key(slots, future_rounds, grid_size, with_bonus, num_bases)
+    _LOADED[key] = None
+
+
+def main(argv=None) -> None:
+    import argparse
+    import time
+
+    parser = argparse.ArgumentParser(
+        description="Precompile + persist the stress-shape EG level "
+        "solve so a fresh process's first plan solve loads instead of "
+        "compiling (see module docstring)."
+    )
+    parser.add_argument("--jobs", type=int, default=1000,
+                        help="job count whose padded slot shape to warm")
+    parser.add_argument("--rounds", type=int, default=50)
+    parser.add_argument("--grid_size", type=int, default=64)
+    args = parser.parse_args(argv)
+
+    from shockwave_tpu.solver.eg_jax import num_slots_for
+
+    slots = num_slots_for(args.jobs)
+    t0 = time.time()
+    paths = warm(slots, args.rounds, args.grid_size)
+    dt = time.time() - t0
+    for p in paths:
+        print(p)
+    print(
+        f"warmed solve_level at slots={slots} rounds={args.rounds} "
+        f"in {dt:.2f}s"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -46,24 +46,58 @@ def pytest_configure(config):
 
 _WALLCLOCK_FLAKES = []
 
+# The retry protocol below reaches into pytest private internals
+# (item._initrequest(), _pytest.runner.runtestprotocol). Those were
+# validated against these major versions; a different major must be
+# re-validated (run the wall-clock tier, check retries reset fixtures
+# and reports still land) and added here, NOT silently trusted — a
+# behavior change in either API would corrupt retries quietly.
+_VALIDATED_PYTEST_MAJORS = (8, 9)
+
 
 def pytest_terminal_summary(terminalreporter):
     if _WALLCLOCK_FLAKES:
         terminalreporter.section("wallclock flakes (passed on retry)")
-        for nodeid, attempts in _WALLCLOCK_FLAKES:
+        for nodeid, attempts, longreprs in _WALLCLOCK_FLAKES:
             terminalreporter.line(f"{nodeid}: passed on attempt {attempts}")
+            # The failed attempts' details would otherwise be discarded
+            # with their reports — keep them so a flake's first failure
+            # is diagnosable from the summary alone (ADVICE r05).
+            for i, longrepr in enumerate(longreprs, start=1):
+                terminalreporter.line(
+                    f"  -- failed attempt {i} --"
+                )
+                for line in str(longrepr).splitlines():
+                    terminalreporter.line(f"  {line}")
 
 
 def pytest_runtest_protocol(item, nextitem):
     marker = item.get_closest_marker("wallclock_retry")
     if marker is None:
         return None
+    import pytest as _pytest_mod
+
+    major = _pytest_mod.version_tuple[0]
+    if major not in _VALIDATED_PYTEST_MAJORS:
+        # Explicit raise, not assert: the guard must survive python -O
+        # (stripped asserts would silently trust unvalidated private
+        # APIs — the exact failure mode it exists to prevent).
+        raise RuntimeError(
+            f"wallclock_retry uses pytest private APIs "
+            f"(item._initrequest, _pytest.runner.runtestprotocol) "
+            f"validated only against pytest majors "
+            f"{_VALIDATED_PYTEST_MAJORS}; running "
+            f"{_pytest_mod.__version__}. Re-validate the retry "
+            f"protocol and extend _VALIDATED_PYTEST_MAJORS in "
+            f"tests/conftest.py."
+        )
     from _pytest.runner import runtestprotocol
 
     retries = marker.kwargs.get("retries", 2)
     item.ihook.pytest_runtest_logstart(
         nodeid=item.nodeid, location=item.location
     )
+    failed_longreprs = []
     for attempt in range(retries + 1):
         reports = runtestprotocol(item, nextitem=nextitem, log=False)
         failed = any(r.failed for r in reports)
@@ -71,8 +105,13 @@ def pytest_runtest_protocol(item, nextitem):
             for report in reports:
                 item.ihook.pytest_runtest_logreport(report=report)
             if not failed and attempt > 0:
-                _WALLCLOCK_FLAKES.append((item.nodeid, attempt + 1))
+                _WALLCLOCK_FLAKES.append(
+                    (item.nodeid, attempt + 1, failed_longreprs)
+                )
             break
+        failed_longreprs.extend(
+            r.longrepr for r in reports if r.failed and r.longrepr
+        )
         import sys
 
         print(

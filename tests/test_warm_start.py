@@ -1,0 +1,95 @@
+"""Solver warm start: the persisted serialized executable must load in
+a process that didn't compile it (simulated via a cleared memo) and
+produce bit-identical counts/objective to the jitted path."""
+
+import numpy as np
+import pytest
+
+from shockwave_tpu.solver import warm_start
+from shockwave_tpu.solver.eg_jax import num_slots_for, solve_level_counts
+from shockwave_tpu.solver.eg_problem import EGProblem
+
+
+def _problem(num_jobs=40, future_rounds=8, num_gpus=16, seed=0):
+    rng = np.random.default_rng(seed)
+    total = rng.integers(5, 60, num_jobs).astype(float)
+    completed = np.floor(total * rng.uniform(0, 0.8, num_jobs))
+    epoch_dur = rng.uniform(60, 2000, num_jobs)
+    return EGProblem(
+        priorities=rng.uniform(0.5, 30.0, num_jobs),
+        completed_epochs=completed,
+        total_epochs=total,
+        epoch_duration=epoch_dur,
+        remaining_runtime=(total - completed) * epoch_dur,
+        nworkers=rng.choice([1, 1, 2], num_jobs).astype(float),
+        num_gpus=num_gpus,
+        round_duration=120.0,
+        future_rounds=future_rounds,
+        regularizer=10.0,
+        log_bases=np.array([0.0, 0.2, 0.4, 0.6, 0.8, 1.0]),
+    )
+
+
+@pytest.fixture
+def isolated_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("SHOCKWAVE_SOLVER_CACHE_DIR", str(tmp_path))
+    saved = dict(warm_start._LOADED)
+    warm_start._LOADED.clear()
+    yield str(tmp_path)
+    warm_start._LOADED.clear()
+    warm_start._LOADED.update(saved)
+
+
+def test_warm_then_load_is_bit_identical(isolated_cache):
+    problem = _problem()
+    slots = num_slots_for(problem.num_jobs)
+
+    # No blob yet: the jitted path runs and load() reports a miss.
+    assert warm_start.load(slots, 8, 64, False) is None
+    counts_ref, obj_ref = solve_level_counts(problem)
+
+    # warm() itself must drop the negative cache the miss above left
+    # behind, so the fast path engages without a process restart.
+    paths = warm_start.warm(slots=slots, future_rounds=8)
+    assert len(paths) == 2  # with and without the switch-cost bonus
+    compiled = warm_start.load(slots, 8, 64, False)
+    assert compiled is not None
+
+    counts, obj = solve_level_counts(problem)
+    assert np.array_equal(counts, counts_ref)
+    assert obj == obj_ref
+    # ...and via the FAST path, not the silent jitted fallback: a
+    # call-time failure would have negatively cached the signature
+    # (warm_start.invalidate) before falling back to bit-identical
+    # results, masking a total cold-start regression.
+    key = warm_start.cache_key(slots, 8, 64, False)
+    assert warm_start._LOADED.get(key) is not None, (
+        "precompiled executable was invalidated at call time; the "
+        "solve silently fell back to the jitted path"
+    )
+
+
+def test_corrupt_blob_falls_back_to_jit(isolated_cache):
+    problem = _problem(seed=1)
+    slots = num_slots_for(problem.num_jobs)
+    key = warm_start.cache_key(slots, 8, 64, False)
+    path = warm_start._blob_path(key)
+    import os
+
+    os.makedirs(warm_start.cache_dir(), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(b"not a pickled executable")
+    assert warm_start.load(slots, 8, 64, False) is None
+    assert not os.path.exists(path), "corrupt blob must be removed"
+    counts, obj = solve_level_counts(problem)  # jitted fallback
+    assert counts.shape == (problem.num_jobs,)
+    assert np.isfinite(obj)
+
+
+def test_cache_key_tracks_solver_source_and_shape():
+    k = warm_start.cache_key(1024, 50, 64, True)
+    assert k == warm_start.cache_key(1024, 50, 64, True)
+    assert k != warm_start.cache_key(1024, 50, 64, False)
+    assert k != warm_start.cache_key(512, 50, 64, True)
+    assert k != warm_start.cache_key(1024, 40, 64, True)
+    assert k != warm_start.cache_key(1024, 50, 64, True, num_bases=7)
