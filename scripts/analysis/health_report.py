@@ -1,0 +1,270 @@
+#!/usr/bin/env python3
+"""Render a scheduler health summary from observability dumps.
+
+Pulls together the three health-facing planes a run exports — the
+watchdog's alert counters/gauge in the metrics dump, the structured
+``health`` instants on the trace timeline, and the flight-recorder
+decision log — into one terminal (or HTML) summary:
+
+  python scripts/analysis/health_report.py results/run/metrics.json \\
+      [--trace results/run/trace.json] \\
+      [--decisions results/run/decisions.jsonl] \\
+      [--html health.html] [--fail-on-alerts]
+
+Terminal output by default; ``--html`` additionally writes a
+standalone HTML page. ``--fail-on-alerts`` exits 1 when the run
+recorded any watchdog alert (CI gate). Missing/truncated inputs exit 2
+with a one-line error, like report_run.py.
+"""
+
+import argparse
+import html as html_mod
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+from scripts.analysis.report_run import (  # noqa: E402
+    Metrics,
+    _fail,
+    _fmt,
+    calibration_fleet,
+    calibration_rows,
+    load_json_input,
+    load_metrics,
+)
+
+
+def collect(metrics_path, trace_path=None, decisions_path=None) -> dict:
+    m = load_metrics(metrics_path)
+    data = {
+        "metrics_file": metrics_path,
+        "health_gauge": m.value("scheduler_health"),
+        "alerts_by_rule": m.labeled_values(
+            "scheduler_health_alerts_total", "rule"
+        ),
+        "rounds": m.value("scheduler_rounds_total"),
+        "preemptions": m.value("scheduler_preemptions_total"),
+        "worst_ftf": m.value("run_worst_ftf"),
+        "makespan_s": m.value("run_makespan_seconds"),
+        "calibration_fleet": calibration_fleet(m),
+        "calibration_jobs": calibration_rows(m),
+        "health_events": [],
+        "decisions": None,
+    }
+    if trace_path:
+        trace = load_json_input(trace_path, "trace")
+        events = trace.get("traceEvents")
+        if not isinstance(events, list):
+            _fail(f"trace file {trace_path}: no traceEvents list")
+        data["health_events"] = [
+            {"ts_s": e.get("ts", 0) / 1e6, **e.get("args", {})}
+            for e in events
+            if e.get("name") == "health" and e.get("ph") == "i"
+        ]
+    if decisions_path:
+        from shockwave_tpu.obs import recorder
+
+        if not os.path.exists(decisions_path):
+            _fail(f"decisions file not found: {decisions_path}")
+        try:
+            data["decisions"] = recorder.summarize_log(decisions_path)
+            data["decisions"]["path"] = decisions_path
+        except ValueError as e:
+            _fail(str(e))
+    return data
+
+
+def total_alerts(data: dict) -> int:
+    return int(sum(data["alerts_by_rule"].values()))
+
+
+def render_text(data: dict) -> str:
+    lines = []
+    alerts = total_alerts(data)
+    verdict = "HEALTHY" if alerts == 0 else "DEGRADED"
+    lines.append(f"=== Scheduler health: {verdict} ===")
+    lines.append(
+        f"rounds={_fmt(data['rounds'])}  "
+        f"preemptions={_fmt(data['preemptions'])}  "
+        f"worst FTF={_fmt(data['worst_ftf'])}  "
+        f"makespan={_fmt(data['makespan_s'], 1)} s"
+    )
+    if alerts:
+        lines.append("")
+        lines.append(f"Alerts ({alerts}):")
+        for rule, count in sorted(data["alerts_by_rule"].items()):
+            lines.append(f"  {rule:<18} x{int(count)}")
+    if data["health_events"]:
+        lines.append("")
+        lines.append("Alert timeline (from trace):")
+        for e in data["health_events"]:
+            detail = ", ".join(
+                f"{k}={_fmt(v)}"
+                for k, v in e.items()
+                if k not in ("ts_s", "rule", "round", "time_s")
+            )
+            lines.append(
+                f"  t={e['ts_s']:>10.1f}s round {e.get('round', '—'):>4} "
+                f" {e.get('rule', '?'):<18} {detail}"
+            )
+    fleet = data["calibration_fleet"]
+    if fleet:
+        lines.append("")
+        lines.append(
+            "Predictor calibration: "
+            f"{_fmt(fleet.get('forecasts_scored'))} forecasts, "
+            f"MAPE {_fmt(fleet.get('mape'))}, "
+            f"bias {_fmt(fleet.get('bias_s'), 1)} s, "
+            f"interval coverage {_fmt(fleet.get('interval_coverage'))}"
+        )
+        worst = sorted(
+            (r for r in data["calibration_jobs"] if r[3] is not None),
+            key=lambda r: -r[3],
+        )[:5]
+        if worst:
+            lines.append("  least-calibrated jobs (by MAPE):")
+            for job, n, bias, mape, cov in worst:
+                lines.append(
+                    f"    job {job:<6} MAPE {_fmt(mape):<8} "
+                    f"bias {_fmt(bias, 1):>10} s  "
+                    f"coverage {_fmt(cov)}  ({_fmt(n)} forecasts)"
+                )
+    d = data["decisions"]
+    if d:
+        lines.append("")
+        lines.append(
+            f"Decision log: {d['plans']} plan records over rounds "
+            f"{d['first_round']}..{d['last_round']} "
+            f"({d['round_contexts']} round contexts; backends "
+            f"{d['backends']})"
+        )
+        lines.append(
+            "  replay: python -m shockwave_tpu.obs.recorder replay "
+            f"{d['path']}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def render_html(data: dict) -> str:
+    """Standalone single-file HTML version of the same summary."""
+    alerts = total_alerts(data)
+    ok = alerts == 0
+    badge = (
+        '<span style="color:#0a0">HEALTHY</span>'
+        if ok
+        else '<span style="color:#c00">DEGRADED</span>'
+    )
+
+    def table(headers, rows):
+        head = "".join(f"<th>{html_mod.escape(str(h))}</th>" for h in headers)
+        body = "".join(
+            "<tr>"
+            + "".join(f"<td>{html_mod.escape(_fmt(c))}</td>" for c in row)
+            + "</tr>"
+            for row in rows
+        )
+        return (
+            '<table border="1" cellpadding="4" cellspacing="0">'
+            f"<tr>{head}</tr>{body}</table>"
+        )
+
+    parts = [
+        "<!DOCTYPE html><html><head><meta charset='utf-8'>"
+        "<title>Scheduler health</title></head>"
+        "<body style='font-family:monospace'>",
+        f"<h1>Scheduler health: {badge}</h1>",
+        "<p>"
+        f"rounds={_fmt(data['rounds'])}, "
+        f"preemptions={_fmt(data['preemptions'])}, "
+        f"worst FTF={_fmt(data['worst_ftf'])}, "
+        f"makespan={_fmt(data['makespan_s'], 1)} s</p>",
+    ]
+    if alerts:
+        parts.append("<h2>Alerts</h2>")
+        parts.append(
+            table(
+                ["rule", "count"],
+                sorted(data["alerts_by_rule"].items()),
+            )
+        )
+    if data["health_events"]:
+        parts.append("<h2>Alert timeline</h2>")
+        parts.append(
+            table(
+                ["t (s)", "round", "rule", "value", "threshold", "job"],
+                [
+                    (
+                        round(e["ts_s"], 1),
+                        e.get("round"),
+                        e.get("rule"),
+                        e.get("value"),
+                        e.get("threshold"),
+                        e.get("job_id", "—"),
+                    )
+                    for e in data["health_events"]
+                ],
+            )
+        )
+    if data["calibration_jobs"]:
+        fleet = data["calibration_fleet"]
+        parts.append("<h2>Predictor calibration</h2>")
+        parts.append(
+            "<p>"
+            f"{_fmt(fleet.get('forecasts_scored'))} forecasts, "
+            f"MAPE {_fmt(fleet.get('mape'))}, "
+            f"bias {_fmt(fleet.get('bias_s'), 1)} s, "
+            f"coverage {_fmt(fleet.get('interval_coverage'))}</p>"
+        )
+        parts.append(
+            table(
+                ["job", "forecasts", "bias s", "MAPE", "coverage"],
+                data["calibration_jobs"],
+            )
+        )
+    d = data["decisions"]
+    if d:
+        parts.append("<h2>Decision log</h2>")
+        parts.append(
+            "<p>"
+            f"{d['plans']} plan records over rounds "
+            f"{d['first_round']}..{d['last_round']}; backends: "
+            f"{html_mod.escape(str(d['backends']))}</p>"
+        )
+    parts.append("</body></html>")
+    return "".join(parts)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("metrics", help="metrics snapshot JSON (--metrics-out)")
+    parser.add_argument("--trace", default=None, help="trace JSON (--trace-out)")
+    parser.add_argument(
+        "--decisions", default=None,
+        help="flight-recorder decision log (--decision-log)",
+    )
+    parser.add_argument("--html", default=None, help="also write HTML here")
+    parser.add_argument(
+        "--fail-on-alerts",
+        action="store_true",
+        help="exit 1 when the run recorded any watchdog alert (CI gate)",
+    )
+    args = parser.parse_args(argv)
+
+    data = collect(args.metrics, args.trace, args.decisions)
+    print(render_text(data), end="")
+    if args.html:
+        from shockwave_tpu.utils.fileio import atomic_write_text
+
+        atomic_write_text(args.html, render_html(data))
+        print(f"Wrote {args.html}")
+    if args.fail_on_alerts and total_alerts(data) > 0:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

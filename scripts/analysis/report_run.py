@@ -10,7 +10,11 @@ physical drivers). Markdown out, stdout or a file.
 
 Usage:
   python scripts/analysis/report_run.py results/run/metrics.json \
-      [--trace results/run/trace.json] [-o report.md]
+      [--trace results/run/trace.json] [-o report.md] [--json]
+
+``--json`` emits the same tables as one machine-readable JSON object
+(CI consumption). Missing or truncated input files exit 2 with a
+one-line error on stderr, no traceback.
 """
 
 import argparse
@@ -24,6 +28,29 @@ sys.path.insert(
 )
 
 from shockwave_tpu.obs.metrics import SCHEMA  # noqa: E402
+
+
+def _fail(message: str) -> None:
+    print(f"error: {message}", file=sys.stderr)
+    raise SystemExit(2)
+
+
+def load_json_input(path: str, kind: str) -> dict:
+    """Load a dump with CLI-friendly failure modes: a clear one-line
+    error (not a traceback) for missing paths and for files truncated
+    by a killed run's non-atomic copy."""
+    if not os.path.exists(path):
+        _fail(f"{kind} file not found: {path}")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except json.JSONDecodeError as e:
+        _fail(
+            f"{kind} file {path} is not valid JSON (truncated "
+            f"mid-write?): {e}"
+        )
+    except OSError as e:
+        _fail(f"cannot read {kind} file {path}: {e}")
 
 
 def _fmt(value, digits=3):
@@ -56,6 +83,14 @@ class Metrics:
             )
         self.metrics = snapshot["metrics"]
 
+    def labeled_values(self, name, label_key):
+        """{label value -> series value} for a gauge/counter family."""
+        return {
+            s["labels"][label_key]: s["value"]
+            for s in self.series(name)
+            if label_key in s["labels"]
+        }
+
     def value(self, name, default=None, **labels):
         metric = self.metrics.get(name)
         if metric is None:
@@ -70,28 +105,78 @@ class Metrics:
         return metric["series"] if metric else []
 
 
+# (display label, metric name, unit, digits) — shared by the markdown
+# overview table and the --json output.
+OVERVIEW_METRICS = [
+    ("Makespan", "run_makespan_seconds", " s", 1),
+    ("Average JCT", "run_avg_jct_seconds", " s", 1),
+    ("Utilization", "run_utilization", "", 3),
+    ("Worst FTF", "run_worst_ftf", "", 3),
+    ("Unfair fraction", "run_unfair_fraction_pct", " %", 1),
+    ("Rounds", "scheduler_rounds_total", "", 3),
+    ("Jobs admitted", "scheduler_jobs_admitted_total", "", 3),
+    ("Jobs completed", "scheduler_jobs_completed_total", "", 3),
+    ("Jobs failed", "scheduler_jobs_failed_total", "", 3),
+    ("Preemptions", "scheduler_preemptions_total", "", 3),
+    ("Lease extensions", "scheduler_lease_extensions_total", "", 3),
+    ("Kills", "scheduler_kills_total", "", 3),
+    ("Dispatches", "scheduler_dispatches_total", "", 3),
+    ("Health alerts", "scheduler_health_alerts_total", "", 3),
+]
+
+
 def overview_rows(m: Metrics):
     rows = []
-
-    def add(label, name, unit="", digits=3):
+    for label, name, unit, digits in OVERVIEW_METRICS:
+        if name == "scheduler_health_alerts_total":
+            # Counter with a per-rule label: total across rules.
+            series = m.series(name)
+            if series:
+                rows.append(
+                    (label, _fmt(sum(s["value"] for s in series), digits))
+                )
+            continue
         value = m.value(name)
         if value is not None:
             rows.append((label, f"{_fmt(value, digits)}{unit}"))
-
-    add("Makespan", "run_makespan_seconds", " s", 1)
-    add("Average JCT", "run_avg_jct_seconds", " s", 1)
-    add("Utilization", "run_utilization")
-    add("Worst FTF", "run_worst_ftf")
-    add("Unfair fraction", "run_unfair_fraction_pct", " %", 1)
-    add("Rounds", "scheduler_rounds_total")
-    add("Jobs admitted", "scheduler_jobs_admitted_total")
-    add("Jobs completed", "scheduler_jobs_completed_total")
-    add("Jobs failed", "scheduler_jobs_failed_total")
-    add("Preemptions", "scheduler_preemptions_total")
-    add("Lease extensions", "scheduler_lease_extensions_total")
-    add("Kills", "scheduler_kills_total")
-    add("Dispatches", "scheduler_dispatches_total")
     return rows
+
+
+def calibration_fleet(m: Metrics):
+    fleet = {}
+    for key, name in [
+        ("forecasts_scored", "predictor_calibration_scored"),
+        ("mape", "predictor_calibration_mape"),
+        ("bias_s", "predictor_calibration_bias_seconds"),
+        ("interval_coverage", "predictor_calibration_coverage"),
+    ]:
+        value = m.value(name)
+        if value is not None:
+            fleet[key] = value
+    return fleet
+
+
+def calibration_rows(m: Metrics):
+    """One row per job: forecasts scored, mean signed error, MAPE,
+    credible-interval coverage (from the per-job calibration gauges)."""
+    mape = m.labeled_values("predictor_job_mape", "job_id")
+    bias = m.labeled_values("predictor_job_bias_seconds", "job_id")
+    coverage = m.labeled_values("predictor_job_coverage", "job_id")
+    counts = m.labeled_values("predictor_job_forecasts", "job_id")
+
+    def job_sort_key(j):
+        return (0, int(j)) if j.isdigit() else (1, j)
+
+    return [
+        (
+            job,
+            counts.get(job),
+            bias.get(job),
+            mape.get(job),
+            coverage.get(job),
+        )
+        for job in sorted(mape, key=job_sort_key)
+    ]
 
 
 def histogram_rows(m: Metrics, name, label_keys):
@@ -220,9 +305,16 @@ def trace_sections(trace: dict):
     return "\n".join(lines)
 
 
+def load_metrics(metrics_path) -> Metrics:
+    snapshot = load_json_input(metrics_path, "metrics")
+    try:
+        return Metrics(snapshot)
+    except ValueError as e:
+        _fail(str(e))
+
+
 def build_report(metrics_path, trace_path=None):
-    with open(metrics_path) as f:
-        m = Metrics(json.load(f))
+    m = load_metrics(metrics_path)
 
     out = [f"# Run report — `{os.path.basename(metrics_path)}`", ""]
     out += ["## Outcome", ""]
@@ -290,12 +382,101 @@ def build_report(metrics_path, trace_path=None):
                 runtime,
             )
         )
+    calibration = calibration_rows(m)
+    if calibration:
+        fleet = calibration_fleet(m)
+        out += ["", "## Predictor calibration", ""]
+        out.append(
+            "Remaining-runtime forecasts scored against realized "
+            "processing time at job completion "
+            f"({_fmt(fleet.get('forecasts_scored'))} forecasts fleet-wide: "
+            f"MAPE {_fmt(fleet.get('mape'))}, "
+            f"bias {_fmt(fleet.get('bias_s'), 1)} s, "
+            f"interval coverage {_fmt(fleet.get('interval_coverage'))})."
+        )
+        out.append("")
+        out.append(
+            _table(
+                ["job", "forecasts", "bias s", "MAPE", "coverage"],
+                calibration,
+            )
+        )
 
     if trace_path:
-        with open(trace_path) as f:
-            trace = json.load(f)
-        out += ["", trace_sections(trace)]
+        trace = load_json_input(trace_path, "trace")
+        try:
+            out += ["", trace_sections(trace)]
+        except ValueError as e:
+            _fail(f"trace file {trace_path}: {e}")
     return "\n".join(out) + "\n"
+
+
+def build_json(metrics_path, trace_path=None) -> dict:
+    """The same report as one machine-readable object (--json; CI
+    consumption)."""
+    m = load_metrics(metrics_path)
+    data = {
+        "metrics_file": metrics_path,
+        "overview": {
+            name: m.value(name)
+            for _, name, _, _ in OVERVIEW_METRICS
+            if m.value(name) is not None
+        },
+        "solves": [
+            dict(
+                zip(
+                    ("backend", "ok", "count", "total_s", "mean_s",
+                     "min_s", "max_s"),
+                    row,
+                )
+            )
+            for row in histogram_rows(
+                m, "shockwave_solve_seconds", ["backend", "ok"]
+            )
+        ],
+        "plan_phases": [
+            dict(
+                zip(
+                    ("phase", "count", "total_s", "mean_s", "min_s",
+                     "max_s"),
+                    row,
+                )
+            )
+            for row in histogram_rows(
+                m, "shockwave_plan_phase_seconds", ["phase"]
+            )
+        ],
+        "health_alerts": m.labeled_values(
+            "scheduler_health_alerts_total", "rule"
+        ),
+        "scheduler_health": m.value("scheduler_health"),
+        "calibration": {
+            "fleet": calibration_fleet(m),
+            "jobs": [
+                dict(
+                    zip(
+                        ("job", "forecasts", "bias_s", "mape", "coverage"),
+                        row,
+                    )
+                )
+                for row in calibration_rows(m)
+            ],
+        },
+    }
+    if trace_path:
+        trace = load_json_input(trace_path, "trace")
+        events = trace.get("traceEvents")
+        if not isinstance(events, list):
+            _fail(f"trace file {trace_path}: no traceEvents list")
+        data["trace"] = {
+            "events": len(events),
+            "health_events": [
+                {"ts_s": e.get("ts", 0) / 1e6, **e.get("args", {})}
+                for e in events
+                if e.get("name") == "health" and e.get("ph") == "i"
+            ],
+        }
+    return data
 
 
 def main(argv=None):
@@ -306,8 +487,16 @@ def main(argv=None):
     )
     parser.add_argument("-o", "--output", default=None, help="write here "
                         "instead of stdout")
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="emit one machine-readable JSON object instead of markdown",
+    )
     args = parser.parse_args(argv)
-    report = build_report(args.metrics, args.trace)
+    if args.json:
+        report = json.dumps(build_json(args.metrics, args.trace), indent=1)
+    else:
+        report = build_report(args.metrics, args.trace)
     if args.output:
         from shockwave_tpu.utils.fileio import atomic_write_text
 

@@ -1,0 +1,156 @@
+#!/usr/bin/env python3
+"""Standing perf gate: fail on >10% regression vs bench history.
+
+``bench.py`` appends every run to ``results/bench_history.json``; this
+script compares the newest entry (or an explicit ``--current`` record —
+the JSON line bench.py prints, saved to a file) against the most recent
+PRIOR entry on the same platform and exits 1 if any tracked series
+regressed by more than ``--max-regression`` (default 10%).
+
+Tracked series (direction-aware):
+  value    warm-solve median seconds      lower is better
+  cold_s   fresh-process first solve      lower is better
+
+Usage (the standing gate; see docs/USAGE.md "Health & forensics"):
+  python bench.py                      # appends to results/bench_history.json
+  python scripts/ci/check_bench_regression.py
+
+With no same-platform baseline (first run on a platform, empty
+history) the gate passes with a notice — there is nothing to regress
+against.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# series name -> True when lower is better.
+TRACKED = {"value": True, "cold_s": True}
+
+
+def load_history(path):
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path) as f:
+            history = json.load(f)
+    except json.JSONDecodeError as e:
+        print(
+            f"error: bench history {path} is not valid JSON: {e}",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    if not isinstance(history, list):
+        print(
+            f"error: bench history {path} is not a list of records",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    return history
+
+
+def pick_baseline(history, current):
+    """Most recent history entry on the current record's platform that
+    is not the current record itself (bench.py appends the current run
+    to the history before printing it)."""
+    platform = current.get("platform")
+    for entry in reversed(history):
+        if entry is current:
+            continue
+        if platform and entry.get("platform") != platform:
+            continue
+        if entry.get("ts") == current.get("ts"):
+            continue
+        return entry
+    return None
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--history",
+        default=os.path.join(REPO_ROOT, "results", "bench_history.json"),
+        help="bench history file (default: results/bench_history.json)",
+    )
+    parser.add_argument(
+        "--current",
+        default=None,
+        help="JSON file with the current bench record (bench.py's "
+        "printed line); default: the newest history entry",
+    )
+    parser.add_argument(
+        "--max-regression",
+        type=float,
+        default=0.10,
+        help="fail above this fractional regression (default 0.10)",
+    )
+    parser.add_argument(
+        "--series",
+        nargs="+",
+        default=sorted(TRACKED),
+        choices=sorted(TRACKED),
+        help="tracked series to gate on",
+    )
+    args = parser.parse_args(argv)
+
+    history = load_history(args.history)
+    if args.current:
+        try:
+            with open(args.current) as f:
+                current = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: cannot read --current: {e}", file=sys.stderr)
+            raise SystemExit(2)
+    else:
+        if not history:
+            print(
+                f"PASS (no bench history at {args.history}; nothing to "
+                "compare)"
+            )
+            return 0
+        current = history[-1]
+
+    baseline = pick_baseline(history, current)
+    if baseline is None:
+        print(
+            "PASS (no prior same-platform entry in history; nothing to "
+            "compare)"
+        )
+        return 0
+
+    print(
+        f"current: {current.get('ts')} [{current.get('platform')}]  vs  "
+        f"baseline: {baseline.get('ts')} [{baseline.get('platform')}]"
+    )
+    failures = []
+    for series in args.series:
+        lower_is_better = TRACKED[series]
+        cur, base = current.get(series), baseline.get(series)
+        if cur is None or base is None or base == 0:
+            print(f"  {series:<8} skipped (missing in current or baseline)")
+            continue
+        change = (cur - base) / base if lower_is_better else (base - cur) / base
+        direction = "regression" if change > 0 else "improvement"
+        print(
+            f"  {series:<8} {base:.4g} -> {cur:.4g}  "
+            f"({100 * abs(change):.1f}% {direction})"
+        )
+        if change > args.max_regression:
+            failures.append(
+                f"{series}: {base:.4g} -> {cur:.4g} "
+                f"(+{100 * change:.1f}% > {100 * args.max_regression:.0f}%)"
+            )
+    if failures:
+        print("FAIL: " + "; ".join(failures))
+        return 1
+    print("PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -66,6 +66,8 @@ def run_physical_cluster(
     round_overhead_fraction=None,
     metrics_out=None,
     trace_out=None,
+    decision_log=None,
+    watchdog_rules=None,
 ):
     """Drive the full trace against a live localhost cluster; writes
     <out_dir>/{summary.json,round_log.json,timelines.json} and returns
@@ -85,8 +87,17 @@ def run_physical_cluster(
     # its wall-since-start clock and the registry catches registration.
     if metrics_out:
         obs.configure(metrics=True)
+        obs.configure_calibration()
     if trace_out:
         obs.configure(trace=True)
+    if decision_log:
+        obs.configure_recorder(decision_log)
+    if watchdog_rules is not None:
+        # {} = defaults; a dict = per-rule overrides. Calibration rides
+        # along (as in obs.apply_telemetry_args): the watchdog's MAPE
+        # rule is dead without the tracker's series.
+        obs.configure_watchdog(watchdog_rules or None)
+        obs.configure_calibration()
     worker_env = dict(worker_env)
     if metrics_out:
         worker_env["SHOCKWAVE_METRICS_OUT"] = os.path.join(
@@ -193,6 +204,8 @@ def run_physical_cluster(
                 for j, t in completed.items()
             },
         }
+        if obs.get_watchdog().enabled:
+            summary["scheduler_health"] = obs.get_watchdog().summary()
         if extra_summary is not None:
             summary.update(extra_summary(sched, run_dir))
         obs.export_run_summary(

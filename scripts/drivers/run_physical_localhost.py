@@ -169,6 +169,8 @@ def main(argv=None):
         round_overhead_fraction=args.round_overhead_fraction,
         metrics_out=args.metrics_out,
         trace_out=args.trace_out,
+        decision_log=args.decision_log,
+        watchdog_rules=obs.watchdog_rules_from_args(args),
         extra_summary=lambda sched, run_dir: {"trace": args.trace},
     )
     return summary

@@ -29,10 +29,7 @@ from shockwave_tpu.policies import get_available_policies, get_policy
 
 
 def main(args):
-    if args.metrics_out:
-        obs.configure(metrics=True)
-    if args.trace_out:
-        obs.configure(trace=True)
+    obs.apply_telemetry_args(args)
     jobs, arrival_times = parse_trace(args.trace_file)
     throughputs = (
         read_throughputs(args.throughputs_file)

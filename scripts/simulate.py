@@ -97,26 +97,18 @@ def main(args):
 
     preemption_overheads = None
     if args.preemption_overheads:
+        from shockwave_tpu.utils.fileio import read_json_arg
+
         # A JSON literal (scalar seconds, or {family: seconds}) or a
         # path to a JSON file holding one.
-        if os.path.exists(args.preemption_overheads):
-            with open(args.preemption_overheads) as f:
-                preemption_overheads = json.load(f)
-        else:
-            try:
-                preemption_overheads = json.loads(args.preemption_overheads)
-            except json.JSONDecodeError:
-                raise SystemExit(
-                    f"--preemption_overheads {args.preemption_overheads!r} "
-                    "is neither an existing file nor a JSON literal"
-                ) from None
+        preemption_overheads = read_json_arg(
+            args.preemption_overheads, "--preemption_overheads"
+        )
 
-    # Telemetry: enabling must precede Scheduler construction so the
-    # tracer adopts the simulator's virtual clock.
-    if args.metrics_out:
-        obs.configure(metrics=True)
-    if args.trace_out:
-        obs.configure(trace=True)
+    # Observability: enabling must precede Scheduler construction so the
+    # tracer adopts the simulator's virtual clock and the flight
+    # recorder sees the first planning round.
+    obs.apply_telemetry_args(args)
 
     policy = get_policy(args.policy, solver=args.solver, seed=args.seed)
     sched = Scheduler(

@@ -404,6 +404,7 @@ class PhysicalScheduler(Scheduler):
                         assignments[key] = ids
                         assigned_singles.update(key.singletons())
                         occupied.update(ids)
+                preempted_this_round = []
                 for key, prev_ids in self._current_worker_assignments.items():
                     if not any(s in self._jobs for s in key.singletons()):
                         continue
@@ -411,6 +412,7 @@ class PhysicalScheduler(Scheduler):
                         assignments[key]
                     ) != set(prev_ids):
                         self._num_preemptions += 1
+                        preempted_this_round.append(key)
                         obs.counter(
                             "scheduler_preemptions_total",
                             "still-active jobs that lost their workers "
@@ -453,6 +455,9 @@ class PhysicalScheduler(Scheduler):
                     "scheduler_scheduled_jobs",
                     "jobs granted workers this round",
                 ).set(len(assignments))
+                self._round_observability(
+                    assignments, preempted=preempted_this_round
+                )
                 for key, worker_ids in assignments.items():
                     if key in extended:
                         continue  # still running under an extended lease

@@ -185,6 +185,12 @@ class Scheduler:
         self._total_steps_run: Dict[JobId, int] = {}
         self._job_time_so_far: Dict[JobId, Dict[str, float]] = {}
         self._job_cost_so_far: Dict[JobId, float] = {}
+        # Cumulative processing (run) seconds each job has received —
+        # the realized counterpart the calibration tracker scores the
+        # predictor's remaining-runtime forecasts against. Tracked
+        # unconditionally: one dict add per micro-task completion, and
+        # scheduling decisions never read it.
+        self._job_total_run_time: Dict[JobId, float] = {}
         self._throughputs: Dict[JobId, dict] = {}
         self._original_bs: Dict[JobId, int] = {}
         self._bs_scale: Dict[JobId, Optional[int]] = {}
@@ -380,6 +386,7 @@ class Scheduler:
         self._steps_run_so_far[job_id] = {}
         self._job_time_so_far[job_id] = {}
         self._job_cost_so_far[job_id] = 0.0
+        self._job_total_run_time[job_id] = 0.0
         self._job_timelines[job_id] = [[] for _ in range(job.scale_factor)]
         self._throughputs[job_id] = {}
         self._original_bs[job_id] = job.batch_size
@@ -499,6 +506,17 @@ class Scheduler:
             self._record_completion_telemetry(
                 job_id, self._job_completion_times[job_id]
             )
+        calibration = obs.get_calibration()
+        if calibration.enabled:
+            if self._job_completion_times[job_id] is not None:
+                calibration.record_outcome(
+                    job_id.integer,
+                    self._job_total_run_time.get(job_id, 0.0),
+                )
+            else:
+                # A job dropped after repeated failures never realized
+                # its remaining runtime; its forecasts are unjudgeable.
+                calibration.discard(job_id.integer)
         job_type_key = self._job_id_to_job_type[job_id]
         self._job_type_to_job_ids[job_type_key].discard(job_id)
         del self._steps_run_so_far[job_id]
@@ -1318,6 +1336,10 @@ class Scheduler:
                     self._running_jobs.remove(single)
                     self._steps_run_so_far[single][worker_type] += num_steps
                     self._total_steps_run[single] += num_steps
+                    self._job_total_run_time[single] = (
+                        self._job_total_run_time.get(single, 0.0)
+                        + execution_time
+                    )
                     if self._get_remaining_steps(single) <= 0:
                         to_remove.append(single)
             max_execution_time = max(merged_times)
@@ -1455,6 +1477,72 @@ class Scheduler:
         self._bs_scale[job_id] = None
         if self._shockwave is not None:
             self._shockwave.set_recompute_flag()
+
+    def _round_observability(
+        self, assignments, preempted=None
+    ) -> None:
+        """Per-round taps for the observability planes beyond plain
+        metrics: flight-recorder round context, predictor-calibration
+        forecasts, and the health watchdog. One enabled-flags check when
+        everything is off (the default), so un-instrumented runs pay a
+        single branch per round."""
+        recorder = obs.get_recorder()
+        calibration = obs.get_calibration()
+        watchdog = obs.get_watchdog()
+        if not (recorder.enabled or calibration.enabled or watchdog.enabled):
+            return
+        now = self.get_current_timestamp()
+        if recorder.enabled:
+            recorder.record_round_context(
+                self._num_completed_rounds,
+                now,
+                assignments=assignments,
+                job_steps={
+                    j.integer: self._total_steps_run.get(j, 0)
+                    for j in self._jobs
+                },
+                preempted=preempted,
+            )
+        if calibration.enabled and self._shockwave is not None:
+            for j in self._jobs:
+                md = self._shockwave.get_metadata(j)
+                if md is None or md.completed_epochs >= md.total_epochs:
+                    continue
+                run_so_far = self._job_total_run_time.get(j, 0.0)
+                # Score the now-to-finish forecast (planner horizon math
+                # excludes the in-progress epoch; see
+                # JobMetadata.remaining_runtime_to_completion), with the
+                # credible interval shifted by the same offset. The
+                # posterior is evaluated once and threaded through.
+                base = md.remaining_runtime()
+                predicted = md.remaining_runtime_to_completion(
+                    run_so_far, base=base
+                )
+                lo, hi = md.remaining_runtime_interval(mean=base)
+                offset = predicted - base
+                calibration.record_forecast(
+                    j.integer,
+                    run_so_far,
+                    predicted,
+                    lo + offset,
+                    hi + offset,
+                    ts_s=now,
+                    ape_floor_s=md.mean_epoch_duration(),
+                )
+        if watchdog.enabled:
+            watchdog.check_round(
+                self._num_completed_rounds,
+                now,
+                job_steps={
+                    j.integer: self._total_steps_run.get(j, 0)
+                    for j in self._jobs
+                },
+                scheduled=[
+                    s.integer
+                    for key in assignments
+                    for s in key.singletons()
+                ],
+            )
 
     def _shockwave_scheduler_update(self) -> None:
         """Push epoch progress into the planner and advance its round
@@ -1676,6 +1764,7 @@ class Scheduler:
                     )
             else:
                 consecutive_idle_rounds = 0
+            preempted_this_round = []
             for job_id in self._current_worker_assignments:
                 if any(s in self._jobs for s in job_id.singletons()):
                     self._num_lease_extension_opportunities += 1
@@ -1684,6 +1773,7 @@ class Scheduler:
                     ) == set(scheduled_jobs[job_id])
                     if not kept:
                         self._num_preemptions += 1
+                        preempted_this_round.append(job_id)
                         obs.counter(
                             "scheduler_preemptions_total",
                             "still-active jobs that lost their workers "
@@ -1740,6 +1830,9 @@ class Scheduler:
                     "active_jobs": len(self._jobs),
                 },
             )
+            self._round_observability(
+                scheduled_jobs, preempted=preempted_this_round
+            )
 
             for job_id, worker_ids in scheduled_jobs.items():
                 worker_type = self._worker_id_to_worker_type[worker_ids[0]]
@@ -1795,6 +1888,7 @@ class Scheduler:
         "_total_steps_run",
         "_job_time_so_far",
         "_job_cost_so_far",
+        "_job_total_run_time",
         "_throughputs",
         "_original_bs",
         "_bs_scale",

@@ -42,6 +42,19 @@ Physical runs add ``rpc_handler_seconds{method}``,
 ``scheduler_kills_total``, and the worker-side
 ``worker_launches_total`` / ``worker_job_seconds`` /
 ``worker_kills_total`` families.
+
+Beyond the two telemetry planes, three sibling observability planes
+share the same disabled-by-default null-object contract:
+
+  * :class:`~shockwave_tpu.obs.recorder.FlightRecorder` — the JSONL
+    decision log of every planning round, replayable offline
+    (``--decision-log``);
+  * :class:`~shockwave_tpu.obs.calibration.CalibrationTracker` — online
+    scoring of the predictor's remaining-runtime forecasts (rides the
+    metrics plane);
+  * :class:`~shockwave_tpu.obs.watchdog.Watchdog` — per-round SLO rules
+    over the registry emitting ``health`` events and the
+    ``scheduler_health`` gauge (``--watchdog``).
 """
 
 from __future__ import annotations
@@ -49,6 +62,7 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from shockwave_tpu.obs.calibration import CalibrationTracker
 from shockwave_tpu.obs.metrics import (  # noqa: F401 (re-exported API)
     Counter,
     Gauge,
@@ -56,10 +70,15 @@ from shockwave_tpu.obs.metrics import (  # noqa: F401 (re-exported API)
     MetricsRegistry,
     SCHEMA,
 )
+from shockwave_tpu.obs.recorder import FlightRecorder
 from shockwave_tpu.obs.trace import EventTracer
+from shockwave_tpu.obs.watchdog import Watchdog
 
 _registry = MetricsRegistry(enabled=False)
 _tracer = EventTracer(enabled=False)
+_recorder = FlightRecorder(enabled=False)
+_calibration = CalibrationTracker(enabled=False)
+_watchdog = Watchdog(enabled=False)
 
 
 class _NullInstrument:
@@ -107,6 +126,26 @@ def configure_from_env(env=None) -> dict:
     return {"metrics": metrics_out, "trace": trace_out}
 
 
+def configure_recorder(path: str) -> None:
+    """Point the flight recorder at a JSONL decision-log path and
+    enable it (what the ``--decision-log`` driver flag does)."""
+    _recorder.configure(path)
+
+
+def configure_watchdog(rules=None) -> None:
+    """Enable the health watchdog. Its rules read the metrics registry,
+    so the metrics plane is switched on too (export remains opt-in via
+    ``--metrics-out``)."""
+    _registry.enabled = True
+    _watchdog.configure(rules=rules, enabled=True)
+
+
+def configure_calibration(enabled: bool = True) -> None:
+    _calibration.enabled = enabled
+    if enabled:
+        _registry.enabled = True
+
+
 def metrics_enabled() -> bool:
     return _registry.enabled
 
@@ -127,13 +166,28 @@ def get_tracer() -> EventTracer:
     return _tracer
 
 
+def get_recorder() -> FlightRecorder:
+    return _recorder
+
+
+def get_calibration() -> CalibrationTracker:
+    return _calibration
+
+
+def get_watchdog() -> Watchdog:
+    return _watchdog
+
+
 def reset() -> None:
-    """Tests only: drop all recorded state and disable both planes."""
+    """Tests only: drop all recorded state and disable every plane."""
     _registry.reset()
     _registry.enabled = False
     _tracer.reset()
     _tracer.enabled = False
     _tracer.set_clock(None)
+    _recorder.reset()
+    _calibration.reset()
+    _watchdog.reset()
 
 
 # -- instrument accessors (fetch-by-name; null when disabled) -----------
@@ -253,8 +307,9 @@ def backend_phases(backend: str, num_jobs: int, total: bool = True):
 
 # -- CLI contract -------------------------------------------------------
 def add_telemetry_args(parser) -> None:
-    """The shared --trace-out/--metrics-out argparse pair every driver
-    exposes (underscore spellings accepted as aliases)."""
+    """The shared observability argparse flags every driver exposes
+    (underscore spellings accepted as aliases): telemetry exports plus
+    the flight recorder and health watchdog."""
     parser.add_argument(
         "--trace-out",
         "--trace_out",
@@ -271,8 +326,69 @@ def add_telemetry_args(parser) -> None:
         type=str,
         default=None,
         help="write the metrics-registry snapshot (JSON) here; feed it "
-        "to scripts/analysis/report_run.py",
+        "to scripts/analysis/report_run.py (also turns on predictor "
+        "calibration scoring for Shockwave runs)",
     )
+    parser.add_argument(
+        "--decision-log",
+        "--decision_log",
+        dest="decision_log",
+        type=str,
+        default=None,
+        help="append every planning decision (full planner input + "
+        "plan) to this JSONL flight-recorder log; replay with "
+        "`python -m shockwave_tpu.obs.recorder replay <log>`",
+    )
+    parser.add_argument(
+        "--watchdog",
+        action="store_true",
+        help="evaluate scheduler-health SLO rules each round and emit "
+        "structured health events + the scheduler_health gauge",
+    )
+    parser.add_argument(
+        "--watchdog-config",
+        "--watchdog_config",
+        dest="watchdog_config",
+        type=str,
+        default=None,
+        help="watchdog rule overrides: a JSON literal or a path to a "
+        "JSON file, e.g. '{\"worst_ftf\": {\"threshold\": 1.5}}' "
+        "(implies --watchdog)",
+    )
+
+
+def watchdog_rules_from_args(args):
+    """``None`` when the args don't request the watchdog; ``{}`` for the
+    default rule set; a dict of per-rule overrides when
+    ``--watchdog-config`` names a JSON literal or file."""
+    from shockwave_tpu.utils.fileio import read_json_arg
+
+    watchdog_config = getattr(args, "watchdog_config", None)
+    if not (getattr(args, "watchdog", False) or watchdog_config):
+        return None
+    if not watchdog_config:
+        return {}
+    return read_json_arg(watchdog_config, "--watchdog-config")
+
+
+def apply_telemetry_args(args) -> None:
+    """Enable every observability plane the parsed driver args request.
+    Call BEFORE constructing the scheduler so the tracer can adopt its
+    clock and the first round is recorded."""
+    if getattr(args, "metrics_out", None):
+        configure(metrics=True)
+        # Calibration scoring rides the metrics plane: it only observes,
+        # and its series are what report_run.py's calibration table and
+        # the watchdog MAPE rule consume.
+        _calibration.enabled = True
+    if getattr(args, "trace_out", None):
+        configure(trace=True)
+    if getattr(args, "decision_log", None):
+        configure_recorder(args.decision_log)
+    rules = watchdog_rules_from_args(args)
+    if rules is not None:
+        configure_watchdog(rules or None)
+        _calibration.enabled = True
 
 
 def export_run_summary(
@@ -288,7 +404,7 @@ def export_run_summary(
     carries the summary table scripts/analysis/report_run.py prints) and
     export to the requested paths. One implementation for every driver —
     the gauges cannot drift per entry point."""
-    if not (metrics_out or trace_out):
+    if not (metrics_out or trace_out or _recorder.enabled or _watchdog.enabled):
         return
     if makespan is not None:
         gauge("run_makespan_seconds", "trace makespan").set(makespan)
@@ -310,6 +426,15 @@ def export_run_summary(
     if trace_out:
         export_trace(trace_out)
         print(f"Wrote {trace_out} (load in https://ui.perfetto.dev)")
+    if _recorder.enabled and _recorder.path:
+        _recorder.close()  # flush first: profile records count too
+        print(
+            f"Wrote {_recorder.path} ({_recorder.num_records} decision "
+            "records; replay with `python -m shockwave_tpu.obs.recorder "
+            f"replay {_recorder.path}`)"
+        )
+    if _watchdog.enabled:
+        print(_watchdog.format_summary())
 
 
 # -- export -------------------------------------------------------------

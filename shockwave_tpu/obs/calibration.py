@@ -1,0 +1,229 @@
+"""Online predictor-calibration scoring.
+
+Shockwave's planner stakes every priority and finish-time-fairness
+estimate on :meth:`JobMetadata.remaining_runtime` — the Bayesian
+remaining-processing-time forecast. This tracker closes the loop: each
+round the scheduler records the live forecast (and its credible
+interval) for every active job alongside the processing time the job
+has received so far; when the job retires, every forecast it ever made
+is scored against what actually happened:
+
+  realized remaining = total processing seconds at completion
+                       - processing seconds at forecast time
+
+(processing time, not wall time: the forecast predicts the job's own
+compute, and judging it against queueing delay would blame the
+predictor for the scheduler's contention).
+
+Scores, per forecast: signed error (predicted - realized, positive =
+over-forecast), absolute percentage error, and whether the realized
+value fell inside the Dirichlet credible interval. Published per-job
+and fleet-wide into the PR-2 metrics registry so the calibration table
+rides the ordinary ``--metrics-out`` dump into
+``scripts/analysis/report_run.py`` and the watchdog's MAPE rule.
+
+Fleet-wide series::
+
+    predictor_forecast_error_seconds   histogram  signed error
+    predictor_forecast_ape             histogram  |error| / realized
+    predictor_interval_total           counter    {covered}
+    predictor_calibration_mape         gauge      fleet MAPE
+    predictor_calibration_bias_seconds gauge      fleet mean signed error
+    predictor_calibration_coverage     gauge      interval hit fraction
+    predictor_calibration_scored       gauge      forecasts scored
+
+Per-job series (label ``job_id``): ``predictor_job_mape``,
+``predictor_job_bias_seconds``, ``predictor_job_coverage``,
+``predictor_job_forecasts``.
+
+Disabled by default with the usual one-attribute-check fast path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+_EPS = 1e-9
+
+
+class CalibrationTracker:
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        # job -> list of (run_time_at_forecast, predicted, lo, hi, ts)
+        self._pending: Dict[object, list] = {}
+        # job -> {"n", "abs_pct_sum", "signed_sum", "covered", "with_interval"}
+        self._scored: Dict[object, dict] = {}
+
+    def reset(self) -> None:
+        self.enabled = False
+        with self._lock:
+            self._pending.clear()
+            self._scored.clear()
+
+    # -- recording ------------------------------------------------------
+    def record_forecast(
+        self,
+        job_id,
+        run_time_so_far_s: float,
+        predicted_remaining_s: float,
+        lo_s: Optional[float] = None,
+        hi_s: Optional[float] = None,
+        ts_s: Optional[float] = None,
+        ape_floor_s: float = 0.0,
+    ) -> None:
+        """``ape_floor_s`` floors the APE denominator (typically one
+        mean epoch duration): a forecast made seconds before completion
+        divides by a near-zero realized remainder and would otherwise
+        dominate the MAPE with a scoring artifact, not a predictor
+        error."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._pending.setdefault(job_id, []).append(
+                (
+                    float(run_time_so_far_s),
+                    float(predicted_remaining_s),
+                    None if lo_s is None else float(lo_s),
+                    None if hi_s is None else float(hi_s),
+                    ts_s,
+                    float(ape_floor_s),
+                )
+            )
+
+    def discard(self, job_id) -> None:
+        """Drop a job's unscored forecasts (failed jobs never realize a
+        remaining runtime to judge them against)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._pending.pop(job_id, None)
+
+    def record_outcome(self, job_id, total_run_time_s: float) -> None:
+        """Score every pending forecast for a retiring job against its
+        realized processing time and publish the updated aggregates."""
+        if not self.enabled:
+            return
+        from shockwave_tpu import obs
+
+        with self._lock:
+            forecasts = self._pending.pop(job_id, [])
+            if not forecasts:
+                return
+            stats = self._scored.setdefault(
+                job_id,
+                {
+                    "n": 0,
+                    "abs_pct_sum": 0.0,
+                    "signed_sum": 0.0,
+                    "covered": 0,
+                    "with_interval": 0,
+                },
+            )
+            err_h = obs.histogram(
+                "predictor_forecast_error_seconds",
+                "signed remaining-runtime forecast error "
+                "(predicted - realized)",
+            )
+            ape_h = obs.histogram(
+                "predictor_forecast_ape",
+                "absolute percentage error of remaining-runtime forecasts",
+            )
+            cov_c = obs.counter(
+                "predictor_interval_total",
+                "forecasts whose realized value fell inside/outside the "
+                "credible interval",
+            )
+            for run_at, predicted, lo, hi, _ts, ape_floor in forecasts:
+                realized = max(
+                    float(total_run_time_s) - run_at, _EPS
+                )
+                signed = predicted - realized
+                ape = abs(signed) / max(realized, ape_floor, _EPS)
+                stats["n"] += 1
+                stats["abs_pct_sum"] += ape
+                stats["signed_sum"] += signed
+                err_h.observe(signed)
+                ape_h.observe(ape)
+                if lo is not None and hi is not None:
+                    stats["with_interval"] += 1
+                    covered = lo - _EPS <= realized <= hi + _EPS
+                    stats["covered"] += int(covered)
+                    cov_c.inc(covered=str(covered))
+            self._publish_job(job_id, stats)
+            self._publish_fleet()
+
+    # -- publication ----------------------------------------------------
+    def _publish_job(self, job_id, stats: dict) -> None:
+        from shockwave_tpu import obs
+
+        n = stats["n"]
+        if n == 0:
+            return
+        label = str(job_id)
+        obs.gauge(
+            "predictor_job_mape", "per-job forecast MAPE"
+        ).set(stats["abs_pct_sum"] / n, job_id=label)
+        obs.gauge(
+            "predictor_job_bias_seconds", "per-job mean signed error"
+        ).set(stats["signed_sum"] / n, job_id=label)
+        obs.gauge(
+            "predictor_job_forecasts", "forecasts scored for this job"
+        ).set(n, job_id=label)
+        if stats["with_interval"]:
+            obs.gauge(
+                "predictor_job_coverage",
+                "fraction of this job's forecasts inside the interval",
+            ).set(stats["covered"] / stats["with_interval"], job_id=label)
+
+    def _publish_fleet(self) -> None:
+        from shockwave_tpu import obs
+
+        n = sum(s["n"] for s in self._scored.values())
+        if n == 0:
+            return
+        obs.gauge(
+            "predictor_calibration_mape",
+            "fleet-wide remaining-runtime forecast MAPE",
+        ).set(sum(s["abs_pct_sum"] for s in self._scored.values()) / n)
+        obs.gauge(
+            "predictor_calibration_bias_seconds",
+            "fleet-wide mean signed forecast error",
+        ).set(sum(s["signed_sum"] for s in self._scored.values()) / n)
+        obs.gauge(
+            "predictor_calibration_scored", "forecasts scored fleet-wide"
+        ).set(n)
+        with_interval = sum(
+            s["with_interval"] for s in self._scored.values()
+        )
+        if with_interval:
+            obs.gauge(
+                "predictor_calibration_coverage",
+                "fleet-wide credible-interval hit fraction",
+            ).set(
+                sum(s["covered"] for s in self._scored.values())
+                / with_interval
+            )
+
+    # -- inspection ------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Per-job calibration table (tests / health report)."""
+        with self._lock:
+            table = {
+                str(job_id): {
+                    "forecasts": s["n"],
+                    "mape": s["abs_pct_sum"] / s["n"] if s["n"] else None,
+                    "bias_s": s["signed_sum"] / s["n"] if s["n"] else None,
+                    "coverage": (
+                        s["covered"] / s["with_interval"]
+                        if s["with_interval"]
+                        else None
+                    ),
+                }
+                for job_id, s in self._scored.items()
+            }
+            pending = {
+                str(job_id): len(v) for job_id, v in self._pending.items()
+            }
+        return {"jobs": table, "pending": pending}

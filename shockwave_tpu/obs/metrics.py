@@ -16,17 +16,36 @@ Snapshot schema (``MetricsRegistry.snapshot``), also what
                         "series": [{"labels": {...}, ...values...}]}}}
 
 Counters/gauges carry ``{"value": float}`` per series; histograms carry
-``{"count", "sum", "min", "max"}``. ``render_text`` emits the same data
-in the Prometheus exposition format (the ``/metrics`` dump RPC's wire
-payload).
+``{"count", "sum", "min", "max", "buckets"}`` where ``buckets`` maps a
+Prometheus ``le`` boundary (string, including ``"+Inf"``) to the
+CUMULATIVE observation count at that boundary. ``render_text`` emits the
+same data in the Prometheus exposition format (the ``/metrics`` dump
+RPC's wire payload), with proper ``_bucket{le=...}`` series so dumps
+load into real Prometheus tooling unchanged.
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 SCHEMA = "shockwave-metrics-v1"
+
+# Latency-oriented log-ish boundaries wide enough to also bin epoch/JCT
+# durations (seconds) and small ratios (FTF); +Inf is implicit.
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+    1800.0, 3600.0, 14400.0, 86400.0,
+)
+
+
+def _fmt_le(bound: float) -> str:
+    """Prometheus ``le`` label text: integral bounds render Go-style
+    ("1.0", not "1") so round-trips through real Prometheus scrapers
+    keep the same series identity."""
+    return str(float(bound))
 
 
 def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:
@@ -111,8 +130,29 @@ class Gauge(_Instrument):
 class Histogram(_Instrument):
     kind = "histogram"
 
+    def __init__(
+        self,
+        registry: "MetricsRegistry",
+        name: str,
+        help: str,
+        buckets: Optional[Sequence[float]] = None,
+    ):
+        super().__init__(registry, name, help)
+        self._bounds = tuple(
+            sorted(float(b) for b in (buckets or DEFAULT_BUCKETS))
+        )
+
     def _new_series(self) -> dict:
-        return {"count": 0, "sum": 0.0, "min": None, "max": None}
+        # "buckets" holds NON-cumulative per-bound counts (one slot per
+        # finite bound; observations above the last bound only land in
+        # "count", which is the +Inf bucket). Snapshots cumulate.
+        return {
+            "count": 0,
+            "sum": 0.0,
+            "min": None,
+            "max": None,
+            "buckets": [0] * len(self._bounds),
+        }
 
     def observe(self, value: float, **labels) -> None:
         registry = self._registry
@@ -127,6 +167,19 @@ class Histogram(_Instrument):
                 series["min"] = value
             if series["max"] is None or value > series["max"]:
                 series["max"] = value
+            # Prometheus le is inclusive: bucket i counts value <= bound.
+            idx = bisect.bisect_left(self._bounds, value)
+            if idx < len(self._bounds):
+                series["buckets"][idx] += 1
+
+    def _cumulative_buckets(self, series: dict) -> "Dict[str, int]":
+        out = {}
+        running = 0
+        for bound, count in zip(self._bounds, series["buckets"]):
+            running += count
+            out[_fmt_le(bound)] = running
+        out["+Inf"] = series["count"]
+        return out
 
     def snapshot_series(self) -> list:
         return [
@@ -136,6 +189,7 @@ class Histogram(_Instrument):
                 "sum": s["sum"],
                 "min": s["min"],
                 "max": s["max"],
+                "buckets": self._cumulative_buckets(s),
             }
             for s in self._series.values()
         ]
@@ -154,11 +208,11 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._instruments: "Dict[str, _Instrument]" = {}
 
-    def _get(self, cls, name: str, help: str) -> _Instrument:
+    def _get(self, cls, name: str, help: str, **kwargs) -> _Instrument:
         with self._lock:
             inst = self._instruments.get(name)
             if inst is None:
-                inst = cls(self, name, help)
+                inst = cls(self, name, help, **kwargs)
                 self._instruments[name] = inst
             elif not isinstance(inst, cls):
                 raise TypeError(
@@ -172,8 +226,15 @@ class MetricsRegistry:
     def gauge(self, name: str, help: str = "") -> Gauge:
         return self._get(Gauge, name, help)
 
-    def histogram(self, name: str, help: str = "") -> Histogram:
-        return self._get(Histogram, name, help)
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        buckets: Optional[Sequence[float]] = None,
+    ) -> Histogram:
+        """``buckets`` applies only at first registration; later fetches
+        by name reuse the existing boundary set."""
+        return self._get(Histogram, name, help, buckets=buckets)
 
     # -- export ---------------------------------------------------------
     def snapshot(self) -> dict:
@@ -189,15 +250,19 @@ class MetricsRegistry:
         return {"schema": SCHEMA, "metrics": metrics}
 
     def render_text(self) -> str:
-        """Prometheus exposition format. Histograms are flattened to
-        ``_count``/``_sum``/``_min``/``_max`` series (the summary-style
-        rendering; no proper buckets are kept)."""
+        """Prometheus exposition format. Histograms render as proper
+        ``histogram`` families — cumulative ``_bucket{le=...}`` series
+        (including ``+Inf``) plus ``_sum``/``_count`` — loadable by real
+        Prometheus tooling unchanged. The min/max extrema (which the
+        exposition format's histogram type has no slot for) are emitted
+        as sibling ``<name>_min``/``<name>_max`` gauge families."""
 
-        def fmt_labels(labels: dict) -> str:
-            if not labels:
+        def fmt_labels(labels: dict, **extra) -> str:
+            merged = {**labels, **extra}
+            if not merged:
                 return ""
             inner = ",".join(
-                f'{k}="{v}"' for k, v in sorted(labels.items())
+                f'{k}="{v}"' for k, v in sorted(merged.items())
             )
             return "{" + inner + "}"
 
@@ -206,18 +271,29 @@ class MetricsRegistry:
         for name, metric in snap["metrics"].items():
             if metric["help"]:
                 lines.append(f"# HELP {name} {metric['help']}")
-            kind = "untyped" if metric["type"] == "histogram" else metric["type"]
-            lines.append(f"# TYPE {name} {kind}")
-            for series in metric["series"]:
-                labels = fmt_labels(series["labels"])
-                if metric["type"] == "histogram":
-                    for stat in ("count", "sum", "min", "max"):
-                        value = series[stat]
-                        if value is None:
-                            continue
-                        lines.append(f"{name}_{stat}{labels} {value}")
-                else:
+            lines.append(f"# TYPE {name} {metric['type']}")
+            if metric["type"] != "histogram":
+                for series in metric["series"]:
+                    labels = fmt_labels(series["labels"])
                     lines.append(f"{name}{labels} {series['value']}")
+                continue
+            for series in metric["series"]:
+                for le, cum in series["buckets"].items():
+                    bucket_labels = fmt_labels(series["labels"], le=le)
+                    lines.append(f"{name}_bucket{bucket_labels} {cum}")
+                labels = fmt_labels(series["labels"])
+                lines.append(f"{name}_sum{labels} {series['sum']}")
+                lines.append(f"{name}_count{labels} {series['count']}")
+            for stat in ("min", "max"):
+                stat_series = [
+                    s for s in metric["series"] if s[stat] is not None
+                ]
+                if not stat_series:
+                    continue
+                lines.append(f"# TYPE {name}_{stat} gauge")
+                for series in stat_series:
+                    labels = fmt_labels(series["labels"])
+                    lines.append(f"{name}_{stat}{labels} {series[stat]}")
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:

@@ -1,0 +1,675 @@
+"""Flight recorder: an append-only JSONL log of scheduling decisions.
+
+Every planning round the Shockwave planner makes, the recorder snapshots
+the FULL planner input — per-job predictor metadata (epoch profiles,
+measured throughput schedules, Dirichlet posteriors), remaining-runtime
+forecasts, finish-time history, incumbents and switching costs — plus
+the decision it produced (the boolean plan window, its EG objective,
+the backend that solved it, the solve record). The scheduler adds one
+``round_context`` record per executed round (assignments, per-job
+progress, preemptions), so a dump answers "why did job 7 get preempted
+in round 41" without a cluster.
+
+Records append via a single ``O_APPEND`` write each
+(:func:`shockwave_tpu.utils.fileio.atomic_append_text`): a killed run
+keeps every completed decision, and readers skip at most one truncated
+final line.
+
+Replay: :func:`replay_plan_record` restores the recorded planner state
+(:func:`shockwave_tpu.policies.shockwave.planner_from_state`) and
+re-runs ``_replan`` offline — same math, same backend dispatch — then
+diffs the produced plan window against the recorded one. An empty diff
+for every record means the log is a faithful, deterministic account of
+the run; a non-empty diff after a policy change is exactly the A/B
+evidence ("on round 12's recorded inputs, the new policy keeps job 7").
+
+CLI::
+
+    python -m shockwave_tpu.obs.recorder summary results/run/decisions.jsonl
+    python -m shockwave_tpu.obs.recorder replay  results/run/decisions.jsonl
+    python -m shockwave_tpu.obs.recorder replay  results/run/decisions.jsonl --round 12
+
+Disabled by default (``FlightRecorder.enabled`` is False) behind the
+same null-object contract as the rest of :mod:`shockwave_tpu.obs`:
+every ``record_*`` call is one attribute check and an early return, so
+un-instrumented runs stay bit-identical.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import OrderedDict
+from typing import Iterator, List, Optional
+
+SCHEMA = "shockwave-decisions-v1"
+
+
+# ----------------------------------------------------------------------
+# JSON codec: planner state holds numpy arrays, JobId keys, int-keyed
+# dicts and tuples — none of which survive plain JSON. Every container
+# is tagged so decode() restores the EXACT object graph state_dict()
+# produced (replay depends on it).
+# ----------------------------------------------------------------------
+class _Scalars(list):
+    """A list the builder guarantees holds only JSON scalars; encode()
+    passes it through without the per-element type scan."""
+
+    __slots__ = ()
+
+
+def encode(obj):
+    import numpy as np
+
+    from shockwave_tpu.core.ids import JobId
+
+    if type(obj) is _Scalars:
+        return obj
+    if obj is None or isinstance(obj, (bool, str)):
+        return obj
+    if isinstance(obj, (int, float)):
+        return obj
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        # Epoch profile arrays are tens of thousands of entries long but
+        # hold a handful of constant runs (one batch-size regime spans
+        # thousands of epochs); run-length encode when it pays. Both
+        # branches round-trip exactly — values are repeated, not
+        # approximated.
+        if obj.ndim == 1 and obj.size >= 32:
+            boundaries = np.flatnonzero(obj[1:] != obj[:-1]) + 1
+            if boundaries.size + 1 <= obj.size // 4:
+                starts = np.concatenate(([0], boundaries))
+                ends = np.concatenate((boundaries, [obj.size]))
+                return {
+                    "__ndrle__": obj.dtype.str,
+                    "runs": _Scalars(
+                        x
+                        for s, e in zip(starts, ends)
+                        for x in (obj[s].item(), int(e - s))
+                    ),
+                }
+        return {"__nd__": obj.dtype.str, "data": _Scalars(obj.tolist())}
+    if isinstance(obj, JobId):
+        return {"__jobid__": list(obj.as_tuple())}
+    if isinstance(obj, tuple):
+        return {"__tuple__": [encode(x) for x in obj]}
+    if isinstance(obj, dict):
+        return {
+            "__pairs__": [[encode(k), encode(v)] for k, v in obj.items()],
+            "__od__": isinstance(obj, OrderedDict),
+        }
+    if isinstance(obj, (list, set)):
+        # Fast path for the common bulk case (epoch profiles are long
+        # lists of plain floats): a type scan is ~5x cheaper than
+        # per-element recursion.
+        if all(type(x) in (int, float, str, bool, type(None)) for x in obj):
+            return list(obj)
+        return [encode(x) for x in obj]
+    raise TypeError(
+        f"flight recorder cannot encode {type(obj).__name__!r}"
+    )
+
+
+def decode(obj):
+    import numpy as np
+
+    from shockwave_tpu.core.ids import JobId
+
+    if isinstance(obj, list):
+        return [decode(x) for x in obj]
+    if not isinstance(obj, dict):
+        return obj
+    if "__nd__" in obj:
+        return np.asarray(obj["data"], dtype=np.dtype(obj["__nd__"]))
+    if "__ndrle__" in obj:
+        flat = obj["runs"]
+        values = np.asarray(flat[0::2], dtype=np.dtype(obj["__ndrle__"]))
+        counts = np.asarray(flat[1::2], dtype=np.int64)
+        return np.repeat(values, counts)
+    if "__jobid__" in obj:
+        return JobId(*obj["__jobid__"])
+    if "__tuple__" in obj:
+        return tuple(decode(x) for x in obj["__tuple__"])
+    if "__pairs__" in obj:
+        cls = OrderedDict if obj.get("__od__") else dict
+        return cls((decode(k), decode(v)) for k, v in obj["__pairs__"])
+    # Plain JSON object (a record envelope, not planner state).
+    return {k: decode(v) for k, v in obj.items()}
+
+
+def _job_key(job_id) -> str:
+    """Stable string identity for a job across record/replay (JobId in
+    real runs, arbitrary hashables in unit fixtures)."""
+    return str(job_id)
+
+
+# ----------------------------------------------------------------------
+# JobMetadata state splitting. A planner snapshot is dominated by
+# per-job epoch arrays that never change after admission; serializing
+# them into EVERY plan record made the log ~1 MB/record. Instead the
+# immutable profile is emitted once per job (a ``job_profile`` record)
+# and plan records carry only the dynamic fields plus a reference;
+# derived fields (the rescaled ``epoch_durations`` and its memo keys)
+# are dropped entirely and recomputed at replay — the rescale is a pure,
+# idempotent function of the throughput schedule
+# (JobMetadata.recompute_epoch_durations).
+# ----------------------------------------------------------------------
+_MD_STATIC_FIELDS = (
+    "total_epochs",
+    "nsamples_per_epoch",
+    "nworkers",
+    "epoch_batch_sizes",
+    "estimated_epoch_durations",
+    "regimes",
+    "dirichlet",
+    "round_duration",
+)
+# Schema-parity fields no planner math reads (profiles.py synthesizes
+# them as zeros): dropped from the log, rebuilt empty at replay.
+_MD_DROPPED_FIELDS = ("epoch_mem_reqs", "epoch_gpu_reqs")
+_MD_DYNAMIC_FIELDS = (
+    "completed_epochs",
+    "submit_time",
+    "_schedule_version",
+)
+
+
+def _profile_fingerprint(md_state: dict) -> tuple:
+    """Cheap change tripwire for the statically-assumed profile fields
+    (they are immutable by construction; a mismatch re-emits)."""
+    est = md_state["estimated_epoch_durations"]
+    return (
+        md_state["total_epochs"],
+        md_state["nsamples_per_epoch"],
+        md_state["round_duration"],
+        len(est),
+        float(est[0]) if len(est) else 0.0,
+        float(est[-1]) if len(est) else 0.0,
+    )
+
+
+def _split_metadata_state(md_state: dict, emitted_rounds: int = 0):
+    """``emitted_rounds`` entries of the throughput schedule were
+    already logged by earlier plan records for this job; only the tail
+    is carried (the schedule is append-only — rounds execute once), as
+    three parallel scalar lists so encode() skips per-entry recursion.
+    Returns (static profile, dynamic record, total schedule length)."""
+    static = {f: md_state[f] for f in _MD_STATIC_FIELDS}
+    dynamic = {f: md_state[f] for f in _MD_DYNAMIC_FIELDS}
+    schedule = md_state["throughput_schedule"]
+    rounds = sorted(schedule)[emitted_rounds:]
+    dynamic["tput_base"] = int(emitted_rounds)
+    dynamic["tput_rounds"] = _Scalars(int(r) for r in rounds)
+    dynamic["tput_values"] = _Scalars(float(schedule[r][0]) for r in rounds)
+    dynamic["tput_bss"] = _Scalars(int(schedule[r][1]) for r in rounds)
+    return static, dynamic, len(schedule)
+
+
+def _rebuild_metadata_state(
+    profile: dict, dynamic: dict, schedule: "Optional[dict]" = None
+) -> dict:
+    import numpy as np
+
+    state = {**profile, **dynamic}
+    state.pop("tput_base", None)
+    inline = {
+        r: (t, b)
+        for r, t, b in zip(
+            state.pop("tput_rounds"),
+            state.pop("tput_values"),
+            state.pop("tput_bss"),
+        )
+    }
+    state["throughput_schedule"] = inline if schedule is None else schedule
+    for field in _MD_DROPPED_FIELDS:
+        state[field] = []
+    # Derived fields: start from the as-profiled durations with the
+    # memo keys cleared so the first recompute_epoch_durations() call
+    # re-applies the (deterministic) measured-throughput rescale.
+    state["epoch_durations"] = np.asarray(
+        profile["estimated_epoch_durations"], dtype=np.float64
+    ).copy()
+    state["_rescale_key"] = None
+    state["_bs_durations_cache"] = None
+    return state
+
+
+# ----------------------------------------------------------------------
+# The recorder.
+# ----------------------------------------------------------------------
+class FlightRecorder:
+    """Append-only decision log, process-global like the metrics
+    registry (see :mod:`shockwave_tpu.obs`).
+
+    Recording must not perturb the system it observes, so the hot path
+    does only mutation-safety work: planner snapshots are SPLIT into
+    freshly-built / immutable-by-construction structures and queued.
+    JSON encoding and the actual appends happen in :meth:`flush` —
+    automatically every ``FLUSH_EVERY`` records (bounding both memory
+    and crash-loss) and at :meth:`close` (which every driver's export
+    path calls). Appends go through
+    :func:`~shockwave_tpu.utils.fileio.atomic_append_text`, one
+    ``O_APPEND`` write per batch.
+    """
+
+    # Memory/crash-loss bound, not a hot-path cadence: at ~3 KB per
+    # queued record this caps the buffer near 12 MB. Long-running
+    # physical drivers hit it between rounds; short sims flush once at
+    # close.
+    FLUSH_EVERY = 4096
+
+    def __init__(self, enabled: bool = False, path: Optional[str] = None):
+        self.enabled = enabled
+        self.path = path
+        self.num_records = 0
+        self._lock = threading.Lock()
+        self._pending: list = []
+        # job key -> fingerprint of the job_profile already emitted.
+        self._profiles_emitted: dict = {}
+        # job key -> throughput-schedule entries already logged (plan
+        # records carry only the tail since the previous one).
+        self._tput_emitted: dict = {}
+
+    def configure(self, path: str) -> None:
+        """Point the recorder at a log path and enable it; queues a
+        header record so readers can sanity-check the schema."""
+        self.path = path
+        self.enabled = True
+        self.num_records = 0
+        self._pending = []
+        self._profiles_emitted = {}
+        self._tput_emitted = {}
+        self._append({"event": "header", "schema": SCHEMA})
+        self.flush()
+
+    def reset(self) -> None:
+        self.enabled = False
+        self.path = None
+        self.num_records = 0
+        with self._lock:
+            self._pending = []
+        self._profiles_emitted = {}
+        self._tput_emitted = {}
+
+    def close(self) -> None:
+        self.flush()
+        self.enabled = False
+
+    def _append(self, record: dict) -> None:
+        with self._lock:
+            self._pending.append(record)
+            self.num_records += 1
+            should_flush = len(self._pending) >= self.FLUSH_EVERY
+        if should_flush:
+            self.flush()
+
+    def flush(self) -> None:
+        """Slim, encode and append every queued record — all the real
+        packaging work, off the scheduling hot path."""
+        from shockwave_tpu.utils.fileio import atomic_append_text
+
+        with self._lock:
+            pending, self._pending = self._pending, []
+            if not pending or self.path is None:
+                return
+            lines = []
+            for record in pending:
+                raw = record.pop("planner_state_raw", None)
+                if raw is not None:
+                    record["planner_state"] = self._slim_planner_state(
+                        raw, lines
+                    )
+                for field in ("planner_state", "profile", "problem"):
+                    if field in record:
+                        record[field] = encode(record[field])
+                lines.append(json.dumps(record, separators=(",", ":")))
+            atomic_append_text(self.path, "\n".join(lines) + "\n")
+
+    def _slim_planner_state(self, planner_state: dict, lines: list) -> dict:
+        """Compact a raw planner snapshot for one plan record: factor
+        each job's immutable profile out into a ``job_profile`` record
+        (appended to ``lines`` ahead of the plan record, once per job),
+        delta-encode the append-only throughput schedules, pack tuple
+        histories into scalar lists, and drop pure-output fields.
+        Caller holds the lock."""
+        slim_state = dict(planner_state)
+        slim_state["job_metadata"] = slim_md = OrderedDict()
+        # The solve history is observability output, not planner input;
+        # the plan cache is pure output too (_replan prunes then
+        # overwrites the whole window) — replay reads neither.
+        slim_state["solve_times"] = []
+        slim_state["solve_records"] = []
+        slim_state["schedules"] = OrderedDict()
+        slim_state["finish_time_estimates"] = {
+            job: {
+                "rounds": _Scalars(int(r) for r, _ in history),
+                "estimates": _Scalars(float(ft) for _, ft in history),
+            }
+            for job, history in planner_state[
+                "finish_time_estimates"
+            ].items()
+        }
+        for job_id, md_state in planner_state["job_metadata"].items():
+            key = _job_key(job_id)
+            static, dynamic, emitted = _split_metadata_state(
+                md_state, self._tput_emitted.get(key, 0)
+            )
+            self._tput_emitted[key] = emitted
+            fingerprint = _profile_fingerprint(md_state)
+            if self._profiles_emitted.get(key) != fingerprint:
+                lines.append(
+                    json.dumps(
+                        {
+                            "event": "job_profile",
+                            "job": key,
+                            "profile": encode(static),
+                        },
+                        separators=(",", ":"),
+                    )
+                )
+                self.num_records += 1
+                self._profiles_emitted[key] = fingerprint
+            dynamic["__profile_ref__"] = key
+            # Keep the original key type: the planner state round-trips
+            # through encode(), which preserves JobId/ints.
+            slim_md[job_id] = dynamic
+        return slim_state
+
+    # -- emission -------------------------------------------------------
+    def record_plan(
+        self,
+        planner_state: dict,
+        plan: dict,
+        backend: str,
+        objective: Optional[float],
+        solve_record: Optional[dict] = None,
+        problem_summary: Optional[dict] = None,
+        pool: Optional[str] = None,
+    ) -> None:
+        """One planning decision: ``planner_state`` is the PRE-replan
+        :meth:`ShockwavePlanner.state_dict` snapshot (replay re-enters
+        ``_replan`` from it), ``plan`` maps round offset -> scheduled
+        job keys, ``problem_summary`` the solver-facing arrays (job
+        order, forecasts, priorities, switching costs, incumbents)."""
+        if not self.enabled:
+            return
+        # Hot path: queue the snapshot with minimal copying. Everything
+        # state_dict() hands over is either a fresh copy or immutable by
+        # construction EXCEPT each job's throughput_schedule, which the
+        # scheduler keeps appending to — shallow-copy those now; all
+        # slimming/encoding happens at flush().
+        raw = dict(planner_state)
+        raw["job_metadata"] = {
+            job_id: {
+                **md_state,
+                "throughput_schedule": dict(md_state["throughput_schedule"]),
+            }
+            for job_id, md_state in planner_state["job_metadata"].items()
+        }
+        record = {
+            "event": "plan",
+            "round": int(planner_state.get("round_index", 0)),
+            "backend": backend,
+            "objective": objective,
+            "plan": {str(k): [_job_key(j) for j in v] for k, v in plan.items()},
+            "planner_state_raw": raw,
+        }
+        if solve_record is not None:
+            record["solve"] = dict(solve_record)
+        if problem_summary is not None:
+            record["problem"] = problem_summary
+        if pool is not None:
+            record["pool"] = pool
+        self._append(record)
+
+    def record_round_context(
+        self,
+        round_index: int,
+        time_s: float,
+        assignments: dict,
+        job_steps: dict,
+        preempted: Optional[list] = None,
+    ) -> None:
+        """Scheduler-side context for one executed round: worker
+        assignments, per-job step progress, and who got preempted.
+        ``job_steps`` maps job key -> completed steps (richer per-job
+        state lives in the plan records' planner snapshots)."""
+        if not self.enabled:
+            return
+        self._append(
+            {
+                "event": "round_context",
+                "round": int(round_index),
+                "time": float(time_s),
+                "assignments": {
+                    _job_key(k): list(v) for k, v in assignments.items()
+                },
+                "job_steps": {_job_key(k): v for k, v in job_steps.items()},
+                "preempted": [_job_key(k) for k in (preempted or [])],
+            }
+        )
+
+
+# ----------------------------------------------------------------------
+# Reading + replay.
+# ----------------------------------------------------------------------
+def iter_records(path: str) -> Iterator[dict]:
+    """Yield records, skipping a truncated (crash-interrupted) final
+    line; a non-final corrupt line raises — that is data loss, not an
+    interrupted append."""
+    with open(path) as f:
+        lines = f.readlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            yield json.loads(line)
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                return
+            raise ValueError(
+                f"{path}:{i + 1}: corrupt decision record (not the "
+                "final line, so not a truncated append)"
+            )
+
+
+def accumulate_schedules(record: dict, schedules: dict) -> None:
+    """Fold one (already decoded) plan record's delta-encoded
+    throughput tails into the per-job full schedules ``schedules``
+    (job key -> {round: (tput, bs)}). Must be applied to every plan
+    record in file order, including ones the caller will not replay."""
+    for job_id, md_state in record["planner_state"]["job_metadata"].items():
+        ref = md_state.get("__profile_ref__")
+        if ref is None:
+            continue
+        full = schedules.setdefault(ref, {})
+        base = md_state.get("tput_base", 0)
+        if base != len(full):
+            raise ValueError(
+                f"job {ref!r}: plan record expects {base} prior "
+                f"throughput entries, log accumulated {len(full)} — "
+                "records missing or out of order"
+            )
+        for r, t, b in zip(
+            md_state["tput_rounds"],
+            md_state["tput_values"],
+            md_state["tput_bss"],
+        ):
+            full[r] = (t, b)
+
+
+def replay_plan_record(
+    record: dict,
+    profiles: Optional[dict] = None,
+    schedules: Optional[dict] = None,
+) -> dict:
+    """Re-run one recorded planning round offline and diff the plan.
+
+    ``record`` must be pre-decoded (:func:`decode`) with
+    :func:`accumulate_schedules` already applied; ``profiles`` maps job
+    keys to decoded ``job_profile`` payloads and ``schedules`` to the
+    accumulated full throughput schedules (:func:`replay_log` maintains
+    both while scanning). Returns ``{"round", "recorded", "replayed",
+    "diff"}`` where ``diff`` maps round offsets whose job sets disagree
+    to the two sides; an empty ``diff`` means the replay reproduced the
+    decision exactly.
+    """
+    import copy
+
+    from shockwave_tpu.policies.shockwave import planner_from_state
+
+    state = dict(record["planner_state"])
+    resolved = OrderedDict()
+    for job_id, md_state in state["job_metadata"].items():
+        md_state = dict(md_state)
+        ref = md_state.pop("__profile_ref__", None)
+        if ref is not None:
+            if profiles is None or ref not in profiles:
+                raise ValueError(
+                    f"plan record references job_profile {ref!r} not "
+                    "seen earlier in the log"
+                )
+            md_state = _rebuild_metadata_state(
+                profiles[ref],
+                md_state,
+                schedule=copy.deepcopy((schedules or {}).get(ref, {})),
+            )
+        resolved[job_id] = md_state
+    state["job_metadata"] = resolved
+    state["finish_time_estimates"] = {
+        job: (
+            list(zip(history["rounds"], history["estimates"]))
+            if isinstance(history, dict)
+            else list(history)  # inline-state records: already tuples
+        )
+        for job, history in state["finish_time_estimates"].items()
+    }
+    planner = planner_from_state(state)
+    planner._replan()
+    start = planner.round_index
+    replayed = {
+        str(r - start): [_job_key(j) for j in planner.schedules[r]]
+        for r in sorted(planner.schedules)
+        if r >= start
+    }
+    recorded = {k: list(v) for k, v in record["plan"].items()}
+    diff = {}
+    for offset in sorted(set(recorded) | set(replayed), key=int):
+        a = recorded.get(offset, [])
+        b = replayed.get(offset, [])
+        if sorted(a) != sorted(b):
+            diff[offset] = {"recorded": a, "replayed": b}
+    return {
+        "round": record.get("round"),
+        "recorded": recorded,
+        "replayed": replayed,
+        "diff": diff,
+    }
+
+
+def replay_log(path: str, round_index: Optional[int] = None) -> List[dict]:
+    """Replay every ``plan`` record in a decision log (or just those of
+    one planning round) and return the per-record replay results.
+    ``job_profile`` records and the delta-encoded throughput tails are
+    applied in file order — every plan record is scanned even when only
+    one round is replayed."""
+    results = []
+    profiles: dict = {}
+    schedules: dict = {}
+    for record in iter_records(path):
+        event = record.get("event")
+        if event == "job_profile":
+            profiles[record["job"]] = decode(record["profile"])
+            continue
+        if event != "plan":
+            continue
+        record = dict(record)
+        record["planner_state"] = decode(record["planner_state"])
+        accumulate_schedules(record, schedules)
+        if round_index is not None and record.get("round") != round_index:
+            continue
+        results.append(
+            replay_plan_record(
+                record, profiles=profiles, schedules=schedules
+            )
+        )
+    return results
+
+
+def summarize_log(path: str) -> dict:
+    """Cheap structural summary (no replay): record counts, round span,
+    backends, objective range."""
+    plans = 0
+    contexts = 0
+    rounds = []
+    backends = {}
+    objectives = []
+    for record in iter_records(path):
+        event = record.get("event")
+        if event == "plan":
+            plans += 1
+            rounds.append(record.get("round"))
+            backends[record.get("backend")] = (
+                backends.get(record.get("backend"), 0) + 1
+            )
+            if record.get("objective") is not None:
+                objectives.append(record["objective"])
+        elif event == "round_context":
+            contexts += 1
+    return {
+        "plans": plans,
+        "round_contexts": contexts,
+        "first_round": min(rounds) if rounds else None,
+        "last_round": max(rounds) if rounds else None,
+        "backends": backends,
+        "objective_min": min(objectives) if objectives else None,
+        "objective_max": max(objectives) if objectives else None,
+    }
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="Inspect / replay a flight-recorder decision log"
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_sum = sub.add_parser("summary", help="structural summary, no replay")
+    p_sum.add_argument("log")
+    p_rep = sub.add_parser(
+        "replay",
+        help="re-run recorded planning rounds offline and diff the plans",
+    )
+    p_rep.add_argument("log")
+    p_rep.add_argument(
+        "--round", type=int, default=None,
+        help="replay only this planning round",
+    )
+    args = parser.parse_args(argv)
+
+    if args.cmd == "summary":
+        print(json.dumps(summarize_log(args.log), indent=1))
+        return 0
+
+    results = replay_log(args.log, round_index=args.round)
+    mismatched = [r for r in results if r["diff"]]
+    for r in mismatched:
+        print(f"round {r['round']}: plan diverged")
+        for offset, sides in r["diff"].items():
+            print(
+                f"  +{offset}: recorded={sides['recorded']} "
+                f"replayed={sides['replayed']}"
+            )
+    print(
+        f"replayed {len(results)} plan record(s): "
+        f"{len(results) - len(mismatched)} exact, {len(mismatched)} diverged"
+    )
+    return 1 if mismatched else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

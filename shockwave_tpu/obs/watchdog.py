@@ -1,0 +1,367 @@
+"""Scheduler health watchdog: SLO rules over the live metrics registry.
+
+Each round the scheduler (sim and physical) calls
+:meth:`Watchdog.check_round`; the watchdog reads the metrics registry —
+the same series every other consumer sees — plus a small per-round
+context the scheduler supplies (per-job step progress), evaluates its
+rule set, and emits structured ``health`` events:
+
+  * an ``i`` (instant) trace event named ``health`` on the
+    ``scheduler/health`` track, args carrying rule/value/threshold;
+  * a ``scheduler_health_alerts_total{rule}`` counter increment;
+  * the ``scheduler_health`` gauge — 1.0 while every rule is quiet,
+    0.0 on any round that fired.
+
+Rules (all thresholds overridable via a config dict, e.g. the
+``--watchdog-config`` driver flag):
+
+``worst_ftf``        worst finish-time-fairness rho so far above
+                     ``threshold`` (a drifting rho means some job is
+                     being systematically starved).
+``solver_time``      this round's mean plan-solve seconds above
+                     ``blowup_factor`` x the rolling baseline of the
+                     previous ``baseline_window`` solving rounds.
+``straggler``        a job granted workers for ``rounds_without_progress``
+                     consecutive rounds with zero step progress.
+``calibration_mape`` fleet forecast MAPE above ``threshold`` once at
+                     least ``min_forecasts`` forecasts were scored.
+``lease_churn``      preemptions this round >= ``min_preemptions`` AND
+                     above ``spike_factor`` x the rolling per-round mean.
+
+A rule re-fires only when its value worsens past the last fired value
+(no per-round alert spam while a breach persists). Disabled by default
+behind the standard one-attribute-check fast path.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+DEFAULT_RULES: Dict[str, dict] = {
+    "worst_ftf": {"threshold": 2.0},
+    "solver_time": {
+        "baseline_window": 20,
+        "blowup_factor": 3.0,
+        "min_baseline_rounds": 5,
+        "min_seconds": 0.05,
+    },
+    "straggler": {"rounds_without_progress": 3},
+    "calibration_mape": {"threshold": 0.5, "min_forecasts": 5},
+    "lease_churn": {
+        "window": 10,
+        "spike_factor": 3.0,
+        "min_preemptions": 4,
+        "min_history_rounds": 3,
+    },
+}
+
+
+def merge_rules(overrides: Optional[dict]) -> Dict[str, dict]:
+    """Defaults overlaid with per-rule overrides; an override of
+    ``false``/``null`` disables that rule entirely."""
+    rules = {name: dict(cfg) for name, cfg in DEFAULT_RULES.items()}
+    for name, cfg in (overrides or {}).items():
+        if name not in rules:
+            raise ValueError(
+                f"unknown watchdog rule {name!r}; known: "
+                f"{sorted(DEFAULT_RULES)}"
+            )
+        if cfg in (False, None):
+            rules.pop(name)
+        else:
+            rules[name].update(cfg)
+    return rules
+
+
+class Watchdog:
+    def __init__(self, enabled: bool = False, rules: Optional[dict] = None):
+        self.enabled = enabled
+        self.rules = merge_rules(rules)
+        self._lock = threading.Lock()
+        self.alerts: List[dict] = []
+        self._rounds_checked = 0
+        # Rolling state.
+        self._last_counters: Dict[str, float] = {}
+        self._solve_means: deque = deque()
+        self._preemption_deltas: deque = deque()
+        # job -> [last_steps, consecutive scheduled rounds w/o progress]
+        self._progress: Dict[object, list] = {}
+        # Jobs granted workers at the PREVIOUS check: the steps delta a
+        # check observes covers the previous round's execution.
+        self._prev_scheduled: set = set()
+        # rule -> value at last fire (re-fire only on worsening).
+        self._last_fired: Dict[str, float] = {}
+
+    def configure(
+        self, rules: Optional[dict] = None, enabled: bool = True
+    ) -> None:
+        self.rules = merge_rules(rules)
+        self.enabled = enabled
+
+    def reset(self) -> None:
+        self.enabled = False
+        self.rules = merge_rules(None)
+        with self._lock:
+            self.alerts.clear()
+            self._rounds_checked = 0
+            self._last_counters.clear()
+            self._solve_means.clear()
+            self._preemption_deltas.clear()
+            self._progress.clear()
+            self._prev_scheduled.clear()
+            self._last_fired.clear()
+
+    # -- registry access -----------------------------------------------
+    @staticmethod
+    def _snapshot() -> dict:
+        from shockwave_tpu import obs
+
+        return obs.get_registry().snapshot()["metrics"]
+
+    @staticmethod
+    def _gauge_value(metrics: dict, name: str):
+        metric = metrics.get(name)
+        if not metric or not metric["series"]:
+            return None
+        for series in metric["series"]:
+            if not series["labels"]:
+                return series["value"]
+        return None
+
+    @staticmethod
+    def _histogram_totals(metrics: dict, name: str):
+        """(count, sum, max) summed/maxed over every label series."""
+        metric = metrics.get(name)
+        if not metric or not metric["series"]:
+            return 0, 0.0, None
+        count = sum(s["count"] for s in metric["series"])
+        total = sum(s["sum"] for s in metric["series"])
+        maxes = [s["max"] for s in metric["series"] if s["max"] is not None]
+        return count, total, max(maxes) if maxes else None
+
+    @staticmethod
+    def _counter_total(metrics: dict, name: str) -> float:
+        metric = metrics.get(name)
+        if not metric:
+            return 0.0
+        return sum(s["value"] for s in metric["series"])
+
+    # -- evaluation -----------------------------------------------------
+    def check_round(
+        self,
+        round_index: int,
+        now_s: float,
+        job_steps: Optional[Dict[object, int]] = None,
+        scheduled: Optional[list] = None,
+    ) -> List[dict]:
+        """Evaluate every configured rule; returns this round's alerts."""
+        if not self.enabled:
+            return []
+        from shockwave_tpu import obs
+
+        with self._lock:
+            self._rounds_checked += 1
+            metrics = self._snapshot()
+            fired: List[dict] = []
+
+            if "worst_ftf" in self.rules:
+                self._check_worst_ftf(metrics, round_index, fired)
+            if "solver_time" in self.rules:
+                self._check_solver_time(metrics, round_index, fired)
+            if "calibration_mape" in self.rules:
+                self._check_calibration(metrics, round_index, fired)
+            if "lease_churn" in self.rules:
+                self._check_lease_churn(metrics, round_index, fired)
+            if "straggler" in self.rules and job_steps is not None:
+                self._check_stragglers(
+                    job_steps, scheduled or [], round_index, fired
+                )
+
+            for alert in fired:
+                alert["time_s"] = float(now_s)
+                self.alerts.append(alert)
+                obs.counter(
+                    "scheduler_health_alerts_total",
+                    "watchdog SLO rule violations",
+                ).inc(rule=alert["rule"])
+                obs.instant(
+                    "health", cat="health", tid="health",
+                    ts_s=now_s, args=dict(alert),
+                )
+            obs.gauge(
+                "scheduler_health",
+                "1 while every watchdog rule is quiet, 0 on rounds "
+                "with an alert",
+            ).set(0.0 if fired else 1.0)
+            return fired
+
+    def _fire(
+        self, fired: list, rule: str, round_index: int, value: float,
+        threshold: float, **detail,
+    ) -> None:
+        """Append an alert unless this breach already fired at an equal
+        or worse value (hysteresis against per-round spam). Callers
+        must :meth:`_rearm` the rule on rounds where it is back under
+        threshold, so a LATER distinct breach fires again."""
+        last = self._last_fired.get(rule)
+        if last is not None and value <= last:
+            return
+        self._last_fired[rule] = value
+        fired.append(
+            {
+                "rule": rule,
+                "round": int(round_index),
+                "value": round(float(value), 6),
+                "threshold": round(float(threshold), 6),
+                **detail,
+            }
+        )
+
+    def _rearm(self, rule: str) -> None:
+        self._last_fired.pop(rule, None)
+
+    def _check_worst_ftf(self, metrics, round_index, fired) -> None:
+        cfg = self.rules["worst_ftf"]
+        _, _, worst = self._histogram_totals(metrics, "scheduler_job_ftf")
+        if worst is not None and worst > cfg["threshold"]:
+            self._fire(
+                fired, "worst_ftf", round_index, worst, cfg["threshold"]
+            )
+        # NOTE: worst-so-far is monotone, so it never re-arms — by
+        # design, one alert per new worst value.
+
+    def _check_solver_time(self, metrics, round_index, fired) -> None:
+        cfg = self.rules["solver_time"]
+        count, total, _ = self._histogram_totals(
+            metrics, "shockwave_solve_seconds"
+        )
+        d_count = count - self._last_counters.get("solve_count", 0)
+        d_total = total - self._last_counters.get("solve_sum", 0.0)
+        self._last_counters["solve_count"] = count
+        self._last_counters["solve_sum"] = total
+        if d_count <= 0:
+            return  # no solve this round: baseline unchanged
+        mean = d_total / d_count
+        baseline = list(self._solve_means)
+        self._solve_means.append(mean)
+        while len(self._solve_means) > cfg["baseline_window"]:
+            self._solve_means.popleft()
+        if len(baseline) < cfg["min_baseline_rounds"]:
+            return
+        baseline_mean = sum(baseline) / len(baseline)
+        threshold = max(
+            cfg["blowup_factor"] * baseline_mean, cfg["min_seconds"]
+        )
+        if mean > threshold:
+            self._fire(
+                fired, "solver_time", round_index, mean, threshold,
+                baseline_s=round(baseline_mean, 6),
+            )
+        else:
+            self._rearm("solver_time")
+
+    def _check_calibration(self, metrics, round_index, fired) -> None:
+        cfg = self.rules["calibration_mape"]
+        mape = self._gauge_value(metrics, "predictor_calibration_mape")
+        scored = self._gauge_value(metrics, "predictor_calibration_scored")
+        if mape is None or (scored or 0) < cfg["min_forecasts"]:
+            return
+        if mape > cfg["threshold"]:
+            self._fire(
+                fired, "calibration_mape", round_index, mape,
+                cfg["threshold"], forecasts=int(scored),
+            )
+        else:
+            self._rearm("calibration_mape")
+
+    def _check_lease_churn(self, metrics, round_index, fired) -> None:
+        cfg = self.rules["lease_churn"]
+        total = self._counter_total(metrics, "scheduler_preemptions_total")
+        delta = total - self._last_counters.get("preemptions", 0.0)
+        self._last_counters["preemptions"] = total
+        history = list(self._preemption_deltas)
+        self._preemption_deltas.append(delta)
+        while len(self._preemption_deltas) > cfg["window"]:
+            self._preemption_deltas.popleft()
+        if len(history) < cfg["min_history_rounds"]:
+            return
+        baseline = sum(history) / len(history)
+        threshold = max(
+            cfg["spike_factor"] * baseline, cfg["min_preemptions"]
+        )
+        if delta >= cfg["min_preemptions"] and delta > threshold:
+            self._fire(
+                fired, "lease_churn", round_index, delta, threshold,
+                baseline_per_round=round(baseline, 3),
+            )
+        else:
+            self._rearm("lease_churn")
+
+    def _check_stragglers(
+        self, job_steps, scheduled, round_index, fired
+    ) -> None:
+        cfg = self.rules["straggler"]
+        limit = cfg["rounds_without_progress"]
+        for job_id, steps in job_steps.items():
+            state = self._progress.get(job_id)
+            if state is None:
+                self._progress[job_id] = [steps, 0]
+                continue
+            # ANY change counts as progress, not just growth: a
+            # batch-size rescale rewrites the step basis (total steps
+            # SHRINK when bs doubles) and must not read as a stall.
+            if steps != state[0]:
+                state[0] = steps
+                state[1] = 0
+            elif job_id in self._prev_scheduled:
+                # The steps delta observed NOW covers the previous
+                # round's execution, so a stall is attributed to jobs
+                # granted workers in the PREVIOUS check — a job idle
+                # last round trivially made no progress. One alert per
+                # stall episode (the count resets on any progress),
+                # emitted directly: the shared per-rule hysteresis slot
+                # would let one stalled job mask another.
+                state[1] += 1
+                if state[1] == limit:
+                    fired.append(
+                        {
+                            "rule": "straggler",
+                            "round": int(round_index),
+                            "value": float(state[1]),
+                            "threshold": float(limit),
+                            "job_id": str(job_id),
+                        }
+                    )
+        for gone in [j for j in self._progress if j not in job_steps]:
+            del self._progress[gone]
+        self._prev_scheduled = set(scheduled)
+
+    # -- summary --------------------------------------------------------
+    def summary(self) -> dict:
+        with self._lock:
+            by_rule: Dict[str, int] = {}
+            for alert in self.alerts:
+                by_rule[alert["rule"]] = by_rule.get(alert["rule"], 0) + 1
+            return {
+                "healthy": not self.alerts,
+                "alerts": len(self.alerts),
+                "rounds_checked": self._rounds_checked,
+                "by_rule": by_rule,
+            }
+
+    def format_summary(self) -> str:
+        s = self.summary()
+        if s["healthy"]:
+            return (
+                f"Scheduler health: OK "
+                f"({s['rounds_checked']} rounds watched, 0 alerts)"
+            )
+        detail = ", ".join(
+            f"{rule} x{n}" for rule, n in sorted(s["by_rule"].items())
+        )
+        return (
+            f"Scheduler health: DEGRADED — {s['alerts']} alert(s) over "
+            f"{s['rounds_checked']} rounds ({detail})"
+        )

@@ -86,6 +86,9 @@ class ShockwavePlanner:
         # sharded/native/level per problem size — "seconds", "ok",
         # "round", "num_jobs", and "error" on failures}.
         self.solve_records: List[dict] = []
+        # Worker-type tag when owned by a PoolSetPlanner (flight-recorder
+        # records carry it so per-pool decisions stay attributable).
+        self.pool_label: Optional[str] = None
 
     # -- scheduler-facing interface -------------------------------------
     def add_job(
@@ -117,6 +120,11 @@ class ShockwavePlanner:
         md = self.job_metadata.get(job_id)
         if md is not None:
             md.complete(min(int(num_epochs), md.total_epochs))
+
+    def get_metadata(self, job_id) -> Optional[JobMetadata]:
+        """The job's predictor state (calibration scoring reads the
+        live remaining-runtime forecast through this)."""
+        return self.job_metadata.get(job_id)
 
     def increment_round(self) -> None:
         # The round at the cursor has just executed: its jobs are the
@@ -449,6 +457,12 @@ class ShockwavePlanner:
             ).inc(backend=backend)
 
     def _replan(self) -> None:
+        # Flight recorder: snapshot the PRE-replan planner state —
+        # _build_problem appends to the finish-time history it also
+        # reads, so replay must re-enter from exactly this point to
+        # reproduce the priorities (and hence the plan) bit-for-bit.
+        recorder = obs.get_recorder()
+        pre_state = self.state_dict() if recorder.enabled else None
         # Past rounds are never read again; keep the cache bounded.
         for r in [r for r in self.schedules if r < self.round_index]:
             del self.schedules[r]
@@ -499,6 +513,28 @@ class ShockwavePlanner:
                 self.schedules[self.round_index + r] = [
                     job_ids[j] for j in range(len(job_ids)) if Y[j, r]
                 ]
+            if pre_state is not None:
+                recorder.record_plan(
+                    planner_state=pre_state,
+                    plan={
+                        r: list(self.schedules[self.round_index + r])
+                        for r in range(self.future_rounds)
+                    },
+                    backend=backend_used,
+                    objective=float(problem.objective_value(Y)),
+                    solve_record=self.solve_records[-1],
+                    problem_summary={
+                        "job_ids": list(job_ids),
+                        "remaining_runtime_s": problem.remaining_runtime,
+                        "priorities": problem.priorities,
+                        "switch_cost": problem.switch_cost,
+                        "incumbent": problem.incumbent,
+                        "nworkers": problem.nworkers,
+                        "num_gpus": problem.num_gpus,
+                        "future_rounds": problem.future_rounds,
+                    },
+                    pool=self.pool_label,
+                )
 
     def _apply_stickiness(self, Y: np.ndarray, problem: EGProblem) -> np.ndarray:
         """Lease stickiness: pull granted incumbents into the plan's first
@@ -618,6 +654,8 @@ class PoolSetPlanner:
             (wt, ShockwavePlanner({**config, "num_gpus": n}, backend=backend))
             for wt, n in sorted(pools.items())
         )
+        for wt, child in self.children.items():
+            child.pool_label = wt
         self.job_pool: Dict[object, str] = {}
         # Cumulative admissions per pool (observability; the live load
         # used for balancing is pool_incomplete_jobs).
@@ -682,6 +720,10 @@ class PoolSetPlanner:
         if child is not None:
             child.set_progress(job_id, num_epochs)
 
+    def get_metadata(self, job_id):
+        child = self._child_of(job_id)
+        return child.get_metadata(job_id) if child is not None else None
+
     def increment_round(self) -> None:
         for child in self.children.values():
             child.increment_round()
@@ -740,6 +782,8 @@ class PoolSetPlanner:
             (wt, ShockwavePlanner.from_state(cs))
             for wt, cs in state["children"].items()
         )
+        for wt, child in planner.children.items():
+            child.pool_label = wt
         planner.job_pool = dict(state["job_pool"])
         planner.assignments = dict(state.get("assignments", {}))
         return planner

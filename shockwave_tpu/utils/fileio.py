@@ -13,6 +13,49 @@ import os
 import tempfile
 
 
+def read_json_arg(value: str, flag: str):
+    """CLI convention for JSON-valued flags: ``value`` is either a path
+    to a JSON file or a JSON literal. Raises SystemExit with a one-line
+    message naming ``flag`` when it is neither."""
+    import json
+
+    if os.path.exists(value):
+        with open(value) as f:
+            return json.load(f)
+    try:
+        return json.loads(value)
+    except json.JSONDecodeError:
+        raise SystemExit(
+            f"{flag} {value!r} is neither an existing file nor a JSON "
+            "literal"
+        ) from None
+
+
+def atomic_append_text(path: str, text: str) -> None:
+    """Append ``text`` to ``path`` in a single O_APPEND write.
+
+    The append-only counterpart of :func:`atomic_write_text` for
+    grow-only logs (the flight recorder's JSONL decision log): one
+    ``os.write`` on an ``O_APPEND`` descriptor is atomic with respect to
+    concurrent appenders on local filesystems, and a crash mid-call can
+    only lose or truncate the FINAL record — readers that skip a
+    non-parsing last line recover every completed record.
+    """
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        view = memoryview(text.encode("utf-8"))
+        while view:
+            # os.write may write fewer bytes than asked (large batch,
+            # EINTR progress); a silently-dropped tail would corrupt a
+            # middle-of-log record, which readers treat as data loss.
+            written = os.write(fd, view)
+            view = view[written:]
+    finally:
+        os.close(fd)
+
+
 def atomic_write_text(path: str, text: str) -> None:
     directory = os.path.dirname(os.path.abspath(path))
     os.makedirs(directory, exist_ok=True)

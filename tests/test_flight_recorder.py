@@ -1,0 +1,243 @@
+"""Flight recorder: codec round-trips, record -> replay plan equality,
+truncated-log tolerance, and the committed-artifact forensics contract."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from shockwave_tpu import obs
+from shockwave_tpu.core.ids import JobId
+from shockwave_tpu.core.job import Job
+from shockwave_tpu.core.scheduler import Scheduler
+from shockwave_tpu.data.default_oracle import generate_oracle
+from shockwave_tpu.data.profiles import synthesize_profiles
+from shockwave_tpu.data.workload_info import steps_per_epoch
+from shockwave_tpu.obs import recorder as rec
+from shockwave_tpu.policies import get_policy
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT_LOG = os.path.join(
+    REPO_ROOT, "results", "flight_recorder", "decisions.jsonl"
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_obs():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# ----------------------------------------------------------------------
+# JSON codec.
+# ----------------------------------------------------------------------
+class TestCodec:
+    def test_scalar_container_roundtrip(self):
+        from collections import OrderedDict
+
+        original = {
+            "ints": [1, 2, 3],
+            "mixed": [1, "a", None, True],
+            "tuple": (1.5, 2),
+            "int_keys": {3: "x", 7: (1, 2)},
+            "od": OrderedDict([("b", 1), ("a", 2)]),
+            "jobid": JobId(5),
+            "pair": JobId(3, 9),
+        }
+        decoded = rec.decode(json.loads(json.dumps(rec.encode(original))))
+        assert decoded["ints"] == [1, 2, 3]
+        assert decoded["tuple"] == (1.5, 2)
+        assert decoded["int_keys"] == {3: "x", 7: (1, 2)}
+        assert list(decoded["od"]) == ["b", "a"]
+        assert decoded["jobid"] == JobId(5)
+        assert decoded["pair"] == JobId(3, 9)
+
+    def test_ndarray_roundtrip_exact(self):
+        arrays = [
+            np.arange(10, dtype=np.int64),
+            np.linspace(0.1, 9.7, 50),
+            np.array([], dtype=np.float64),
+        ]
+        for arr in arrays:
+            back = rec.decode(json.loads(json.dumps(rec.encode(arr))))
+            assert back.dtype == arr.dtype
+            np.testing.assert_array_equal(back, arr)
+
+    def test_ndarray_rle_kicks_in_and_roundtrips(self):
+        # Long constant runs (the epoch-profile shape) must RLE...
+        arr = np.repeat(np.array([5.0, 3.0, 5.0]), [4000, 2000, 1000])
+        encoded = rec.encode(arr)
+        assert "__ndrle__" in encoded
+        assert len(encoded["runs"]) == 6  # 3 runs x (value, count)
+        back = rec.decode(json.loads(json.dumps(encoded)))
+        assert back.dtype == arr.dtype
+        np.testing.assert_array_equal(back, arr)
+        # ...while high-entropy arrays stay verbatim.
+        noisy = np.arange(100, dtype=np.float64)
+        assert "__nd__" in rec.encode(noisy)
+
+    def test_unencodable_type_raises(self):
+        with pytest.raises(TypeError):
+            rec.encode(object())
+
+
+# ----------------------------------------------------------------------
+# Record -> replay on a fixed-seed sim.
+# ----------------------------------------------------------------------
+def _tiny_jobs(num_jobs=4, epochs=3):
+    jobs, arrivals = [], []
+    for _ in range(num_jobs):
+        jobs.append(
+            Job(
+                job_type="ResNet-18 (batch size 32)",
+                command="python3 main.py --data_dir=%s/cifar10 --batch_size 32",
+                num_steps_arg="--num_steps",
+                total_steps=steps_per_epoch("ResNet-18", 32) * epochs,
+                scale_factor=1,
+                mode="static",
+            )
+        )
+        arrivals.append(0.0)
+    return jobs, arrivals
+
+
+def _run_shockwave_sim(num_gpus=2):
+    jobs, arrivals = _tiny_jobs()
+    oracle = generate_oracle()
+    profiles = synthesize_profiles(jobs, oracle)
+    sched = Scheduler(
+        get_policy("shockwave_tpu"),
+        throughputs=oracle,
+        seed=0,
+        time_per_iteration=120,
+        profiles=profiles,
+        shockwave_config={
+            "num_gpus": num_gpus,
+            "time_per_iteration": 120,
+            "future_rounds": 6,
+            "lambda": 2.0,
+            "k": 1e-3,
+        },
+    )
+    makespan = sched.simulate({"v100": num_gpus}, arrivals, jobs)
+    return sched, makespan
+
+
+def test_record_then_replay_reproduces_every_plan(tmp_path):
+    log = str(tmp_path / "decisions.jsonl")
+    obs.configure_recorder(log)
+    _, makespan = _run_shockwave_sim()
+    assert makespan > 0
+    obs.get_recorder().close()
+
+    results = rec.replay_log(log)
+    assert results, "no plan records recorded"
+    for result in results:
+        assert result["diff"] == {}, (
+            f"round {result['round']} diverged: {result['diff']}"
+        )
+    # The recorded plans are non-trivial (some round schedules jobs).
+    assert any(any(v for v in r["recorded"].values()) for r in results)
+
+
+def test_log_carries_context_and_solve_attribution(tmp_path):
+    log = str(tmp_path / "decisions.jsonl")
+    obs.configure_recorder(log)
+    _run_shockwave_sim()
+    obs.get_recorder().close()
+
+    records = list(rec.iter_records(log))
+    assert records[0] == {"event": "header", "schema": rec.SCHEMA}
+    events = {r["event"] for r in records}
+    assert {"plan", "round_context", "job_profile"} <= events
+    for r in records:
+        if r["event"] != "plan":
+            continue
+        # Every plan names the backend that actually solved it and its
+        # problem summary (the "why" data).
+        assert r["backend"] in ("native", "level", "sharded")
+        assert r["solve"]["ok"] is True
+        assert "problem" in r and "objective" in r
+    ctx = next(r for r in records if r["event"] == "round_context")
+    assert "assignments" in ctx and "job_steps" in ctx
+
+
+def test_replay_summary_cli(tmp_path):
+    log = str(tmp_path / "decisions.jsonl")
+    obs.configure_recorder(log)
+    _run_shockwave_sim()
+    obs.get_recorder().close()
+    obs.reset()  # replay below must not re-record
+
+    summary = rec.summarize_log(log)
+    assert summary["plans"] >= 1
+    assert summary["backends"]
+    assert rec.main(["summary", log]) == 0
+    assert rec.main(["replay", log]) == 0
+
+
+def test_truncated_final_line_is_tolerated(tmp_path):
+    log = str(tmp_path / "decisions.jsonl")
+    obs.configure_recorder(log)
+    _run_shockwave_sim()
+    obs.get_recorder().close()
+    with open(log, "rb") as f:
+        data = f.read()
+    truncated = str(tmp_path / "truncated.jsonl")
+    with open(truncated, "wb") as f:
+        f.write(data[: len(data) - 40])  # chop inside the last record
+    complete = list(rec.iter_records(log))
+    recovered = list(rec.iter_records(truncated))
+    assert len(recovered) == len(complete) - 1
+
+    # A corrupt NON-final line is data loss and must raise.
+    lines = data.decode().splitlines()
+    lines[1] = lines[1][:10]
+    corrupt = str(tmp_path / "corrupt.jsonl")
+    with open(corrupt, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with pytest.raises(ValueError, match="corrupt decision record"):
+        list(rec.iter_records(corrupt))
+
+
+def test_disabled_recorder_writes_nothing(tmp_path):
+    _run_shockwave_sim()
+    assert os.listdir(str(tmp_path)) == []
+    assert obs.get_recorder().num_records == 0
+
+
+# ----------------------------------------------------------------------
+# The committed artifact: replaying the checked-in 12-job decision log
+# must reproduce every plan exactly (the forensics contract cannot rot).
+# ----------------------------------------------------------------------
+def test_committed_decision_log_replays_exactly():
+    results = rec.replay_log(ARTIFACT_LOG)
+    assert len(results) >= 5, "artifact log has suspiciously few plans"
+    for result in results:
+        assert result["diff"] == {}, (
+            f"round {result['round']} diverged: {result['diff']}"
+        )
+
+
+def test_committed_decision_log_cli_summary():
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "shockwave_tpu.obs.recorder",
+            "summary",
+            ARTIFACT_LOG,
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    summary = json.loads(out.stdout)
+    assert summary["plans"] >= 5
+    assert summary["round_contexts"] >= 10

@@ -99,6 +99,35 @@ class TestMetricsRegistry:
         assert "lat_seconds_count 1" in text
         assert "lat_seconds_sum 0.25" in text
 
+    def test_histogram_renders_prometheus_buckets(self):
+        reg = MetricsRegistry(enabled=True)
+        h = reg.histogram("lat_seconds", "latency")
+        for v in (0.003, 0.2, 0.2, 7.0, 1e9):  # 1e9 beyond all bounds
+            h.observe(v)
+        text = reg.render_text()
+        assert "# TYPE lat_seconds histogram" in text
+        # Cumulative le series, including +Inf == _count.
+        assert 'lat_seconds_bucket{le="0.005"} 1' in text
+        assert 'lat_seconds_bucket{le="0.25"} 3' in text
+        assert 'lat_seconds_bucket{le="10.0"} 4' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 5' in text
+        assert "lat_seconds_count 5" in text
+        # min/max live in sibling gauge families, not the histogram.
+        assert "# TYPE lat_seconds_min gauge" in text
+        assert "# TYPE lat_seconds_max gauge" in text
+        # le composes with user labels.
+        h.observe(0.001, backend="x")
+        labeled = reg.render_text()
+        assert 'lat_seconds_bucket{backend="x",le="0.001"} 1' in labeled
+
+    def test_custom_buckets_and_snapshot_cumulativity(self):
+        reg = MetricsRegistry(enabled=True)
+        h = reg.histogram("q_seconds", buckets=[1.0, 2.0])
+        for v in (0.5, 1.5, 3.0):
+            h.observe(v)
+        series = reg.snapshot()["metrics"]["q_seconds"]["series"][0]
+        assert series["buckets"] == {"1.0": 1, "2.0": 2, "+Inf": 3}
+
     def test_module_helpers_null_when_disabled(self):
         # Disabled module-level accessors hand back the null instrument:
         # no state accumulates even if the handle is retained.
@@ -391,6 +420,74 @@ def test_dump_metrics_rpc_round_trip():
         server.stop(grace=0)
     assert "scheduler_rounds_total 3.0" in text
     assert "# TYPE scheduler_rounds_total counter" in text
+
+
+def test_dump_metrics_rpc_under_concurrent_writers():
+    """A client scraping /metrics while scheduler threads mutate the
+    registry (new instruments, new label series, bucket updates) must
+    always get a complete, well-formed exposition — the lock hands the
+    renderer a consistent snapshot, never a half-updated one."""
+    import threading
+
+    from shockwave_tpu.runtime.rpc import scheduler_server
+    from shockwave_tpu.runtime.rpc.worker_client import WorkerRpcClient
+    from shockwave_tpu.utils.hostenv import free_port
+
+    obs.configure(metrics=True)
+    stop = threading.Event()
+    errors = []
+
+    def writer(tid):
+        i = 0
+        try:
+            while not stop.is_set():
+                obs.counter("w_total", "writes").inc(tid=str(tid))
+                obs.histogram("w_seconds", "latency").observe(
+                    (i % 100) / 10.0, tid=str(tid)
+                )
+                obs.gauge("w_gauge", "g").set(i, tid=str(tid))
+                obs.counter(f"w_churn_{i % 7}_total", "churn").inc()
+                i += 1
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    port = free_port()
+    server = scheduler_server.serve(
+        port, {"dump_metrics": obs.render_prometheus}
+    )
+    threads = [
+        threading.Thread(target=writer, args=(t,), daemon=True)
+        for t in range(3)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        client = WorkerRpcClient("127.0.0.1", port)
+        last_count = -1.0
+        for _ in range(25):
+            text = client.dump_metrics()
+            # Well-formed: every non-comment line is "name[{labels}] value".
+            for line in text.strip().splitlines():
+                if line.startswith("#"):
+                    continue
+                name_part, value = line.rsplit(" ", 1)
+                float(value)
+                assert name_part[0].isalpha(), line
+            # Monotone counter across scrapes (sum over writer series).
+            totals = [
+                float(line.rsplit(" ", 1)[1])
+                for line in text.splitlines()
+                if line.startswith("w_total{")
+            ]
+            if totals:
+                assert sum(totals) >= last_count
+                last_count = sum(totals)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        server.stop(grace=0)
+    assert not errors
 
 
 # ----------------------------------------------------------------------

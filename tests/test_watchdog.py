@@ -1,0 +1,301 @@
+"""Health watchdog + calibration tracker: rule firing on injected
+failure scenarios, silence on clean runs, and calibration scoring
+math."""
+
+import pytest
+
+from shockwave_tpu import obs
+from shockwave_tpu.obs.watchdog import DEFAULT_RULES, Watchdog, merge_rules
+from shockwave_tpu.predictor.metadata import JobMetadata
+
+
+@pytest.fixture(autouse=True)
+def clean_obs():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def make_watchdog(**overrides):
+    obs.configure(metrics=True)
+    wd = Watchdog(enabled=True, rules=overrides or None)
+    return wd
+
+
+# ----------------------------------------------------------------------
+# Rule configuration.
+# ----------------------------------------------------------------------
+class TestRuleConfig:
+    def test_defaults_merge_and_override(self):
+        rules = merge_rules({"worst_ftf": {"threshold": 1.5}})
+        assert rules["worst_ftf"]["threshold"] == 1.5
+        assert rules["straggler"] == DEFAULT_RULES["straggler"]
+
+    def test_unknown_rule_rejected(self):
+        with pytest.raises(ValueError, match="unknown watchdog rule"):
+            merge_rules({"made_up": {}})
+
+    def test_rule_disabled_with_false(self):
+        rules = merge_rules({"worst_ftf": False})
+        assert "worst_ftf" not in rules
+
+
+# ----------------------------------------------------------------------
+# Injected failure scenarios (the acceptance scenarios).
+# ----------------------------------------------------------------------
+class TestInjectedScenarios:
+    def test_straggler_fires_after_no_progress_rounds(self):
+        wd = make_watchdog()
+        limit = DEFAULT_RULES["straggler"]["rounds_without_progress"]
+        # Job 1 progresses; job 2 is granted workers but never moves.
+        steps = {1: 0, 2: 100}
+        alerts = []
+        for r in range(limit + 2):
+            steps = {1: steps[1] + 50, 2: 100}
+            alerts += wd.check_round(r, r * 60.0, steps, scheduled=[1, 2])
+        stragglers = [a for a in alerts if a["rule"] == "straggler"]
+        assert len(stragglers) == 1  # one alert per stall episode
+        assert stragglers[0]["job_id"] == "2"
+        assert not [a for a in alerts if a["rule"] != "straggler"]
+
+    def test_straggler_ignores_unscheduled_and_rescaled_jobs(self):
+        wd = make_watchdog()
+        alerts = []
+        steps = 1000
+        for r in range(8):
+            # Batch-size rescale SHRINKS the step counter mid-run; any
+            # change must count as progress, and a job with no workers
+            # must never read as stalled.
+            steps = steps - 100 if r == 3 else steps
+            alerts += wd.check_round(
+                r, r * 60.0, {1: steps, 2: 0}, scheduled=[1] if r == 3 else []
+            )
+        assert alerts == []
+
+    def test_solver_slowdown_fires_against_rolling_baseline(self):
+        wd = make_watchdog()
+        hist = obs.histogram("shockwave_solve_seconds", "t")
+        alerts = []
+        for r in range(8):
+            hist.observe(0.2, backend="level", ok="True")
+            alerts += wd.check_round(r, r * 60.0)
+        assert alerts == []
+        hist.observe(5.0, backend="level", ok="True")  # 25x blowup
+        alerts = wd.check_round(8, 480.0)
+        assert [a["rule"] for a in alerts] == ["solver_time"]
+        assert alerts[0]["value"] > alerts[0]["threshold"]
+        assert alerts[0]["baseline_s"] == pytest.approx(0.2)
+
+    def test_worst_ftf_fires_and_rearms_only_on_worsening(self):
+        wd = make_watchdog()
+        ftf = obs.histogram("scheduler_job_ftf", "rho")
+        ftf.observe(1.2)
+        assert wd.check_round(0, 0.0) == []
+        ftf.observe(2.5)
+        assert [a["rule"] for a in wd.check_round(1, 60.0)] == ["worst_ftf"]
+        # Same breach value: no per-round spam...
+        assert wd.check_round(2, 120.0) == []
+        # ...but a worse value re-fires.
+        ftf.observe(3.5)
+        assert [a["rule"] for a in wd.check_round(3, 180.0)] == ["worst_ftf"]
+
+    def test_lease_churn_spike_fires(self):
+        wd = make_watchdog()
+        preemptions = obs.counter("scheduler_preemptions_total", "p")
+        alerts = []
+        for r in range(6):
+            preemptions.inc(1)
+            alerts += wd.check_round(r, r * 60.0)
+        assert alerts == []
+        preemptions.inc(20)  # churn spike
+        alerts = wd.check_round(6, 360.0)
+        assert [a["rule"] for a in alerts] == ["lease_churn"]
+
+    def test_calibration_mape_rule_respects_min_forecasts(self):
+        wd = make_watchdog()
+        obs.gauge("predictor_calibration_mape", "m").set(0.9)
+        obs.gauge("predictor_calibration_scored", "n").set(3)
+        assert wd.check_round(0, 0.0) == []  # below min_forecasts
+        obs.gauge("predictor_calibration_scored", "n").set(50)
+        alerts = wd.check_round(1, 60.0)
+        assert [a["rule"] for a in alerts] == ["calibration_mape"]
+
+    def test_alerts_emit_health_series_and_events(self):
+        obs.configure(trace=True)
+        wd = make_watchdog()
+        obs.histogram("scheduler_job_ftf", "rho").observe(9.0)
+        wd.check_round(0, 12.0)
+        snap = obs.get_registry().snapshot()["metrics"]
+        assert snap["scheduler_health"]["series"][0]["value"] == 0.0
+        alerts = snap["scheduler_health_alerts_total"]["series"]
+        assert {s["labels"]["rule"]: s["value"] for s in alerts} == {
+            "worst_ftf": 1.0
+        }
+        events = obs.get_tracer().export_dict()["traceEvents"]
+        health = [e for e in events if e.get("name") == "health"]
+        assert len(health) == 1
+        assert health[0]["args"]["rule"] == "worst_ftf"
+        assert health[0]["ts"] == pytest.approx(12.0 * 1e6)
+        # A quiet round flips the gauge back to healthy.
+        wd.check_round(1, 60.0)
+        snap = obs.get_registry().snapshot()["metrics"]
+        assert snap["scheduler_health"]["series"][0]["value"] == 1.0
+
+    def test_summary_formats(self):
+        wd = make_watchdog()
+        assert "OK" in wd.format_summary()
+        obs.histogram("scheduler_job_ftf", "rho").observe(9.0)
+        wd.check_round(0, 0.0)
+        text = wd.format_summary()
+        assert "DEGRADED" in text and "worst_ftf x1" in text
+
+
+# ----------------------------------------------------------------------
+# Clean end-to-end run: watchdog stays silent.
+# ----------------------------------------------------------------------
+def test_watchdog_silent_on_clean_sim():
+    from tests.test_flight_recorder import _run_shockwave_sim
+
+    obs.configure_watchdog(None)
+    obs.get_calibration().enabled = True
+    _run_shockwave_sim()
+    summary = obs.get_watchdog().summary()
+    assert summary["healthy"], summary
+    assert summary["rounds_checked"] > 0
+
+
+# ----------------------------------------------------------------------
+# Calibration tracker.
+# ----------------------------------------------------------------------
+class TestCalibration:
+    def test_scoring_math(self):
+        obs.configure(metrics=True)
+        cal = obs.get_calibration()
+        cal.enabled = True
+        # Forecast at t0 (0 run-seconds): predicts 100s, realized 80s.
+        cal.record_forecast(7, 0.0, 100.0, lo_s=70.0, hi_s=130.0)
+        # Forecast at 50 run-seconds: predicts 30s, realized 30s.
+        cal.record_forecast(7, 50.0, 30.0, lo_s=20.0, hi_s=40.0)
+        cal.record_outcome(7, 80.0)
+        snap = cal.snapshot()["jobs"]["7"]
+        assert snap["forecasts"] == 2
+        assert snap["bias_s"] == pytest.approx((20.0 + 0.0) / 2)
+        assert snap["mape"] == pytest.approx((20.0 / 80.0 + 0.0) / 2)
+        # Both realized remainders (80 and 30) land inside their
+        # intervals ([70,130] and [20,40]).
+        assert snap["coverage"] == 1.0
+
+    def test_coverage_counts_interval_hits(self):
+        obs.configure(metrics=True)
+        cal = obs.get_calibration()
+        cal.enabled = True
+        cal.record_forecast(1, 0.0, 100.0, lo_s=90.0, hi_s=110.0)  # miss
+        cal.record_forecast(1, 0.0, 100.0, lo_s=10.0, hi_s=300.0)  # hit
+        cal.record_outcome(1, 150.0)
+        snap = cal.snapshot()["jobs"]["1"]
+        assert snap["coverage"] == 0.5
+        metrics = obs.get_registry().snapshot()["metrics"]
+        series = {
+            s["labels"]["covered"]: s["value"]
+            for s in metrics["predictor_interval_total"]["series"]
+        }
+        assert series == {"True": 1.0, "False": 1.0}
+
+    def test_ape_floor_damps_near_completion_artifacts(self):
+        obs.configure(metrics=True)
+        cal = obs.get_calibration()
+        cal.enabled = True
+        # 1s of realized remainder vs a 50s forecast would be APE 49
+        # without the floor; with a 100s epoch floor it is 0.49.
+        cal.record_forecast(2, 99.0, 50.0, ape_floor_s=100.0)
+        cal.record_outcome(2, 100.0)
+        assert cal.snapshot()["jobs"]["2"]["mape"] == pytest.approx(0.49)
+
+    def test_discard_drops_unjudgeable_forecasts(self):
+        obs.configure(metrics=True)
+        cal = obs.get_calibration()
+        cal.enabled = True
+        cal.record_forecast(3, 0.0, 100.0)
+        cal.discard(3)
+        cal.record_outcome(3, 10.0)  # nothing pending: no series
+        assert cal.snapshot()["jobs"] == {}
+
+    def test_disabled_tracker_is_inert(self):
+        cal = obs.get_calibration()
+        cal.record_forecast(1, 0.0, 10.0)
+        cal.record_outcome(1, 10.0)
+        assert cal.snapshot() == {"jobs": {}, "pending": {}}
+
+    def test_sim_publishes_calibration_series(self):
+        from tests.test_flight_recorder import _run_shockwave_sim
+
+        obs.configure(metrics=True)
+        obs.get_calibration().enabled = True
+        _run_shockwave_sim()
+        metrics = obs.get_registry().snapshot()["metrics"]
+        for name in (
+            "predictor_forecast_error_seconds",
+            "predictor_forecast_ape",
+            "predictor_calibration_mape",
+            "predictor_calibration_coverage",
+            "predictor_job_mape",
+        ):
+            assert metrics[name]["series"], f"missing series {name}"
+        # Static jobs at oracle throughput: the predictor must be tight.
+        assert (
+            metrics["predictor_calibration_mape"]["series"][0]["value"]
+            < 0.10
+        )
+        assert (
+            metrics["predictor_calibration_coverage"]["series"][0]["value"]
+            > 0.9
+        )
+
+
+# ----------------------------------------------------------------------
+# The credible interval on JobMetadata.
+# ----------------------------------------------------------------------
+class TestRemainingRuntimeInterval:
+    def _md(self, bs_pattern, durations, round_s=60.0):
+        return JobMetadata(
+            {
+                "num_epochs": len(bs_pattern),
+                "num_samples_per_epoch": 1000,
+                "bs_every_epoch": list(bs_pattern),
+                "duration_every_epoch": list(durations),
+            },
+            round_s,
+            1,
+        )
+
+    def test_interval_brackets_mean_and_orders(self):
+        md = self._md([32] * 5 + [64] * 5, [10.0] * 5 + [6.0] * 5)
+        md.complete(2)
+        mean = md.remaining_runtime()
+        lo, hi = md.remaining_runtime_interval()
+        assert lo <= mean <= hi
+        assert lo >= 0.0
+        assert hi - lo > 0.0  # never degenerate for an unfinished job
+
+    def test_single_regime_floor_keeps_interval_usable(self):
+        md = self._md([32] * 6, [10.0] * 6)
+        md.complete(1)
+        lo, hi = md.remaining_runtime_interval()
+        # Dirichlet variance is zero; the floor (one epoch duration)
+        # still leaves room for rounding/rescale error.
+        assert hi - lo >= 2 * md.mean_epoch_duration() - 1e-9
+
+    def test_remaining_runtime_to_completion_adds_in_progress_epoch(self):
+        md = self._md([32] * 4, [10.0] * 4)
+        md.complete(1)
+        base = md.remaining_runtime()
+        # No processing into epoch 1 yet: a full epoch is outstanding.
+        assert md.remaining_runtime_to_completion(10.0) == pytest.approx(
+            base + 10.0
+        )
+        # Halfway through the in-progress epoch.
+        assert md.remaining_runtime_to_completion(15.0) == pytest.approx(
+            base + 5.0
+        )
+        md.complete(4)
+        assert md.remaining_runtime_to_completion(40.0) == 0.0
