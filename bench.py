@@ -34,6 +34,7 @@ import statistics
 import time
 
 import numpy as np
+from shockwave_tpu.utils.fileio import atomic_write_json
 
 RUNS = 5
 
@@ -304,8 +305,7 @@ def main():
         }
     )
     os.makedirs(os.path.dirname(hist_path), exist_ok=True)
-    with open(hist_path, "w") as f:
-        json.dump(history, f, indent=2)
+    atomic_write_json(hist_path, history)
 
     print(json.dumps(record))
 

@@ -33,6 +33,7 @@ sys.path.insert(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ),
 )
+from shockwave_tpu.utils.fileio import atomic_write_json  # noqa: E402
 
 REFERENCE_TRACE = (
     "/root/reference/scheduler/traces/shockwave/"
@@ -237,8 +238,7 @@ def main(argv=None):
                     )
         out["quantization_decomposition"] = decomp
 
-    with open(args.output, "w") as f:
-        json.dump(out, f, indent=1)
+    atomic_write_json(args.output, out, indent=1)
     print(f"wrote {args.output}")
 
 

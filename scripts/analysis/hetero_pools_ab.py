@@ -26,6 +26,7 @@ sys.path.insert(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ),
 )
+from shockwave_tpu.utils.fileio import atomic_write_json  # noqa: E402
 
 CLUSTER = {"v100": 8, "p100": 4, "k80": 4}
 
@@ -109,8 +110,7 @@ def main(argv=None):
         ),
     }
     os.makedirs(os.path.dirname(args.output), exist_ok=True)
-    with open(args.output, "w") as f:
-        json.dump(out, f, indent=2)
+    atomic_write_json(args.output, out)
     print(json.dumps(out, indent=2))
     print(f"wrote {args.output}")
 

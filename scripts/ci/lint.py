@@ -1,0 +1,63 @@
+#!/usr/bin/env python3
+"""Standing static-analysis gate: shockwave-lint with a ratchet.
+
+Runs ``shockwave_tpu.analysis`` over the default enforcement scope
+(``shockwave_tpu/``, ``scripts/``, ``bench.py``) against the committed
+baseline (``lint_baseline.json``) and exits non-zero when either
+direction of the ratchet is violated:
+
+  exit 1  NEW findings — code introduced a violation the baseline does
+          not accept. Fix it, or suppress the line with a justified
+          ``# shockwave-lint: disable=<rule>`` comment.
+  exit 2  STALE baseline — findings the baseline still carries were
+          fixed, so the committed debt ledger can shrink but didn't.
+          Regenerate it (only ever smaller) with
+          ``python -m shockwave_tpu.analysis --write-baseline``.
+
+Usage (the standing gate; see docs/USAGE.md "Static analysis"):
+  python scripts/ci/lint.py [--json]
+
+This is the same check tier-1 enforces via
+``tests/test_analysis.py::test_repo_is_clean_against_baseline``; the
+script form exists for CI pipelines and pre-push hooks that want the
+finding list on stdout without a pytest run.
+"""
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+sys.path.insert(0, REPO_ROOT)
+
+from shockwave_tpu.analysis.cli import main  # noqa: E402
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="shockwave-lint CI gate (ratcheting baseline)"
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    args = parser.parse_args()
+    argv = ["--json"] if args.json else []
+    rc = main(argv)
+    if rc == 0:
+        print("lint gate PASS: no new findings, baseline exact")
+    elif rc == 1:
+        print(
+            "lint gate FAIL: new findings (fix, or suppress with a "
+            "justified `# shockwave-lint: disable=<rule>` comment)",
+            file=sys.stderr,
+        )
+    elif rc == 2:
+        print(
+            "lint gate FAIL: stale baseline — debt was paid down; "
+            "shrink the ledger with "
+            "`python -m shockwave_tpu.analysis --write-baseline`",
+            file=sys.stderr,
+        )
+    sys.exit(rc)

@@ -42,6 +42,7 @@ from shockwave_tpu.data.default_oracle import generate_oracle  # noqa: E402
 from shockwave_tpu.data.generate import generate_trace_jobs  # noqa: E402
 from shockwave_tpu.data.profiles import synthesize_profiles  # noqa: E402
 from shockwave_tpu.policies import get_policy  # noqa: E402
+from shockwave_tpu.utils.fileio import atomic_write_json
 
 BLIND = "max_sum_throughput_normalized_by_cost_perf"
 AWARE = "max_sum_throughput_normalized_by_cost_perf_SLOs"
@@ -154,8 +155,7 @@ def main(argv=None):
         "winning_cells": len(wins),
     }
     os.makedirs(os.path.dirname(args.output), exist_ok=True)
-    with open(args.output, "w") as f:
-        json.dump(out, f, indent=1)
+    atomic_write_json(args.output, out, indent=1)
     print(f"wrote {args.output}; {len(wins)}/{len(cells)} cells with "
           "strictly fewer violations under steering")
 

@@ -32,6 +32,8 @@ REPO = os.path.dirname(
 )
 sys.path.insert(0, REPO)
 
+from shockwave_tpu.utils.fileio import atomic_write_json  # noqa: E402
+
 _CHILD = r"""
 import json, sys, time
 t_import0 = time.time()
@@ -109,8 +111,7 @@ def main(argv=None):
         ),
     }
     assert parity, (cold, warmed)
-    with open(args.output, "w") as f:
-        json.dump(out, f, indent=1)
+    atomic_write_json(args.output, out, indent=1)
     print(json.dumps(out, indent=1))
     print(f"wrote {args.output}")
 

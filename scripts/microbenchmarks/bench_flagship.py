@@ -30,6 +30,7 @@ sys.path.insert(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ),
 )
+from shockwave_tpu.utils.fileio import atomic_write_json  # noqa: E402
 
 # Advertised dense bf16 peak FLOP/s per chip, for the MFU estimate.
 _PEAK_FLOPS = {
@@ -287,8 +288,7 @@ def main(argv=None):
                 results["runs"].append(row)
                 print(json.dumps(row))
     if args.output:
-        with open(args.output, "w") as f:
-            json.dump(results, f, indent=1)
+        atomic_write_json(args.output, results, indent=1)
         print(f"wrote {args.output}")
 
 

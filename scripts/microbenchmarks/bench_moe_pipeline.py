@@ -59,6 +59,7 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
+from shockwave_tpu.utils.fileio import atomic_write_json
 
 PRESETS = {
     # The flagship single-chip shape (110M-params tier on a v5e).
@@ -501,8 +502,7 @@ def main(argv=None):
     # MoE variants' donated states and cleared jit caches, the same
     # section read up to 6x noisier (heap churn skews the slope chain).
     bench_pipeline(out, shape)
-    with open(args.output, "w") as f:
-        json.dump(out, f, indent=1)
+    atomic_write_json(args.output, out, indent=1)
 
     dense = bench_lm("dense_dff%d" % d_ff, shape, 0, d_ff, d_ff, out)
     bench_lm("moe2_dff%d_matched_params" % (d_ff // 2), shape, 2,
@@ -545,8 +545,7 @@ def main(argv=None):
         and all(out["loss_parity"]["per_variant_ok"].values())
     )
 
-    with open(args.output, "w") as f:
-        json.dump(out, f, indent=1)
+    atomic_write_json(args.output, out, indent=1)
 
     # Multi-stage wall-clock needs >= 4 devices; re-exec on the
     # 8-virtual-CPU-device recipe when this process can't see them
@@ -561,8 +560,7 @@ def main(argv=None):
             "shape), stage axis sharded over a real 'pipe' mesh axis"
         )
 
-    with open(args.output, "w") as f:
-        json.dump(out, f, indent=1)
+    atomic_write_json(args.output, out, indent=1)
     print(f"wrote {args.output}")
 
 

@@ -26,6 +26,7 @@ sys.path.insert(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ),
 )
+from shockwave_tpu.utils.fileio import atomic_write_json  # noqa: E402
 
 # (num_gpus, future_rounds, num_jobs): budget = gpus * rounds grants.
 CONFIGS = [
@@ -92,8 +93,7 @@ def main():
         "platform": jax.devices()[0].platform,
         "results": results,
     }
-    with open(args.out, "w") as f:
-        json.dump(out, f, indent=2)
+    atomic_write_json(args.out, out)
     print(f"merged grant_batch_sweep into {args.out}", file=sys.stderr)
 
 

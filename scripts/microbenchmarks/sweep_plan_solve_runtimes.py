@@ -28,6 +28,7 @@ sys.path.insert(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ),
 )
+from shockwave_tpu.utils.fileio import atomic_write_json  # noqa: E402
 
 DEFAULT_NUM_JOBS = [64, 128, 256, 512, 1024, 2048]
 
@@ -126,8 +127,7 @@ def main(args):
         "gap_reference": gap_reference,
     }
     os.makedirs(os.path.dirname(args.output) or ".", exist_ok=True)
-    with open(args.output, "w") as f:
-        json.dump(artifact, f, indent=2)
+    atomic_write_json(args.output, artifact)
     print(f"Wrote {args.output}")
 
 

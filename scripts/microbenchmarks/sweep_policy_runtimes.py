@@ -36,6 +36,7 @@ from shockwave_tpu.core.ids import JobId
 from shockwave_tpu.data.default_oracle import generate_oracle
 from shockwave_tpu.data.generate import GAVEL_SCALE_FACTOR_DIST, generate_job
 from shockwave_tpu.policies import get_policy
+from shockwave_tpu.utils.fileio import atomic_write_json
 
 DEFAULT_POLICIES = [
     "fifo",
@@ -185,16 +186,14 @@ def main(args):
             results[policy_name][str(num_jobs)] = round(seconds, 4)
             print(f"{policy_name:>40} n={num_jobs:>4}: {seconds:.4f} s")
     os.makedirs(os.path.dirname(args.output) or ".", exist_ok=True)
-    with open(args.output, "w") as f:
-        json.dump(
-            {
-                "config": "3 worker types, n//4 workers each, "
-                f"{args.num_trials} trials, mean seconds per get_allocation",
-                "results": results,
-            },
-            f,
-            indent=2,
-        )
+    atomic_write_json(
+        args.output,
+        {
+            "config": "3 worker types, n//4 workers each, "
+            f"{args.num_trials} trials, mean seconds per get_allocation",
+            "results": results,
+        },
+    )
     print(f"Wrote {args.output}")
 
 

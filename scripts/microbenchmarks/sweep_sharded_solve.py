@@ -33,6 +33,7 @@ sys.path.insert(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ),
 )
+from shockwave_tpu.utils.fileio import atomic_write_json  # noqa: E402
 
 
 def main():
@@ -121,8 +122,7 @@ def main():
     key = platform if args.jobs == 16384 else f"{platform}_{args.jobs}"
     out[key] = entry
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    with open(args.out, "w") as f:
-        json.dump(out, f, indent=2)
+    atomic_write_json(args.out, out)
     print(f"wrote {args.out} [{key}]", file=sys.stderr)
 
 

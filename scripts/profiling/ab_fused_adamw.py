@@ -36,6 +36,7 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
+from shockwave_tpu.utils.fileio import atomic_write_json
 
 BATCH, SEQ, D_MODEL, HEADS, LAYERS, VOCAB = 8, 2048, 1024, 16, 8, 8192
 PAIRS = 8
@@ -156,8 +157,7 @@ def main(argv=None):
         "fused_faster_count": sum(d > 0 for d in deltas),
         "pairs_total": PAIRS,
     }
-    with open(args.output, "w") as f:
-        json.dump(out, f, indent=1)
+    atomic_write_json(args.output, out, indent=1)
     print(json.dumps({k: v for k, v in out.items() if k != "pairs"},
                      indent=1))
     print(f"wrote {args.output}")

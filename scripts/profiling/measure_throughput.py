@@ -46,6 +46,7 @@ from shockwave_tpu.data.default_oracle import (
     _GANG_EFFICIENCY,
 )
 from shockwave_tpu.data.throughputs import stringify_throughputs
+from shockwave_tpu.utils.fileio import atomic_write_json
 
 SCALE_FACTORS = [1, 2, 4, 8]
 
@@ -213,8 +214,7 @@ def main(args):
                 )
 
     oracle = {worker_type: per_type}
-    with open(args.output, "w") as f:
-        json.dump(stringify_throughputs(oracle), f, indent=2)
+    atomic_write_json(args.output, stringify_throughputs(oracle))
     print(f"Wrote {args.output}")
 
 

@@ -36,6 +36,8 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
+from shockwave_tpu.utils.fileio import atomic_write_json  # noqa: E402
+
 
 def fetch(tree):
     leaf = jax.tree_util.tree_leaves(tree)[0]
@@ -283,16 +285,15 @@ def main(argv=None):
     print(rows["full_step_fused_adamw"], flush=True)
 
     if args.output:
-        with open(args.output, "w") as f:
-            json.dump(
-                {
-                    "device": jax.devices()[0].device_kind,
-                    "config": vars(args),
-                    "rows": rows,
-                },
-                f,
-                indent=1,
-            )
+        atomic_write_json(
+            args.output,
+            {
+                "device": jax.devices()[0].device_kind,
+                "config": vars(args),
+                "rows": rows,
+            },
+            indent=1,
+        )
         print(f"wrote {args.output}")
 
 

@@ -44,6 +44,7 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
+from shockwave_tpu.utils.fileio import atomic_write_json
 
 S = 196608
 BATCH = 1
@@ -246,8 +247,7 @@ def main(argv=None):
         record(f"full_grad_8L_S32k_remat_{remat}", sec)
         del variables
 
-    with open(args.output, "w") as f:
-        json.dump(out, f, indent=1)
+    atomic_write_json(args.output, out, indent=1)
     print(f"wrote {args.output}")
 
 

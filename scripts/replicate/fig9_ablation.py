@@ -34,6 +34,7 @@ sys.path.insert(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ),
 )
+from shockwave_tpu.utils.fileio import atomic_write_json  # noqa: E402
 
 from scripts.replicate.scale_experiments import (  # noqa: E402
     FALLBACK_TRACE,
@@ -111,8 +112,7 @@ def main(argv=None):
     out["hyperparameter_grid_best_worst_ftf"] = best_ftf["worst_ftf"]
 
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    with open(args.out, "w") as f:
-        json.dump(out, f, indent=1)
+    atomic_write_json(args.out, out, indent=1)
     print(f"wrote {args.out}")
 
 

@@ -37,6 +37,7 @@ from shockwave_tpu.runtime.testing import (  # noqa: E402
     parse_round_rates,
     start_local_cluster,
 )
+from shockwave_tpu.utils.fileio import atomic_write_json  # noqa: E402
 
 REPO = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -176,8 +177,7 @@ def main(argv=None):
             "would show full rate)"
         ),
     }
-    with open(os.path.join(out_dir, "summary.json"), "w") as f:
-        json.dump(summary, f, indent=2)
+    atomic_write_json(os.path.join(out_dir, "summary.json"), summary)
     print(json.dumps(summary, indent=2)[:600])
     print(f"wrote {out_dir}/summary.json (scratch in {scratch})")
 

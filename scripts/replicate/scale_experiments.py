@@ -36,6 +36,7 @@ from shockwave_tpu.core.scheduler import Scheduler
 from shockwave_tpu.data import load_or_synthesize_profiles, parse_trace
 from shockwave_tpu.data.default_oracle import generate_oracle
 from shockwave_tpu.policies import get_policy
+from shockwave_tpu.utils.fileio import atomic_write_json
 
 REFERENCE_TRACE = (
     "/root/reference/scheduler/traces/shockwave/"
@@ -170,10 +171,9 @@ def main(args):
                 )
             }
     summary_path = os.path.join(args.out, "summary.json")
-    with open(summary_path, "w") as f:
-        json.dump(
-            {"trace": os.path.basename(trace), "results": summary}, f, indent=2
-        )
+    atomic_write_json(
+        summary_path, {"trace": os.path.basename(trace), "results": summary}
+    )
     print(f"Wrote {summary_path} ({len(summary)} cells)")
 
 

@@ -30,6 +30,7 @@ from shockwave_tpu.core.scheduler import Scheduler
 from shockwave_tpu.data import load_or_synthesize_profiles, parse_trace
 from shockwave_tpu.data.default_oracle import generate_oracle
 from shockwave_tpu.policies import get_policy
+from shockwave_tpu.utils.fileio import atomic_write_json
 
 DEFAULT_TRACE = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -108,8 +109,7 @@ def main(args):
         "cells": cells,
     }
     os.makedirs(os.path.dirname(args.output) or ".", exist_ok=True)
-    with open(args.output, "w") as f:
-        json.dump(artifact, f, indent=2)
+    atomic_write_json(args.output, artifact)
     print(f"Wrote {args.output}")
 
 

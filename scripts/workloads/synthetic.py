@@ -19,6 +19,7 @@ sys.path.insert(
 )
 
 from shockwave_tpu.runtime.iterator import ShockwaveIterator
+from shockwave_tpu.utils.fileio import atomic_write_json, atomic_write_text
 
 
 class SyntheticLoader:
@@ -73,8 +74,7 @@ def main():
             with open(attempt_path) as f:
                 attempts = int(f.read().strip() or 0)
         attempts += 1
-        with open(attempt_path, "w") as f:
-            f.write(str(attempts))
+        atomic_write_text(attempt_path, str(attempts))
         if args.crash_attempts < 0 or attempts <= args.crash_attempts:
             # Hard exit: no checkpoint, no iterator progress line -> the
             # dispatcher reports zero progress and the scheduler counts a
@@ -92,8 +92,7 @@ def main():
         return {"steps": 0}
 
     def save_checkpoint(state):
-        with open(ckpt_path, "w") as f:
-            json.dump(state, f)
+        atomic_write_json(ckpt_path, state, indent=0)
 
     state = load_checkpoint()
     loader = SyntheticLoader(args.batch_size)

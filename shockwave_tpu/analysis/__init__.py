@@ -1,0 +1,47 @@
+"""shockwave-lint: repo-specific, JAX-aware static analysis.
+
+The rule catalog targets the hazard classes this codebase actually
+has (donated-buffer reuse, host syncs in hot loops, PRNG key reuse,
+unlocked shared-state mutation, non-atomic artifact writes, solver
+backend interface drift); a committed baseline ratchets the repo-wide
+finding count monotonically toward zero. CLI:
+``python -m shockwave_tpu.analysis`` (see ``docs/USAGE.md``).
+"""
+
+from shockwave_tpu.analysis.baseline import (
+    default_baseline_path,
+    diff_against_baseline,
+    load_baseline,
+    make_baseline,
+    save_baseline,
+)
+from shockwave_tpu.analysis.core import (
+    DEFAULT_SCOPE,
+    FileContext,
+    Finding,
+    Rule,
+    active,
+    check_source,
+    repo_root,
+    run_paths,
+)
+from shockwave_tpu.analysis.rules import RULE_CLASSES, default_rules, rule_by_name
+
+__all__ = [
+    "DEFAULT_SCOPE",
+    "FileContext",
+    "Finding",
+    "Rule",
+    "RULE_CLASSES",
+    "active",
+    "check_source",
+    "default_baseline_path",
+    "default_rules",
+    "diff_against_baseline",
+    "load_baseline",
+    "make_baseline",
+    "repo_root",
+    "rule_by_name",
+    "run_paths",
+    "save_baseline",
+]

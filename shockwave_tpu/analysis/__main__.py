@@ -1,0 +1,5 @@
+import sys
+
+from shockwave_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,106 @@
+"""The lint baseline: a committed ledger of accepted findings that
+ratchets monotonically toward zero.
+
+Semantics (enforced by ``scripts/ci/lint.py`` and the tier-1 test):
+
+- a finding whose fingerprint is NOT in the baseline is **new** — the
+  gate fails; fix it or suppress it with a justified inline comment.
+- a baseline entry matched by no current finding is **stale** — the
+  debt was paid down, so the gate also fails until the baseline is
+  regenerated smaller (``--write-baseline``). Debt can only shrink.
+
+Fingerprints hash (path, rule, stripped source line, occurrence index
+among identical lines) — stable across edits that merely shift line
+numbers, specific enough that a *new* copy of an old sin fingerprints
+differently via the occurrence index.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Iterable, List, Tuple
+
+from shockwave_tpu.analysis.core import Finding, repo_root
+
+DEFAULT_BASELINE_NAME = "lint_baseline.json"
+
+
+def default_baseline_path(root: str | None = None) -> str:
+    return os.path.join(root or repo_root(), DEFAULT_BASELINE_NAME)
+
+
+def fingerprint_findings(
+    findings: Iterable[Finding],
+) -> List[Tuple[str, Finding]]:
+    """(fingerprint, finding) pairs; occurrence index disambiguates
+    repeated identical lines within one file."""
+    seen: Dict[Tuple[str, str, str], int] = {}
+    out: List[Tuple[str, Finding]] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        key = (f.path, f.rule, f.line_text)
+        index = seen.get(key, 0)
+        seen[key] = index + 1
+        digest = hashlib.sha256(
+            "\x1f".join([f.path, f.rule, f.line_text, str(index)]).encode(
+                "utf-8"
+            )
+        ).hexdigest()[:16]
+        out.append((digest, f))
+    return out
+
+
+def make_baseline(findings: Iterable[Finding]) -> dict:
+    entries = [
+        {
+            "fingerprint": fp,
+            "rule": f.rule,
+            "path": f.path,
+            "line": f.line,
+            "line_text": f.line_text,
+        }
+        for fp, f in fingerprint_findings(findings)
+    ]
+    entries.sort(key=lambda e: (e["path"], e["line"], e["rule"]))
+    return {
+        "comment": (
+            "shockwave-lint ratchet baseline: accepted findings may "
+            "only disappear. Regenerate (only ever smaller) with "
+            "`python -m shockwave_tpu.analysis --write-baseline` after "
+            "paying down debt."
+        ),
+        "entries": entries,
+    }
+
+
+def save_baseline(path: str, baseline: dict) -> None:
+    from shockwave_tpu.utils.fileio import atomic_write_text
+
+    atomic_write_text(path, json.dumps(baseline, indent=2) + "\n")
+
+
+def load_baseline(path: str) -> dict:
+    if not os.path.exists(path):
+        return {"entries": []}
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def diff_against_baseline(
+    findings: Iterable[Finding], baseline: dict
+) -> Tuple[List[Finding], List[dict]]:
+    """(new_findings, stale_entries).
+
+    ``new_findings``: active findings not covered by the baseline.
+    ``stale_entries``: baseline entries no current finding matches —
+    debt that was paid down and must now be removed from the ledger.
+    """
+    pairs = fingerprint_findings(findings)
+    current = {fp for fp, _ in pairs}
+    known = {e["fingerprint"] for e in baseline.get("entries", [])}
+    new = [f for fp, f in pairs if fp not in known]
+    stale = [
+        e for e in baseline.get("entries", []) if e["fingerprint"] not in current
+    ]
+    return new, stale

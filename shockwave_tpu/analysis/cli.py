@@ -1,0 +1,157 @@
+"""``python -m shockwave_tpu.analysis`` — run shockwave-lint.
+
+Exit codes: 0 clean (no findings beyond the baseline, no stale
+baseline debt), 1 new findings, 2 stale baseline (ratchet: the debt
+shrank but the committed ledger didn't), 3 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from shockwave_tpu.analysis import baseline as baseline_mod
+from shockwave_tpu.analysis.core import (
+    DEFAULT_SCOPE,
+    Finding,
+    active,
+    repo_root,
+    run_paths,
+)
+from shockwave_tpu.analysis.rules import RULE_CLASSES, rule_by_name
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m shockwave_tpu.analysis",
+        description=(
+            "shockwave-lint: repo-specific JAX-aware static analysis "
+            "with a ratcheting baseline"
+        ),
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help=f"files/dirs to check (default: {' '.join(DEFAULT_SCOPE)})",
+    )
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--rules",
+        help="comma-separated rule names to run (default: all)",
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline file (default: <repo>/lint_baseline.json)",
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="accept the current findings as the new (smaller) baseline",
+    )
+    p.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="also list findings silenced by inline disable comments",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    return p
+
+
+def _resolve_rules(spec: Optional[str]):
+    if not spec:
+        return None
+    rules = []
+    for name in spec.split(","):
+        name = name.strip()
+        if not name:
+            continue
+        try:
+            rules.append(rule_by_name(name))
+        except KeyError:
+            raise SystemExit(f"unknown rule {name!r}; see --list-rules")
+    return rules
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+
+    if args.list_rules:
+        for cls in RULE_CLASSES:
+            print(f"{cls.name}: {cls.description}")
+            print(f"    why: {cls.rationale}")
+        return 0
+
+    try:
+        rules = _resolve_rules(args.rules)
+    except SystemExit as e:
+        print(e, file=sys.stderr)
+        return 3
+
+    findings = run_paths(args.paths or None, rules=rules)
+    act = active(findings)
+    suppressed = [f for f in findings if f.suppressed]
+
+    baseline_path = args.baseline or baseline_mod.default_baseline_path()
+    if args.write_baseline:
+        bl = baseline_mod.make_baseline(act)
+        baseline_mod.save_baseline(baseline_path, bl)
+        print(
+            f"wrote {baseline_path} with {len(bl['entries'])} accepted "
+            "finding(s)"
+        )
+        return 0
+
+    if args.no_baseline:
+        new, stale = act, []
+    else:
+        bl = baseline_mod.load_baseline(baseline_path)
+        new, stale = baseline_mod.diff_against_baseline(act, bl)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "checked_root": repo_root(),
+                    "total_findings": len(act),
+                    "suppressed": len(suppressed),
+                    "new_findings": [f.to_dict() for f in new],
+                    "stale_baseline_entries": stale,
+                    "findings": [f.to_dict() for f in act],
+                },
+                indent=2,
+            )
+        )
+    else:
+        report = new if not args.no_baseline else act
+        for f in report:
+            print(f.render())
+        if args.show_suppressed:
+            for f in suppressed:
+                print(f"{f.render()}  [suppressed]")
+        for e in stale:
+            print(
+                f"stale baseline entry {e['path']}:{e['line']} "
+                f"[{e['rule']}] — finding fixed; shrink the baseline "
+                "with --write-baseline"
+            )
+        print(
+            f"shockwave-lint: {len(act)} finding(s) "
+            f"({len(new)} new, {len(suppressed)} suppressed, "
+            f"{len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'})"
+        )
+
+    if new:
+        return 1
+    if stale:
+        return 2
+    return 0

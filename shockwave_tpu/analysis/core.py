@@ -1,0 +1,293 @@
+"""shockwave-lint core: AST rule framework, suppressions, file walking.
+
+The invariants PRs 1-4 established (donated-buffer discipline, no host
+syncs in hot loops, RNG hygiene, lock-guarded shared state, atomic
+artifact writes, solver-backend interface conformance) are enforced
+nowhere but reviewer memory. This module is the machinery that turns
+them into machine-checked rules: each rule is an AST pass over one file
+producing :class:`Finding` records; inline ``# shockwave-lint:
+disable=<rule>`` comments suppress individual lines with a visible
+justification; the committed baseline (see :mod:`.baseline`) ratchets
+the repo-wide count monotonically toward zero.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+# -- findings -----------------------------------------------------------
+
+_SEVERITIES = ("error", "warning")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location.
+
+    ``line_text`` (the stripped source of the flagged line) is part of
+    the identity used by the baseline fingerprint, so findings stay
+    matched across unrelated edits that only shift line numbers.
+    """
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    line_text: str = ""
+    suppressed: bool = False
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def render(self) -> str:
+        return f"{self.location()}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    """Base class for one lint rule.
+
+    Subclasses set ``name``/``description`` and implement
+    :meth:`check`; ``applies_to`` narrows the rule to the paths where
+    its hazard class lives (e.g. lock discipline only in ``obs/`` and
+    ``runtime/``).
+    """
+
+    name: str = ""
+    description: str = ""
+    rationale: str = ""
+
+    def applies_to(self, relpath: str) -> bool:
+        return True
+
+    def check(self, ctx: "FileContext") -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(
+        self, ctx: "FileContext", node_or_line, message: str
+    ) -> Finding:
+        if isinstance(node_or_line, int):
+            line, col = node_or_line, 0
+        else:
+            line = getattr(node_or_line, "lineno", 1)
+            col = getattr(node_or_line, "col_offset", 0)
+        text = ""
+        if 1 <= line <= len(ctx.lines):
+            text = ctx.lines[line - 1].strip()
+        return Finding(
+            rule=self.name,
+            path=ctx.relpath,
+            line=line,
+            col=col,
+            message=message,
+            line_text=text,
+            suppressed=ctx.is_suppressed(line, self.name),
+        )
+
+
+# -- per-file context ---------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*shockwave-lint:\s*disable=([A-Za-z0-9_,\- ]+)"
+)
+
+
+def _parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    """Map line number -> rule names disabled on that line.
+
+    A trailing comment suppresses its own line; a standalone comment
+    line suppresses the next line too (so a justification can sit above
+    the flagged statement).
+    """
+    suppressions: Dict[int, Set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            line = tok.start[0]
+            suppressions.setdefault(line, set()).update(rules)
+            # Standalone comment: nothing but whitespace before it.
+            if tok.line[: tok.start[1]].strip() == "":
+                suppressions.setdefault(line + 1, set()).update(rules)
+    except tokenize.TokenError:
+        pass
+    return suppressions
+
+
+class FileContext:
+    """Parsed source + suppression map + parent links, shared by rules."""
+
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self.suppressions = _parse_suppressions(source)
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def is_suppressed(self, line: int, rule: str) -> bool:
+        rules = self.suppressions.get(line, set())
+        return rule in rules or "all" in rules
+
+
+# -- shared AST helpers (used by the rule modules) ----------------------
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.random.split' for an Attribute chain, '' when not a chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def iter_scopes(tree: ast.Module) -> Iterator[ast.AST]:
+    """Module plus every (async) function def, each a binding scope."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def walk_scope(scope: ast.AST) -> Iterator[ast.AST]:
+    """Walk a scope's body without descending into nested scopes.
+
+    Ordering-sensitive rules (donation-after-use, rng-key-reuse) reason
+    about execution order, which nested function bodies do not share
+    with their enclosing scope.
+    """
+    body = scope.body if hasattr(scope, "body") else []
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def node_pos(node: ast.AST):
+    return (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+
+
+# -- running ------------------------------------------------------------
+
+DEFAULT_EXCLUDE_DIRS = {"__pycache__", ".git", "results", "traces", "docs"}
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(
+                d for d in dirs if d not in DEFAULT_EXCLUDE_DIRS
+            )
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def check_source(
+    source: str, relpath: str, rules: Sequence[Rule]
+) -> List[Finding]:
+    """Run ``rules`` over one source string as if it lived at ``relpath``.
+
+    Returns every finding including suppressed ones (callers filter on
+    ``Finding.suppressed``). Unparseable sources yield a single
+    ``parse-error`` finding rather than raising, so one bad file cannot
+    take down a repo-wide run.
+    """
+    try:
+        ctx = FileContext(relpath, source)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="parse-error",
+                path=relpath.replace(os.sep, "/"),
+                line=e.lineno or 1,
+                col=e.offset or 0,
+                message=f"file does not parse: {e.msg}",
+            )
+        ]
+    findings: List[Finding] = []
+    for rule in rules:
+        if not rule.applies_to(ctx.relpath):
+            continue
+        findings.extend(rule.check(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def repo_root() -> str:
+    """The directory holding the ``shockwave_tpu`` package."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+DEFAULT_SCOPE = ("shockwave_tpu", "scripts", "bench.py")
+
+
+def run_paths(
+    paths: Optional[Sequence[str]] = None,
+    rules: Optional[Sequence[Rule]] = None,
+    root: Optional[str] = None,
+) -> List[Finding]:
+    """Run rules over files under ``paths`` (repo-relative or absolute).
+
+    Defaults to the committed enforcement scope (the package, scripts,
+    and bench.py) rooted at the repo.
+    """
+    from shockwave_tpu.analysis.rules import default_rules
+
+    root = root or repo_root()
+    rules = list(rules) if rules is not None else default_rules()
+    resolved = [
+        p if os.path.isabs(p) else os.path.join(root, p)
+        for p in (paths or DEFAULT_SCOPE)
+    ]
+    findings: List[Finding] = []
+    for path in iter_python_files([p for p in resolved if os.path.exists(p)]):
+        relpath = os.path.relpath(path, root)
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        findings.extend(check_source(source, relpath, rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def active(findings: Iterable[Finding]) -> List[Finding]:
+    return [f for f in findings if not f.suppressed]

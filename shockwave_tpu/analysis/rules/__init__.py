@@ -1,0 +1,51 @@
+"""The shockwave-lint rule catalog.
+
+One class per hazard class this repo has been bitten by (or explicitly
+guards by convention); see each module's docstring for the rationale
+and ``docs/USAGE.md`` for the operator-facing catalog.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from shockwave_tpu.analysis.core import Rule
+from shockwave_tpu.analysis.rules.conformance import SolverBackendConformance
+from shockwave_tpu.analysis.rules.donation import DonationAfterUse
+from shockwave_tpu.analysis.rules.fileio import NonAtomicArtifactWrite
+from shockwave_tpu.analysis.rules.hotloop import HostSyncInHotLoop
+from shockwave_tpu.analysis.rules.locks import LockDiscipline
+from shockwave_tpu.analysis.rules.rng import RngKeyReuse
+
+RULE_CLASSES = (
+    DonationAfterUse,
+    HostSyncInHotLoop,
+    RngKeyReuse,
+    LockDiscipline,
+    NonAtomicArtifactWrite,
+    SolverBackendConformance,
+)
+
+
+def default_rules() -> List[Rule]:
+    return [cls() for cls in RULE_CLASSES]
+
+
+def rule_by_name(name: str) -> Rule:
+    for cls in RULE_CLASSES:
+        if cls.name == name:
+            return cls()
+    raise KeyError(name)
+
+
+__all__ = [
+    "RULE_CLASSES",
+    "default_rules",
+    "rule_by_name",
+    "DonationAfterUse",
+    "HostSyncInHotLoop",
+    "RngKeyReuse",
+    "LockDiscipline",
+    "NonAtomicArtifactWrite",
+    "SolverBackendConformance",
+]

@@ -1,0 +1,180 @@
+"""Rule: solver-backend-conformance.
+
+Every EG solver backend consumes the same :class:`EGProblem`; the PR-1
+switching-cost term had to be hand-ported to level/greedy/relaxed/
+sharded/native/MILP because nothing checks that a backend implements
+every objective term. This rule makes the interface mechanical: a
+backend module that defines a ``solve*`` entry point must (a) take the
+shared ``EGProblem`` as its first parameter on public ``solve_eg_*``
+entries, and (b) reference the switching-cost term
+(``switch_bonus``, or the raw ``switch_cost``+``incumbent`` pair) so a
+new backend cannot silently optimize the pre-PR-1 objective. The
+planner facade (``policies/shockwave.py``) must keep threading
+``switch_cost=``/``incumbent=`` into the EGProblem it builds, keep a
+dispatch branch for every registered backend, and the JAX cold-start
+entry must stay wired to the warm-start cache.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+from typing import Iterator, List, Set
+
+from shockwave_tpu.analysis.core import FileContext, Finding, Rule, dotted_name
+
+_BACKEND_GLOBS = (
+    "shockwave_tpu/solver/eg_*.py",
+    "shockwave_tpu/native/__init__.py",
+)
+_NON_BACKEND_FILES = {"shockwave_tpu/solver/eg_problem.py"}
+_PLANNER_FILE = "shockwave_tpu/policies/shockwave.py"
+_WARM_START_FILE = "shockwave_tpu/solver/eg_jax.py"
+
+# Dispatch branches the planner must keep: one per registered backend.
+REQUIRED_BACKENDS = ("reference", "native", "level", "sharded", "relaxed")
+
+_SOLVE_ENTRY_RE = re.compile(r"^solve(_|$)")
+
+
+def _is_backend_module(relpath: str) -> bool:
+    if relpath in _NON_BACKEND_FILES:
+        return False
+    return any(fnmatch.fnmatch(relpath, g) for g in _BACKEND_GLOBS)
+
+
+class SolverBackendConformance(Rule):
+    name = "solver-backend-conformance"
+    description = (
+        "solver backend or planner solve path missing a required "
+        "objective term / kwarg (switching cost, warm start) or a "
+        "registered dispatch branch"
+    )
+    rationale = (
+        "interface conformance across solver backends is where "
+        "correctness quietly dies (MPAX): a backend that drops one "
+        "objective term still returns plausible schedules"
+    )
+
+    def applies_to(self, relpath: str) -> bool:
+        return _is_backend_module(relpath) or relpath in (
+            _PLANNER_FILE,
+            _WARM_START_FILE,
+        )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if _is_backend_module(ctx.relpath):
+            yield from self._check_backend(ctx)
+        if ctx.relpath == _WARM_START_FILE:
+            yield from self._check_warm_start(ctx)
+        if ctx.relpath == _PLANNER_FILE:
+            yield from self._check_planner(ctx)
+
+    # -- backend modules ------------------------------------------------
+
+    def _solve_defs(self, ctx: FileContext) -> List[ast.FunctionDef]:
+        return [
+            n
+            for n in ctx.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and _SOLVE_ENTRY_RE.match(n.name.lstrip("_"))
+        ]
+
+    def _references(self, ctx: FileContext, name: str) -> bool:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute) and node.attr == name:
+                return True
+            if isinstance(node, ast.Name) and node.id == name:
+                return True
+            if isinstance(node, ast.Constant) and node.value == name:
+                return True
+        return False
+
+    def _check_backend(self, ctx: FileContext):
+        solves = self._solve_defs(ctx)
+        if not solves:
+            return
+        has_switch_term = self._references(ctx, "switch_bonus") or (
+            self._references(ctx, "switch_cost")
+            and self._references(ctx, "incumbent")
+        )
+        if not has_switch_term:
+            yield self.finding(
+                ctx,
+                solves[0],
+                f"backend module defines {solves[0].name}() but never "
+                "references the switching-cost term (switch_bonus, or "
+                "switch_cost+incumbent) — a plan from this backend "
+                "silently drops incumbents for free",
+            )
+        for fn in solves:
+            if not fn.name.startswith("solve_eg_"):
+                continue
+            params = [a.arg for a in fn.args.args]
+            if not params or params[0] != "problem":
+                yield self.finding(
+                    ctx,
+                    fn,
+                    f"public backend entry {fn.name}() must take the "
+                    "shared EGProblem as its first parameter "
+                    "('problem'), the interface every caller and the "
+                    "bench harness rely on",
+                )
+
+    # -- warm start -----------------------------------------------------
+
+    def _check_warm_start(self, ctx: FileContext):
+        if not self._references(ctx, "warm_start"):
+            yield self.finding(
+                ctx,
+                1,
+                "solver/eg_jax.py no longer references the warm_start "
+                "cache — the sub-2s cold-start contract "
+                "(solve_level_counts) is broken",
+            )
+
+    # -- planner facade -------------------------------------------------
+
+    def _check_planner(self, ctx: FileContext):
+        # (a) The EGProblem the planner builds must thread the
+        # preemption-awareness kwargs.
+        eg_calls = [
+            n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, ast.Call)
+            and dotted_name(n.func).split(".")[-1] == "EGProblem"
+        ]
+        for call in eg_calls:
+            kwargs = {kw.arg for kw in call.keywords}
+            for required in ("switch_cost", "incumbent"):
+                if required not in kwargs:
+                    yield self.finding(
+                        ctx,
+                        call,
+                        f"EGProblem(...) built without {required}= — the "
+                        "planner would solve the zero-overhead objective "
+                        "and thrash incumbents",
+                    )
+        # (b) Every registered backend keeps a dispatch branch.
+        compared: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Compare):
+                continue
+            operands = [node.left] + list(node.comparators)
+            names = {dotted_name(o) for o in operands}
+            if not any(n.endswith("backend") for n in names if n):
+                continue
+            for o in operands:
+                if isinstance(o, ast.Constant) and isinstance(o.value, str):
+                    compared.add(o.value)
+        missing = [b for b in REQUIRED_BACKENDS if b not in compared]
+        for backend in missing:
+            yield self.finding(
+                ctx,
+                1,
+                f"planner dispatch no longer handles backend "
+                f"{backend!r} — removing a backend branch must be "
+                "deliberate (update REQUIRED_BACKENDS in "
+                "analysis/rules/conformance.py alongside)",
+            )

@@ -1,0 +1,239 @@
+"""Rule: donation-after-use.
+
+``jax.jit(..., donate_argnums=...)`` invalidates the donated argument
+buffers at every call — the caller's reference still points at freed
+device memory, and reading it "works" on CPU test runs while silently
+corrupting state on TPU (the exact hazard the donated ``jit_step`` in
+``models/train.py`` documents). This rule tracks callables built with
+``donate_argnums`` and flags any read of a donated argument name after
+the call site without an interposing rebind.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from shockwave_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    iter_scopes,
+    node_pos,
+    walk_scope,
+)
+
+
+def _donate_argnums_literal(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """The donate_argnums keyword as a tuple of ints, or None when the
+    call has no such keyword. Non-literal values -> empty tuple meaning
+    "donates, indices unknown" (treat every positional arg as donated).
+    """
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        value = kw.value
+        if isinstance(value, ast.Constant) and isinstance(value.value, int):
+            return (value.value,)
+        if isinstance(value, (ast.Tuple, ast.List)):
+            nums = []
+            for elt in value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                    nums.append(elt.value)
+                else:
+                    return ()
+            return tuple(nums)
+        return ()
+    return None
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    return name.split(".")[-1] == "jit"
+
+
+def collect_donated_callables(scope: ast.AST) -> Dict[str, Tuple[int, ...]]:
+    """name -> donated positional indices for callables bound in scope.
+
+    Two binding forms: ``f = jax.jit(fn, donate_argnums=...)`` and a
+    function decorated ``@functools.partial(jax.jit, donate_argnums=...)``
+    or ``@jax.jit(donate_argnums=...)`` (decorator position shifts the
+    visible signature by zero, so indices carry over unchanged).
+    """
+    donated: Dict[str, Tuple[int, ...]] = {}
+    for node in walk_scope(scope):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if _is_jit_call(call):
+                nums = _donate_argnums_literal(call)
+                if nums is not None:
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            donated[target.id] = nums
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                inner_names = [dotted_name(a) for a in dec.args]
+                is_partial_jit = dotted_name(dec.func).split(".")[
+                    -1
+                ] == "partial" and any(
+                    n.split(".")[-1] == "jit" for n in inner_names
+                )
+                if is_partial_jit or _is_jit_call(dec):
+                    nums = _donate_argnums_literal(dec)
+                    if nums is not None:
+                        donated[node.name] = nums
+    return donated
+
+
+def _rebound_names(stmt: ast.AST) -> Set[str]:
+    """Names the statement itself rebinds (assignment targets)."""
+    names: Set[str] = set()
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for target in targets:
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                names.add(node.id)
+    return names
+
+
+def _enclosing_stmt(ctx: FileContext, node: ast.AST) -> ast.AST:
+    cur = node
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.stmt,)):
+            return anc
+        cur = anc
+    return cur
+
+
+def _enclosing_loop(ctx: FileContext, node: ast.AST, scope) -> Optional[ast.AST]:
+    for anc in ctx.ancestors(node):
+        if anc is scope:
+            return None
+        if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+            return anc
+    return None
+
+
+class DonationAfterUse(Rule):
+    name = "donation-after-use"
+    description = (
+        "argument buffer donated to a jit-compiled call is read after "
+        "the call site without being rebound"
+    )
+    rationale = (
+        "donated device buffers are freed by XLA at the call; a later "
+        "read aliases dead memory and corrupts training state silently "
+        "on TPU"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for scope in iter_scopes(ctx.tree):
+            donated = collect_donated_callables(scope)
+            if not donated:
+                continue
+            # All Name events in this scope, ordered by position.
+            events = [
+                n
+                for n in walk_scope(scope)
+                if isinstance(n, ast.Name)
+            ]
+            events.sort(key=node_pos)
+            for node in walk_scope(scope):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in donated
+                ):
+                    continue
+                nums = donated[node.func.id]
+                if nums == ():
+                    nums = tuple(range(len(node.args)))
+                stmt = _enclosing_stmt(ctx, node)
+                rebound = _rebound_names(stmt)
+                call_pos = node_pos(node)
+                loop = _enclosing_loop(ctx, node, scope)
+                for idx in nums:
+                    if idx >= len(node.args):
+                        continue
+                    arg = node.args[idx]
+                    if not isinstance(arg, ast.Name):
+                        continue
+                    if arg.id in rebound:
+                        # `v, o, loss = jit_step(v, o, batch)` — the
+                        # call's own targets replace the donated
+                        # binding, the canonical safe idiom.
+                        continue
+                    hit = self._first_bad_use(
+                        ctx, events, arg.id, call_pos, node, loop
+                    )
+                    if hit is not None:
+                        yield self.finding(
+                            ctx,
+                            hit,
+                            f"'{arg.id}' is donated to '{node.func.id}' "
+                            f"(donate_argnums includes {idx}) at line "
+                            f"{node.lineno} and read afterwards; the "
+                            "donated buffer is invalid after the call "
+                            "— rebind it from the call's results or "
+                            "copy before donating",
+                        )
+
+    def _first_bad_use(
+        self,
+        ctx: FileContext,
+        events: List[ast.Name],
+        name: str,
+        call_pos,
+        call_node: ast.Call,
+        loop: Optional[ast.AST],
+    ) -> Optional[ast.Name]:
+        """Earliest Load of ``name`` after the call (before any Store).
+
+        When the call sits in a loop and the loop body never rebinds the
+        name, loads lexically before the call are reads of the dead
+        buffer on iteration 2+ and count as well.
+        """
+        # The call's own argument occurrences sit positionally after the
+        # Call node itself — they are the donation, not a use-after.
+        in_call = {id(n) for n in ast.walk(call_node)}
+        after = [
+            e
+            for e in events
+            if node_pos(e) > call_pos
+            and e.id == name
+            and id(e) not in in_call
+        ]
+        for event in after:
+            if isinstance(event.ctx, ast.Store):
+                return None
+            if isinstance(event.ctx, ast.Load):
+                return event
+        if loop is not None:
+            loop_events = [
+                e
+                for e in ast.walk(loop)
+                if isinstance(e, ast.Name) and e.id == name
+            ]
+            if any(isinstance(e.ctx, ast.Store) for e in loop_events):
+                return None
+            loads = [
+                e
+                for e in loop_events
+                if isinstance(e.ctx, ast.Load) and e is not None
+            ]
+            # Exclude the donated argument occurrence itself.
+            loads = [
+                e
+                for e in loads
+                if node_pos(e) < call_pos
+            ]
+            if loads:
+                return loads[0]
+        return None

@@ -1,0 +1,74 @@
+"""Rule: non-atomic-artifact-write.
+
+A run killed mid-write (preemption, ctrl-C between rounds, OOM) leaves
+a truncated JSON/JSONL artifact that poisons downstream analysis
+silently — the exact failure ``utils/fileio`` exists to prevent with
+temp-file+rename. Every text-mode truncating ``open(..., "w")`` outside
+that module is either an artifact write that must go through
+``atomic_write_text``/``atomic_write_json``/``atomic_append_text``, or
+a justified exception (a live subprocess stdout sink) that carries an
+inline suppression explaining itself.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from shockwave_tpu.analysis.core import FileContext, Finding, Rule
+
+_EXEMPT_FILES = (
+    "shockwave_tpu/utils/fileio.py",
+)
+
+_TRUNCATING_TEXT_MODES = {"w", "wt", "tw", "w+", "wt+"}
+
+
+class NonAtomicArtifactWrite(Rule):
+    name = "non-atomic-artifact-write"
+    description = (
+        'raw truncating open(..., "w") instead of the atomic '
+        "utils/fileio helpers"
+    )
+    rationale = (
+        "a crash mid-write leaves a truncated artifact that every "
+        "downstream reader mis-parses silently; temp+rename is atomic"
+    )
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath not in _EXEMPT_FILES and not relpath.startswith(
+            "tests/"
+        )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (
+                isinstance(node.func, ast.Name) and node.func.id == "open"
+            ):
+                continue
+            mode = self._mode_of(node)
+            if mode in _TRUNCATING_TEXT_MODES:
+                yield self.finding(
+                    ctx,
+                    node,
+                    f'open(..., "{mode}") truncates in place; use '
+                    "shockwave_tpu.utils.fileio.atomic_write_text / "
+                    "atomic_write_json (or atomic_append_text for "
+                    "grow-only logs) so a crash cannot leave a torn "
+                    "artifact",
+                )
+
+    def _mode_of(self, call: ast.Call):
+        mode_node = None
+        if len(call.args) >= 2:
+            mode_node = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode_node = kw.value
+        if isinstance(mode_node, ast.Constant) and isinstance(
+            mode_node.value, str
+        ):
+            return mode_node.value
+        return None

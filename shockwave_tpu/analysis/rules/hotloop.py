@@ -1,0 +1,195 @@
+"""Rule: host-sync-in-hot-loop.
+
+A single ``.item()`` / ``float()`` / ``np.asarray`` /
+``block_until_ready`` on a JAX value inside the train-step loop or a
+``lax.scan`` body forces a device->host transfer every iteration,
+serializing the dispatch pipeline that makes JAX fast (and inside a
+traced scan body it is an outright tracer leak). Scoped to the code
+that owns hot loops: ``models/``, ``parallel/``, and the solver's JAX
+hot path ``solver/eg_jax.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional, Set
+
+from shockwave_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    iter_scopes,
+    walk_scope,
+)
+from shockwave_tpu.analysis.rules.donation import collect_donated_callables
+
+_SCOPE_PREFIXES = (
+    "shockwave_tpu/models/",
+    "shockwave_tpu/parallel/",
+)
+_SCOPE_FILES = ("shockwave_tpu/solver/eg_jax.py",)
+
+# lax control-flow primitives whose callable operand is traced per step.
+_TRACED_LOOP_CALLS = {
+    "jax.lax.scan",
+    "lax.scan",
+    "jax.lax.fori_loop",
+    "lax.fori_loop",
+    "jax.lax.while_loop",
+    "lax.while_loop",
+    "jax.lax.map",
+    "lax.map",
+}
+
+# Callee-name shapes that mark a python for/while loop as a train/round
+# hot loop even when the step callable is not jit-bound in this scope.
+_HOT_CALLEE_RE = re.compile(
+    r"(jit_step|step_fn|train_step|update_step|solve_step)$"
+)
+
+_NUMPY_MODULES = {"np", "numpy", "onp"}
+_NUMPY_SYNC_ATTRS = {"asarray", "array"}
+
+
+def _in_scope(relpath: str) -> bool:
+    return relpath.startswith(_SCOPE_PREFIXES) or relpath in _SCOPE_FILES
+
+
+class HostSyncInHotLoop(Rule):
+    name = "host-sync-in-hot-loop"
+    description = (
+        ".item()/float()/np.asarray/block_until_ready/device_get on a "
+        "JAX value inside a train-step loop or lax.scan/fori/while body"
+    )
+    rationale = (
+        "each host sync in the hot loop stalls async dispatch for a "
+        "full device round-trip (or leaks a tracer inside scan), "
+        "erasing the latency the fast path exists to deliver"
+    )
+
+    def applies_to(self, relpath: str) -> bool:
+        return _in_scope(relpath)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        hot_regions: List[ast.AST] = []
+        hot_kinds: List[str] = []
+
+        # (a) callables handed to lax.scan / fori_loop / while_loop.
+        local_defs = {
+            n.name: n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        traced_names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) not in _TRACED_LOOP_CALLS:
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    hot_regions.append(arg)
+                    hot_kinds.append("lax traced body")
+                elif isinstance(arg, ast.Name) and arg.id in local_defs:
+                    traced_names.add(arg.id)
+        for name in traced_names:
+            hot_regions.append(local_defs[name])
+            hot_kinds.append("lax traced body")
+
+        # (b) python for/while loops that drive a jit step.
+        donated: Set[str] = set()
+        jit_bound: Set[str] = set()
+        for scope in iter_scopes(ctx.tree):
+            donated.update(collect_donated_callables(scope))
+            for node in walk_scope(scope):
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ):
+                    if dotted_name(node.value.func).split(".")[-1] == "jit":
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                jit_bound.add(t.id)
+        step_callables = donated | jit_bound
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+                continue
+            if self._is_hot_loop(node, step_callables):
+                hot_regions.append(node)
+                hot_kinds.append("train-step loop")
+
+        seen: Set[int] = set()
+        for region, kind in zip(hot_regions, hot_kinds):
+            for sync, what in self._sync_sites(region):
+                key = id(sync)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield self.finding(
+                    ctx,
+                    sync,
+                    f"{what} inside a {kind} forces a host sync every "
+                    "iteration; hoist it out of the loop or keep the "
+                    "value on device",
+                )
+
+    def _is_hot_loop(self, loop: ast.AST, step_callables: Set[str]) -> bool:
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if not callee and isinstance(node.func, ast.Name):
+                callee = node.func.id
+            leaf = callee.split(".")[-1] if callee else ""
+            if leaf in step_callables or _HOT_CALLEE_RE.search(leaf or ""):
+                return True
+        return False
+
+    def _sync_sites(self, region: ast.AST):
+        """(node, description) for every host-sync marker in region,
+        not descending into nested defs for python loops (a helper
+        defined inside the loop runs when called, not per iteration) —
+        but a lax body IS the nested def, so walk it fully."""
+        if isinstance(region, (ast.For, ast.While, ast.AsyncFor)):
+            nodes = self._walk_no_defs(region)
+        else:
+            nodes = ast.walk(region)
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                base = dotted_name(func.value)
+                if func.attr == "item" and not node.args:
+                    yield node, ".item()"
+                elif func.attr == "block_until_ready":
+                    yield node, ".block_until_ready()"
+                elif (
+                    base.split(".")[0] in _NUMPY_MODULES
+                    and func.attr in _NUMPY_SYNC_ATTRS
+                ):
+                    yield node, f"{base}.{func.attr}()"
+                elif base == "jax" and func.attr in (
+                    "device_get",
+                    "block_until_ready",
+                ):
+                    yield node, f"jax.{func.attr}()"
+            elif isinstance(func, ast.Name):
+                if func.id == "float" and node.args:
+                    arg = node.args[0]
+                    if not isinstance(arg, ast.Constant):
+                        yield node, "float() on a computed value"
+                elif func.id in ("device_get", "block_until_ready"):
+                    yield node, f"{func.id}()"
+
+    def _walk_no_defs(self, region: ast.AST):
+        stack = list(ast.iter_child_nodes(region))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,240 @@
+"""Rule: lock-discipline.
+
+The metrics registry, tracer, recorder, watchdog, and dispatcher are
+mutated from gRPC handler threads, the round loop, and worker monitor
+threads at once; every one of them guards shared state with a
+``self._lock``. A mutation added outside the ``with self._lock:`` block
+is a data race that only manifests under production thread
+interleavings. Scoped to ``obs/`` and ``runtime/``, the two packages
+with threaded callers.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Set
+
+from shockwave_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+)
+
+_SCOPE_PREFIXES = ("shockwave_tpu/obs/", "shockwave_tpu/runtime/")
+
+_MUTATING_METHODS = {
+    "append",
+    "extend",
+    "insert",
+    "add",
+    "discard",
+    "remove",
+    "pop",
+    "popleft",
+    "popitem",
+    "appendleft",
+    "update",
+    "setdefault",
+    "clear",
+    "sort",
+}
+
+# Methods that establish state rather than mutate shared state.
+_EXEMPT_METHODS = {"__init__", "__new__", "__init_subclass__"}
+
+# A helper invoked only while the public entry point already holds the
+# lock declares the contract in its docstring (the repo's existing
+# convention, e.g. EventTracer._track) or via a `_locked` name suffix;
+# the declaration keeps the contract greppable and review-visible.
+_CALLER_HOLDS_LOCK_RE = re.compile(
+    r"caller[s]?\s+(must\s+)?(hold[s]?|holding)\b[^.]*\block", re.IGNORECASE
+)
+
+
+def _declares_caller_holds_lock(method: ast.AST) -> bool:
+    if method.name.endswith("_locked"):
+        return True
+    doc = ast.get_docstring(method) or ""
+    return bool(_CALLER_HOLDS_LOCK_RE.search(doc))
+
+
+def _lock_attrs_of_class(cls: ast.ClassDef) -> Set[str]:
+    """Attributes assigned ``threading.Lock()``/``RLock()`` anywhere in
+    the class (typically __init__)."""
+    locks: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        leaf = dotted_name(node.value.func).split(".")[-1]
+        if leaf not in ("Lock", "RLock", "Condition"):
+            continue
+        for target in node.targets:
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                locks.add(target.attr)
+    return locks
+
+
+def _with_holds_lock(stmt: ast.With, lock_attrs: Set[str]) -> bool:
+    """True when any context manager expression references a lock attr
+    (``self._lock`` or another object's ``._lock`` — cross-object
+    locking like ``with registry._lock:`` in the metric handles is the
+    documented idiom)."""
+    for item in stmt.items:
+        for node in ast.walk(item.context_expr):
+            if isinstance(node, ast.Attribute) and (
+                node.attr in lock_attrs or "lock" in node.attr.lower()
+            ):
+                return True
+    return False
+
+
+class LockDiscipline(Rule):
+    name = "lock-discipline"
+    description = (
+        "mutation of self.<attr> shared state in a lock-owning class "
+        "outside a `with self._lock` block"
+    )
+    rationale = (
+        "obs/ and runtime/ objects are mutated concurrently from RPC "
+        "handler threads and the round loop; an unguarded write is a "
+        "race that only fails under production interleavings"
+    )
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(_SCOPE_PREFIXES)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            lock_attrs = _lock_attrs_of_class(cls)
+            if not lock_attrs:
+                continue
+            shared = self._shared_attrs(cls, lock_attrs)
+            for method in cls.body:
+                if not isinstance(
+                    method, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if method.name in _EXEMPT_METHODS:
+                    continue
+                if _declares_caller_holds_lock(method):
+                    continue
+                yield from self._check_method(
+                    ctx, cls, method, lock_attrs, shared
+                )
+
+    def _shared_attrs(
+        self, cls: ast.ClassDef, lock_attrs: Set[str]
+    ) -> Set[str]:
+        """self attributes initialized in __init__ — the state the lock
+        exists to guard. Attributes only ever set elsewhere are treated
+        as method-local caches and left to review."""
+        shared: Set[str] = set()
+        for method in cls.body:
+            if (
+                isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and method.name == "__init__"
+            ):
+                for node in ast.walk(method):
+                    if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                        targets = (
+                            node.targets
+                            if isinstance(node, ast.Assign)
+                            else [node.target]
+                        )
+                        for target in targets:
+                            if (
+                                isinstance(target, ast.Attribute)
+                                and isinstance(target.value, ast.Name)
+                                and target.value.id == "self"
+                            ):
+                                shared.add(target.attr)
+        return shared - lock_attrs
+
+    def _check_method(
+        self,
+        ctx: FileContext,
+        cls: ast.ClassDef,
+        method: ast.AST,
+        lock_attrs: Set[str],
+        shared: Set[str],
+    ):
+        # DFS carrying the "lock held" flag through with-blocks.
+        def visit(node: ast.AST, locked: bool):
+            if isinstance(node, ast.With):
+                locked = locked or _with_holds_lock(node, lock_attrs)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node is not method:
+                    # Nested defs run when called; their lock context is
+                    # the caller's, which we cannot see — skip.
+                    return []
+            out = []
+            if not locked:
+                out.extend(self._mutations(node, shared))
+            for child in ast.iter_child_nodes(node):
+                out.extend(visit(child, locked))
+            return out
+
+        for mut_node, attr, how in visit(method, False):
+            yield self.finding(
+                ctx,
+                mut_node,
+                f"{cls.name}.{method.name} {how} 'self.{attr}' outside "
+                f"`with self.{sorted(lock_attrs)[0]}` — shared state in "
+                "a lock-owning class must be mutated under the lock",
+            )
+
+    def _mutations(self, node: ast.AST, shared: Set[str]):
+        """Mutations *directly at* this node (children are handled by
+        the recursive visit so the locked flag stays accurate)."""
+        out = []
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for target in targets:
+                attr = self._self_attr_target(target)
+                if attr and attr in shared:
+                    verb = (
+                        "augments"
+                        if isinstance(node, ast.AugAssign)
+                        else "assigns"
+                    )
+                    out.append((node, attr, verb))
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _MUTATING_METHODS
+                and isinstance(func.value, ast.Attribute)
+                and isinstance(func.value.value, ast.Name)
+                and func.value.value.id == "self"
+                and func.value.attr in shared
+            ):
+                out.append(
+                    (node, func.value.attr, f"calls .{func.attr}() on")
+                )
+        return out
+
+    def _self_attr_target(self, target: ast.AST):
+        """'attr' when target writes self.attr or self.attr[...]"""
+        if isinstance(target, ast.Subscript):
+            target = target.value
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+        ):
+            return target.attr
+        return None

@@ -1,0 +1,208 @@
+"""Rule: rng-key-reuse.
+
+JAX PRNG keys are pure values: feeding the same key to two consumers
+produces *identical* randomness (correlated init and dropout masks, a
+bug that shows up as mysteriously degenerate training, never as an
+error). A key may be consumed once; every further consumer must get a
+fresh key from ``jax.random.split`` / ``fold_in``. This rule tracks
+key-typed names inside each scope and flags a second consumption
+without an interposing rebind.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from shockwave_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    iter_scopes,
+    node_pos,
+    walk_scope,
+)
+
+_KEY_SOURCES = {"PRNGKey", "key", "fold_in"}
+_DERIVE_LEAVES = {"split", "fold_in"}
+
+
+def _is_key_source(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if not name:
+        return False
+    parts = name.split(".")
+    leaf = parts[-1]
+    if leaf == "PRNGKey":
+        return True
+    # jax.random.key / random.key — require the random module prefix so
+    # a generic dict .key() helper is not mistaken for a PRNG source.
+    if leaf in ("key", "fold_in") and len(parts) >= 2 and parts[-2] == "random":
+        return True
+    if leaf == "fold_in" and parts[0] in ("jax", "jrandom", "jr"):
+        return True
+    return False
+
+
+def _is_derive_call(call: ast.Call) -> bool:
+    """A jax.random.split / fold_in call. Requires a random-module
+    prefix so e.g. ``line.split("\\t")`` is never mistaken for a PRNG
+    derivation."""
+    name = dotted_name(call.func)
+    if not name:
+        return False
+    parts = name.split(".")
+    if parts[-1] not in _DERIVE_LEAVES:
+        return False
+    return len(parts) >= 2 and (
+        parts[-2] == "random" or parts[0] in ("jax", "jrandom", "jr")
+    )
+
+
+class RngKeyReuse(Rule):
+    name = "rng-key-reuse"
+    description = (
+        "the same PRNG key is passed to two consumers without an "
+        "interposing split/fold_in"
+    )
+    rationale = (
+        "identical keys produce identical samples — correlated "
+        "initializations and dropout masks that silently degrade "
+        "training instead of failing"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for scope in iter_scopes(ctx.tree):
+            yield from self._check_scope(ctx, scope)
+
+    def _check_scope(self, ctx: FileContext, scope: ast.AST):
+        key_vars = self._collect_key_vars(scope)
+        if not key_vars:
+            return
+        # Ordered (pos, kind, node) events per key var.
+        events: Dict[str, List[Tuple[tuple, str, ast.AST]]] = {
+            k: [] for k in key_vars
+        }
+        for node in walk_scope(scope):
+            if isinstance(node, ast.Name) and node.id in events:
+                if isinstance(node.ctx, ast.Store):
+                    events[node.id].append((node_pos(node), "rebind", node))
+            elif isinstance(node, ast.Call):
+                consumed = self._consumed_keys(node, key_vars)
+                kind = "derive" if _is_derive_call(node) else "consume"
+                for name, arg_node in consumed:
+                    events[name].append((node_pos(arg_node), kind, node))
+        for name, evs in events.items():
+            evs.sort(key=lambda e: e[0])
+            last_use: Optional[ast.AST] = None
+            for pos, kind, node in evs:
+                if kind == "rebind":
+                    last_use = None
+                    continue
+                if last_use is not None:
+                    if self._exclusive_branches(ctx, last_use, node):
+                        continue
+                    if self._terminating_branch_separates(
+                        ctx, last_use, node
+                    ):
+                        continue
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"PRNG key '{name}' already consumed at line "
+                        f"{last_use.lineno} is used again here without "
+                        "split/fold_in — both consumers see identical "
+                        "randomness",
+                    )
+                    last_use = node
+                else:
+                    last_use = node
+
+    def _collect_key_vars(self, scope: ast.AST) -> Set[str]:
+        keys: Set[str] = set()
+        for node in walk_scope(scope):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            if _is_key_source(value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        keys.add(t.id)
+            elif _is_derive_call(value):
+                # k1, k2 = jax.random.split(key): each target a key.
+                for t in node.targets:
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        for elt in t.elts:
+                            if isinstance(elt, ast.Name):
+                                keys.add(elt.id)
+                    elif isinstance(t, ast.Name):
+                        keys.add(t.id)
+        return keys
+
+    def _consumed_keys(self, call: ast.Call, key_vars: Set[str]):
+        """(name, node) for key vars appearing whole as call arguments.
+
+        A subscripted key array (``keys[i]``) selects distinct keys per
+        use and is not tracked; the whole-array name passed bare is.
+        """
+        out = []
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(arg, ast.Name) and arg.id in key_vars:
+                out.append((arg.id, arg))
+        return out
+
+    def _terminating_branch_separates(
+        self, ctx: FileContext, a: ast.AST, b: ast.AST
+    ) -> bool:
+        """True when ``a`` sits in an If body that ends in
+        return/raise/continue/break and ``b`` comes after that whole If
+        — control flow that reaches ``b`` never executed ``a`` (the
+        ``if name == ...: ...; return`` dispatch idiom in
+        models/train.py's build_family)."""
+        b_pos = node_pos(b)
+        for anc in ctx.ancestors(a):
+            if not isinstance(anc, ast.If):
+                continue
+            for branch in (anc.body, anc.orelse):
+                if not branch:
+                    continue
+                if not any(a in ast.walk(s) for s in branch):
+                    continue
+                last = branch[-1]
+                if isinstance(
+                    last, (ast.Return, ast.Raise, ast.Continue, ast.Break)
+                ):
+                    end = (
+                        getattr(anc, "end_lineno", anc.lineno),
+                        getattr(anc, "end_col_offset", 0),
+                    )
+                    if b_pos > end:
+                        return True
+        return False
+
+    def _exclusive_branches(
+        self, ctx: FileContext, a: ast.AST, b: ast.AST
+    ) -> bool:
+        """True when a and b sit in mutually exclusive branches of the
+        same If (or a Try body vs handler) — only one runs, no reuse."""
+        for anc in ctx.ancestors(a):
+            if isinstance(anc, ast.If):
+                in_body = any(a in ast.walk(s) for s in anc.body)
+                other_body = any(b in ast.walk(s) for s in anc.body)
+                in_else = any(a in ast.walk(s) for s in anc.orelse)
+                other_else = any(b in ast.walk(s) for s in anc.orelse)
+                if (in_body and other_else) or (in_else and other_body):
+                    return True
+            if isinstance(anc, ast.Try):
+                in_body = any(a in ast.walk(s) for s in anc.body)
+                other_h = any(
+                    b in ast.walk(h) for h in anc.handlers
+                )
+                in_h = any(a in ast.walk(h) for h in anc.handlers)
+                other_body = any(b in ast.walk(s) for s in anc.body)
+                if (in_body and other_h) or (in_h and other_body):
+                    return True
+        return False

@@ -122,6 +122,8 @@ def generate_oracle(
 
 def write_oracle_json(path: str, **kwargs) -> None:
     from shockwave_tpu.data.throughputs import stringify_throughputs
+    from shockwave_tpu.utils.fileio import atomic_write_json
 
-    with open(path, "w") as f:
-        json.dump(stringify_throughputs(generate_oracle(**kwargs)), f)
+    atomic_write_json(
+        path, stringify_throughputs(generate_oracle(**kwargs)), indent=None
+    )

@@ -66,6 +66,12 @@ def parse_trace(trace_file: str) -> Tuple[List[Job], List[float]]:
 def write_trace(
     trace_file: str, jobs: Iterable[Job], arrival_times: Iterable[float]
 ) -> None:
-    with open(trace_file, "w") as f:
-        for job, arrival in zip(jobs, arrival_times):
-            f.write("%s\t%g\n" % (job.to_trace_line(), float(arrival)))
+    from shockwave_tpu.utils.fileio import atomic_write_text
+
+    atomic_write_text(
+        trace_file,
+        "".join(
+            "%s\t%g\n" % (job.to_trace_line(), float(arrival))
+            for job, arrival in zip(jobs, arrival_times)
+        ),
+    )

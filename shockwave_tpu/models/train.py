@@ -590,6 +590,10 @@ def main(argv=None):
         variables, opt_state, loss = jit_step(variables, opt_state, batch)
         steps += 1
         if steps == 1 and os.environ.get("SHOCKWAVE_PHASE_TIMINGS"):
+            # Deliberate one-time sync: fences the compile-inclusive
+            # first step so the phase scrape attributes it to compile,
+            # not to steady-state train; gated off in production runs.
+            # shockwave-lint: disable=host-sync-in-hot-loop
             loss.block_until_ready()
             mark_phase("first_step_compile")
         if steps >= args.num_steps:

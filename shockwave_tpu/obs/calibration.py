@@ -57,8 +57,8 @@ class CalibrationTracker:
         self._scored: Dict[object, dict] = {}
 
     def reset(self) -> None:
-        self.enabled = False
         with self._lock:
+            self.enabled = False
             self._pending.clear()
             self._scored.clear()
 

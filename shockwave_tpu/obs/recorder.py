@@ -277,27 +277,30 @@ class FlightRecorder:
     def configure(self, path: str) -> None:
         """Point the recorder at a log path and enable it; queues a
         header record so readers can sanity-check the schema."""
-        self.path = path
-        self.enabled = True
-        self.num_records = 0
-        self._pending = []
-        self._profiles_emitted = {}
-        self._tput_emitted = {}
+        with self._lock:
+            self.path = path
+            self.enabled = True
+            self.num_records = 0
+            self._pending = []
+            self._profiles_emitted = {}
+            self._tput_emitted = {}
+        # _append/flush re-take the lock; queue the header after release.
         self._append({"event": "header", "schema": SCHEMA})
         self.flush()
 
     def reset(self) -> None:
-        self.enabled = False
-        self.path = None
-        self.num_records = 0
         with self._lock:
+            self.enabled = False
+            self.path = None
+            self.num_records = 0
             self._pending = []
-        self._profiles_emitted = {}
-        self._tput_emitted = {}
+            self._profiles_emitted = {}
+            self._tput_emitted = {}
 
     def close(self) -> None:
         self.flush()
-        self.enabled = False
+        with self._lock:
+            self.enabled = False
 
     def _append(self, record: dict) -> None:
         with self._lock:

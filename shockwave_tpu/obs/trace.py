@@ -103,7 +103,8 @@ class EventTracer:
         """Install a clock returning seconds (e.g. the simulator's
         virtual timestamp); ``None`` restores wall time since tracer
         creation."""
-        self._clock = clock
+        with self._lock:
+            self._clock = clock
 
     def _now_s(self) -> float:
         if self._clock is not None:

@@ -97,13 +97,14 @@ class Watchdog:
     def configure(
         self, rules: Optional[dict] = None, enabled: bool = True
     ) -> None:
-        self.rules = merge_rules(rules)
-        self.enabled = enabled
+        with self._lock:
+            self.rules = merge_rules(rules)
+            self.enabled = enabled
 
     def reset(self) -> None:
-        self.enabled = False
-        self.rules = merge_rules(None)
         with self._lock:
+            self.enabled = False
+            self.rules = merge_rules(None)
             self.alerts.clear()
             self._rounds_checked = 0
             self._last_counters.clear()
@@ -204,7 +205,8 @@ class Watchdog:
         """Append an alert unless this breach already fired at an equal
         or worse value (hysteresis against per-round spam). Callers
         must :meth:`_rearm` the rule on rounds where it is back under
-        threshold, so a LATER distinct breach fires again."""
+        threshold, so a LATER distinct breach fires again. Caller
+        holds the lock."""
         last = self._last_fired.get(rule)
         if last is not None and value <= last:
             return
@@ -220,9 +222,11 @@ class Watchdog:
         )
 
     def _rearm(self, rule: str) -> None:
+        """Caller holds the lock."""
         self._last_fired.pop(rule, None)
 
     def _check_worst_ftf(self, metrics, round_index, fired) -> None:
+        """Caller holds the lock (check_round)."""
         cfg = self.rules["worst_ftf"]
         _, _, worst = self._histogram_totals(metrics, "scheduler_job_ftf")
         if worst is not None and worst > cfg["threshold"]:
@@ -233,6 +237,7 @@ class Watchdog:
         # design, one alert per new worst value.
 
     def _check_solver_time(self, metrics, round_index, fired) -> None:
+        """Caller holds the lock (check_round)."""
         cfg = self.rules["solver_time"]
         count, total, _ = self._histogram_totals(
             metrics, "shockwave_solve_seconds"
@@ -263,6 +268,7 @@ class Watchdog:
             self._rearm("solver_time")
 
     def _check_calibration(self, metrics, round_index, fired) -> None:
+        """Caller holds the lock (check_round)."""
         cfg = self.rules["calibration_mape"]
         mape = self._gauge_value(metrics, "predictor_calibration_mape")
         scored = self._gauge_value(metrics, "predictor_calibration_scored")
@@ -277,6 +283,7 @@ class Watchdog:
             self._rearm("calibration_mape")
 
     def _check_lease_churn(self, metrics, round_index, fired) -> None:
+        """Caller holds the lock (check_round)."""
         cfg = self.rules["lease_churn"]
         total = self._counter_total(metrics, "scheduler_preemptions_total")
         delta = total - self._last_counters.get("preemptions", 0.0)
@@ -302,6 +309,7 @@ class Watchdog:
     def _check_stragglers(
         self, job_steps, scheduled, round_index, fired
     ) -> None:
+        """Caller holds the lock (check_round)."""
         cfg = self.rules["straggler"]
         limit = cfg["rounds_without_progress"]
         for job_id, steps in job_steps.items():

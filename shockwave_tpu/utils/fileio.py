@@ -56,6 +56,19 @@ def atomic_append_text(path: str, text: str) -> None:
         os.close(fd)
 
 
+def atomic_write_json(path: str, obj, indent: int = 2) -> None:
+    """Serialize ``obj`` as JSON and atomically replace ``path``.
+
+    The one-call form every artifact writer should use instead of
+    ``open(path, "w")`` + ``json.dump`` (shockwave-lint rule
+    non-atomic-artifact-write): a crash mid-dump can never leave a
+    truncated JSON document behind.
+    """
+    import json
+
+    atomic_write_text(path, json.dumps(obj, indent=indent) + "\n")
+
+
 def atomic_write_text(path: str, text: str) -> None:
     directory = os.path.dirname(os.path.abspath(path))
     os.makedirs(directory, exist_ok=True)

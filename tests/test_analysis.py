@@ -1,0 +1,643 @@
+"""shockwave-lint: fixture corpus per rule (positive / negative /
+suppressed), baseline-ratchet semantics, CLI contract, and the tier-1
+repo-wide gate asserting zero findings beyond the committed baseline.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from shockwave_tpu.analysis import (
+    active,
+    check_source,
+    default_rules,
+    diff_against_baseline,
+    load_baseline,
+    make_baseline,
+    repo_root,
+    rule_by_name,
+    run_paths,
+    save_baseline,
+)
+
+
+def findings_for(source, relpath, rule_name):
+    """Active (non-suppressed) findings of one rule over a snippet."""
+    return [
+        f
+        for f in check_source(source, relpath, [rule_by_name(rule_name)])
+        if not f.suppressed
+    ]
+
+
+# -- rule 1: donation-after-use ----------------------------------------
+
+DONATION_POSITIVE = """
+import jax
+
+def train(variables, opt_state, batches):
+    jit_step = jax.jit(step, donate_argnums=(0, 1))
+    new_v, new_o, loss = jit_step(variables, opt_state, batches[0])
+    print(variables["params"])  # read of the donated buffer
+"""
+
+DONATION_NEGATIVE = """
+import jax
+
+def train(variables, opt_state, loader):
+    jit_step = jax.jit(step, donate_argnums=(0, 1))
+    for batch in loader:
+        variables, opt_state, loss = jit_step(variables, opt_state, batch)
+    return variables, opt_state, loss
+"""
+
+DONATION_DECORATOR_POSITIVE = """
+import functools
+import jax
+
+def bench(state, batch):
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state, batch):
+        return state
+
+    out = step(state, batch)
+    return state  # donated 'state' read after the call
+"""
+
+DONATION_SUPPRESSED = """
+import jax
+
+def train(variables, opt_state, batch):
+    jit_step = jax.jit(step, donate_argnums=(0, 1))
+    new_v, new_o, loss = jit_step(variables, opt_state, batch)
+    # shockwave-lint: disable=donation-after-use
+    print(variables["params"])
+"""
+
+
+class TestDonationAfterUse:
+    def test_positive(self):
+        hits = findings_for(DONATION_POSITIVE, "shockwave_tpu/models/x.py",
+                            "donation-after-use")
+        assert len(hits) == 1
+        assert "'variables'" in hits[0].message
+        assert hits[0].line == 7
+
+    def test_negative_rebinding_idiom(self):
+        assert not findings_for(DONATION_NEGATIVE,
+                                "shockwave_tpu/models/x.py",
+                                "donation-after-use")
+
+    def test_decorator_form(self):
+        hits = findings_for(DONATION_DECORATOR_POSITIVE,
+                            "scripts/bench_x.py", "donation-after-use")
+        assert len(hits) == 1
+        assert "'state'" in hits[0].message
+
+    def test_suppressed(self):
+        assert not findings_for(DONATION_SUPPRESSED,
+                                "shockwave_tpu/models/x.py",
+                                "donation-after-use")
+        suppressed = [
+            f
+            for f in check_source(
+                DONATION_SUPPRESSED, "shockwave_tpu/models/x.py",
+                [rule_by_name("donation-after-use")],
+            )
+            if f.suppressed
+        ]
+        assert len(suppressed) == 1
+
+
+# -- rule 2: host-sync-in-hot-loop -------------------------------------
+
+HOTLOOP_POSITIVE_TRAIN = """
+import jax
+import numpy as np
+
+def train(loader, state):
+    jit_step = jax.jit(step)
+    for batch in loader:
+        state, loss = jit_step(state, batch)
+        print(float(loss))  # host sync every iteration
+"""
+
+HOTLOOP_POSITIVE_SCAN = """
+import jax
+import numpy as np
+
+def solve(xs):
+    def body(carry, x):
+        host = np.asarray(x)  # tracer leak / forced sync
+        return carry, host
+
+    return jax.lax.scan(body, 0, xs)
+"""
+
+HOTLOOP_NEGATIVE = """
+import jax
+import jax.numpy as jnp
+
+def train(loader, state):
+    jit_step = jax.jit(step)
+    for batch in loader:
+        state, loss = jit_step(state, batch)
+    return float(loss)  # after the loop: fine
+"""
+
+HOTLOOP_OUT_OF_SCOPE = """
+def run(loader, state):
+    import jax
+    jit_step = jax.jit(step)
+    for batch in loader:
+        state, loss = jit_step(state, batch)
+        print(float(loss))
+"""
+
+HOTLOOP_SUPPRESSED = """
+import jax
+
+def train(loader, state):
+    jit_step = jax.jit(step)
+    for batch in loader:
+        state, loss = jit_step(state, batch)
+        # shockwave-lint: disable=host-sync-in-hot-loop
+        loss.block_until_ready()
+"""
+
+
+class TestHostSyncInHotLoop:
+    def test_train_loop_positive(self):
+        hits = findings_for(HOTLOOP_POSITIVE_TRAIN,
+                            "shockwave_tpu/models/x.py",
+                            "host-sync-in-hot-loop")
+        assert len(hits) == 1
+        assert "float()" in hits[0].message
+
+    def test_scan_body_positive(self):
+        hits = findings_for(HOTLOOP_POSITIVE_SCAN,
+                            "shockwave_tpu/solver/eg_jax.py",
+                            "host-sync-in-hot-loop")
+        assert len(hits) == 1
+        assert "np.asarray" in hits[0].message
+
+    def test_negative_after_loop(self):
+        assert not findings_for(HOTLOOP_NEGATIVE,
+                                "shockwave_tpu/models/x.py",
+                                "host-sync-in-hot-loop")
+
+    def test_scoped_to_hot_packages(self):
+        # Identical code outside models//parallel//eg_jax.py: no finding.
+        assert not findings_for(HOTLOOP_OUT_OF_SCOPE,
+                                "shockwave_tpu/core/x.py",
+                                "host-sync-in-hot-loop")
+
+    def test_suppressed(self):
+        assert not findings_for(HOTLOOP_SUPPRESSED,
+                                "shockwave_tpu/models/x.py",
+                                "host-sync-in-hot-loop")
+
+
+# -- rule 3: rng-key-reuse ---------------------------------------------
+
+RNG_POSITIVE = """
+import jax
+
+def init(seed):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.normal(key, (4,))
+    b = jax.random.normal(key, (4,))  # identical samples
+    return a, b
+"""
+
+RNG_NEGATIVE_SPLIT = """
+import jax
+
+def init(seed):
+    key = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (4,))
+    b = jax.random.normal(k2, (4,))
+    return a, b
+"""
+
+RNG_NEGATIVE_STRING_SPLIT = """
+def parse(line):
+    a, b = line.split("\\t")
+    c = int(a)
+    d = int(a)
+    return c, d, b
+"""
+
+RNG_NEGATIVE_BRANCHES = """
+import jax
+
+def init(seed, kind):
+    key = jax.random.PRNGKey(seed)
+    if kind == "normal":
+        out = jax.random.normal(key, (4,))
+        return out
+    out = jax.random.uniform(key, (4,))
+    return out
+"""
+
+RNG_SUPPRESSED = """
+import jax
+
+def init(seed):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.normal(key, (4,))
+    # shockwave-lint: disable=rng-key-reuse
+    b = jax.random.normal(key, (4,))
+    return a, b
+"""
+
+
+class TestRngKeyReuse:
+    def test_positive(self):
+        hits = findings_for(RNG_POSITIVE, "shockwave_tpu/models/x.py",
+                            "rng-key-reuse")
+        assert len(hits) == 1
+        assert "'key'" in hits[0].message
+
+    def test_negative_split(self):
+        assert not findings_for(RNG_NEGATIVE_SPLIT,
+                                "shockwave_tpu/models/x.py",
+                                "rng-key-reuse")
+
+    def test_string_split_not_a_key(self):
+        assert not findings_for(RNG_NEGATIVE_STRING_SPLIT,
+                                "shockwave_tpu/data/x.py",
+                                "rng-key-reuse")
+
+    def test_terminating_branches_are_exclusive(self):
+        assert not findings_for(RNG_NEGATIVE_BRANCHES,
+                                "shockwave_tpu/models/x.py",
+                                "rng-key-reuse")
+
+    def test_suppressed(self):
+        assert not findings_for(RNG_SUPPRESSED,
+                                "shockwave_tpu/models/x.py",
+                                "rng-key-reuse")
+
+
+# -- rule 4: lock-discipline -------------------------------------------
+
+LOCK_POSITIVE = """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._series = {}
+        self.enabled = False
+
+    def set_enabled(self, value):
+        self.enabled = value  # unguarded write
+
+    def record(self, name, value):
+        with self._lock:
+            self._series[name] = value
+"""
+
+LOCK_NEGATIVE = """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._series = {}
+
+    def record(self, name, value):
+        with self._lock:
+            self._series[name] = value
+"""
+
+LOCK_CALLER_HOLDS = """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._series = {}
+
+    def record(self, name, value):
+        with self._lock:
+            self._store(name, value)
+
+    def _store(self, name, value):
+        \"\"\"Caller holds the lock.\"\"\"
+        self._series[name] = value
+"""
+
+LOCK_SUPPRESSED = """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.enabled = False
+
+    def set_enabled(self, value):
+        # shockwave-lint: disable=lock-discipline
+        self.enabled = value
+"""
+
+
+class TestLockDiscipline:
+    def test_positive(self):
+        hits = findings_for(LOCK_POSITIVE, "shockwave_tpu/obs/x.py",
+                            "lock-discipline")
+        assert len(hits) == 1
+        assert "set_enabled" in hits[0].message
+
+    def test_negative(self):
+        assert not findings_for(LOCK_NEGATIVE, "shockwave_tpu/obs/x.py",
+                                "lock-discipline")
+
+    def test_caller_holds_lock_contract(self):
+        assert not findings_for(LOCK_CALLER_HOLDS,
+                                "shockwave_tpu/obs/x.py",
+                                "lock-discipline")
+
+    def test_scoped_to_threaded_packages(self):
+        assert not findings_for(LOCK_POSITIVE,
+                                "shockwave_tpu/solver/x.py",
+                                "lock-discipline")
+
+    def test_suppressed(self):
+        assert not findings_for(LOCK_SUPPRESSED, "shockwave_tpu/obs/x.py",
+                                "lock-discipline")
+
+
+# -- rule 5: non-atomic-artifact-write ---------------------------------
+
+WRITE_POSITIVE = """
+import json
+
+def save(path, obj):
+    with open(path, "w") as f:
+        json.dump(obj, f)
+"""
+
+WRITE_NEGATIVE = """
+import json
+from shockwave_tpu.utils.fileio import atomic_write_json
+
+def save(path, obj):
+    atomic_write_json(path, obj)
+
+def load(path):
+    with open(path) as f:
+        return json.load(f)
+"""
+
+WRITE_BINARY_NEGATIVE = """
+def save(path, blob):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+"""
+
+WRITE_SUPPRESSED = """
+def open_sink(path):
+    # live stream for a subprocess, not an artifact
+    # shockwave-lint: disable=non-atomic-artifact-write
+    return open(path, "w")
+"""
+
+
+class TestNonAtomicArtifactWrite:
+    def test_positive(self):
+        hits = findings_for(WRITE_POSITIVE, "scripts/analysis/x.py",
+                            "non-atomic-artifact-write")
+        assert len(hits) == 1
+
+    def test_negative(self):
+        assert not findings_for(WRITE_NEGATIVE, "scripts/analysis/x.py",
+                                "non-atomic-artifact-write")
+
+    def test_binary_checkpoint_path_not_flagged(self):
+        assert not findings_for(WRITE_BINARY_NEGATIVE,
+                                "shockwave_tpu/models/x.py",
+                                "non-atomic-artifact-write")
+
+    def test_tests_exempt(self):
+        assert not findings_for(WRITE_POSITIVE, "tests/test_x.py",
+                                "non-atomic-artifact-write")
+
+    def test_suppressed(self):
+        assert not findings_for(WRITE_SUPPRESSED, "scripts/x.py",
+                                "non-atomic-artifact-write")
+
+
+# -- rule 6: solver-backend-conformance --------------------------------
+
+BACKEND_POSITIVE = """
+import numpy as np
+
+def solve_eg_newbackend(problem):
+    # optimizes welfare + makespan but silently drops the
+    # switching-cost term
+    return np.zeros((problem.num_jobs, problem.future_rounds))
+"""
+
+BACKEND_NEGATIVE = """
+import numpy as np
+
+def solve_eg_newbackend(problem):
+    bonus = problem.switch_bonus()
+    return np.zeros((problem.num_jobs, problem.future_rounds))
+"""
+
+BACKEND_BAD_SIGNATURE = """
+def solve_eg_newbackend(costs, switch_bonus, incumbent):
+    return costs
+"""
+
+
+class TestSolverBackendConformance:
+    def test_missing_switch_term(self):
+        hits = findings_for(BACKEND_POSITIVE,
+                            "shockwave_tpu/solver/eg_newbackend.py",
+                            "solver-backend-conformance")
+        assert len(hits) == 1
+        assert "switch" in hits[0].message
+
+    def test_conformant_backend(self):
+        assert not findings_for(BACKEND_NEGATIVE,
+                                "shockwave_tpu/solver/eg_newbackend.py",
+                                "solver-backend-conformance")
+
+    def test_entry_signature(self):
+        hits = findings_for(BACKEND_BAD_SIGNATURE,
+                            "shockwave_tpu/solver/eg_newbackend.py",
+                            "solver-backend-conformance")
+        assert any("first parameter" in f.message for f in hits)
+
+    def test_scoped_to_solver_modules(self):
+        assert not findings_for(BACKEND_POSITIVE,
+                                "shockwave_tpu/core/x.py",
+                                "solver-backend-conformance")
+
+    def test_real_backends_and_planner_conform(self):
+        # The live solver stack must stay clean under this rule.
+        findings = run_paths(
+            ["shockwave_tpu/solver", "shockwave_tpu/policies",
+             "shockwave_tpu/native"],
+            rules=[rule_by_name("solver-backend-conformance")],
+        )
+        assert not active(findings), [f.render() for f in findings]
+
+
+# -- framework: suppressions, parse errors ------------------------------
+
+def test_suppression_line_above_and_trailing():
+    above = """
+x = 1
+# shockwave-lint: disable=non-atomic-artifact-write
+f = open("out.json", "w")
+"""
+    trailing = """
+f = open("out.json", "w")  # shockwave-lint: disable=non-atomic-artifact-write
+"""
+    for src in (above, trailing):
+        assert not findings_for(src, "scripts/x.py",
+                                "non-atomic-artifact-write")
+
+
+def test_suppression_is_rule_specific():
+    src = """
+# shockwave-lint: disable=rng-key-reuse
+f = open("out.json", "w")
+"""
+    assert len(findings_for(src, "scripts/x.py",
+                            "non-atomic-artifact-write")) == 1
+
+
+def test_parse_error_is_a_finding_not_a_crash():
+    findings = check_source("def broken(:\n", "scripts/x.py",
+                            default_rules())
+    assert len(findings) == 1
+    assert findings[0].rule == "parse-error"
+
+
+# -- baseline ratchet ---------------------------------------------------
+
+def test_baseline_roundtrip_and_ratchet(tmp_path):
+    src_v1 = WRITE_POSITIVE
+    findings = findings_for(src_v1, "scripts/x.py",
+                            "non-atomic-artifact-write")
+    bl = make_baseline(findings)
+    path = tmp_path / "baseline.json"
+    save_baseline(str(path), bl)
+    loaded = load_baseline(str(path))
+    assert len(loaded["entries"]) == 1
+
+    # Unchanged code: no new findings, nothing stale.
+    new, stale = diff_against_baseline(findings, loaded)
+    assert not new and not stale
+
+    # Line shift (edit above the finding): fingerprint still matches.
+    shifted = "import os\n" + src_v1
+    shifted_findings = findings_for(shifted, "scripts/x.py",
+                                    "non-atomic-artifact-write")
+    new, stale = diff_against_baseline(shifted_findings, loaded)
+    assert not new and not stale
+
+    # A second, distinct violation: NEW (occurrence index differs).
+    two = src_v1 + '\n\ndef save2(path, obj):\n    with open(path, "w") as f:\n        pass\n'
+    two_findings = findings_for(two, "scripts/x.py",
+                                "non-atomic-artifact-write")
+    new, stale = diff_against_baseline(two_findings, loaded)
+    assert len(new) == 1 and not stale
+
+    # Violation fixed: the baseline entry goes stale (ratchet trips).
+    new, stale = diff_against_baseline([], loaded)
+    assert not new and len(stale) == 1
+
+
+def test_empty_baseline_means_any_finding_is_new():
+    findings = findings_for(WRITE_POSITIVE, "scripts/x.py",
+                            "non-atomic-artifact-write")
+    new, stale = diff_against_baseline(findings, {"entries": []})
+    assert len(new) == 1 and not stale
+
+
+# -- tier-1 repo-wide gate ---------------------------------------------
+
+def test_repo_is_clean_against_baseline():
+    """The committed tree must carry zero findings beyond the committed
+    baseline, and the baseline must carry zero stale entries — the same
+    ratchet scripts/ci/lint.py enforces, here so tier-1 enforces it."""
+    findings = active(run_paths())
+    baseline = load_baseline(
+        str(__import__("pathlib").Path(repo_root()) / "lint_baseline.json")
+    )
+    new, stale = diff_against_baseline(findings, baseline)
+    assert not new, "new lint findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+    assert not stale, f"stale baseline entries (run --write-baseline): {stale}"
+
+
+def test_every_rule_has_a_docstringed_catalog_entry():
+    from shockwave_tpu.analysis.rules import RULE_CLASSES
+
+    assert len(RULE_CLASSES) >= 6
+    for cls in RULE_CLASSES:
+        assert cls.name and cls.description and cls.rationale
+
+
+# -- CLI ----------------------------------------------------------------
+
+def test_cli_json_and_exit_codes(tmp_path):
+    from shockwave_tpu.analysis.cli import main
+
+    bad = tmp_path / "shockwave_tpu"
+    bad.mkdir()
+    victim = bad / "bad_script.py"
+    victim.write_text(WRITE_POSITIVE)
+    baseline = tmp_path / "bl.json"
+
+    # New finding against an empty baseline -> exit 1.
+    rc = main([str(victim), "--baseline", str(baseline)])
+    assert rc == 1
+
+    # Accept it, then the same run is clean -> exit 0.
+    rc = main([str(victim), "--baseline", str(baseline),
+               "--write-baseline"])
+    assert rc == 0
+    rc = main([str(victim), "--baseline", str(baseline)])
+    assert rc == 0
+
+    # Fix the violation; the ledger is now stale -> exit 2.
+    victim.write_text("x = 1\n")
+    rc = main([str(victim), "--baseline", str(baseline)])
+    assert rc == 2
+
+
+def test_cli_subprocess_smoke():
+    out = subprocess.run(
+        [sys.executable, "-m", "shockwave_tpu.analysis", "--list-rules"],
+        capture_output=True, text=True, cwd=repo_root(),
+    )
+    assert out.returncode == 0
+    for name in ("donation-after-use", "host-sync-in-hot-loop",
+                 "rng-key-reuse", "lock-discipline",
+                 "non-atomic-artifact-write",
+                 "solver-backend-conformance"):
+        assert name in out.stdout
+
+
+def test_cli_json_shape():
+    out = subprocess.run(
+        [sys.executable, "-m", "shockwave_tpu.analysis", "--json"],
+        capture_output=True, text=True, cwd=repo_root(),
+    )
+    payload = json.loads(out.stdout)
+    for key in ("total_findings", "suppressed", "new_findings",
+                "stale_baseline_entries", "findings"):
+        assert key in payload

@@ -2,6 +2,8 @@
 stage application exactly, shard over a real "pipe" mesh axis, and train
 (finite loss + grads) end to end."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -133,6 +135,16 @@ def test_pipelined_lm_rejects_moe_with_aux_loss():
 
 
 @pytest.mark.slow
+@pytest.mark.skipif(
+    (os.cpu_count() or 1) < 4,
+    reason=(
+        "wall-clock-sensitive pipeline-bubble timing: the M-vs-2M "
+        "slope needs stable per-tick times, which a host with "
+        f"< 4 CPUs ({os.cpu_count()} here) cannot provide under "
+        "background load (known-flaky on 2-CPU containers, "
+        "CHANGES.md PR 3)"
+    ),
+)
 def test_gpipe_bubble_fraction_matches_analytic_bound():
     """Wall-clock bubble fraction of the GPipe schedule, pinned against
     the analytic (S-1)/(S+M-1). On a single device the bubble shows up
