@@ -4,18 +4,29 @@
 Runs ``shockwave_tpu.analysis`` over the default enforcement scope
 (``shockwave_tpu/``, ``scripts/``, ``bench.py``) against the committed
 baseline (``lint_baseline.json``) and exits non-zero when either
-direction of the ratchet is violated:
+direction of the ratchet is violated — or when the gate itself is
+broken:
 
   exit 1  NEW findings — code introduced a violation the baseline does
           not accept. Fix it, or suppress the line with a justified
           ``# shockwave-lint: disable=<rule>`` comment.
-  exit 2  STALE baseline — findings the baseline still carries were
-          fixed, so the committed debt ledger can shrink but didn't.
-          Regenerate it (only ever smaller) with
-          ``python -m shockwave_tpu.analysis --write-baseline``.
+  exit 2  BROKEN GATE or STALE baseline — the committed
+          ``lint_baseline.json`` is missing or does not parse (CI must
+          treat that as infrastructure failure, not as findings), or
+          findings the baseline still carries were fixed and the
+          committed debt ledger can shrink but didn't (regenerate it,
+          only ever smaller, with
+          ``python -m shockwave_tpu.analysis --write-baseline``).
 
 Usage (the standing gate; see docs/USAGE.md "Static analysis"):
-  python scripts/ci/lint.py [--json]
+  python scripts/ci/lint.py [--json] [--github] [--changed-only]
+
+``--changed-only`` is the pre-commit fast path: only files reported
+modified/added by git (staged, unstaged, and untracked) are checked,
+skipping the repo-wide walk; baseline entries for unchanged files are
+not judged stale. ``--github`` (implied by the ``GITHUB_ACTIONS`` env
+var) emits ``::error file=...`` workflow annotations so findings land
+inline on the PR diff.
 
 This is the same check tier-1 enforces via
 ``tests/test_analysis.py::test_repo_is_clean_against_baseline``; the
@@ -24,7 +35,9 @@ finding list on stdout without a pytest run.
 """
 
 import argparse
+import json
 import os
+import subprocess
 import sys
 
 REPO_ROOT = os.path.dirname(
@@ -34,6 +47,59 @@ sys.path.insert(0, REPO_ROOT)
 
 from shockwave_tpu.analysis.cli import main  # noqa: E402
 
+BASELINE = os.path.join(REPO_ROOT, "lint_baseline.json")
+
+
+def _check_baseline_readable() -> str:
+    """'' when the committed baseline loads; otherwise the reason the
+    gate is broken (CI exits 2: infrastructure failure, not findings)."""
+    if not os.path.exists(BASELINE):
+        return f"baseline {BASELINE} is missing"
+    try:
+        with open(BASELINE, encoding="utf-8") as f:
+            data = json.load(f)
+    except (json.JSONDecodeError, OSError) as e:
+        return f"baseline {BASELINE} does not parse: {e}"
+    if not isinstance(data, dict) or "entries" not in data:
+        return f"baseline {BASELINE} has no 'entries' ledger"
+    return ""
+
+
+def _changed_python_files():
+    """Repo-relative .py files modified/added per git (staged, unstaged,
+    untracked) within the enforcement scope."""
+    out = subprocess.run(
+        # --untracked-files=all: without it a brand-new DIRECTORY shows
+        # as one "?? dir/" entry and every .py inside it would be
+        # invisible to the fast path.
+        ["git", "status", "--porcelain", "--untracked-files=all"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        check=True,
+    ).stdout
+    changed = []
+    for line in out.splitlines():
+        status, _, path = line[:2], line[2], line[3:].strip()
+        if status.strip().startswith("D"):
+            continue
+        if " -> " in path:  # rename: keep the new side
+            path = path.split(" -> ", 1)[1]
+        if path.startswith('"') and path.endswith('"'):
+            # Porcelain C-quotes paths with specials; unescape the
+            # common cases rather than skipping the file.
+            path = path[1:-1].encode().decode("unicode_escape")
+        if not path.endswith(".py"):
+            continue
+        if not (
+            path.startswith(("shockwave_tpu/", "scripts/"))
+            or path == "bench.py"
+        ):
+            continue
+        if os.path.exists(os.path.join(REPO_ROOT, path)):
+            changed.append(path)
+    return sorted(set(changed))
+
 
 if __name__ == "__main__":
     parser = argparse.ArgumentParser(
@@ -42,8 +108,50 @@ if __name__ == "__main__":
     parser.add_argument(
         "--json", action="store_true", help="machine-readable output"
     )
+    parser.add_argument(
+        "--github",
+        action="store_true",
+        help="emit GitHub Actions ::error annotations (implied when "
+        "GITHUB_ACTIONS is set)",
+    )
+    parser.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="pre-commit fast path: check only git-modified files",
+    )
     args = parser.parse_args()
-    argv = ["--json"] if args.json else []
+
+    broken = _check_baseline_readable()
+    if broken:
+        print(f"lint gate BROKEN: {broken}", file=sys.stderr)
+        print(
+            "restore lint_baseline.json from the main branch, or "
+            "regenerate it with "
+            "`python -m shockwave_tpu.analysis --write-baseline`",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+
+    argv = []
+    if args.json:
+        argv.append("--json")
+    elif args.github or os.environ.get("GITHUB_ACTIONS"):
+        argv += ["--format", "github"]
+    if args.changed_only:
+        try:
+            changed = _changed_python_files()
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            print(
+                f"lint gate BROKEN: git status failed ({e}); "
+                "run without --changed-only",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        if not changed:
+            print("lint gate PASS: no changed python files")
+            sys.exit(0)
+        argv += changed
+
     rc = main(argv)
     if rc == 0:
         print("lint gate PASS: no new findings, baseline exact")

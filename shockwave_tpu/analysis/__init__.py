@@ -3,45 +3,57 @@
 The rule catalog targets the hazard classes this codebase actually
 has (donated-buffer reuse, host syncs in hot loops, PRNG key reuse,
 unlocked shared-state mutation, non-atomic artifact writes, solver
-backend interface drift); a committed baseline ratchets the repo-wide
-finding count monotonically toward zero. CLI:
+backend interface drift — and, interprocedurally, lock-order cycles,
+transitive host syncs, swallowed exceptions); a committed baseline
+ratchets the repo-wide finding count monotonically toward zero. CLI:
 ``python -m shockwave_tpu.analysis`` (see ``docs/USAGE.md``).
+
+This ``__init__`` is LAZY (PEP 562): production modules (obs, runtime,
+native, the solver) import :mod:`shockwave_tpu.analysis.sanitize` on
+their hot import paths, and reaching it must not pay for the whole
+rule catalog — the exports below resolve on first attribute access.
 """
 
-from shockwave_tpu.analysis.baseline import (
-    default_baseline_path,
-    diff_against_baseline,
-    load_baseline,
-    make_baseline,
-    save_baseline,
-)
-from shockwave_tpu.analysis.core import (
-    DEFAULT_SCOPE,
-    FileContext,
-    Finding,
-    Rule,
-    active,
-    check_source,
-    repo_root,
-    run_paths,
-)
-from shockwave_tpu.analysis.rules import RULE_CLASSES, default_rules, rule_by_name
+import importlib
 
-__all__ = [
-    "DEFAULT_SCOPE",
-    "FileContext",
-    "Finding",
-    "Rule",
-    "RULE_CLASSES",
-    "active",
-    "check_source",
-    "default_baseline_path",
-    "default_rules",
-    "diff_against_baseline",
-    "load_baseline",
-    "make_baseline",
-    "repo_root",
-    "rule_by_name",
-    "run_paths",
-    "save_baseline",
-]
+# name -> submodule that defines it.
+_EXPORTS = {
+    "default_baseline_path": "baseline",
+    "diff_against_baseline": "baseline",
+    "load_baseline": "baseline",
+    "make_baseline": "baseline",
+    "save_baseline": "baseline",
+    "DEFAULT_SCOPE": "core",
+    "FileContext": "core",
+    "Finding": "core",
+    "ProjectRule": "core",
+    "Rule": "core",
+    "active": "core",
+    "check_source": "core",
+    "checked_relpaths": "core",
+    "repo_root": "core",
+    "run_paths": "core",
+    "RULE_CLASSES": "rules",
+    "default_rules": "rules",
+    "rule_by_name": "rules",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        modname = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    value = getattr(
+        importlib.import_module(f".{modname}", __name__), name
+    )
+    globals()[name] = value  # cache: subsequent access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -3,6 +3,16 @@
 Exit codes: 0 clean (no findings beyond the baseline, no stale
 baseline debt), 1 new findings, 2 stale baseline (ratchet: the debt
 shrank but the committed ledger didn't), 3 usage/internal error.
+
+Output formats (``--format``): ``text`` (default), ``json``
+(machine-readable; ``--json`` is an alias), ``github`` (GitHub
+Actions ``::error file=...`` workflow annotations — what
+``scripts/ci/lint.py`` emits on CI so findings land inline on the PR
+diff).
+
+When the checked paths are a subset of the repo (``--changed-only``
+pre-commit runs), baseline entries for UNCHECKED files are not
+reported stale — a partial run can only see partial debt.
 """
 
 from __future__ import annotations
@@ -17,6 +27,7 @@ from shockwave_tpu.analysis.core import (
     DEFAULT_SCOPE,
     Finding,
     active,
+    checked_relpaths,
     repo_root,
     run_paths,
 )
@@ -36,10 +47,40 @@ def _parser() -> argparse.ArgumentParser:
         nargs="*",
         help=f"files/dirs to check (default: {' '.join(DEFAULT_SCOPE)})",
     )
-    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--format",
+        choices=("text", "json", "github"),
+        default=None,
+        help="output format: text (default), json, or github "
+        "(::error workflow annotations for Actions)",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable output (alias for --format json)",
+    )
     p.add_argument(
         "--rules",
         help="comma-separated rule names to run (default: all)",
+    )
+    p.add_argument(
+        "--fix",
+        action="store_true",
+        help="apply the available autofixes (currently: rewrite "
+        'open(..., "w")+json.dump/f.write to the atomic '
+        "utils/fileio helpers) in place",
+    )
+    p.add_argument(
+        "--dry-run",
+        action="store_true",
+        help="with --fix: print the unified diff, write nothing",
+    )
+    p.add_argument(
+        "--lock-graph",
+        action="store_true",
+        help="print the interprocedural lock acquisition-order graph "
+        "as JSON (the static prediction to diff against the "
+        "SHOCKWAVE_SANITIZE=locks observed order) and exit",
     )
     p.add_argument(
         "--baseline",
@@ -82,14 +123,78 @@ def _resolve_rules(spec: Optional[str]):
     return rules
 
 
+def _github_escape(text: str) -> str:
+    """GitHub workflow-command data escaping (%, CR, LF)."""
+    return (
+        text.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+    )
+
+
+def _emit_github(new: List[Finding], stale: List[dict]) -> None:
+    for f in new:
+        print(
+            f"::error file={f.path},line={f.line},col={f.col + 1},"
+            f"title=shockwave-lint {f.rule}::{_github_escape(f.message)}"
+        )
+    for e in stale:
+        print(
+            f"::warning file={e['path']},line={e['line']},"
+            f"title=shockwave-lint stale baseline::"
+            f"{_github_escape('finding fixed; shrink the baseline with --write-baseline')}"
+        )
+
+
+def _run_fix(args) -> int:
+    import os
+
+    from shockwave_tpu.analysis import fixers
+    from shockwave_tpu.analysis.core import iter_python_files
+
+    root = repo_root()
+    resolved = [
+        p if os.path.isabs(p) else os.path.join(root, p)
+        for p in (args.paths or DEFAULT_SCOPE)
+    ]
+    triples = []
+    for path in iter_python_files(
+        [p for p in resolved if os.path.exists(p)]
+    ):
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            triples.append((path, relpath, f.read()))
+    descriptions, diff = fixers.fix_files(triples, dry_run=args.dry_run)
+    if args.dry_run:
+        if diff:
+            print(diff, end="")
+        print(
+            f"shockwave-lint --fix --dry-run: {len(descriptions)} "
+            "rewrite(s) available (nothing written)"
+        )
+    else:
+        for d in descriptions:
+            print(f"fixed {d}")
+        print(f"shockwave-lint --fix: {len(descriptions)} rewrite(s) applied")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = _parser().parse_args(argv)
+    fmt = args.format or ("json" if args.json else "text")
 
     if args.list_rules:
         for cls in RULE_CLASSES:
             print(f"{cls.name}: {cls.description}")
             print(f"    why: {cls.rationale}")
         return 0
+
+    if args.lock_graph:
+        from shockwave_tpu.analysis.rules.interproc import lock_graph_dict
+
+        print(json.dumps(lock_graph_dict(), indent=2))
+        return 0
+
+    if args.fix:
+        return _run_fix(args)
 
     try:
         rules = _resolve_rules(args.rules)
@@ -116,8 +221,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         bl = baseline_mod.load_baseline(baseline_path)
         new, stale = baseline_mod.diff_against_baseline(act, bl)
+        if args.paths:
+            # Partial run: only entries for files we actually checked
+            # can be judged stale.
+            checked = checked_relpaths(args.paths)
+            stale = [e for e in stale if e["path"] in checked]
 
-    if args.json:
+    if fmt == "json":
         print(
             json.dumps(
                 {
@@ -130,6 +240,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 },
                 indent=2,
             )
+        )
+    elif fmt == "github":
+        _emit_github(new, stale)
+        print(
+            f"shockwave-lint: {len(act)} finding(s) "
+            f"({len(new)} new, {len(stale)} stale baseline "
+            f"entr{'y' if len(stale) == 1 else 'ies'})"
         )
     else:
         report = new if not args.no_baseline else act

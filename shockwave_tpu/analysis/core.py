@@ -65,6 +65,7 @@ class Rule:
     name: str = ""
     description: str = ""
     rationale: str = ""
+    project_level: bool = False
 
     def applies_to(self, relpath: str) -> bool:
         return True
@@ -92,6 +93,24 @@ class Rule:
             line_text=text,
             suppressed=ctx.is_suppressed(line, self.name),
         )
+
+
+class ProjectRule(Rule):
+    """A rule that analyzes the whole project at once (symbol table +
+    call graph) instead of one file at a time.
+
+    ``check`` is a no-op so project rules compose with the per-file
+    runner; :func:`run_paths` invokes :meth:`check_project` exactly once
+    per run and scopes the findings to the checked files.
+    """
+
+    project_level = True
+
+    def check(self, ctx: "FileContext") -> Iterator[Finding]:
+        return iter(())
+
+    def check_project(self, project) -> Iterator[Finding]:
+        raise NotImplementedError
 
 
 # -- per-file context ---------------------------------------------------
@@ -275,18 +294,56 @@ def run_paths(
 
     root = root or repo_root()
     rules = list(rules) if rules is not None else default_rules()
+    file_rules = [r for r in rules if not r.project_level]
+    project_rules = [r for r in rules if r.project_level]
     resolved = [
         p if os.path.isabs(p) else os.path.join(root, p)
         for p in (paths or DEFAULT_SCOPE)
     ]
     findings: List[Finding] = []
+    checked: Set[str] = set()
     for path in iter_python_files([p for p in resolved if os.path.exists(p)]):
         relpath = os.path.relpath(path, root)
+        checked.add(relpath.replace(os.sep, "/"))
         with open(path, encoding="utf-8") as f:
             source = f.read()
-        findings.extend(check_source(source, relpath, rules))
+        findings.extend(check_source(source, relpath, file_rules))
+    if project_rules and any(
+        c.startswith("shockwave_tpu/") for c in checked
+    ):
+        # Project rules always analyze the whole package (a cross-file
+        # hazard needs both halves in view) but only REPORT findings in
+        # the checked scope, so --changed-only stays fast and exact —
+        # and skips the build entirely when no checked file could
+        # receive an interprocedural finding.
+        from shockwave_tpu.analysis.project import Project
+
+        project = Project.build(root)
+        for rule in project_rules:
+            for f in rule.check_project(project):
+                if f.path in checked:
+                    findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
+
+
+def checked_relpaths(
+    paths: Optional[Sequence[str]] = None, root: Optional[str] = None
+) -> Set[str]:
+    """The repo-relative files a :func:`run_paths` call with the same
+    arguments would check — what the baseline's stale-entry scoping
+    uses for partial (``--changed-only``) runs."""
+    root = root or repo_root()
+    resolved = [
+        p if os.path.isabs(p) else os.path.join(root, p)
+        for p in (paths or DEFAULT_SCOPE)
+    ]
+    return {
+        os.path.relpath(p, root).replace(os.sep, "/")
+        for p in iter_python_files(
+            [p for p in resolved if os.path.exists(p)]
+        )
+    }
 
 
 def active(findings: Iterable[Finding]) -> List[Finding]:

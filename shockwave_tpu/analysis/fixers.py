@@ -1,0 +1,224 @@
+"""Autofixes for mechanically-rewritable findings.
+
+Currently one fixer: the ``non-atomic-artifact-write`` rule's two
+dominant shapes rewrite to the :mod:`shockwave_tpu.utils.fileio`
+helpers losslessly::
+
+    with open(path, "w") as f:          ->  atomic_write_json(path, obj,
+        json.dump(obj, f, indent=2)                           indent=2)
+
+    with open(path, "w") as f:          ->  atomic_write_text(path, text)
+        f.write(text)
+
+Anything fancier (multiple statements in the with body, extra
+``json.dump`` kwargs the helper has no slot for, writes in a loop) is
+left for a human — a wrong autofix is worse than a finding.
+
+The fixer inserts a function-local
+``from shockwave_tpu.utils.fileio import ...`` immediately above the
+rewritten statement unless the module already imports the helper at
+top level: scripts in this repo do a ``sys.path.insert`` dance before
+their project imports, and a local import is immune to that ordering.
+
+``python -m shockwave_tpu.analysis --fix`` applies fixes in place;
+``--fix --dry-run`` prints the unified diff and writes nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+from typing import List, Optional, Tuple
+
+from shockwave_tpu.analysis.core import FileContext
+
+_TRUNCATING_TEXT_MODES = {"w", "wt", "tw", "w+", "wt+"}
+
+# json.dump keywords atomic_write_json can represent.
+_DUMP_KW_OK = {"indent"}
+
+
+class Fix:
+    """One planned rewrite: replace source lines [start, end] (1-based,
+    inclusive) with ``replacement`` (a list of full lines)."""
+
+    __slots__ = ("start", "end", "replacement", "description")
+
+    def __init__(self, start, end, replacement, description):
+        self.start = start
+        self.end = end
+        self.replacement = replacement
+        self.description = description
+
+
+def _open_mode(call: ast.Call) -> Optional[str]:
+    mode_node = None
+    if len(call.args) >= 2:
+        mode_node = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if isinstance(mode_node, ast.Constant) and isinstance(
+        mode_node.value, str
+    ):
+        return mode_node.value
+    return None
+
+
+def _match_open_with(stmt: ast.With):
+    """(open_call, bound_name) when stmt is `with open(..., "w") as f:`
+    with NOTHING beyond path and mode — an encoding/newline/buffering
+    argument has no slot on the atomic helpers, and dropping it would
+    silently change the written bytes."""
+    if len(stmt.items) != 1:
+        return None
+    item = stmt.items[0]
+    call = item.context_expr
+    if not (
+        isinstance(call, ast.Call)
+        and isinstance(call.func, ast.Name)
+        and call.func.id == "open"
+        and call.args
+    ):
+        return None
+    if len(call.args) > 2:
+        return None
+    if any(kw.arg != "mode" for kw in call.keywords):
+        return None
+    if _open_mode(call) not in _TRUNCATING_TEXT_MODES:
+        return None
+    if not isinstance(item.optional_vars, ast.Name):
+        return None
+    return call, item.optional_vars.id
+
+
+def _module_imports_helper(tree: ast.Module, name: str) -> bool:
+    for stmt in tree.body:
+        if isinstance(stmt, ast.ImportFrom):
+            if stmt.module and stmt.module.endswith("utils.fileio"):
+                if any(a.name == name for a in stmt.names):
+                    return True
+    return False
+
+
+def plan_fixes(source: str, relpath: str) -> List[Fix]:
+    """Every non-atomic-artifact-write rewrite this fixer can do safely
+    in ``source``. Suppressed lines are respected (a justified
+    suppression documents a deliberate exception — don't "fix" it)."""
+    try:
+        ctx = FileContext(relpath, source)
+    except SyntaxError:
+        return []
+    fixes: List[Fix] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.With):
+            continue
+        if ctx.is_suppressed(node.lineno, "non-atomic-artifact-write"):
+            continue
+        matched = _match_open_with(node)
+        if matched is None:
+            continue
+        open_call, fname = matched
+        if len(node.body) != 1 or not isinstance(node.body[0], ast.Expr):
+            continue
+        inner = node.body[0].value
+        if not isinstance(inner, ast.Call):
+            continue
+        path_src = ast.get_source_segment(source, open_call.args[0])
+        if path_src is None:
+            continue
+        indent = " " * node.col_offset
+        func = inner.func
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "json"
+            and func.attr == "dump"
+            and len(inner.args) == 2
+            and isinstance(inner.args[1], ast.Name)
+            and inner.args[1].id == fname
+            and all(kw.arg in _DUMP_KW_OK for kw in inner.keywords)
+        ):
+            obj_src = ast.get_source_segment(source, inner.args[0])
+            if obj_src is None:
+                continue
+            kw_src = ""
+            for kw in inner.keywords:
+                kw_val = ast.get_source_segment(source, kw.value)
+                if kw_val is None:
+                    kw_src = None
+                    break
+                kw_src += f", {kw.arg}={kw_val}"
+            if kw_src is None:
+                continue
+            helper = "atomic_write_json"
+            call_src = f"{helper}({path_src}, {obj_src}{kw_src})"
+        elif (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == fname
+            and func.attr == "write"
+            and len(inner.args) == 1
+            and not inner.keywords
+        ):
+            text_src = ast.get_source_segment(source, inner.args[0])
+            if text_src is None:
+                continue
+            helper = "atomic_write_text"
+            call_src = f"{helper}({path_src}, {text_src})"
+        else:
+            continue
+        lines = [f"{indent}{call_src}\n"]
+        if not _module_imports_helper(ctx.tree, helper):
+            lines.insert(
+                0,
+                f"{indent}from shockwave_tpu.utils.fileio import "
+                f"{helper}\n",
+            )
+        fixes.append(
+            Fix(
+                node.lineno,
+                node.body[0].end_lineno,
+                lines,
+                f"{relpath}:{node.lineno}: open+{func.attr} -> {helper}",
+            )
+        )
+    return fixes
+
+
+def apply_fixes(source: str, fixes: List[Fix]) -> str:
+    lines = source.splitlines(keepends=True)
+    for fix in sorted(fixes, key=lambda f: f.start, reverse=True):
+        lines[fix.start - 1: fix.end] = fix.replacement
+    return "".join(lines)
+
+
+def fix_files(
+    paths_and_sources: List[Tuple[str, str, str]], dry_run: bool
+) -> Tuple[List[str], str]:
+    """Run the fixer over ``(abspath, relpath, source)`` triples.
+    Returns (descriptions, unified diff). Writes files unless
+    ``dry_run``."""
+    from shockwave_tpu.utils.fileio import atomic_write_text
+
+    descriptions: List[str] = []
+    diffs: List[str] = []
+    for abspath, relpath, source in paths_and_sources:
+        fixes = plan_fixes(source, relpath)
+        if not fixes:
+            continue
+        fixed = apply_fixes(source, fixes)
+        descriptions.extend(f.description for f in fixes)
+        diffs.append(
+            "".join(
+                difflib.unified_diff(
+                    source.splitlines(keepends=True),
+                    fixed.splitlines(keepends=True),
+                    fromfile=f"a/{relpath}",
+                    tofile=f"b/{relpath}",
+                )
+            )
+        )
+        if not dry_run:
+            atomic_write_text(abspath, fixed)
+    return descriptions, "".join(diffs)

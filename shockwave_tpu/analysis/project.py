@@ -1,0 +1,699 @@
+"""Project-wide symbol table and call graph for interprocedural rules.
+
+The per-file rules (:mod:`.rules`) cannot see the hazards that only
+exist ACROSS files: a lock acquired in ``runtime/dispatcher.py`` while
+a call chain reaches another lock in ``obs/metrics.py``, a host sync
+buried two calls below a hot loop, an exception swallowed by a helper
+the gRPC handler delegates to. This module parses every module under
+``shockwave_tpu/`` once and answers the questions those rules need:
+
+* **symbol table** — modules, module-level functions/classes/instances,
+  class methods, with ``from``-import and alias resolution between
+  project modules (external imports are recorded but opaque);
+* **method resolution** — ``self.foo()`` through the class and its
+  project-local bases; ``obj.foo()`` through the inferred type of
+  ``obj`` (module-level instances, ``self._attr = Class(...)`` fields,
+  flow-insensitive function locals);
+* **decorator unwrapping** — ``f = jax.jit(step)`` /
+  ``@functools.partial(jax.jit, ...)`` resolve calls to the wrapped
+  function, so tracing follows the python body, not the wrapper;
+* **call graph + fixpoints** — per-function callee sets with call-site
+  nodes, and transitive "which locks does this call acquire" /
+  "which host-sync sites does this call reach" closures with witness
+  chains for the findings.
+
+Everything is flow-insensitive and intentionally conservative in the
+direction each rule needs (see the rule docstrings).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from shockwave_tpu.analysis.core import (
+    _parse_suppressions,
+    dotted_name,
+    repo_root,
+)
+
+# Leaf callables that create a lock object. ``make_lock``/``make_rlock``
+# are the sanitizer factories (:mod:`shockwave_tpu.analysis.sanitize`);
+# the threading names are the raw primitives they wrap.
+LOCK_FACTORIES = {"Lock", "RLock", "make_lock", "make_rlock"}
+CONDITION_FACTORIES = {"Condition", "make_condition"}
+
+
+class FunctionInfo:
+    """One function or method definition."""
+
+    __slots__ = (
+        "qname", "name", "module", "cls", "node", "calls", "decorators",
+        "local_imports",
+    )
+
+    def __init__(self, qname, name, module, cls, node):
+        self.qname: str = qname
+        self.name: str = name
+        self.module: "ModuleInfo" = module
+        self.cls: Optional["ClassInfo"] = cls
+        self.node: ast.AST = node
+        # filled by Project._link: list of (call_node, callee_qname)
+        self.calls: List[Tuple[ast.Call, str]] = []
+        self.decorators: List[str] = [
+            dotted_name(d.func) if isinstance(d, ast.Call) else dotted_name(d)
+            for d in node.decorator_list
+        ]
+        # Function-local `from shockwave_tpu import obs`-style imports
+        # (the repo's lazy-import idiom); merged over module imports
+        # during call resolution.
+        self.local_imports: Dict[str, str] = {}
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<fn {self.qname}>"
+
+
+class ClassInfo:
+    __slots__ = (
+        "qname", "name", "module", "node", "methods", "bases",
+        "lock_attrs", "lock_aliases", "attr_types",
+    )
+
+    def __init__(self, qname, name, module, node):
+        self.qname: str = qname
+        self.name: str = name
+        self.module: "ModuleInfo" = module
+        self.node: ast.ClassDef = node
+        self.methods: Dict[str, FunctionInfo] = {}
+        self.bases: List[str] = [dotted_name(b) for b in node.bases]
+        # self attributes assigned a lock factory call anywhere in the
+        # class body (typically __init__).
+        self.lock_attrs: Set[str] = set()
+        # Condition(self._lock)-style aliases: alias attr -> lock attr.
+        self.lock_aliases: Dict[str, str] = {}
+        # self._attr = SomeProjectClass(...) -> class qname (field types).
+        self.attr_types: Dict[str, str] = {}
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<class {self.qname}>"
+
+
+class ModuleInfo:
+    __slots__ = (
+        "modname", "relpath", "tree", "source", "lines", "suppressions",
+        "functions", "classes", "imports", "instances", "module_locks",
+        "aliased_defs", "traced_defs",
+    )
+
+    def __init__(self, modname, relpath, source, tree):
+        self.modname: str = modname
+        self.relpath: str = relpath
+        self.source: str = source
+        self.lines: List[str] = source.splitlines()
+        self.tree: ast.Module = tree
+        self.suppressions = _parse_suppressions(source)
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        # local name -> dotted target ("shockwave_tpu.obs" for modules,
+        # "shockwave_tpu.obs.metrics.MetricsRegistry" for symbols).
+        self.imports: Dict[str, str] = {}
+        # module-level `x = SomeClass(...)` -> class qname.
+        self.instances: Dict[str, str] = {}
+        # module-level `_lock = threading.Lock()` names.
+        self.module_locks: Set[str] = set()
+        # module-level `g = jax.jit(f)` / `g = f` aliases -> local fn name.
+        self.aliased_defs: Dict[str, str] = {}
+        # Local fn names wrapped by a TRACING wrapper (jit/remat) at
+        # module level — only these make the body device code; a plain
+        # `public = _impl` alias or lru_cache wrapper does not.
+        self.traced_defs: Set[str] = set()
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<module {self.modname}>"
+
+
+# -- jit/decorator unwrapping -------------------------------------------
+
+_WRAPPER_LEAVES = {"jit", "partial", "wraps", "lru_cache", "cache", "remat"}
+
+
+def unwrap_call(value: ast.AST) -> ast.AST:
+    """Peel ``jax.jit(f, ...)`` / ``functools.partial(g, ...)`` wrappers
+    down to the innermost wrapped expression."""
+    while isinstance(value, ast.Call):
+        leaf = dotted_name(value.func).split(".")[-1]
+        if leaf in _WRAPPER_LEAVES and value.args:
+            value = value.args[0]
+        else:
+            break
+    return value
+
+
+_TRACING_LEAVES = {"jit", "remat"}
+
+
+def _wrapper_chain_traces(value: ast.AST) -> bool:
+    """True when a ``g = wrapper(...)(f)`` chain contains a TRACING
+    wrapper (jit/remat) — those make the wrapped body device code; a
+    plain alias or ``lru_cache``/``wraps`` does not."""
+    while isinstance(value, ast.Call):
+        leaf = dotted_name(value.func).split(".")[-1]
+        if leaf in _TRACING_LEAVES:
+            return True
+        if leaf in _WRAPPER_LEAVES and value.args:
+            value = value.args[0]
+        else:
+            break
+    return False
+
+
+# -- building -----------------------------------------------------------
+
+def _module_name(relpath: str) -> str:
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+class Project:
+    """Symbol table + call graph over one package tree."""
+
+    def __init__(self, root: str, package: str = "shockwave_tpu"):
+        self.root = root
+        self.package = package
+        self.modules: Dict[str, ModuleInfo] = {}  # by modname
+        self.by_path: Dict[str, ModuleInfo] = {}  # by relpath
+        self.functions: Dict[str, FunctionInfo] = {}  # by qname
+        self.classes: Dict[str, ClassInfo] = {}  # by qname
+
+    # -- construction ----------------------------------------------------
+    @classmethod
+    def build(
+        cls, root: Optional[str] = None, package: str = "shockwave_tpu"
+    ) -> "Project":
+        root = root or repo_root()
+        project = cls(root, package)
+        pkg_dir = os.path.join(root, package)
+        for dirpath, dirnames, filenames in os.walk(pkg_dir):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in ("__pycache__",)
+            )
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                relpath = os.path.relpath(path, root).replace(os.sep, "/")
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                project.add_module(relpath, source)
+        project.link()
+        return project
+
+    def add_module(self, relpath: str, source: str) -> Optional[ModuleInfo]:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            return None  # per-file rules report parse errors already
+        mod = ModuleInfo(_module_name(relpath), relpath, source, tree)
+        self.modules[mod.modname] = mod
+        self.by_path[relpath] = mod
+        self._collect(mod)
+        return mod
+
+    def _collect(self, mod: ModuleInfo) -> None:
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Import):
+                for alias in stmt.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    target = alias.name if alias.asname else alias.name.split(".")[0]
+                    mod.imports[local] = target
+            elif isinstance(stmt, ast.ImportFrom):
+                base = self._from_base(mod, stmt)
+                for alias in stmt.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    mod.imports[local] = f"{base}.{alias.name}" if base else alias.name
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qname = f"{mod.modname}.{stmt.name}"
+                info = FunctionInfo(qname, stmt.name, mod, None, stmt)
+                mod.functions[stmt.name] = info
+                self.functions[qname] = info
+            elif isinstance(stmt, ast.ClassDef):
+                self._collect_class(mod, stmt)
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                target = stmt.targets[0]
+                if not isinstance(target, ast.Name):
+                    continue
+                value = stmt.value
+                if isinstance(value, ast.Call):
+                    leaf = dotted_name(value.func).split(".")[-1]
+                    if leaf in LOCK_FACTORIES | CONDITION_FACTORIES:
+                        mod.module_locks.add(target.id)
+                        continue
+                    inner = unwrap_call(value)
+                    if isinstance(inner, ast.Name):
+                        # g = jax.jit(f): alias to the wrapped local def.
+                        mod.aliased_defs[target.id] = inner.id
+                        if _wrapper_chain_traces(value):
+                            mod.traced_defs.add(inner.id)
+                    elif isinstance(value.func, (ast.Name, ast.Attribute)):
+                        # x = SomeClass(...): module-level instance.
+                        mod.instances[target.id] = dotted_name(value.func) or (
+                            value.func.id
+                            if isinstance(value.func, ast.Name)
+                            else ""
+                        )
+                elif isinstance(value, ast.Name):
+                    mod.aliased_defs[target.id] = value.id
+
+    def _from_base(self, mod: ModuleInfo, stmt: ast.ImportFrom) -> str:
+        if stmt.level == 0:
+            return stmt.module or ""
+        # Relative import: resolve against this module's package.
+        parts = mod.modname.split(".")
+        # A package __init__ counts as the package itself.
+        is_pkg = mod.relpath.endswith("__init__.py")
+        up = stmt.level - (1 if is_pkg else 0)
+        base_parts = parts[: len(parts) - up] if up else parts
+        if stmt.module:
+            base_parts = base_parts + stmt.module.split(".")
+        return ".".join(base_parts)
+
+    def _collect_class(self, mod: ModuleInfo, node: ast.ClassDef) -> None:
+        qname = f"{mod.modname}.{node.name}"
+        cls = ClassInfo(qname, node.name, mod, node)
+        mod.classes[node.name] = cls
+        self.classes[qname] = cls
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fq = f"{qname}.{stmt.name}"
+                info = FunctionInfo(fq, stmt.name, mod, cls, stmt)
+                cls.methods[stmt.name] = info
+                self.functions[fq] = info
+        # Lock attrs, Condition aliases, and field types from every
+        # method body (typically __init__).
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign) or not isinstance(
+                sub.value, ast.Call
+            ):
+                continue
+            for target in sub.targets:
+                if not (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    continue
+                leaf = dotted_name(sub.value.func).split(".")[-1]
+                if leaf in LOCK_FACTORIES:
+                    cls.lock_attrs.add(target.attr)
+                elif leaf in CONDITION_FACTORIES:
+                    # Condition(self._lock) aliases the underlying lock;
+                    # a bare Condition() owns a fresh (anonymous) lock.
+                    alias_of = None
+                    for arg in sub.value.args:
+                        if (
+                            isinstance(arg, ast.Attribute)
+                            and isinstance(arg.value, ast.Name)
+                            and arg.value.id == "self"
+                        ):
+                            alias_of = arg.attr
+                    if alias_of:
+                        cls.lock_aliases[target.attr] = alias_of
+                    else:
+                        cls.lock_attrs.add(target.attr)
+                else:
+                    callee = dotted_name(sub.value.func)
+                    if callee:
+                        cls.attr_types[target.attr] = callee
+
+    # -- linking ---------------------------------------------------------
+    def link(self) -> None:
+        """Resolve attr_types/instances to class qnames and build the
+        per-function callee lists."""
+        for mod in self.modules.values():
+            mod.instances = {
+                name: resolved
+                for name, target in mod.instances.items()
+                if (resolved := self._resolve_class_name(mod, target))
+            }
+            for cls in mod.classes.values():
+                cls.attr_types = {
+                    attr: resolved
+                    for attr, target in cls.attr_types.items()
+                    if (resolved := self._resolve_class_name(mod, target))
+                }
+        for fn in list(self.functions.values()):
+            fn.calls = list(self._resolve_calls(fn))
+
+    def _resolve_dotted(
+        self, mod: ModuleInfo, dotted: str, extra: Optional[Dict[str, str]] = None
+    ) -> Optional[str]:
+        """Resolve a dotted reference seen in ``mod`` to a fully
+        qualified project name (module, class, or function), or None.
+        ``extra`` holds function-local imports that shadow the module's."""
+        if not dotted:
+            return None
+        head, _, rest = dotted.partition(".")
+        target = (extra or {}).get(head) or mod.imports.get(head)
+        if target is None:
+            # An unimported head: either a module-local symbol or junk.
+            if head in mod.classes or head in mod.functions:
+                target = f"{mod.modname}.{head}"
+            elif dotted.startswith(self.package):
+                target = head
+            else:
+                return None
+        full = f"{target}.{rest}" if rest else target
+        # Normalize chains that route through modules:
+        # "shockwave_tpu.obs.metrics.MetricsRegistry" etc.
+        return full
+
+    def _resolve_class_name(
+        self, mod: ModuleInfo, dotted: str
+    ) -> Optional[str]:
+        full = self._resolve_dotted(mod, dotted)
+        if full is None:
+            return None
+        if full in self.classes:
+            return full
+        # "pkg.module.Class" where the import bound a module.
+        modname, _, leaf = full.rpartition(".")
+        target_mod = self.modules.get(modname)
+        if target_mod and leaf in target_mod.classes:
+            return f"{modname}.{leaf}"
+        return None
+
+    def resolve_function(
+        self,
+        mod: ModuleInfo,
+        dotted: str,
+        extra: Optional[Dict[str, str]] = None,
+    ) -> Optional[FunctionInfo]:
+        """Resolve a (possibly dotted) callee name seen in ``mod``."""
+        full = self._resolve_dotted(mod, dotted, extra)
+        if full is None:
+            return None
+        if full in self.functions:
+            return self.functions[full]
+        modname, _, leaf = full.rpartition(".")
+        target_mod = self.modules.get(modname)
+        if target_mod:
+            if leaf in target_mod.aliased_defs:
+                leaf = target_mod.aliased_defs[leaf]
+            if leaf in target_mod.functions:
+                return target_mod.functions[leaf]
+            if leaf in target_mod.classes:
+                init = target_mod.classes[leaf].methods.get("__init__")
+                return init
+        if full in self.classes:
+            return self.classes[full].methods.get("__init__")
+        return None
+
+    def _method_on(self, cls_qname: str, name: str) -> Optional[FunctionInfo]:
+        """Method lookup through project-local bases (one-level MRO walk,
+        depth-limited against cycles)."""
+        seen = set()
+        stack = [cls_qname]
+        while stack:
+            qn = stack.pop(0)
+            if qn in seen or qn not in self.classes:
+                continue
+            seen.add(qn)
+            cls = self.classes[qn]
+            if name in cls.methods:
+                return cls.methods[name]
+            for base in cls.bases:
+                resolved = self._resolve_class_name(cls.module, base)
+                if resolved:
+                    stack.append(resolved)
+        return None
+
+    def _resolve_calls(
+        self, fn: FunctionInfo
+    ) -> Iterator[Tuple[ast.Call, str]]:
+        mod = fn.module
+        fn.local_imports = self._collect_local_imports(fn)
+        local_types = self._local_types(fn)
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self._resolve_call(fn, mod, node, local_types)
+            if callee is not None:
+                yield node, callee.qname
+
+    def _collect_local_imports(self, fn: FunctionInfo) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    out[local] = (
+                        alias.name if alias.asname else alias.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                base = self._from_base(fn.module, node)
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    out[local] = (
+                        f"{base}.{alias.name}" if base else alias.name
+                    )
+        return out
+
+    def _local_types(self, fn: FunctionInfo) -> Dict[str, str]:
+        """Flow-insensitive ``x = SomeClass(...)`` locals."""
+        types: Dict[str, str] = {}
+        for node in ast.walk(fn.node):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                resolved = self._resolve_class_name(
+                    fn.module, dotted_name(node.value.func)
+                )
+                if resolved:
+                    types[node.targets[0].id] = resolved
+        return types
+
+    def _resolve_call(
+        self,
+        fn: FunctionInfo,
+        mod: ModuleInfo,
+        node: ast.Call,
+        local_types: Dict[str, str],
+    ) -> Optional[FunctionInfo]:
+        func = node.func
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name in mod.aliased_defs:
+                name = mod.aliased_defs[name]
+            # Function-local jit aliases: g = jax.jit(f); g(...)
+            local_alias = self._local_alias(fn, func.id)
+            if local_alias:
+                name = local_alias
+            if fn.cls and name in fn.cls.methods:
+                # A bare method name only resolves via self/cls, skip.
+                pass
+            if name in mod.functions:
+                return mod.functions[name]
+            if name in mod.classes:
+                return mod.classes[name].methods.get("__init__")
+            resolved = self.resolve_function(mod, name, fn.local_imports)
+            if resolved:
+                return resolved
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id == "self" and fn.cls is not None:
+                m = self._method_on(fn.cls.qname, func.attr)
+                if m is not None:
+                    return m
+                # self._field.method()-style handled below via attr_types
+                return None
+            if base.id in local_types:
+                return self._method_on(local_types[base.id], func.attr)
+            if base.id in mod.instances:
+                return self._method_on(mod.instances[base.id], func.attr)
+            # module.func() or Class.method() via imports
+            return self.resolve_function(
+                mod, f"{base.id}.{func.attr}", fn.local_imports
+            )
+        if (
+            isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name)
+            and base.value.id == "self"
+            and fn.cls is not None
+        ):
+            # self._field.method(): field type from __init__.
+            field_type = fn.cls.attr_types.get(base.attr)
+            if field_type:
+                return self._method_on(field_type, func.attr)
+            return None
+        # module.sub.func() chains
+        return self.resolve_function(mod, dotted_name(func), fn.local_imports)
+
+    def _local_alias(self, fn: FunctionInfo, name: str) -> Optional[str]:
+        """``jit_step = jax.jit(step_fn, ...)`` inside ``fn`` aliases
+        jit_step -> step_fn (decorator unwrapping, assignment form)."""
+        for node in ast.walk(fn.node):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name
+                and isinstance(node.value, ast.Call)
+            ):
+                inner = unwrap_call(node.value)
+                if isinstance(inner, ast.Name) and inner.id != name:
+                    return inner.id
+        return None
+
+    # -- lock model ------------------------------------------------------
+    def lock_node(self, fn: FunctionInfo, expr: ast.AST) -> Optional[str]:
+        """The project-wide lock identity acquired by ``with <expr>:`` (or
+        ``<expr>.acquire()``), e.g. ``"obs.metrics.MetricsRegistry._lock"``
+        — or None when expr is not a recognizable lock reference."""
+        short = lambda qn: qn[len(self.package) + 1:] if qn.startswith(
+            self.package + "."
+        ) else qn
+        if isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name
+        ):
+            owner = expr.value.id
+            attr = expr.attr
+            if owner == "self" and fn.cls is not None:
+                attr = fn.cls.lock_aliases.get(attr, attr)
+                if attr in fn.cls.lock_attrs:
+                    return f"{short(fn.cls.qname)}.{attr}"
+                return None
+            # registry._lock style cross-object reference.
+            cls_qn = None
+            if owner in fn.module.instances:
+                cls_qn = fn.module.instances[owner]
+            else:
+                lt = self._local_types(fn)
+                cls_qn = lt.get(owner)
+            if cls_qn and cls_qn in self.classes:
+                cls = self.classes[cls_qn]
+                attr = cls.lock_aliases.get(attr, attr)
+                if attr in cls.lock_attrs:
+                    return f"{short(cls_qn)}.{attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in fn.module.module_locks:
+                return f"{short(fn.module.modname)}.{expr.id}"
+        return None
+
+    def direct_acquisitions(
+        self, fn: FunctionInfo
+    ) -> List[Tuple[ast.AST, str]]:
+        """(site, lock node) for every with-statement acquisition
+        directly in ``fn``'s body (nested defs excluded — they run when
+        called, under the caller's lock context)."""
+        out: List[Tuple[ast.AST, str]] = []
+        for node in self._walk_own(fn.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lock = self.lock_node(fn, item.context_expr)
+                    if lock:
+                        out.append((node, lock))
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr == "acquire"
+                ):
+                    lock = self.lock_node(fn, func.value)
+                    if lock:
+                        out.append((node, lock))
+        return out
+
+    @staticmethod
+    def _walk_own(fn_node: ast.AST) -> Iterator[ast.AST]:
+        stack = list(ast.iter_child_nodes(fn_node))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -- fixpoints -------------------------------------------------------
+    def transitive_acquires(self) -> Dict[str, Set[str]]:
+        """qname -> set of lock nodes the function may acquire, directly
+        or through any resolvable call chain."""
+        direct: Dict[str, Set[str]] = {
+            qn: {lock for _, lock in self.direct_acquisitions(fn)}
+            for qn, fn in self.functions.items()
+        }
+        return self._closure(direct)
+
+    def _closure(self, direct: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
+        result = {qn: set(s) for qn, s in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for qn, fn in self.functions.items():
+                acc = result[qn]
+                before = len(acc)
+                for _, callee in fn.calls:
+                    acc |= result.get(callee, set())
+                if len(acc) != before:
+                    changed = True
+        return result
+
+    def witness_chain(
+        self,
+        start: str,
+        predicate,
+        reach: Dict[str, Set[str]],
+        want,
+        limit: int = 8,
+    ) -> List[str]:
+        """A shortest call chain from ``start`` to a function where
+        ``predicate(qname)`` holds, following only edges that keep
+        ``want`` reachable per ``reach``. Returns qnames including both
+        endpoints."""
+        from collections import deque
+
+        queue = deque([[start]])
+        seen = {start}
+        while queue:
+            path = queue.popleft()
+            qn = path[-1]
+            if predicate(qn):
+                return path
+            if len(path) >= limit:
+                continue
+            fn = self.functions.get(qn)
+            if fn is None:
+                continue
+            for _, callee in fn.calls:
+                if callee in seen:
+                    continue
+                if want not in reach.get(callee, set()) and not predicate(
+                    callee
+                ):
+                    continue
+                seen.add(callee)
+                queue.append(path + [callee])
+        return [start]
+
+    def is_suppressed(self, relpath: str, line: int, rule: str) -> bool:
+        mod = self.by_path.get(relpath)
+        if mod is None:
+            return False
+        rules = mod.suppressions.get(line, set())
+        return rule in rules or "all" in rules

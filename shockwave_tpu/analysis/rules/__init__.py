@@ -14,6 +14,11 @@ from shockwave_tpu.analysis.rules.conformance import SolverBackendConformance
 from shockwave_tpu.analysis.rules.donation import DonationAfterUse
 from shockwave_tpu.analysis.rules.fileio import NonAtomicArtifactWrite
 from shockwave_tpu.analysis.rules.hotloop import HostSyncInHotLoop
+from shockwave_tpu.analysis.rules.interproc import (
+    LockOrderCycle,
+    SwallowedException,
+    TransitiveHostSync,
+)
 from shockwave_tpu.analysis.rules.locks import LockDiscipline
 from shockwave_tpu.analysis.rules.rng import RngKeyReuse
 
@@ -24,6 +29,9 @@ RULE_CLASSES = (
     LockDiscipline,
     NonAtomicArtifactWrite,
     SolverBackendConformance,
+    LockOrderCycle,
+    TransitiveHostSync,
+    SwallowedException,
 )
 
 
@@ -48,4 +56,7 @@ __all__ = [
     "LockDiscipline",
     "NonAtomicArtifactWrite",
     "SolverBackendConformance",
+    "LockOrderCycle",
+    "TransitiveHostSync",
+    "SwallowedException",
 ]

@@ -1,0 +1,638 @@
+"""Interprocedural rules: lock-order cycles, transitive host syncs,
+swallowed exceptions.
+
+These are the hazards PR 5's per-file rules structurally cannot see:
+every one of them needs the project symbol table / call graph
+(:mod:`shockwave_tpu.analysis.project`) because the two halves of the
+bug live in different functions — usually different files.
+
+* **lock-order-cycle** — the dispatcher, scheduler, and every obs plane
+  guard state with their own lock and call into each other (metrics
+  increments under the dispatcher lock, registry snapshots under the
+  watchdog lock). Each "acquires lock B while holding lock A" pair —
+  observed directly as nested ``with`` blocks or transitively through
+  any resolvable call chain — is an edge in a global lock graph; a
+  cycle means two production threads can deadlock. Reacquiring a
+  non-reentrant ``Lock`` through a call chain is reported too: that one
+  deadlocks a single thread, deterministically.
+
+* **transitive-host-sync** — the per-file host-sync rule only sees a
+  ``.item()`` lexically inside the hot loop. This rule follows calls
+  *out of* the hot region (lax-traced bodies, jit-step driving loops)
+  across files and flags any reachable ``.item()`` /
+  ``block_until_ready`` / ``device_get`` / ``np.asarray`` — the silent
+  per-iteration device round-trips that ROADMAP's replanning-under-
+  churn and plan-ahead pipelining items cannot afford.
+
+* **swallowed-exception** — the gRPC/retry paths must never eat an
+  error invisibly: a handler that neither re-raises, logs through the
+  project logger, nor increments an error counter turns a dead worker
+  into an infinite hang. Helpers the handler delegates to are followed
+  through the call graph before flagging.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from shockwave_tpu.analysis.core import (
+    Finding,
+    ProjectRule,
+    dotted_name,
+)
+from shockwave_tpu.analysis.project import (
+    FunctionInfo,
+    Project,
+    unwrap_call,
+)
+
+
+def _project_finding(
+    rule, project: Project, fn: FunctionInfo, node, message: str
+) -> Finding:
+    line = getattr(node, "lineno", 1)
+    col = getattr(node, "col_offset", 0)
+    mod = fn.module
+    text = ""
+    if 1 <= line <= len(mod.lines):
+        text = mod.lines[line - 1].strip()
+    return Finding(
+        rule=rule.name,
+        path=mod.relpath,
+        line=line,
+        col=col,
+        message=message,
+        line_text=text,
+        suppressed=project.is_suppressed(mod.relpath, line, rule.name),
+    )
+
+
+# -- lock-order-cycle ---------------------------------------------------
+
+_REENTRANT_FACTORIES = {"RLock", "make_rlock"}
+
+
+class LockOrderCycle(ProjectRule):
+    name = "lock-order-cycle"
+    description = (
+        "two locks acquired in opposite orders on different call paths "
+        "(potential deadlock), or a non-reentrant lock reacquired "
+        "through a call chain"
+    )
+    rationale = (
+        "obs/ and runtime/ objects lock independently and call into "
+        "each other from RPC handler threads, the round loop, and "
+        "monitor threads; an AB/BA inversion only deadlocks under "
+        "production interleavings, never in single-threaded tests"
+    )
+
+    def graph(self, project: Project):
+        """The full held-before graph: ``(edges, self_deadlocks)`` where
+        ``edges`` maps ``(held, acquired)`` lock pairs to the first
+        witness ``(fn, site, chain)``. The CLI's ``--lock-graph`` dump
+        and the committed sweep evidence both come from here."""
+        reach = project.transitive_acquires()
+        reentrant = self._reentrant_locks(project)
+        edges: Dict[Tuple[str, str], tuple] = {}
+        self_deadlocks: List[tuple] = []
+        for fn in project.functions.values():
+            self._walk(
+                project, fn, fn.node, (), reach, reentrant, edges,
+                self_deadlocks,
+            )
+        return edges, self_deadlocks
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        edges, self_deadlocks = self.graph(project)
+
+        for fn, site, lock, chain in self_deadlocks:
+            yield _project_finding(
+                self, project, fn, site,
+                f"non-reentrant lock {lock} reacquired while already "
+                f"held (self-deadlock): {' -> '.join(chain)}",
+            )
+
+        # Cycle detection over the held-before graph.
+        graph: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+        reported: Set[frozenset] = set()
+        for (a, b), (fn, site, chain) in sorted(
+            edges.items(), key=lambda kv: (kv[1][0].module.relpath,
+                                           getattr(kv[1][1], "lineno", 0))
+        ):
+            if a == b:
+                continue
+            path_back = self._path(graph, b, a)
+            if path_back is None:
+                continue
+            cycle = frozenset([a, b])
+            if cycle in reported:
+                continue
+            reported.add(cycle)
+            back = " -> ".join(path_back)
+            yield _project_finding(
+                self, project, fn, site,
+                f"lock-order cycle: {a} held while acquiring {b} here "
+                f"(via {' -> '.join(chain)}), but elsewhere {back} — "
+                "opposite acquisition orders can deadlock",
+            )
+
+    # -- helpers ---------------------------------------------------------
+    def _reentrant_locks(self, project: Project) -> Set[str]:
+        """Lock nodes backed by RLock (reacquisition is legal)."""
+        short = lambda qn: (
+            qn[len(project.package) + 1:]
+            if qn.startswith(project.package + ".")
+            else qn
+        )
+        out: Set[str] = set()
+        for cls in project.classes.values():
+            for sub in ast.walk(cls.node):
+                if not isinstance(sub, ast.Assign) or not isinstance(
+                    sub.value, ast.Call
+                ):
+                    continue
+                leaf = dotted_name(sub.value.func).split(".")[-1]
+                if leaf not in _REENTRANT_FACTORIES:
+                    continue
+                for target in sub.targets:
+                    if (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                    ):
+                        out.add(f"{short(cls.qname)}.{target.attr}")
+        # Condition() with no explicit lock wraps an RLock.
+        for cls in project.classes.values():
+            for attr, alias_of in cls.lock_aliases.items():
+                lock = f"{short(cls.qname)}.{alias_of}"
+                if lock in out:
+                    out.add(f"{short(cls.qname)}.{attr}")
+        return out
+
+    def _walk(
+        self, project, fn, node, held, reach, reentrant, edges,
+        self_deadlocks,
+    ):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in node.items:
+                lock = project.lock_node(fn, item.context_expr)
+                if lock:
+                    acquired.append(lock)
+            for lock in acquired:
+                for holder in held:
+                    if holder == lock:
+                        if lock not in reentrant:
+                            self_deadlocks.append(
+                                (fn, node, lock, (fn.qname,))
+                            )
+                        continue
+                    edges.setdefault(
+                        (holder, lock), (fn, node, (fn.qname,))
+                    )
+                held = held + (lock,)
+        elif isinstance(node, ast.Call):
+            callee_qn = None
+            for call_node, qn in fn.calls:
+                if call_node is node:
+                    callee_qn = qn
+                    break
+            if callee_qn is not None and held:
+                for target in reach.get(callee_qn, set()):
+                    chain = tuple(
+                        project.witness_chain(
+                            callee_qn,
+                            lambda q: target
+                            in {
+                                lock
+                                for _, lock in project.direct_acquisitions(
+                                    project.functions[q]
+                                )
+                            }
+                            if q in project.functions
+                            else False,
+                            reach,
+                            target,
+                        )
+                    )
+                    for holder in held:
+                        if holder == target:
+                            if target not in reentrant:
+                                self_deadlocks.append(
+                                    (fn, node, target,
+                                     (fn.qname,) + chain)
+                                )
+                            continue
+                        edges.setdefault(
+                            (holder, target),
+                            (fn, node, (fn.qname,) + chain),
+                        )
+        elif isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            if node is not fn.node:
+                return  # nested defs execute under their caller's locks
+        for child in ast.iter_child_nodes(node):
+            self._walk(
+                project, fn, child, held, reach, reentrant, edges,
+                self_deadlocks,
+            )
+
+    @staticmethod
+    def _path(graph, start, goal) -> Optional[List[str]]:
+        from collections import deque
+
+        queue = deque([[start]])
+        seen = {start}
+        while queue:
+            path = queue.popleft()
+            if path[-1] == goal:
+                return path
+            for nxt in graph.get(path[-1], ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    queue.append(path + [nxt])
+        return None
+
+
+# -- transitive-host-sync -----------------------------------------------
+
+_TRACED_LOOP_CALLS = {
+    "jax.lax.scan",
+    "lax.scan",
+    "jax.lax.fori_loop",
+    "lax.fori_loop",
+    "jax.lax.while_loop",
+    "lax.while_loop",
+    "jax.lax.map",
+    "lax.map",
+}
+
+_HOT_CALLEE_RE = re.compile(
+    r"(jit_step|step_fn|train_step|update_step|solve_step)$"
+)
+
+_NUMPY_MODULES = {"np", "numpy", "onp"}
+
+# A callee that IS the host boundary on purpose says so in its
+# docstring; the declaration is the contract (same convention as the
+# lock rule's "caller holds the lock").
+_HOST_BOUNDARY_RE = re.compile(
+    r"host[- ](tail|side|boundary|fetch)", re.IGNORECASE
+)
+
+
+def _sync_sites(fn_node: ast.AST) -> List[Tuple[ast.AST, str]]:
+    """Direct host-sync markers in one function body (not descending
+    into nested defs)."""
+    out: List[Tuple[ast.AST, str]] = []
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            base = dotted_name(func.value)
+            if func.attr == "item" and not node.args:
+                out.append((node, ".item()"))
+            elif func.attr == "block_until_ready":
+                out.append((node, ".block_until_ready()"))
+            elif (
+                base.split(".")[0] in _NUMPY_MODULES
+                and func.attr in ("asarray", "array")
+            ):
+                out.append((node, f"{base}.{func.attr}()"))
+            elif base == "jax" and func.attr == "device_get":
+                out.append((node, "jax.device_get()"))
+        elif isinstance(func, ast.Name) and func.id == "device_get":
+            out.append((node, "device_get()"))
+    return out
+
+
+class TransitiveHostSync(ProjectRule):
+    name = "transitive-host-sync"
+    description = (
+        "a call chain from a hot loop (lax body / jit-step loop) "
+        "reaches .item()/block_until_ready/device_get/np.asarray in "
+        "another function"
+    )
+    rationale = (
+        "the per-file rule only sees syncs lexically inside the loop; "
+        "a helper two calls down stalls the dispatch pipeline just the "
+        "same, every iteration, invisibly"
+    )
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        # Which functions contain direct sync sites (and are not
+        # declared host boundaries).
+        syncs: Dict[str, List[Tuple[ast.AST, str]]] = {}
+        for qn, fn in project.functions.items():
+            doc = ast.get_docstring(fn.node) or ""
+            if _HOST_BOUNDARY_RE.search(doc):
+                continue
+            sites = _sync_sites(fn.node)
+            if sites:
+                syncs[qn] = sites
+
+        # Transitive closure: which sync-containing functions does each
+        # function reach (through resolvable calls)?
+        reach: Dict[str, Set[str]] = {
+            qn: ({qn} if qn in syncs else set())
+            for qn in project.functions
+        }
+        changed = True
+        while changed:
+            changed = False
+            for qn, fn in project.functions.items():
+                acc = reach[qn]
+                before = len(acc)
+                for _, callee in fn.calls:
+                    acc |= reach.get(callee, set())
+                if len(acc) != before:
+                    changed = True
+
+        seen: Set[Tuple[str, int, str]] = set()
+        for fn in project.functions.values():
+            for region_call, callee_qn in self._hot_region_calls(
+                project, fn
+            ):
+                targets = reach.get(callee_qn, set())
+                # Syncs in the SAME function as the hot region are the
+                # per-file rule's findings; only cross-function ones here.
+                targets = {t for t in targets if t != fn.qname}
+                for target in sorted(targets):
+                    site, what = syncs[target][0]
+                    key = (
+                        fn.module.relpath,
+                        getattr(region_call, "lineno", 0),
+                        target,
+                    )
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    chain = project.witness_chain(
+                        callee_qn, lambda q: q == target, reach, target
+                    )
+                    tmod = project.functions[target].module
+                    yield _project_finding(
+                        self, project, fn, region_call,
+                        f"hot-loop call reaches {what} at "
+                        f"{tmod.relpath}:{getattr(site, 'lineno', '?')} "
+                        f"via {' -> '.join([fn.qname] + list(chain))} — "
+                        "a host sync every iteration; hoist it out of "
+                        "the loop or keep the value on device",
+                    )
+
+    def _hot_region_calls(
+        self, project: Project, fn: FunctionInfo
+    ) -> Iterator[Tuple[ast.Call, str]]:
+        """(call node, resolved callee qname) for calls inside hot
+        regions of ``fn``: lax-traced bodies handed to scan/fori/while,
+        jitted function bodies, and python loops driving a jit step."""
+        resolved = {id(c): qn for c, qn in fn.calls}
+        regions: List[ast.AST] = []
+
+        # (a) the whole body when fn itself is jitted (traced code).
+        if self._is_jitted(project, fn):
+            regions.append(fn.node)
+
+        # (b) local defs handed to lax.scan/fori/while in this fn are
+        # covered when those defs are themselves walked (their calls are
+        # their own FunctionInfo's); here we mark python loops only.
+        donated_or_jit = self._local_jit_names(fn)
+        for node in Project._walk_own(fn.node):
+            if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+                if self._is_hot_loop(node, donated_or_jit):
+                    regions.append(node)
+
+        emitted: Set[int] = set()
+        for region in regions:
+            walk = (
+                Project._walk_own(region)
+                if region is fn.node
+                else ast.walk(region)
+            )
+            for node in walk:
+                if (
+                    isinstance(node, ast.Call)
+                    and id(node) in resolved
+                    and id(node) not in emitted
+                ):
+                    emitted.add(id(node))
+                    yield node, resolved[id(node)]
+
+    def _is_jitted(self, project: Project, fn: FunctionInfo) -> bool:
+        for dec in fn.node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            leaf = dotted_name(target).split(".")[-1]
+            if leaf == "jit":
+                return True
+            if leaf == "partial" and isinstance(dec, ast.Call) and dec.args:
+                if dotted_name(dec.args[0]).split(".")[-1] == "jit":
+                    return True
+        # Module-level alias g = jax.jit(f) marks f as traced; a plain
+        # `public = _impl` alias or lru_cache wrapper does not.
+        mod = fn.module
+        if fn.name in mod.traced_defs:
+            return True
+        # Handed to lax.scan / fori_loop / while_loop anywhere in the
+        # module: the body is traced per iteration.
+        for other in mod.functions.values():
+            for node in ast.walk(other.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if dotted_name(node.func) not in _TRACED_LOOP_CALLS:
+                    continue
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id == fn.name:
+                        return True
+        return False
+
+    def _local_jit_names(self, fn: FunctionInfo) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(fn.node):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                call = node.value
+                leaf = dotted_name(call.func).split(".")[-1]
+                has_donate = any(
+                    kw.arg in ("donate_argnums", "donate_argnames")
+                    for kw in call.keywords
+                )
+                if leaf == "jit" or (
+                    isinstance(unwrap_call(call), ast.Name) and has_donate
+                ):
+                    names.add(node.targets[0].id)
+        return names
+
+    def _is_hot_loop(self, loop: ast.AST, jit_names: Set[str]) -> bool:
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if not callee and isinstance(node.func, ast.Name):
+                callee = node.func.id
+            leaf = callee.split(".")[-1] if callee else ""
+            if leaf in jit_names or _HOT_CALLEE_RE.search(leaf or ""):
+                return True
+        return False
+
+
+# -- swallowed-exception ------------------------------------------------
+
+_SCOPE_PREFIXES = ("shockwave_tpu/runtime/",)
+_SCOPE_FILES = ("shockwave_tpu/core/physical.py",)
+
+_LOG_METHODS = {
+    "debug", "info", "warning", "error", "exception", "critical", "log",
+}
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [dotted_name(e) for e in t.elts]
+    else:
+        names = [dotted_name(t)]
+    return any(
+        n.split(".")[-1] in ("Exception", "BaseException") for n in names
+    )
+
+
+def _node_reports(node: ast.AST) -> bool:
+    """Does this single statement/expression visibly report the error?"""
+    if isinstance(node, ast.Raise):
+        return True
+    if isinstance(node, ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in _LOG_METHODS:
+                base = dotted_name(func.value).split(".")[0].lower()
+                if "log" in base or base in ("self", "cls"):
+                    return True
+            if func.attr == "print_exc":
+                return True
+            if func.attr == "inc":
+                # obs.counter(...).inc() / self._errors.inc() — an error
+                # counter increment is a visible report.
+                return True
+    return False
+
+
+class SwallowedException(ProjectRule):
+    name = "swallowed-exception"
+    description = (
+        "bare `except`/`except Exception` on the gRPC/retry paths that "
+        "neither re-raises, logs via the project logger, nor "
+        "increments an error counter"
+    )
+    rationale = (
+        "a swallowed RPC/retry failure turns a dead worker or a "
+        "failed dispatch into an invisible hang: the scheduler waits "
+        "on a Done that can never arrive"
+    )
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        # Fixpoint: which functions visibly report (log/inc/raise) on
+        # some path — used to credit helpers the handler delegates to.
+        reports: Dict[str, bool] = {}
+        for qn, fn in project.functions.items():
+            reports[qn] = any(
+                _node_reports(n) for n in ast.walk(fn.node)
+            )
+        changed = True
+        while changed:
+            changed = False
+            for qn, fn in project.functions.items():
+                if reports[qn]:
+                    continue
+                if any(reports.get(callee, False) for _, callee in fn.calls):
+                    reports[qn] = True
+                    changed = True
+
+        for fn in project.functions.values():
+            relpath = fn.module.relpath
+            if not (
+                relpath.startswith(_SCOPE_PREFIXES)
+                or relpath in _SCOPE_FILES
+            ):
+                continue
+            resolved = {id(c): qn for c, qn in fn.calls}
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Try):
+                    continue
+                for handler in node.handlers:
+                    if not _handler_is_broad(handler):
+                        continue
+                    if self._handler_reports(handler, resolved, reports):
+                        continue
+                    yield _project_finding(
+                        self, project, fn, handler,
+                        f"{fn.qname} swallows "
+                        f"{self._handler_label(handler)} without "
+                        "re-raising, logging, or incrementing an error "
+                        "counter — on the gRPC/retry paths this turns "
+                        "failures into silent hangs",
+                    )
+
+    @staticmethod
+    def _handler_label(handler: ast.ExceptHandler) -> str:
+        if handler.type is None:
+            return "a bare except"
+        return f"`except {dotted_name(handler.type) or 'Exception'}`"
+
+    def _handler_reports(
+        self, handler: ast.ExceptHandler, resolved, reports
+    ) -> bool:
+        for node in ast.walk(handler):
+            if _node_reports(node):
+                return True
+            if isinstance(node, ast.Call) and id(node) in resolved:
+                if reports.get(resolved[id(node)], False):
+                    return True
+        return False
+
+
+def lock_graph_dict(project: Optional[Project] = None) -> dict:
+    """JSON-ready dump of the project's lock acquisition-order graph —
+    what ``python -m shockwave_tpu.analysis --lock-graph`` prints and
+    the committed sweep evidence records. An operator triaging a
+    deadlock diffs this against the sanitizer's observed order."""
+    project = project or Project.build()
+    edges, self_deadlocks = LockOrderCycle().graph(project)
+    return {
+        "edges": [
+            {
+                "held": a,
+                "acquired": b,
+                "site": f"{fn.module.relpath}:{getattr(site, 'lineno', 0)}",
+                "via": list(chain),
+            }
+            for (a, b), (fn, site, chain) in sorted(edges.items())
+        ],
+        "self_deadlocks": [
+            {
+                "lock": lock,
+                "site": f"{fn.module.relpath}:{getattr(site, 'lineno', 0)}",
+                "via": list(chain),
+            }
+            for fn, site, lock, chain in self_deadlocks
+        ],
+    }

@@ -61,8 +61,11 @@ def _declares_caller_holds_lock(method: ast.AST) -> bool:
 
 
 def _lock_attrs_of_class(cls: ast.ClassDef) -> Set[str]:
-    """Attributes assigned ``threading.Lock()``/``RLock()`` anywhere in
-    the class (typically __init__)."""
+    """Attributes assigned ``threading.Lock()``/``RLock()`` — or the
+    sanitizer factories ``make_lock``/``make_rlock``/``make_condition``
+    (:mod:`shockwave_tpu.analysis.sanitize`), which production classes
+    use so ``SHOCKWAVE_SANITIZE=locks`` can instrument them — anywhere
+    in the class (typically __init__)."""
     locks: Set[str] = set()
     for node in ast.walk(cls):
         if not isinstance(node, ast.Assign):
@@ -70,7 +73,10 @@ def _lock_attrs_of_class(cls: ast.ClassDef) -> Set[str]:
         if not isinstance(node.value, ast.Call):
             continue
         leaf = dotted_name(node.value.func).split(".")[-1]
-        if leaf not in ("Lock", "RLock", "Condition"):
+        if leaf not in (
+            "Lock", "RLock", "Condition",
+            "make_lock", "make_rlock", "make_condition",
+        ):
             continue
         for target in node.targets:
             if (

@@ -1,0 +1,630 @@
+"""Runtime sanitizers: the dynamic oracle behind the static rules.
+
+``SHOCKWAVE_SANITIZE=locks,jax`` (comma-separated kinds) switches on:
+
+* **locks** — every lock the production classes create through
+  :func:`make_lock` / :func:`make_rlock` / :func:`make_condition`
+  becomes an instrumented wrapper that records the per-thread
+  acquisition order into a process-global lockdep-style graph and
+  RAISES on:
+
+  - an **order inversion**: thread acquires B while holding A after
+    any thread has acquired A while holding B (the dynamic counterpart
+    of the static ``lock-order-cycle`` rule — the static rule proves
+    the graph cycle can exist, the sanitizer proves a run actually
+    walked both sides);
+  - a **self-deadlock**: blocking re-acquisition of a non-reentrant
+    lock the same thread already holds (raised instead of hanging);
+  - a **hold-time ceiling** breach: a critical section held longer
+    than ``SHOCKWAVE_SANITIZE_HOLD_S`` seconds (default 10) — the
+    precursor of every "scheduler round stalls behind a metrics
+    flush" incident.
+
+* **jax** — hot JAX entry points opt in via :func:`watch_jit` (the
+  train step) and :func:`jax_entry` / :func:`check_recompiles` (the
+  solver): calls run under ``jax.transfer_guard_device_to_host
+  ("disallow")`` so an implicit device→host transfer raises at the
+  offending line, and a compilation counter fails the run when a
+  shape-stable loop recompiles (cache size exceeding the distinct
+  signatures/budget seen — the silent 20-40 s stall the watchdog's
+  solver-time rule can only flag after the fact).
+
+Disabled (the default), every factory returns the raw
+``threading`` primitive and every wrapper returns its argument —
+zero overhead, bit-identical behavior.
+
+Violations raise immediately AND are recorded; :func:`report` returns
+a JSON-ready summary (the committed smoke artifact) and
+:func:`violations_as_findings` renders them as
+:class:`~shockwave_tpu.analysis.core.Finding` records so runtime
+evidence flows through the same fingerprint/baseline machinery as the
+static rules.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from shockwave_tpu.analysis.core import Finding
+
+__all__ = [
+    "SanitizerError",
+    "LockOrderViolation",
+    "LockHoldViolation",
+    "RecompileViolation",
+    "configure",
+    "enabled",
+    "make_lock",
+    "make_rlock",
+    "make_condition",
+    "watch_jit",
+    "jax_entry",
+    "check_recompiles",
+    "report",
+    "reset",
+    "violations_as_findings",
+]
+
+
+class SanitizerError(RuntimeError):
+    """Base class for sanitizer-detected violations."""
+
+
+class LockOrderViolation(SanitizerError):
+    pass
+
+
+class LockHoldViolation(SanitizerError):
+    pass
+
+
+class RecompileViolation(SanitizerError):
+    pass
+
+
+# -- configuration ------------------------------------------------------
+
+_DEFAULT_HOLD_S = 10.0
+
+# Explicit override (tests / drivers); None means "read the env".
+_configured: Optional[frozenset] = None
+
+
+def configure(kinds=None) -> None:
+    """Explicitly enable sanitizer kinds (an iterable of ``"locks"`` /
+    ``"jax"``), overriding ``SHOCKWAVE_SANITIZE``; ``configure(None)``
+    returns control to the environment variable."""
+    global _configured
+    _configured = None if kinds is None else frozenset(kinds)
+
+
+def active_kinds() -> frozenset:
+    if _configured is not None:
+        return _configured
+    raw = os.environ.get("SHOCKWAVE_SANITIZE", "")
+    return frozenset(k.strip() for k in raw.split(",") if k.strip())
+
+
+def enabled(kind: str) -> bool:
+    return kind in active_kinds()
+
+
+def hold_ceiling_s() -> float:
+    try:
+        return float(os.environ.get("SHOCKWAVE_SANITIZE_HOLD_S", ""))
+    except ValueError:
+        return _DEFAULT_HOLD_S
+
+
+# -- shared violation ledger -------------------------------------------
+
+_state_lock = threading.Lock()
+_violations: List[dict] = []
+
+
+def _caller_site() -> Tuple[str, int, str]:
+    """(relpath, line, source text) of the first stack frame outside
+    this module — the production line that committed the violation."""
+    import linecache
+    import sys
+
+    frame = sys._getframe(1)
+    here = os.path.abspath(__file__)
+
+    def _internal(f) -> bool:
+        filename = f.f_code.co_filename
+        # Condition routes acquisitions through threading.py
+        # (__enter__/wait/_acquire_restore); the witness the operator
+        # needs is the production `with self._cv:` line, not stdlib.
+        return filename == here or filename.endswith(
+            os.sep + "threading.py"
+        )
+
+    while frame is not None and _internal(frame):
+        frame = frame.f_back
+    if frame is None:  # pragma: no cover - defensive
+        return "<unknown>", 0, ""
+    filename = frame.f_code.co_filename
+    line = frame.f_lineno
+    text = linecache.getline(filename, line).strip()
+    from shockwave_tpu.analysis.core import repo_root
+
+    root = repo_root()
+    try:
+        rel = os.path.relpath(filename, root)
+    except ValueError:  # pragma: no cover - different drive (windows)
+        rel = filename
+    if rel.startswith(".."):
+        rel = filename
+    return rel.replace(os.sep, "/"), line, text
+
+
+def _record_violation(kind: str, rule: str, message: str) -> dict:
+    path, line, text = _caller_site()
+    entry = {
+        "kind": kind,
+        "rule": rule,
+        "path": path,
+        "line": line,
+        "line_text": text,
+        "message": message,
+        "thread": threading.current_thread().name,
+    }
+    with _state_lock:
+        _violations.append(entry)
+    return entry
+
+
+def violations() -> List[dict]:
+    with _state_lock:
+        return list(_violations)
+
+
+def violations_as_findings() -> List[Finding]:
+    """Runtime violations as lint findings, so a CI harness can merge
+    them into the same fingerprint/baseline ratchet as the static
+    rules."""
+    return [
+        Finding(
+            rule=v["rule"],
+            path=v["path"],
+            line=v["line"],
+            col=0,
+            message=v["message"],
+            line_text=v["line_text"],
+        )
+        for v in violations()
+    ]
+
+
+# -- lock sanitizer -----------------------------------------------------
+
+# (held_name, acquired_name) -> first witness {thread, site}
+_lock_edges: Dict[Tuple[str, str], dict] = {}
+_tls = threading.local()
+
+
+def _held_stack() -> List["_Held"]:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = []
+        _tls.held = stack
+    return stack
+
+
+class _Held:
+    __slots__ = ("lock", "t_acquired", "count")
+
+    def __init__(self, lock):
+        self.lock = lock
+        self.t_acquired = time.monotonic()
+        self.count = 1
+
+
+class SanitizedLock:
+    """Instrumented wrapper around ``threading.Lock``/``RLock`` that
+    maintains the per-thread held stack and the global acquisition-order
+    graph. Exposes the ``Condition`` integration surface
+    (``_release_save``/``_acquire_restore``/``_is_owned``) so
+    ``threading.Condition(sanitized_lock)`` works unchanged — a
+    ``wait()`` correctly pops the lock from the held stack for its
+    duration."""
+
+    def __init__(self, name: str, inner, reentrant: bool):
+        self.name = name
+        self._inner = inner
+        self._reentrant = reentrant
+
+    # -- core protocol ---------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        stack = _held_stack()
+        mine = next((h for h in stack if h.lock is self), None)
+        if mine is not None and not self._reentrant and blocking:
+            entry = _record_violation(
+                "locks",
+                "sanitize-self-deadlock",
+                f"blocking re-acquisition of non-reentrant lock "
+                f"{self.name} already held by this thread — this would "
+                "deadlock; raised instead",
+            )
+            raise LockOrderViolation(entry["message"])
+        if blocking and mine is None:
+            # Inversion check BEFORE the blocking acquire: when the
+            # other side of an AB/BA pair is live (the other thread
+            # holds what we want and wants what we hold), checking
+            # after acquire() returns would never run — the deadlock
+            # the sanitizer exists to catch would hang undiagnosed.
+            self._precheck_inversion(stack)
+        ok = (
+            self._inner.acquire(blocking, timeout)
+            if timeout != -1 or not blocking
+            else self._inner.acquire()
+        )
+        if not ok:
+            return ok
+        if mine is not None and self._reentrant:
+            mine.count += 1
+            return ok
+        self._note_acquired(stack)
+        stack.append(_Held(self))
+        return ok
+
+    def _precheck_inversion(self, stack: List[_Held]) -> None:
+        held_names = {h.lock.name for h in stack if h.lock is not self}
+        if not held_names:
+            return
+        with _state_lock:
+            inverted = sorted(
+                held
+                for held in held_names
+                if (self.name, held) in _lock_edges
+            )
+        if inverted:
+            witness = _lock_edges[(self.name, inverted[0])]
+            entry = _record_violation(
+                "locks",
+                "sanitize-lock-order",
+                f"lock-order inversion: acquiring {self.name} while "
+                f"holding {inverted[0]}, but {witness['thread']} "
+                f"previously acquired {inverted[0]} while holding "
+                f"{self.name} (at {witness['site']}) — AB/BA deadlock "
+                "hazard; raised before blocking",
+            )
+            raise LockOrderViolation(entry["message"])
+
+    def _note_acquired(self, stack: List[_Held]) -> None:
+        held_names = {h.lock.name for h in stack if h.lock is not self}
+        if not held_names:
+            return
+        path, line, _ = _caller_site()
+        site = f"{path}:{line}"
+        with _state_lock:
+            for held in held_names:
+                _lock_edges.setdefault(
+                    (held, self.name),
+                    {
+                        "thread": threading.current_thread().name,
+                        "site": site,
+                    },
+                )
+            inverted = sorted(
+                held
+                for held in held_names
+                if (self.name, held) in _lock_edges
+            )
+        if inverted:
+            witness = _lock_edges[(self.name, inverted[0])]
+            entry = _record_violation(
+                "locks",
+                "sanitize-lock-order",
+                f"lock-order inversion: acquiring {self.name} while "
+                f"holding {inverted[0]}, but {witness['thread']} "
+                f"previously acquired {inverted[0]} while holding "
+                f"{self.name} (at {witness['site']}) — AB/BA deadlock "
+                "hazard",
+            )
+            self._inner.release()
+            raise LockOrderViolation(entry["message"])
+
+    def release(self):
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i].lock is self:
+                held = stack[i]
+                if self._reentrant and held.count > 1:
+                    held.count -= 1
+                    self._inner.release()
+                    return
+                del stack[i]
+                self._inner.release()
+                dt = time.monotonic() - held.t_acquired
+                ceiling = hold_ceiling_s()
+                if dt > ceiling:
+                    import sys
+
+                    entry = _record_violation(
+                        "locks",
+                        "sanitize-lock-hold",
+                        f"lock {self.name} held for {dt:.3f}s, over the "
+                        f"{ceiling:.3f}s ceiling — long critical "
+                        "sections stall every contending thread",
+                    )
+                    # If the with-body is already unwinding a real
+                    # error, record only: replacing it with the hold
+                    # violation would misattribute the run's failure
+                    # to a slow critical section.
+                    if sys.exc_info()[0] is None:
+                        raise LockHoldViolation(entry["message"])
+                return
+        # Not held by this thread (foreign release) — delegate and let
+        # threading raise its own error.
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    # -- Condition integration ------------------------------------------
+    def _release_save(self):
+        stack = _held_stack()
+        saved_entry = None
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i].lock is self:
+                saved_entry = stack.pop(i)
+                break
+        if hasattr(self._inner, "_release_save"):
+            inner_state = self._inner._release_save()
+        else:
+            self._inner.release()
+            inner_state = None
+        return (inner_state, saved_entry)
+
+    def _acquire_restore(self, state):
+        inner_state, saved_entry = state
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(inner_state)
+        else:
+            self._inner.acquire()
+        stack = _held_stack()
+        if saved_entry is not None:
+            saved_entry.t_acquired = time.monotonic()
+            stack.append(saved_entry)
+        else:  # pragma: no cover - defensive
+            stack.append(_Held(self))
+
+    def _is_owned(self):
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        return any(h.lock is self for h in _held_stack())
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<SanitizedLock {self.name} {self._inner!r}>"
+
+
+def make_lock(name: str):
+    """A ``threading.Lock`` — instrumented when the lock sanitizer is
+    active. ``name`` is the project-wide lock identity, conventionally
+    matching the static analyzer's node names
+    (``"obs.metrics.MetricsRegistry._lock"``)."""
+    if enabled("locks"):
+        return SanitizedLock(name, threading.Lock(), reentrant=False)
+    return threading.Lock()
+
+
+def make_rlock(name: str):
+    if enabled("locks"):
+        return SanitizedLock(name, threading.RLock(), reentrant=True)
+    return threading.RLock()
+
+
+def make_condition(lock=None, name: str = "condition"):
+    """``threading.Condition`` over ``lock`` (itself typically from
+    :func:`make_lock`/:func:`make_rlock`); creates a sanitized RLock
+    when none is given."""
+    return threading.Condition(lock if lock is not None else make_rlock(name))
+
+
+def observed_lock_graph() -> dict:
+    """The dynamically observed acquisition-order edges — diff against
+    ``python -m shockwave_tpu.analysis --lock-graph`` (the static
+    prediction) when triaging a deadlock."""
+    with _state_lock:
+        return {
+            "edges": [
+                {"held": a, "acquired": b, **w}
+                for (a, b), w in sorted(_lock_edges.items())
+            ]
+        }
+
+
+# -- jax sanitizer ------------------------------------------------------
+
+_jax_entries: Dict[str, dict] = {}
+_jit_watches: Dict[str, "_JitWatch"] = {}
+_recompile_checks: Dict[str, dict] = {}
+
+
+def _d2h_guard():
+    import jax
+
+    return jax.transfer_guard_device_to_host("disallow")
+
+
+class _JitWatch:
+    """Wraps a jitted callable: every call runs under the
+    device-to-host transfer guard, and cache growth beyond
+    ``max_compiles`` raises — a shape-stable loop must compile once."""
+
+    def __init__(self, name: str, fn, max_compiles: int):
+        self.name = name
+        self._fn = fn
+        self.max_compiles = max_compiles
+        self.calls = 0
+
+    def compiles(self) -> int:
+        cache_size = getattr(self._fn, "_cache_size", None)
+        return int(cache_size()) if callable(cache_size) else -1
+
+    def __call__(self, *args, **kwargs):
+        with _d2h_guard():
+            out = self._fn(*args, **kwargs)
+        self.calls += 1
+        size = self.compiles()
+        if size > self.max_compiles:
+            entry = _record_violation(
+                "jax",
+                "sanitize-recompile",
+                f"{self.name} recompiled: jit cache holds {size} "
+                f"entries after call {self.calls}, budget "
+                f"{self.max_compiles} — a shape-stable loop is "
+                "recompiling (shape/dtype/static-arg churn)",
+            )
+            raise RecompileViolation(entry["message"])
+        return out
+
+    def __getattr__(self, attr):
+        return getattr(self._fn, attr)
+
+
+def watch_jit(name: str, fn, max_compiles: int = 1):
+    """Instrument a jitted callable when the jax sanitizer is active;
+    returns ``fn`` unchanged otherwise."""
+    if not enabled("jax"):
+        return fn
+    watch = _JitWatch(name, fn, max_compiles)
+    with _state_lock:
+        _jit_watches[name] = watch
+    return watch
+
+
+class _JaxEntry:
+    def __init__(self, name):
+        self._name = name
+        self._guard = _d2h_guard()
+
+    def __enter__(self):
+        with _state_lock:
+            _jax_entries.setdefault(self._name, {"calls": 0})["calls"] += 1
+        self._guard.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._guard.__exit__(*exc)
+
+
+class _NullEntry:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_ENTRY = _NullEntry()
+
+
+def jax_entry(name: str):
+    """Context manager for a hot device entry point (the solver's
+    device head): device-to-host transfers inside raise while the jax
+    sanitizer is active. The host tail (explicit ``jax.device_get`` /
+    ``np.asarray`` on the returned arrays) belongs OUTSIDE the block."""
+    if not enabled("jax"):
+        return _NULL_ENTRY
+    return _JaxEntry(name)
+
+
+def check_recompiles(name: str, fn, signature) -> None:
+    """Record one call of jitted ``fn`` under the distinct-shape
+    ``signature`` and fail when its compile cache outgrew the number of
+    distinct signatures seen — i.e. a recompile happened with no shape
+    change to justify it."""
+    if not enabled("jax"):
+        return
+    cache_size = getattr(fn, "_cache_size", None)
+    size = int(cache_size()) if callable(cache_size) else -1
+    with _state_lock:
+        st = _recompile_checks.get(name)
+        if st is None:
+            # The jit cache is process-global and may hold entries from
+            # callers that predate sanitizing (or aren't checked at
+            # all); charge everything before the first checked call —
+            # which itself may have compiled one entry — to a baseline
+            # so only growth past the tracked signatures counts.
+            st = _recompile_checks[name] = {
+                "signatures": set(),
+                "calls": 0,
+                "compiles": 0,
+                "baseline": max(0, size - 1),
+            }
+        st["signatures"].add(signature)
+        st["calls"] += 1
+        st["compiles"] = size
+        budget = st["baseline"] + len(st["signatures"])
+    if size > budget:
+        entry = _record_violation(
+            "jax",
+            "sanitize-recompile",
+            f"{name} recompiled: jit cache holds {size} entries against "
+            f"a budget of {budget} ({st['baseline']} pre-existing + "
+            f"{len(st['signatures'])} distinct checked signature(s)) — "
+            "a shape-stable call path is recompiling",
+        )
+        raise RecompileViolation(entry["message"])
+
+
+# -- reporting ----------------------------------------------------------
+
+def report() -> dict:
+    """JSON-ready summary of everything the active sanitizers saw —
+    the committed smoke artifact's payload."""
+    with _state_lock:
+        return {
+            "active": sorted(active_kinds()),
+            "violations": list(_violations),
+            "locks": {
+                "edges": [
+                    {"held": a, "acquired": b, **w}
+                    for (a, b), w in sorted(_lock_edges.items())
+                ],
+            },
+            "jax": {
+                "entries": {
+                    name: dict(st) for name, st in sorted(_jax_entries.items())
+                },
+                "watches": {
+                    name: {"calls": w.calls, "compiles": w.compiles()}
+                    for name, w in sorted(_jit_watches.items())
+                },
+                "recompile_checks": {
+                    name: {
+                        "calls": st["calls"],
+                        "distinct_signatures": len(st["signatures"]),
+                        "compiles": st["compiles"],
+                        "baseline": st["baseline"],
+                    }
+                    for name, st in sorted(_recompile_checks.items())
+                },
+            },
+        }
+
+
+def reset() -> None:
+    """Tests only: drop all recorded sanitizer state."""
+    global _violations
+    with _state_lock:
+        _violations = []
+        _lock_edges.clear()
+        _jax_entries.clear()
+        _jit_watches.clear()
+        _recompile_checks.clear()
+    _tls.held = []

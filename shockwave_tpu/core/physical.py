@@ -19,16 +19,20 @@ JOB_COMPLETION_BUFFER_TIME=60):
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
 from shockwave_tpu import obs
+from shockwave_tpu.analysis import sanitize
 from shockwave_tpu.core.ids import JobId
 from shockwave_tpu.core.scheduler import Scheduler
 from shockwave_tpu.data.workload_info import steps_per_epoch
 from shockwave_tpu.runtime.lease import INFINITY
+
+LOG = logging.getLogger("core.physical")
 
 SCHEDULE_RECOMPUTE_FRACTION = 0.5
 LEASE_UPDATE_FRACTION = 0.75
@@ -61,8 +65,10 @@ class PhysicalScheduler(Scheduler):
         self._completion_buffer = completion_buffer_seconds
         self._start_time = time.time()
 
-        self._lock = threading.RLock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = sanitize.make_rlock(
+            "core.physical.PhysicalScheduler._lock"
+        )
+        self._cv = sanitize.make_condition(self._lock)
         self._worker_connections: Dict[int, object] = {}
         self._worker_addrs: Dict[int, Tuple[str, int]] = {}
         self._round_id = 0
@@ -569,7 +575,19 @@ class PhysicalScheduler(Scheduler):
                 try:
                     self._worker_connections[worker_id].kill_job(job_int)
                 except Exception:
-                    pass
+                    # The synthesized zero-progress Done below still
+                    # converges bookkeeping, but a kill RPC that cannot
+                    # reach its worker is exactly how a dead host first
+                    # shows up — it must be visible, not swallowed.
+                    LOG.warning(
+                        "kill RPC for job %s on worker %s failed",
+                        job_int, worker_id, exc_info=True,
+                    )
+                    obs.counter(
+                        "scheduler_kill_rpc_failures_total",
+                        "kill RPCs that raised instead of reaching "
+                        "their worker",
+                    ).inc()
         deadline = time.time() + KILL_WAIT_SECONDS
         with self._cv:
             while any(
@@ -605,5 +623,11 @@ class PhysicalScheduler(Scheduler):
             try:
                 client.shutdown()
             except Exception:
-                pass
+                # Workers racing us to exit is normal at teardown; keep
+                # it on the record at debug so a shutdown that hangs has
+                # a trail, without alarming clean exits.
+                LOG.debug(
+                    "worker shutdown RPC failed (worker likely already "
+                    "gone)", exc_info=True,
+                )
         self._server.stop(grace=2)

@@ -533,6 +533,12 @@ def main(argv=None):
         variables, opt_state = jax.device_put((variables, opt_state))
     mark_phase("restore")
     jit_step = jax.jit(step_fn, donate_argnums=(0, 1))
+    # SHOCKWAVE_SANITIZE=jax: every step runs under the device-to-host
+    # transfer guard and a recompile after warmup (the loop is
+    # shape-stable by construction) fails the run; a no-op otherwise.
+    from shockwave_tpu.analysis import sanitize
+
+    jit_step = sanitize.watch_jit("train.jit_step", jit_step)
     # Each gang member generates ITS OWN data shard (distinct rng per
     # rank); single-process runs keep the plain seed.
     np_rng = np.random.default_rng(args.seed + jax.process_index())
@@ -616,6 +622,13 @@ def main(argv=None):
         f"[{args.model}] steps={steps} loss={loss_str} "
         f"throughput={steps / max(elapsed, 1e-9):.2f} steps/s"
     )
+    if sanitize.active_kinds():
+        # One machine-readable line so the launching harness (the
+        # sanitize smoke gate, a dispatcher scraping worker stdout) can
+        # collect the sanitizer verdict without a side channel.
+        import json as _json
+
+        print("SANITIZE " + _json.dumps(sanitize.report()))
 
 
 if __name__ == "__main__":

@@ -11,15 +11,16 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import Optional
+
+from shockwave_tpu.analysis import sanitize as _sanitize
 
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "eg_greedy.cpp")
 _LIB_PATH = os.path.join(_HERE, "_eg_greedy.so")
-_lock = threading.Lock()
+_lock = _sanitize.make_lock("native._lock")
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 

@@ -41,7 +41,7 @@ Disabled by default with the usual one-attribute-check fast path.
 
 from __future__ import annotations
 
-import threading
+from shockwave_tpu.analysis import sanitize
 from typing import Dict, List, Optional
 
 _EPS = 1e-9
@@ -50,7 +50,7 @@ _EPS = 1e-9
 class CalibrationTracker:
     def __init__(self, enabled: bool = False):
         self.enabled = enabled
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("obs.calibration.CalibrationTracker._lock")
         # job -> list of (run_time_at_forecast, predicted, lo, hi, ts)
         self._pending: Dict[object, list] = {}
         # job -> {"n", "abs_pct_sum", "signed_sum", "covered", "with_interval"}

@@ -27,8 +27,9 @@ load into real Prometheus tooling unchanged.
 from __future__ import annotations
 
 import bisect
-import threading
 from typing import Dict, Optional, Sequence, Tuple
+
+from shockwave_tpu.analysis import sanitize
 
 SCHEMA = "shockwave-metrics-v1"
 
@@ -205,7 +206,7 @@ class MetricsRegistry:
 
     def __init__(self, enabled: bool = False):
         self.enabled = enabled
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("obs.metrics.MetricsRegistry._lock")
         self._instruments: "Dict[str, _Instrument]" = {}
 
     def _get(self, cls, name: str, help: str, **kwargs) -> _Instrument:

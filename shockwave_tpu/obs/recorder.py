@@ -38,7 +38,7 @@ un-instrumented runs stay bit-identical.
 from __future__ import annotations
 
 import json
-import threading
+from shockwave_tpu.analysis import sanitize
 from collections import OrderedDict
 from typing import Iterator, List, Optional
 
@@ -266,7 +266,7 @@ class FlightRecorder:
         self.enabled = enabled
         self.path = path
         self.num_records = 0
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("obs.recorder.FlightRecorder._lock")
         self._pending: list = []
         # job key -> fingerprint of the job_profile already emitted.
         self._profiles_emitted: dict = {}

@@ -26,7 +26,7 @@ near-free when tracing is off.
 from __future__ import annotations
 
 import json
-import threading
+from shockwave_tpu.analysis import sanitize
 import time
 from typing import Callable, Dict, Optional, Tuple
 
@@ -90,7 +90,7 @@ class _Span:
 class EventTracer:
     def __init__(self, enabled: bool = False):
         self.enabled = enabled
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("obs.trace.EventTracer._lock")
         self._events: list = []
         self._epoch = time.perf_counter()
         self._clock: Optional[Callable[[], float]] = None

@@ -35,7 +35,7 @@ behind the standard one-attribute-check fast path.
 
 from __future__ import annotations
 
-import threading
+from shockwave_tpu.analysis import sanitize
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -79,7 +79,7 @@ class Watchdog:
     def __init__(self, enabled: bool = False, rules: Optional[dict] = None):
         self.enabled = enabled
         self.rules = merge_rules(rules)
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("obs.watchdog.Watchdog._lock")
         self.alerts: List[dict] = []
         self._rounds_checked = 0
         # Rolling state.

@@ -22,6 +22,7 @@ import time
 from typing import Dict, List
 
 from shockwave_tpu import obs
+from shockwave_tpu.analysis import sanitize
 
 LOG = logging.getLogger("runtime.dispatcher")
 
@@ -52,7 +53,9 @@ class Dispatcher:
         for accel_id in accelerator_ids:
             self._accelerator_queue.put(accel_id)
 
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock(
+            "runtime.dispatcher.Dispatcher._lock"
+        )
         # (job_id, worker_id) -> subprocess.Popen: one gang job can have
         # several ranks on one multi-accelerator host.
         self._procs: Dict[tuple, subprocess.Popen] = {}

@@ -16,11 +16,14 @@ GAVEL_* at gavel_iterator.py:48-52, dispatcher.py:332-337):
 from __future__ import annotations
 
 import datetime
+import logging
 import os
 import time
 from typing import Callable, Optional
 
 from shockwave_tpu.runtime.lease import INFINITY, Lease
+
+LOG = logging.getLogger("runtime.iterator")
 
 LEASE_UPDATE_FRACTION = 0.75
 
@@ -38,7 +41,10 @@ def _default_barrier() -> Optional[Callable[[], None]]:
             if dist.is_available() and dist.is_initialized():
                 return dist.barrier
         except Exception:
-            pass
+            # Feature probe only — torch being present but broken must
+            # not kill the training process, but it IS worth a trail
+            # when a gang later stops on mismatched steps.
+            LOG.debug("torch.distributed barrier probe failed", exc_info=True)
     if "jax" in sys.modules:
         try:
             import jax
@@ -50,7 +56,7 @@ def _default_barrier() -> Optional[Callable[[], None]]:
                     "shockwave_lease_expiry"
                 )
         except Exception:
-            pass
+            LOG.debug("jax multihost barrier probe failed", exc_info=True)
     return None
 
 

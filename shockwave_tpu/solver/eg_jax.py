@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from shockwave_tpu import obs
+from shockwave_tpu.analysis import sanitize
 from shockwave_tpu.solver.eg_problem import EGProblem
 
 _EPS = 1e-6
@@ -581,27 +582,48 @@ def solve_level_counts(problem: EGProblem) -> Tuple[np.ndarray, float]:
     )
     if with_bonus:
         kwargs["switch_bonus"] = packed["switch_bonus"]
+    # Sanitizer contract (SHOCKWAVE_SANITIZE=jax): the device dispatch
+    # runs under the device-to-host transfer guard — only the RETURN
+    # fetch below may sync, and a recompile at an already-seen solve
+    # signature fails the run.
+    solve_sig = (
+        slots, int(problem.future_rounds), with_bonus,
+        int(log_bases.shape[0]),
+    )
     precompiled = warm_start.load(
         slots, int(problem.future_rounds), 64, with_bonus,
         num_bases=int(log_bases.shape[0]),
     )
     if precompiled is not None:
         try:
-            counts, obj = precompiled(*args, **kwargs)
+            with sanitize.jax_entry("solver.solve_level_counts"):
+                counts, obj = precompiled(*args, **kwargs)
             return (
                 np.asarray(counts)[: problem.num_jobs].astype(np.int64),
                 float(obj),
             )
+        except sanitize.SanitizerError:
+            raise
         except Exception:
+            if sanitize.enabled("jax"):
+                # A transfer-guard trip inside the precompiled call is
+                # jax's own error type, not a SanitizerError; treating
+                # it as executable drift would silently disable the
+                # warm-start cache and re-surface the violation on the
+                # wrong (fallback) path. Under the sanitizer, nothing
+                # is swallowed.
+                raise
             # Executable/argument drift (e.g. dtype promotion change):
             # disable it for the process and take the jitted path.
             warm_start.invalidate(
                 slots, int(problem.future_rounds), 64, with_bonus,
                 num_bases=int(log_bases.shape[0]),
             )
-    counts, obj = solve_level(
-        *args, future_rounds=int(problem.future_rounds), **kwargs
-    )
+    with sanitize.jax_entry("solver.solve_level_counts"):
+        counts, obj = solve_level(
+            *args, future_rounds=int(problem.future_rounds), **kwargs
+        )
+    sanitize.check_recompiles("solver.solve_level", solve_level, solve_sig)
     counts = np.asarray(counts)[: problem.num_jobs].astype(np.int64)
     return counts, float(obj)
 

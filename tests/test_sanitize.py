@@ -1,0 +1,312 @@
+"""Runtime sanitizers (shockwave_tpu/analysis/sanitize.py): the lock
+sanitizer must catch AB/BA inversions, self-deadlocks, and hold-time
+breaches; the JAX sanitizer must pass a shape-stable loop and fail a
+shape-changing one; disabled, everything must be the raw primitive.
+"""
+
+import threading
+import time
+
+import pytest
+
+from shockwave_tpu.analysis import sanitize
+
+
+@pytest.fixture
+def locks_active():
+    sanitize.configure(["locks"])
+    sanitize.reset()
+    yield
+    sanitize.configure(None)
+    sanitize.reset()
+
+
+@pytest.fixture
+def jax_active():
+    sanitize.configure(["jax"])
+    sanitize.reset()
+    yield
+    sanitize.configure(None)
+    sanitize.reset()
+
+
+# -- lock sanitizer -----------------------------------------------------
+
+class TestLockSanitizer:
+    def test_disabled_returns_raw_primitives(self):
+        sanitize.configure(None)
+        assert "SanitizedLock" not in type(sanitize.make_lock("x")).__name__
+        lock = sanitize.make_lock("x")
+        with lock:
+            pass
+
+    def test_ab_ba_inversion_raises(self, locks_active):
+        a = sanitize.make_lock("test.A")
+        b = sanitize.make_lock("test.B")
+        with a:
+            with b:
+                pass
+        caught = []
+
+        def inverted():
+            try:
+                with b:
+                    with a:
+                        pass
+            except sanitize.LockOrderViolation as e:
+                caught.append(e)
+
+        t = threading.Thread(target=inverted)
+        t.start()
+        t.join()
+        assert len(caught) == 1
+        assert "test.A" in str(caught[0]) and "test.B" in str(caught[0])
+        rules = {v["rule"] for v in sanitize.violations()}
+        assert "sanitize-lock-order" in rules
+
+    def test_live_inversion_raises_before_blocking(self, locks_active):
+        """With the other side of the AB/BA pair LIVE (a thread holds A
+        and keeps it), acquiring A while holding B must raise before
+        the blocking acquire — not hang in the real deadlock."""
+        a = sanitize.make_lock("test.liveA")
+        b = sanitize.make_lock("test.liveB")
+        with a:
+            with b:
+                pass
+        release = threading.Event()
+        holding = threading.Event()
+
+        def holder():
+            with a:
+                holding.set()
+                release.wait(timeout=10)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert holding.wait(timeout=5)
+        try:
+            with pytest.raises(sanitize.LockOrderViolation):
+                with b:
+                    a.acquire()  # would deadlock without the pre-check
+        finally:
+            release.set()
+            t.join(timeout=5)
+        assert not t.is_alive()
+
+    def test_condition_witness_site_is_production_line(self, locks_active):
+        """Acquisitions routed through threading.Condition must record
+        this file as the witness, not threading.py."""
+        other = sanitize.make_lock("test.cvw_other")
+        cv = sanitize.make_condition(
+            sanitize.make_rlock("test.cvw_lock")
+        )
+        with other:
+            with cv:
+                pass
+        edges = sanitize.observed_lock_graph()["edges"]
+        edge = next(
+            e for e in edges
+            if e["held"] == "test.cvw_other"
+            and e["acquired"] == "test.cvw_lock"
+        )
+        assert "threading.py" not in edge["site"]
+        assert "test_sanitize.py" in edge["site"]
+
+    def test_hold_breach_does_not_mask_body_exception(
+        self, locks_active, monkeypatch
+    ):
+        monkeypatch.setenv("SHOCKWAVE_SANITIZE_HOLD_S", "0.02")
+        h = sanitize.make_lock("test.Hmask")
+        with pytest.raises(ValueError, match="real failure"):
+            with h:
+                time.sleep(0.05)
+                raise ValueError("real failure")
+        # The breach is still on the record, just not the raised error.
+        assert any(
+            v["rule"] == "sanitize-lock-hold" for v in sanitize.violations()
+        )
+
+    def test_consistent_order_is_quiet(self, locks_active):
+        a = sanitize.make_lock("test.A")
+        b = sanitize.make_lock("test.B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert sanitize.violations() == []
+
+    def test_self_deadlock_raises_instead_of_hanging(self, locks_active):
+        c = sanitize.make_lock("test.C")
+        with pytest.raises(sanitize.LockOrderViolation):
+            with c:
+                with c:
+                    pass
+
+    def test_rlock_reentrancy_allowed(self, locks_active):
+        r = sanitize.make_rlock("test.R")
+        with r:
+            with r:
+                pass
+        assert sanitize.violations() == []
+
+    def test_condition_wait_notify(self, locks_active):
+        lock = sanitize.make_rlock("test.cv_lock")
+        cv = sanitize.make_condition(lock)
+        ready = []
+
+        def waiter():
+            with cv:
+                while not ready:
+                    cv.wait(timeout=5)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        with cv:
+            ready.append(1)
+            cv.notify_all()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert sanitize.violations() == []
+
+    def test_hold_ceiling_raises(self, locks_active, monkeypatch):
+        monkeypatch.setenv("SHOCKWAVE_SANITIZE_HOLD_S", "0.02")
+        h = sanitize.make_lock("test.H")
+        with pytest.raises(sanitize.LockHoldViolation):
+            with h:
+                time.sleep(0.06)
+        assert any(
+            v["rule"] == "sanitize-lock-hold" for v in sanitize.violations()
+        )
+
+    def test_violations_render_as_findings(self, locks_active):
+        c = sanitize.make_lock("test.F")
+        with pytest.raises(sanitize.LockOrderViolation):
+            with c:
+                with c:
+                    pass
+        findings = sanitize.violations_as_findings()
+        assert findings and findings[0].rule == "sanitize-self-deadlock"
+        assert findings[0].line > 0
+
+    def test_obs_registry_concurrency_under_sanitizer(self, locks_active):
+        """The production metrics registry with sanitized locks:
+        concurrent writers, zero violations."""
+        from shockwave_tpu.obs.metrics import MetricsRegistry
+
+        registry = MetricsRegistry(enabled=True)
+        errors = []
+
+        def writer(i):
+            try:
+                for n in range(50):
+                    registry.counter("c").inc(label=str(i))
+                    registry.histogram("h").observe(n * 0.001, label=str(i))
+            except Exception as e:  # pragma: no cover - the assertion
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=writer, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert sanitize.violations() == []
+        snap = registry.snapshot()
+        total = sum(
+            s["value"] for s in snap["metrics"]["c"]["series"]
+        )
+        assert total == 4 * 50
+
+
+# -- jax sanitizer ------------------------------------------------------
+
+class TestJaxSanitizer:
+    def test_watch_jit_passthrough_when_disabled(self):
+        sanitize.configure(None)
+        fn = object()
+        assert sanitize.watch_jit("x", fn) is fn
+
+    def test_shape_stable_loop_is_quiet(self, jax_active):
+        import jax
+        import jax.numpy as jnp
+
+        step = sanitize.watch_jit(
+            "test.jit_step", jax.jit(lambda s, b: s + b.sum())
+        )
+        s = jnp.zeros(())
+        for _ in range(20):
+            s = step(s, jnp.ones((8,)))
+        assert step.calls == 20
+        assert step.compiles() == 1
+        assert sanitize.violations() == []
+
+    def test_shape_changing_loop_raises(self, jax_active):
+        import jax
+        import jax.numpy as jnp
+
+        w = sanitize.watch_jit("test.shapes", jax.jit(lambda x: x * 2))
+        with pytest.raises(sanitize.RecompileViolation):
+            for n in (4, 5):
+                w(jnp.ones((n,)))
+        assert any(
+            v["rule"] == "sanitize-recompile" for v in sanitize.violations()
+        )
+
+    def test_check_recompiles_signature_budget(self, jax_active):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: x + 1)
+        f(jnp.ones((4,)))
+        sanitize.check_recompiles("test.solver", f, signature=(4,))
+        f(jnp.ones((4,)))  # warm: cache stays at 1
+        sanitize.check_recompiles("test.solver", f, signature=(4,))
+        f(jnp.ones((8,)))  # new signature: growth budgeted
+        sanitize.check_recompiles("test.solver", f, signature=(8,))
+        assert sanitize.violations() == []
+        # A recompile the signatures cannot explain fails.
+        f(jnp.ones((16,)))
+        with pytest.raises(sanitize.RecompileViolation):
+            sanitize.check_recompiles("test.solver", f, signature=(8,))
+
+    def test_jax_entry_installs_d2h_guard(self, jax_active):
+        import jax
+
+        with sanitize.jax_entry("test.entry"):
+            assert (
+                jax.config.jax_transfer_guard_device_to_host == "disallow"
+            )
+        report = sanitize.report()
+        assert report["jax"]["entries"]["test.entry"]["calls"] == 1
+
+    def test_solver_entry_wiring(self, jax_active):
+        """solve_level_counts runs warm under the sanitizer with no
+        violations — the committed smoke gate's in-process half."""
+        import numpy as np
+
+        from shockwave_tpu.solver.eg_jax import solve_level_counts
+        from shockwave_tpu.solver.eg_problem import EGProblem
+
+        problem = EGProblem(
+            priorities=np.ones(4),
+            completed_epochs=np.zeros(4),
+            total_epochs=np.full(4, 10.0),
+            epoch_duration=np.full(4, 100.0),
+            remaining_runtime=np.full(4, 1000.0),
+            nworkers=np.ones(4),
+            num_gpus=2,
+            round_duration=100.0,
+            future_rounds=3,
+            regularizer=0.001,
+            log_bases=np.array([0.0, 0.2, 0.4, 0.6, 0.8, 1.0]),
+        )
+        counts1, obj1 = solve_level_counts(problem)
+        counts2, obj2 = solve_level_counts(problem)
+        assert np.array_equal(counts1, counts2)
+        assert obj1 == obj2
+        assert sanitize.violations() == []
+        entries = sanitize.report()["jax"]["entries"]
+        assert entries["solver.solve_level_counts"]["calls"] >= 2
