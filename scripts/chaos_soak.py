@@ -1,0 +1,305 @@
+#!/usr/bin/env python3
+"""Chaos soak: a seeded churn/reclaim + solver-fault campaign against
+the simulator, with the full recovery contract asserted.
+
+Runs the same synthetic trace twice — fault-free baseline, then under a
+generated :func:`shockwave_tpu.runtime.faults.generate_churn_plan`
+fault plan (worker crashes, spot reclamations, churn re-adds, solver
+slowdowns/timeouts) — and verifies:
+
+  * ZERO lost jobs: every job completes despite sustained churn;
+  * every applied fault is paired with a recovery (injector summary AND
+    fault->recovery records in the flight-recorder decision log);
+  * the decision log replays EXACTLY (degraded solves replay through
+    the backend that actually produced them);
+  * the solver degradation ladder demonstrably fell back (>= 1 round
+    tagged ``degraded`` in solve_records) without breaching the round
+    deadline;
+  * the worst finish-time-fairness degradation vs the fault-free run is
+    measured and reported.
+
+Writes ``soak.json`` (+ a README table) under ``--out``; exits non-zero
+on any violated invariant, so the short-plan variant doubles as the CI
+gate (scripts/ci/chaos_smoke.py).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from shockwave_tpu import obs
+from shockwave_tpu.core.job import Job
+from shockwave_tpu.core.scheduler import Scheduler
+from shockwave_tpu.data.default_oracle import generate_oracle
+from shockwave_tpu.data.profiles import synthesize_profiles
+from shockwave_tpu.data.workload_info import steps_per_epoch
+from shockwave_tpu.obs.recorder import iter_records, replay_log
+from shockwave_tpu.policies import get_policy
+from shockwave_tpu.runtime import faults
+from shockwave_tpu.utils.fileio import atomic_write_json, atomic_write_text
+
+MODELS = [("ResNet-18", 32), ("ResNet-50", 64)]
+
+
+def make_jobs(num_jobs: int, epochs: int, arrival_gap_s: float, seed: int):
+    jobs, arrivals = [], []
+    for i in range(num_jobs):
+        model, bs = MODELS[i % len(MODELS)]
+        jobs.append(
+            Job(
+                job_type=f"{model} (batch size {bs})",
+                command="python3 main.py",
+                total_steps=steps_per_epoch(model, bs) * epochs,
+                scale_factor=[1, 1, 2, 1][i % 4],
+                mode="static",
+            )
+        )
+        arrivals.append(i * arrival_gap_s)
+    return jobs, arrivals
+
+
+def run_sim(args, jobs, arrivals, profiles, oracle, decision_log=None):
+    """One simulation; jobs/profiles are rebuilt per run by the caller
+    (the scheduler mutates Job objects)."""
+    config = {
+        "num_gpus": args.num_gpus,
+        "time_per_iteration": args.round_s,
+        "future_rounds": args.future_rounds,
+        "lambda": 2.0,
+        "k": 1e-3,
+        "solver_rel_gap": 1e-3,
+        "solver_timeout": 15,
+        "plan_deadline_s": args.plan_deadline_s,
+    }
+    obs.reset()  # fresh metrics/recorder/watchdog state per run
+    if decision_log is not None:
+        obs.configure_recorder(decision_log)
+        obs.configure_watchdog()
+    sched = Scheduler(
+        get_policy(args.policy),
+        throughputs=oracle,
+        seed=args.seed,
+        time_per_iteration=args.round_s,
+        profiles=profiles,
+        shockwave_config=config if args.policy.startswith("shockwave") else None,
+    )
+    makespan = sched.simulate(
+        {"v100": args.num_gpus}, list(arrivals), list(jobs)
+    )
+    ftf_list, unfair = sched.get_finish_time_fairness()
+    completed = sum(
+        1 for t in sched._job_completion_times.values() if t is not None
+    )
+    if decision_log is not None:
+        obs.get_recorder().close()
+    return {
+        "makespan_s": makespan,
+        "completed": completed,
+        "worst_ftf": max(ftf_list) if ftf_list else None,
+        "unfair_fraction": unfair,
+        "rounds": sched._num_completed_rounds,
+        "preemptions": sched.get_num_preemptions(),
+        "solve_records": list(getattr(sched._shockwave, "solve_records", []))
+        if sched._shockwave is not None
+        else [],
+        "watchdog_alerts": list(obs.get_watchdog().alerts),
+    }
+
+
+def pair_log_faults(decision_log: str):
+    """(fault_ids, recovery_ids, unpaired) from the decision log; a
+    fault without ``fault_id`` (physical heartbeat deaths) pairs on
+    (kind, worker_id, round)."""
+    fault_keys, recovery_keys = [], []
+    for record in iter_records(decision_log):
+        event = record.get("event")
+        if event not in ("fault", "recovery"):
+            continue
+        key = record.get(
+            "fault_id",
+            (record.get("kind"), record.get("worker_id"), record.get("round")),
+        )
+        (fault_keys if event == "fault" else recovery_keys).append(key)
+    unpaired = [k for k in fault_keys if k not in set(recovery_keys)]
+    return fault_keys, recovery_keys, unpaired
+
+
+def main(args) -> int:
+    os.makedirs(args.out, exist_ok=True)
+    oracle = generate_oracle()
+
+    def fresh_inputs():
+        jobs, arrivals = make_jobs(
+            args.num_jobs, args.epochs, args.arrival_gap_s, args.seed
+        )
+        return jobs, arrivals, synthesize_profiles(jobs, oracle)
+
+    failures = []
+
+    # -- fault-free baseline -------------------------------------------
+    faults.reset()
+    jobs, arrivals, profiles = fresh_inputs()
+    baseline = run_sim(args, jobs, arrivals, profiles, oracle)
+    print(
+        f"baseline: makespan {baseline['makespan_s']:.0f}s, "
+        f"worst FTF {baseline['worst_ftf']:.3f}, "
+        f"{baseline['rounds']} rounds"
+    )
+
+    # -- chaos run ------------------------------------------------------
+    plan = faults.generate_churn_plan(
+        args.seed,
+        horizon_s=baseline["makespan_s"],
+        num_workers=args.num_gpus,
+        target_events=args.target_events,
+        round_s=args.round_s,
+        min_capacity=max(2, args.num_gpus // 4),
+        solver_faults=args.solver_faults,
+    )
+    stem = os.path.splitext(args.result_name)[0]
+    plan_path = os.path.join(args.out, f"{stem}_fault_plan.json")
+    atomic_write_text(plan_path, plan.to_json())
+    injector = faults.configure(plan)
+    decision_log = os.path.join(args.out, f"{stem}_decision_log.jsonl")
+    if os.path.exists(decision_log):
+        os.remove(decision_log)
+    jobs, arrivals, profiles = fresh_inputs()
+    chaos = run_sim(
+        args, jobs, arrivals, profiles, oracle, decision_log=decision_log
+    )
+    summary = injector.summary()
+    faults.reset()  # replay below must not consume leftover events
+    print(
+        f"chaos:    makespan {chaos['makespan_s']:.0f}s, "
+        f"worst FTF {chaos['worst_ftf']:.3f}, {chaos['rounds']} rounds, "
+        f"{summary['applied']} faults applied"
+    )
+
+    # -- invariants -----------------------------------------------------
+    if chaos["completed"] != args.num_jobs:
+        failures.append(
+            f"LOST JOBS: {args.num_jobs - chaos['completed']} of "
+            f"{args.num_jobs} never completed"
+        )
+    if summary["applied"] < args.min_events:
+        failures.append(
+            f"only {summary['applied']} fault events applied "
+            f"(need >= {args.min_events}; plan had "
+            f"{summary['planned_events']})"
+        )
+    if summary["unrecovered"]:
+        failures.append(
+            f"{len(summary['unrecovered'])} applied faults never "
+            f"recovered: {summary['unrecovered'][:10]}"
+        )
+    fault_keys, recovery_keys, unpaired = pair_log_faults(decision_log)
+    if not fault_keys:
+        failures.append("decision log recorded no fault events")
+    if unpaired:
+        failures.append(
+            f"{len(unpaired)} decision-log faults lack a recovery "
+            f"record: {unpaired[:10]}"
+        )
+    degraded = [r for r in chaos["solve_records"] if r.get("degraded")]
+    if not degraded:
+        failures.append(
+            "solver ladder never degraded (expected >= 1 tagged round)"
+        )
+    over_deadline = [
+        r
+        for r in chaos["solve_records"]
+        if args.plan_deadline_s is not None
+        and r["seconds"] > args.plan_deadline_s + args.round_s * 0.1
+    ]
+    if over_deadline:
+        failures.append(
+            f"{len(over_deadline)} solves breached the "
+            f"{args.plan_deadline_s}s plan deadline"
+        )
+    replays = replay_log(decision_log)
+    diverged = [r for r in replays if r["diff"]]
+    if diverged:
+        failures.append(
+            f"replay diverged on {len(diverged)}/{len(replays)} plan "
+            f"records (first: round {diverged[0]['round']})"
+        )
+
+    result = {
+        "seed": args.seed,
+        "num_jobs": args.num_jobs,
+        "num_gpus": args.num_gpus,
+        "policy": args.policy,
+        "plan_deadline_s": args.plan_deadline_s,
+        "planned_events": summary["planned_events"],
+        "applied_events": summary["applied"],
+        "recovered_events": summary["recovered"],
+        "log_faults": len(fault_keys),
+        "log_recoveries": len(recovery_keys),
+        "degraded_rounds": len(degraded),
+        "replayed_plans": len(replays),
+        "replay_exact": len(replays) - len(diverged),
+        "baseline": {
+            k: baseline[k]
+            for k in (
+                "makespan_s", "worst_ftf", "unfair_fraction", "rounds",
+                "preemptions",
+            )
+        },
+        "chaos": {
+            k: chaos[k]
+            for k in (
+                "makespan_s", "worst_ftf", "unfair_fraction", "rounds",
+                "preemptions",
+            )
+        },
+        "worst_ftf_delta": (
+            chaos["worst_ftf"] - baseline["worst_ftf"]
+            if chaos["worst_ftf"] is not None
+            and baseline["worst_ftf"] is not None
+            else None
+        ),
+        "watchdog_alert_rules": sorted(
+            {a["rule"] for a in chaos["watchdog_alerts"]}
+        ),
+        "failures": failures,
+        "ok": not failures,
+    }
+    out_json = os.path.join(args.out, args.result_name)
+    atomic_write_json(out_json, result)
+    print(f"wrote {out_json}")
+    for line in failures:
+        print(f"FAIL: {line}")
+    if not failures:
+        print(
+            f"OK: {summary['applied']} faults, 0 lost jobs, "
+            f"{len(degraded)} degraded rounds, {len(replays)} plans "
+            f"replayed exactly, worst-FTF delta "
+            f"{result['worst_ftf_delta']:+.3f}"
+        )
+    return 1 if failures else 0
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--out", type=str, default="results/chaos")
+    parser.add_argument("--result_name", type=str, default="soak.json")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--policy", type=str, default="shockwave_tpu")
+    parser.add_argument("--num_jobs", type=int, default=48)
+    parser.add_argument("--num_gpus", type=int, default=16)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--arrival_gap_s", type=float, default=30.0)
+    parser.add_argument("--round_s", type=float, default=120.0)
+    parser.add_argument("--future_rounds", type=int, default=8)
+    parser.add_argument("--plan_deadline_s", type=float, default=30.0)
+    parser.add_argument("--target_events", type=int, default=1100)
+    parser.add_argument("--min_events", type=int, default=1000)
+    parser.add_argument("--solver_faults", type=int, default=6)
+    return parser
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(build_parser().parse_args()))

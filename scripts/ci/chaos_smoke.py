@@ -1,0 +1,44 @@
+#!/usr/bin/env python3
+"""CI gate: short seeded chaos run — zero lost jobs, clean recovery.
+
+A scaled-down :mod:`scripts.chaos_soak` campaign (fixed seed, ~80 churn
+/reclaim/solver-fault events over a 10-job sim) asserting the full
+recovery contract: no job lost, every applied fault paired with a
+recovery event in the flight recorder, the solver degradation ladder
+falling back without breaching the plan deadline, and exact decision-log
+replay. Regenerates ``results/chaos/chaos_smoke.json``; exits 1 on any
+violated invariant. Wired into the verify skill next to the
+bench-regression and sanitize gates.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from chaos_soak import build_parser, main  # noqa: E402  (scripts/ on path)
+
+
+def run(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    # The smoke shape: small, seeded, fast (< ~2 min on a CPU host).
+    args.result_name = "chaos_smoke.json"
+    args.num_jobs = 10
+    args.num_gpus = 4
+    args.target_events = 80
+    args.min_events = 50
+    args.solver_faults = 3
+    args.seed = 0
+    return main(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(run())

@@ -110,6 +110,14 @@ def main(args):
     # recorder sees the first planning round.
     obs.apply_telemetry_args(args)
 
+    # Fault injection (chaos runs): arm the committed plan before the
+    # scheduler exists so the first round already sees the injector.
+    fault_injector = None
+    if args.fault_plan:
+        from shockwave_tpu.runtime import faults
+
+        fault_injector = faults.configure(args.fault_plan)
+
     policy = get_policy(args.policy, solver=args.solver, seed=args.seed)
     sched = Scheduler(
         policy,
@@ -159,6 +167,13 @@ def main(args):
         print(f"Worst FTF: {max(ftf_list):.3f}")
         print(f"Unfair job fraction: {unfair_fraction:.1f}%")
     print(f"Preemptions: {sched.get_num_preemptions()}")
+    if fault_injector is not None:
+        summary = fault_injector.summary()
+        print(
+            f"Faults: {summary['applied']} applied, "
+            f"{summary['recovered']} recovered, "
+            f"{len(summary['unrecovered'])} unrecovered"
+        )
     if sched._time_per_iteration != args.time_per_iteration:
         print(
             f"Round auto-sized: {args.time_per_iteration} s -> "
@@ -233,6 +248,15 @@ if __name__ == "__main__":
         "consumed by scripts/analysis/postprocess_log.py",
     )
     obs.add_telemetry_args(parser)
+    parser.add_argument(
+        "--fault-plan",
+        dest="fault_plan",
+        type=str,
+        default=None,
+        help="arm fault injection from this JSON fault plan "
+        "(see shockwave_tpu/runtime/faults.py; generate one with "
+        "scripts/chaos_soak.py)",
+    )
     parser.add_argument("--no_profile_cache", action="store_true")
     parser.add_argument(
         "--preemption_overheads",

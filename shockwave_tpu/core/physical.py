@@ -46,6 +46,7 @@ class PhysicalScheduler(Scheduler):
         policy,
         port: int = 50060,
         completion_buffer_seconds: float = JOB_COMPLETION_BUFFER_SECONDS,
+        heartbeat_timeout_s: Optional[float] = None,
         **kwargs,
     ):
         # The reference's fixed 1920s reset throttle assumes 360s rounds
@@ -95,6 +96,31 @@ class PhysicalScheduler(Scheduler):
         # Dispatch-time worker sets (assignments rotate before Done arrives).
         self._dispatched_worker_ids: Dict[JobId, tuple] = {}
 
+        # Worker liveness: heartbeat timestamps under their OWN lock so
+        # the (cheap, frequent) SendHeartbeat handler never queues
+        # behind the round loop's long-held condition lock. Lock order
+        # is strictly _cv -> _hb_lock (the reaper reads heartbeats
+        # while planning; the handler takes only _hb_lock).
+        self._hb_lock = sanitize.make_lock(
+            "core.physical.PhysicalScheduler._hb_lock"
+        )
+        self._last_heartbeat: Dict[int, float] = {}
+        # Workers already retired: a merely-stalled (not dead) worker
+        # keeps heartbeating after its reap, and re-admitting its id to
+        # the liveness map would leak an entry that can never expire
+        # away (the worker is gone from every placement structure).
+        self._retired_workers: set = set()
+        # A worker silent past this many seconds is declared dead: its
+        # outstanding micro-tasks are requeued with fault-completions,
+        # capacity shrinks, and the planner replans. Registration seeds
+        # the clock (registration IS the first lease) — a worker that
+        # dies before its first heartbeat must still expire, or its
+        # jobs stay pinned to a dead-but-registered host forever.
+        # <= 0 disables (required for heartbeat-less worker agents).
+        if heartbeat_timeout_s is None:
+            heartbeat_timeout_s = max(15.0, 2.5 * self._time_per_iteration)
+        self._heartbeat_timeout_s = float(heartbeat_timeout_s)
+
         from shockwave_tpu.runtime.rpc import scheduler_server
 
         self._server = scheduler_server.serve(
@@ -102,6 +128,7 @@ class PhysicalScheduler(Scheduler):
             {
                 "register_worker": self._register_worker_rpc,
                 "done": self._done_rpc,
+                "heartbeat": self._heartbeat_rpc,
                 "init_job": self._init_job_rpc,
                 "update_lease": self._update_lease_rpc,
                 # /metrics-style text dump: any client (or grpcurl-style
@@ -121,6 +148,17 @@ class PhysicalScheduler(Scheduler):
         from shockwave_tpu.runtime.rpc.scheduler_client import SchedulerRpcClient
 
         with self._cv:
+            # Idempotency gate: registration is retried with backoff, so
+            # an agent whose RegisterWorker response was lost re-sends
+            # it; handing out a second set of worker ids would double
+            # the agent's capacity on paper.
+            existing = sorted(
+                wid
+                for wid, addr in self._worker_addrs.items()
+                if addr == (ip_addr, port)
+            )
+            if existing:
+                return existing, self._time_per_iteration
             worker_ids = self.register_worker(
                 worker_type, num_gpus=num_accelerators
             )
@@ -128,8 +166,179 @@ class PhysicalScheduler(Scheduler):
             for worker_id in worker_ids:
                 self._worker_connections[worker_id] = client
                 self._worker_addrs[worker_id] = (ip_addr, port)
+            # Registration starts the liveness lease; see
+            # _heartbeat_rpc / _dead_workers. Lock order _cv -> _hb_lock.
+            now = time.monotonic()
+            with self._hb_lock:
+                for worker_id in worker_ids:
+                    self._last_heartbeat[worker_id] = now
             self._cv.notify_all()
         return worker_ids, self._time_per_iteration
+
+    def _heartbeat_rpc(self, worker_id) -> None:
+        """Liveness ping from a worker agent; deliberately does NOT take
+        the round loop's condition lock (see _hb_lock)."""
+        with self._hb_lock:
+            worker_id = int(worker_id)
+            if worker_id not in self._retired_workers:
+                self._last_heartbeat[worker_id] = time.monotonic()
+
+    # -- worker death ---------------------------------------------------
+    def _dead_workers(self) -> list:
+        """Workers whose heartbeats expired (the lease-expiry check).
+        Caller holds the lock (_cv); takes _hb_lock inside — lock order
+        _cv -> _hb_lock."""
+        if self._heartbeat_timeout_s <= 0:
+            return []
+        now = time.monotonic()
+        with self._hb_lock:
+            return [
+                wid
+                for wid, last in self._last_heartbeat.items()
+                if now - last > self._heartbeat_timeout_s
+                and wid in self._worker_id_to_worker_type
+            ]
+
+    def _reap_dead_workers(self) -> list:
+        """Caller holds the lock (_cv). Detect heartbeat-expired workers
+        and recover: requeue their outstanding micro-tasks as
+        fault-completions (no failed-attempt charged to the job),
+        unregister them so capacity shrinks, and flag the planner to
+        replan. Returns the reaped worker ids."""
+        dead = self._dead_workers()
+        for worker_id in dead:
+            self._retire_worker(worker_id, kind="heartbeat_expired")
+        return dead
+
+    def _retire_worker(
+        self, worker_id: int, kind: str, fault_id=None
+    ) -> list:
+        """Caller holds the lock (_cv). The single recovery path for a
+        worker that is gone — heartbeat expiry, injected crash, or spot
+        reclamation: requeue its outstanding micro-tasks as
+        fault-completions, unregister it, stamp the fault+recovery pair
+        into the flight recorder, and force a replan onto the surviving
+        fleet. Returns the requeued job keys."""
+        recorder = obs.get_recorder()
+        now = self.get_current_timestamp()
+        requeued = []
+        for key, wid in list(self._outstanding):
+            if wid != worker_id:
+                continue
+            self._outstanding.discard((key, wid))
+            self._jobs_with_extended_lease.discard(key)
+            zeros = [0] * len(key.singletons())
+            self._done_callback(
+                key, wid, zeros, [0.0] * len(key.singletons()),
+                fault=True,
+            )
+            requeued.append(str(key))
+        LOG.warning(
+            "worker %s retired (%s); requeued %s, capacity %d -> %d",
+            worker_id, kind, requeued or "nothing",
+            len(self._worker_ids), len(self._worker_ids) - 1,
+        )
+        self.remove_worker(worker_id)
+        obs.counter(
+            "scheduler_worker_deaths_total",
+            "workers lost to crash or capacity reclamation",
+        ).inc(kind=kind)
+        obs.instant(
+            "worker_death", cat="fault", tid="faults",
+            args={"worker_id": worker_id, "kind": kind,
+                  "requeued": requeued},
+        )
+        if recorder.enabled:
+            record = {
+                "kind": kind,
+                "worker_id": worker_id,
+                "round": self._round_id,
+                "time": now,
+                "requeued": requeued,
+            }
+            if fault_id is not None:
+                record["fault_id"] = fault_id
+            recorder.record_fault(record)
+            recorder.record_recovery(
+                {**record, "how": "requeued_and_replanned"}
+            )
+        if self._shockwave is not None:
+            self._shockwave.set_recompute_flag()
+        self._cv.notify_all()
+        return requeued
+
+    def _apply_physical_fault_events(self, injector) -> None:
+        """Caller holds the lock (_cv). Injected worker churn against
+        the LIVE cluster: a worker_crash / capacity_reclaim event
+        force-retires real registered workers (best-effort Reset RPC so
+        their training processes die too, mirroring a spot preemption
+        notice — fired on a side thread: a blocking RPC under the
+        round loop's condition lock would stall every lease renewal
+        behind a black-holed host); worker_add has no physical analog
+        (machines cannot be conjured) and is skipped loudly."""
+        from shockwave_tpu.runtime import faults as faults_mod
+
+        for event in injector.due_cluster_events(
+            self.get_current_timestamp()
+        ):
+            obs.counter(
+                "fault_injected_total",
+                "fault events delivered by the injector",
+            ).inc(kind=event.kind)
+            if event.kind == "worker_add":
+                LOG.warning(
+                    "fault event %d (worker_add) skipped: physical mode "
+                    "cannot conjure machines", event.event_id,
+                )
+                injector.mark_applied(event, skipped="no_physical_analog")
+                injector.mark_recovered(
+                    event.event_id, how="skipped_no_physical_analog"
+                )
+                continue
+            victims = faults_mod.select_victims(
+                injector.plan, event, self._worker_id_to_worker_type
+            )
+            reset_clients = [
+                self._worker_connections[worker_id]
+                for worker_id in victims
+                if worker_id in self._worker_connections
+            ]
+            requeued = []
+            for worker_id in victims:
+                requeued.extend(
+                    self._retire_worker(
+                        worker_id, kind=event.kind,
+                        fault_id=event.event_id,
+                    )
+                )
+            if reset_clients:
+                threading.Thread(
+                    target=self._reset_reclaimed_workers,
+                    args=(reset_clients,),
+                    daemon=True,
+                ).start()
+            injector.mark_applied(
+                event, workers=victims, requeued=requeued
+            )
+            injector.mark_recovered(
+                event.event_id, how="requeued_and_replanned",
+                workers=victims,
+            )
+
+    def remove_worker(self, worker_id: int) -> None:
+        """Base removal plus the physical-only maps (connections,
+        addresses, heartbeats, the staged next-round plan)."""
+        super().remove_worker(worker_id)
+        self._worker_connections.pop(worker_id, None)
+        self._worker_addrs.pop(worker_id, None)
+        with self._hb_lock:
+            self._last_heartbeat.pop(worker_id, None)
+            self._retired_workers.add(worker_id)
+        self._next_assignments = OrderedDict(
+            (key, ids)
+            for key, ids in self._next_assignments.items()
+            if worker_id not in ids
+        )
 
     def _observe_rpc(self, method: str, start: float) -> None:
         obs.histogram(
@@ -149,6 +358,21 @@ class PhysicalScheduler(Scheduler):
                 key = JobId(job_ids[0], job_ids[1])
                 steps_list = list(num_steps)
                 times_list = list(execution_times)
+            # Idempotency gate: clients retry Done with backoff, so a
+            # report whose response was lost can arrive twice; and a
+            # worker reaped/killed while its report was in flight has
+            # already had a completion synthesized. Every legitimate
+            # first report has an outstanding entry (dispatch adds it);
+            # anything else would double-credit steps or crash on a
+            # retired worker's ids.
+            if (key, worker_id) not in self._outstanding:
+                obs.counter(
+                    "scheduler_duplicate_done_total",
+                    "Done reports dropped as retransmits or "
+                    "already-reconciled micro-tasks",
+                ).inc()
+                self._observe_rpc("Done", rpc_start)
+                return
             now = self.get_current_timestamp()
             for single, log_text in zip(key.singletons(), logs):
                 if single in self._job_timelines:
@@ -287,9 +511,40 @@ class PhysicalScheduler(Scheduler):
                     )
                 self._outstanding.add((key, worker_id))
                 rpc_start = time.perf_counter()
-                self._worker_connections[worker_id].run_job(
-                    descriptions, worker_id, self._round_id
-                )
+                client = self._worker_connections.get(worker_id)
+                try:
+                    if client is None:
+                        raise KeyError(
+                            f"worker {worker_id} has no connection "
+                            "(died between planning and dispatch?)"
+                        )
+                    # The client retries with backoff internally; an
+                    # exception here means every attempt failed.
+                    client.run_job(
+                        descriptions, worker_id, self._round_id
+                    )
+                except Exception:
+                    # A dispatch that cannot reach its worker must not
+                    # leave the micro-task outstanding (the round-end
+                    # wait would burn the whole completion buffer) nor
+                    # crash the round loop: synthesize a zero-progress
+                    # fault completion and let heartbeat expiry decide
+                    # whether the worker is actually dead.
+                    LOG.warning(
+                        "dispatch of job %s to worker %s failed after "
+                        "retries", key, worker_id, exc_info=True,
+                    )
+                    obs.counter(
+                        "scheduler_dispatch_failures_total",
+                        "RunJob dispatches that exhausted every retry",
+                    ).inc()
+                    self._outstanding.discard((key, worker_id))
+                    zeros = [0] * len(key.singletons())
+                    self._done_callback(
+                        key, worker_id, zeros,
+                        [0.0] * len(key.singletons()), fault=True,
+                    )
+                    continue
                 obs.histogram(
                     "rpc_client_seconds",
                     "scheduler-to-worker RPC round-trip latency",
@@ -304,13 +559,32 @@ class PhysicalScheduler(Scheduler):
 
     # -- the round loop -------------------------------------------------
     def wait_for_workers(self, count: int, timeout: float = 120.0) -> None:
+        """Block until ``count`` workers registered. The timeout error
+        lists exactly who DID register (id, type, agent address) so the
+        missing worker is identifiable from the message alone — "only
+        1/2 registered" with no names cost real debugging time."""
         deadline = time.time() + timeout
         with self._cv:
             while len(self._worker_ids) < count:
                 remaining = deadline - time.time()
                 if remaining <= 0:
+                    registered = [
+                        "%d (%s @ %s:%s)"
+                        % (
+                            wid,
+                            self._worker_id_to_worker_type.get(wid, "?"),
+                            *self._worker_addrs.get(wid, ("?", "?")),
+                        )
+                        for wid in self._worker_ids
+                    ]
                     raise TimeoutError(
-                        f"only {len(self._worker_ids)}/{count} workers registered"
+                        f"only {len(self._worker_ids)}/{count} workers "
+                        f"registered with scheduler port {self._port} "
+                        f"after {timeout:.1f}s; registered: "
+                        f"[{', '.join(registered) or 'none'}] — the "
+                        f"missing {count - len(self._worker_ids)} never "
+                        "called RegisterWorker (check the worker agents' "
+                        "logs / --sched_port wiring)"
                     )
                 self._cv.wait(timeout=remaining)
 
@@ -324,8 +598,14 @@ class PhysicalScheduler(Scheduler):
     def run(self, max_rounds: Optional[int] = None) -> None:
         """Drive rounds until every added job completes
         (reference: _schedule_with_rounds scheduler.py:2080-2129)."""
+        from shockwave_tpu.runtime import faults
+
+        fault_injector = faults.active()
         while not self._shutdown_requested.is_set():
             with self._cv:
+                if fault_injector is not None:
+                    self._apply_physical_fault_events(fault_injector)
+                self._reap_dead_workers()
                 if len(self._jobs) == 0:
                     expected = self._num_expected_jobs
                     if expected is None or self._num_jobs_in_trace >= expected:
@@ -515,7 +795,12 @@ class PhysicalScheduler(Scheduler):
                     wait = deadline - time.time()
                     if wait <= 0:
                         break
-                    self._cv.wait(timeout=wait)
+                    self._cv.wait(timeout=min(wait, 1.0))
+                    # A worker dying mid-wait must clear its outstanding
+                    # micro-tasks (fault-completions) instead of burning
+                    # the whole completion buffer waiting for a Done
+                    # report that will never come.
+                    self._reap_dead_workers()
                 stragglers = {
                     key for key, _ in (expected & self._outstanding)
                 }
@@ -573,15 +858,22 @@ class PhysicalScheduler(Scheduler):
         for worker_id in worker_ids:
             for job_int in key.as_tuple():
                 try:
-                    self._worker_connections[worker_id].kill_job(job_int)
+                    client = self._worker_connections.get(worker_id)
+                    if client is None:
+                        continue  # worker already retired
+                    # Retried with backoff inside the client
+                    # (runtime/retry.py); reaching here means every
+                    # attempt failed.
+                    client.kill_job(job_int)
                 except Exception:
                     # The synthesized zero-progress Done below still
                     # converges bookkeeping, but a kill RPC that cannot
-                    # reach its worker is exactly how a dead host first
-                    # shows up — it must be visible, not swallowed.
+                    # reach its worker even after retries is exactly how
+                    # a dead host first shows up — it must be visible,
+                    # not swallowed.
                     LOG.warning(
-                        "kill RPC for job %s on worker %s failed",
-                        job_int, worker_id, exc_info=True,
+                        "kill RPC for job %s on worker %s failed after "
+                        "retries", job_int, worker_id, exc_info=True,
                     )
                     obs.counter(
                         "scheduler_kill_rpc_failures_total",
@@ -604,6 +896,20 @@ class PhysicalScheduler(Scheduler):
                     self._done_callback(
                         key, worker_id, zeros, [0.0] * len(key.singletons())
                     )
+
+    @staticmethod
+    def _reset_reclaimed_workers(clients) -> None:
+        """Best-effort Reset for injected reclamations, off the round
+        loop's locks (the workers are already retired from every
+        placement structure; this only hastens their processes' end)."""
+        for client in clients:
+            try:
+                client.reset()
+            except Exception:
+                LOG.debug(
+                    "reset of reclaimed worker failed (already gone)",
+                    exc_info=True,
+                )
 
     def _micro_task_scale_factor(self, job_id) -> int:
         ids = self._dispatched_worker_ids.get(job_id)

@@ -211,6 +211,9 @@ class Scheduler:
         self._job_priority_weights: Dict[JobId, float] = {}
         self._num_jobs_in_trace = 0
         self._in_progress_updates: Dict[JobId, list] = {}
+        # Micro-tasks with at least one fault-synthesized completion in
+        # their in-flight merge (see _done_callback's fault flag).
+        self._fault_tainted: set = set()
         self._job_timelines: Dict[JobId, list] = {}
         # Structured event log (job admissions, per-round assignments,
         # completions) consumed by scripts/analysis/postprocess_log.py —
@@ -373,6 +376,170 @@ class Scheduler:
         self._worker_type_to_worker_ids[worker_type].append(server_ids)
         self._need_to_update_allocation = True
         return server_ids
+
+    def remove_worker(self, worker_id: int) -> None:
+        """Unregister a dead or reclaimed worker from every placement
+        structure. Jobs holding the worker lose their current
+        assignment (the next scheduling pass re-places them, with the
+        planner's switching-cost term pricing the forced migration);
+        per-worker accounting dicts keep their entries so utilization
+        and cost math over the worker's lifetime stays intact."""
+        worker_type = self._worker_id_to_worker_type.pop(worker_id, None)
+        if worker_type is None:
+            return
+        self._worker_ids.remove(worker_id)
+        self._cluster_spec[worker_type] -= 1
+        servers = self._worker_type_to_worker_ids[worker_type]
+        for server in servers:
+            if worker_id in server:
+                server.remove(worker_id)
+        self._worker_type_to_worker_ids[worker_type] = [
+            s for s in servers if s
+        ]
+        self._available_worker_ids.discard(worker_id)
+        for key in [
+            k
+            for k, ids in self._current_worker_assignments.items()
+            if worker_id in ids
+        ]:
+            del self._current_worker_assignments[key]
+        self._need_to_update_allocation = True
+        self._sync_planner_capacity()
+
+    def _sync_planner_capacity(self) -> None:
+        """Propagate a capacity change (worker death, reclamation, churn
+        re-add) into the Shockwave planner so the next replan solves for
+        the fleet that actually exists. Called on removal and by the
+        fault applier after churn re-adds — NOT on ordinary initial
+        registration, which must stay bit-identical to the configured
+        ``num_gpus`` semantics."""
+        if self._shockwave is None:
+            return
+        if self._shockwave_is_pool_set():
+            for wt in list(self._shockwave.children):
+                count = self._cluster_spec.get(wt, 0)
+                if count > 0 and count != self._shockwave.pools.get(wt):
+                    self._shockwave.set_pool_capacity(wt, count)
+            return
+        try:
+            pool_type = self._shockwave_pool_type()
+        except ValueError:
+            return
+        count = self._cluster_spec.get(pool_type, 0)
+        if count > 0:
+            self._shockwave.set_capacity(count)
+
+    # ------------------------------------------------------------------
+    # Fault application (simulation path; physical mode detects real
+    # worker death via heartbeat expiry in core/physical.py).
+    # ------------------------------------------------------------------
+    def _apply_cluster_fault_events(self, injector, running_jobs) -> None:
+        """Apply every due churn/reclaim event from the armed fault plan
+        at this round boundary. Crashed or reclaimed workers take their
+        running micro-tasks down with them: each affected task is
+        force-completed with zero steps (``fault=True``, so the job is
+        not charged a failed attempt), the job stays in the table for
+        re-placement, capacity shrinks, and the planner is flagged to
+        replan. Every applied event is paired with a recovery record in
+        the flight recorder."""
+        from shockwave_tpu.runtime import faults as faults_mod
+
+        recorder = obs.get_recorder()
+        for event in injector.due_cluster_events(self._current_timestamp):
+            now = self._current_timestamp
+            obs.counter(
+                "fault_injected_total",
+                "fault events delivered by the injector",
+            ).inc(kind=event.kind)
+            if event.kind == "worker_add":
+                capacity = sum(self._cluster_spec.values())
+                count = event.count
+                if injector.plan.max_capacity is not None:
+                    count = min(
+                        count, max(injector.plan.max_capacity - capacity, 0)
+                    )
+                worker_type = event.worker_type or self._worker_types[0]
+                added = []
+                for _ in range(count):
+                    added.extend(
+                        self.register_worker(worker_type, num_gpus=1)
+                    )
+                self._sync_planner_capacity()
+                if added:
+                    obs.counter(
+                        "scheduler_capacity_adds_total",
+                        "workers restored by churn/spot re-add events",
+                    ).inc(len(added))
+                detail = {"added_workers": added}
+                how = "capacity_restored"
+            else:  # worker_crash / capacity_reclaim
+                victims = faults_mod.select_victims(
+                    injector.plan, event, self._worker_id_to_worker_type
+                )
+                requeued = self._crash_workers(victims, running_jobs, now)
+                if victims:
+                    obs.counter(
+                        "scheduler_worker_deaths_total",
+                        "workers lost to crash or capacity reclamation",
+                    ).inc(len(victims), kind=event.kind)
+                detail = {
+                    "workers": victims,
+                    "requeued": [str(k) for k in requeued],
+                }
+                how = "requeued_and_replanned"
+            obs.instant(
+                "fault", cat="fault", tid="faults",
+                args={"fault_id": event.event_id, "kind": event.kind,
+                      **{k: str(v) for k, v in detail.items()}},
+            )
+            record = {
+                "fault_id": event.event_id,
+                "kind": event.kind,
+                "round": self._num_completed_rounds,
+                "time": now,
+                **detail,
+            }
+            if recorder.enabled:
+                recorder.record_fault(record)
+                recorder.record_recovery({**record, "how": how})
+            injector.mark_applied(event, **detail)
+            injector.mark_recovered(event.event_id, how=how, **detail)
+
+    def _crash_workers(self, victims, running_jobs, now) -> list:
+        """Kill ``victims`` mid-simulation: force-complete every running
+        micro-task holding one of them with zero progress (the round's
+        work since the last checkpoint is lost — the realistic cost of
+        a crash), then unregister the workers. Returns the requeued job
+        keys."""
+        victim_set = set(victims)
+        requeued = []
+        if not victim_set:
+            return requeued
+        survivors = []
+        while running_jobs:
+            entry = heapq.heappop(running_jobs)
+            _, job_id, worker_ids, _, round_start = entry
+            if victim_set & set(worker_ids):
+                elapsed = max(now - round_start, 0.0)
+                n = len(job_id.singletons())
+                for wid in worker_ids:
+                    self._done_callback(
+                        job_id, wid, [0] * n, [elapsed] * n, fault=True
+                    )
+                requeued.append(job_id)
+                self._num_preemptions += 1
+                obs.counter(
+                    "scheduler_preemptions_total",
+                    "still-active jobs that lost their workers "
+                    "at a round boundary",
+                ).inc()
+            else:
+                survivors.append(entry)
+        for entry in survivors:
+            heapq.heappush(running_jobs, entry)
+        for worker_id in victims:
+            self.remove_worker(worker_id)
+        return requeued
 
     # ------------------------------------------------------------------
     # Job lifecycle.
@@ -1261,11 +1428,18 @@ class Scheduler:
         return len(self._current_worker_assignments[job_id])
 
     def _done_callback(
-        self, job_id, worker_id, all_num_steps, all_execution_times
+        self, job_id, worker_id, all_num_steps, all_execution_times,
+        fault: bool = False,
     ) -> None:
         """Merge per-worker completions for a micro-task; update steps, time
         and batch-size adaptation; remove finished jobs
-        (reference: scheduler.py:3223-3482, simulation-relevant paths)."""
+        (reference: scheduler.py:3223-3482, simulation-relevant paths).
+
+        ``fault=True`` marks a completion synthesized because the WORKER
+        died under the job (crash, reclamation, heartbeat expiry): the
+        zero-progress report then does not count toward the job's
+        MAX_FAILED_ATTEMPTS — penalizing a job for its host's death
+        would let sustained churn evict healthy jobs."""
         to_remove: List[JobId] = []
         worker_type = self._worker_id_to_worker_type[worker_id]
         self._available_worker_ids.add(worker_id)
@@ -1276,8 +1450,17 @@ class Scheduler:
         scale_factor = self._micro_task_scale_factor(job_id)
         updates = self._in_progress_updates.setdefault(job_id, [])
         updates.append((worker_id, all_num_steps, all_execution_times))
+        if fault:
+            # The taint must survive partial gang merges: when rank A's
+            # completion is synthesized for a dead worker but rank B
+            # reports normally LATER, B's call completes the merge with
+            # fault=False and would charge the job a failed attempt for
+            # its host's death.
+            self._fault_tainted.add(job_id)
         if len(updates) < scale_factor:
             return
+        fault = fault or job_id in self._fault_tainted
+        self._fault_tainted.discard(job_id)
         updates.sort(key=lambda x: x[0])
         micro_task_succeeded = True
         merged_steps = [0] * len(job_id.singletons())
@@ -1308,7 +1491,7 @@ class Scheduler:
 
         if not micro_task_succeeded:
             self._logger.info("[Micro-task failed]\tJob ID: %s", job_id)
-            if not job_id.is_pair and is_active[job_id]:
+            if not fault and not job_id.is_pair and is_active[job_id]:
                 self._num_failures_per_job[job_id] += 1
                 if self._num_failures_per_job[job_id] >= MAX_FAILED_ATTEMPTS:
                     to_remove.append(job_id)
@@ -1607,6 +1790,13 @@ class Scheduler:
         """
         import os as _os
 
+        from shockwave_tpu.runtime import faults
+
+        # Armed fault injection (chaos runs): churn/reclaim events from
+        # the plan are applied at round boundaries below; None — the
+        # default — costs one check per round.
+        fault_injector = faults.active()
+
         assert arrival_times is not None and jobs is not None
         remaining_jobs = len(jobs)
         queued_jobs = list(zip(arrival_times, jobs))
@@ -1687,6 +1877,14 @@ class Scheduler:
             elif next_job_arrival_time is not None:
                 self._current_timestamp = max(
                     self._current_timestamp, next_job_arrival_time
+                )
+
+            # Injected churn lands BEFORE the completion drain: a worker
+            # crashed mid-round must take its micro-task's progress down
+            # with it, not let the task complete normally first.
+            if fault_injector is not None:
+                self._apply_cluster_fault_events(
+                    fault_injector, running_jobs
                 )
 
             # Complete every running micro-task (they all end by round end).
@@ -1913,6 +2111,7 @@ class Scheduler:
         "_completed_jobs",
         "_slos",
         "_in_progress_updates",
+        "_fault_tainted",
         "_job_timelines",
         "_round_log",
         "_current_worker_assignments",

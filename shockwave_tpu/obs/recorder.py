@@ -456,6 +456,23 @@ class FlightRecorder:
             }
         )
 
+    def record_fault(self, detail: dict) -> None:
+        """An injected or detected fault (worker death, reclamation,
+        solver fault). ``detail`` must be plain JSON-serializable data;
+        the chaos harness pairs it with a recovery record by
+        ``fault_id`` / (kind, worker_id)."""
+        if not self.enabled:
+            return
+        self._append({"event": "fault", **detail})
+
+    def record_recovery(self, detail: dict) -> None:
+        """The recovery that answers a recorded fault (requeue+replan,
+        ladder fallback, retry success); same pairing keys as
+        :meth:`record_fault` plus ``how``."""
+        if not self.enabled:
+            return
+        self._append({"event": "recovery", **detail})
+
 
 # ----------------------------------------------------------------------
 # Reading + replay.
@@ -551,6 +568,13 @@ def replay_plan_record(
         )
         for job, history in state["finish_time_estimates"].items()
     }
+    # Replay is offline math, not a timing re-enactment: disable the
+    # degradation ladder's deadline so a slow replay host cannot fall
+    # down a different rung than the recorded solve. The snapshot's
+    # backend is already stamped with the backend that actually
+    # produced the plan (including ladder fallbacks).
+    state["config"] = dict(state["config"])
+    state["config"].pop("plan_deadline_s", None)
     planner = planner_from_state(state)
     planner._replan()
     start = planner.round_index
@@ -608,6 +632,8 @@ def summarize_log(path: str) -> dict:
     backends, objective range."""
     plans = 0
     contexts = 0
+    faults = 0
+    recoveries = 0
     rounds = []
     backends = {}
     objectives = []
@@ -623,9 +649,15 @@ def summarize_log(path: str) -> dict:
                 objectives.append(record["objective"])
         elif event == "round_context":
             contexts += 1
+        elif event == "fault":
+            faults += 1
+        elif event == "recovery":
+            recoveries += 1
     return {
         "plans": plans,
         "round_contexts": contexts,
+        "faults": faults,
+        "recoveries": recoveries,
         "first_round": min(rounds) if rounds else None,
         "last_round": max(rounds) if rounds else None,
         "backends": backends,

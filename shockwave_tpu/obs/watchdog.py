@@ -27,6 +27,12 @@ Rules (all thresholds overridable via a config dict, e.g. the
                      least ``min_forecasts`` forecasts were scored.
 ``lease_churn``      preemptions this round >= ``min_preemptions`` AND
                      above ``spike_factor`` x the rolling per-round mean.
+``solver_degraded``  the plan solve fell down the degradation ladder
+                     (``shockwave_solver_degraded_total`` advanced by
+                     >= ``min_events`` since the last check).
+``worker_death``     workers lost to crash/reclamation/heartbeat expiry
+                     (``scheduler_worker_deaths_total`` advanced by
+                     >= ``min_workers``).
 
 A rule re-fires only when its value worsens past the last fired value
 (no per-round alert spam while a breach persists). Disabled by default
@@ -55,6 +61,8 @@ DEFAULT_RULES: Dict[str, dict] = {
         "min_preemptions": 4,
         "min_history_rounds": 3,
     },
+    "solver_degraded": {"min_events": 1},
+    "worker_death": {"min_workers": 1},
 }
 
 
@@ -179,6 +187,20 @@ class Watchdog:
                 self._check_stragglers(
                     job_steps, scheduled or [], round_index, fired
                 )
+            if "solver_degraded" in self.rules:
+                self._check_counter_delta(
+                    metrics, "solver_degraded",
+                    "shockwave_solver_degraded_total",
+                    self.rules["solver_degraded"]["min_events"],
+                    round_index, fired,
+                )
+            if "worker_death" in self.rules:
+                self._check_counter_delta(
+                    metrics, "worker_death",
+                    "scheduler_worker_deaths_total",
+                    self.rules["worker_death"]["min_workers"],
+                    round_index, fired,
+                )
 
             for alert in fired:
                 alert["time_s"] = float(now_s)
@@ -224,6 +246,21 @@ class Watchdog:
     def _rearm(self, rule: str) -> None:
         """Caller holds the lock."""
         self._last_fired.pop(rule, None)
+
+    def _check_counter_delta(
+        self, metrics, rule, counter, min_delta, round_index, fired
+    ) -> None:
+        """Event-counter rule shape (degraded solves, worker deaths):
+        fire when the counter advanced by at least ``min_delta`` since
+        the previous check; a quiet round re-arms. Caller holds the
+        lock (check_round)."""
+        total = self._counter_total(metrics, counter)
+        delta = total - self._last_counters.get(counter, 0.0)
+        self._last_counters[counter] = total
+        if delta >= min_delta:
+            self._fire(fired, rule, round_index, delta, min_delta)
+        else:
+            self._rearm(rule)
 
     def _check_worst_ftf(self, metrics, round_index, fired) -> None:
         """Caller holds the lock (check_round)."""

@@ -64,6 +64,19 @@ class ShockwavePlanner:
         # relaunch overheads the scheduler threads through add_job. 0
         # disables the switching-cost term even when overheads are known.
         self.switch_cost_weight = float(config.get("switch_cost_weight", 1.0))
+        # Per-round planning deadline (seconds) for the degradation
+        # ladder: primary backend -> relaxed PGD -> native greedy, each
+        # rung budgeted against what remains. None (default) keeps the
+        # single-backend behavior; the ladder also engages when fault
+        # injection is armed so injected solver slowdowns/timeouts have
+        # a recovery path instead of a wedged round.
+        raw_deadline = config.get("plan_deadline_s")
+        self.plan_deadline_s = (
+            float(raw_deadline) if raw_deadline is not None else None
+        )
+        # Ladder outcome of the most recent solve (consumed by
+        # _record_solve to tag degraded rounds in solve_records).
+        self._last_ladder: Optional[dict] = None
 
         self.round_index = 0
         self.recompute_flag = False
@@ -131,6 +144,18 @@ class ShockwavePlanner:
         # incumbents the next replan's switching-cost term protects.
         self.last_round_jobs = list(self.schedules.get(self.round_index, []))
         self.round_index += 1
+
+    def set_capacity(self, num_gpus: int) -> None:
+        """Capacity changed under the planner (worker death, spot
+        reclamation, churn re-add): solve the next plan against the
+        fleet that actually exists. Clamped to >= 1 — a zero-chip plan
+        has no meaning and the applier never reclaims the last chip."""
+        num_gpus = max(1, int(num_gpus))
+        if num_gpus == self.num_gpus:
+            return
+        self.num_gpus = num_gpus
+        self.config["num_gpus"] = num_gpus
+        self.recompute_flag = True
 
     def set_recompute_flag(self) -> None:
         self.recompute_flag = True
@@ -311,11 +336,187 @@ class ShockwavePlanner:
         """Returns (schedule, backend_used) — ``backend_used`` is the
         backend that actually produced the solve, which for the "tpu"
         latency-aware dispatch differs per problem size.
+
+        With a per-round planning deadline (``plan_deadline_s``) or
+        armed fault injection, the solve runs under the degradation
+        ladder (:meth:`_solve_with_ladder`); otherwise this is a
+        straight dispatch to the configured backend."""
+        from shockwave_tpu.runtime import faults
+
+        injector = faults.active()
+        self._last_ladder = None
+        self._attempted_backend = self.backend
+        if self.plan_deadline_s is None and injector is None:
+            return self._solve_backend(self.backend, problem)
+        return self._solve_with_ladder(problem, injector)
+
+    def _ladder_rungs(self) -> List[str]:
+        """Degradation ladder: configured backend, then the relaxed PGD
+        solve, then the native greedy (cheapest, host-only). Rungs the
+        host cannot run (no C++ toolchain) are dropped; the primary
+        always stays."""
+        rungs = [self.backend]
+        for fallback in ("relaxed", "native"):
+            if fallback not in rungs:
+                rungs.append(fallback)
+        from shockwave_tpu import native as native_mod
+
+        if not native_mod.available():
+            rungs = [r for r in rungs if r != "native"] or [self.backend]
+        return rungs
+
+    def _solve_with_ladder(
+        self, problem: EGProblem, injector
+    ) -> "Tuple[np.ndarray, str]":
+        """Run the solve down the degradation ladder under the round's
+        planning budget. Every rung but the last is bounded by the
+        remaining deadline (a rung that blows it is abandoned — its
+        thread is left to finish into the void); the FINAL rung runs to
+        completion unconditionally, because a plan is mandatory.
+        Injected solver faults are consumed one per attempt:
+        ``solver_timeout`` charges the rung as timed out without
+        burning wall-clock (deterministic in simulation),
+        ``solver_slowdown`` stretches the attempt by ``delay_s`` so a
+        real deadline can overrun naturally."""
+        import threading
+
+        start = time.monotonic()
+        deadline = self.plan_deadline_s
+        rungs = self._ladder_rungs()
+        attempts: List[dict] = []
+        faults_hit: list = []
+        last_error: Optional[BaseException] = None
+        for i, backend in enumerate(rungs):
+            is_last = i == len(rungs) - 1
+            fault = (
+                injector.next_solver_fault(self.round_index)
+                if injector is not None
+                else None
+            )
+            if fault is not None:
+                faults_hit.append(fault)
+                injector.mark_applied(
+                    fault, round=self.round_index, backend=backend
+                )
+                obs.counter(
+                    "fault_injected_total",
+                    "fault events delivered by the injector",
+                ).inc(kind=fault.kind)
+            if (
+                fault is not None
+                and fault.kind == "solver_timeout"
+                and not is_last
+            ):
+                # A plan is mandatory: an injected timeout charges every
+                # rung but the last, which always runs (the docstring's
+                # contract — raising here would turn a survivable
+                # injected fault into a crashed round).
+                attempts.append(
+                    {"backend": backend, "outcome": "timeout_injected"}
+                )
+                last_error = TimeoutError(
+                    f"injected solver timeout (fault {fault.event_id})"
+                )
+                continue
+            remaining = (
+                None
+                if deadline is None
+                else deadline - (time.monotonic() - start)
+            )
+            if remaining is not None and remaining <= 0 and not is_last:
+                attempts.append(
+                    {"backend": backend, "outcome": "skipped_budget"}
+                )
+                continue
+            delay_s = fault.delay_s if fault is not None else 0.0
+            box: dict = {}
+
+            def run_attempt(backend=backend, delay_s=delay_s):
+                try:
+                    if delay_s:
+                        time.sleep(delay_s)
+                    box["result"] = self._solve_backend(backend, problem)
+                except Exception as e:  # noqa: BLE001 - re-raised below
+                    box["error"] = e
+
+            if remaining is None or is_last:
+                run_attempt()
+            else:
+                worker = threading.Thread(target=run_attempt, daemon=True)
+                worker.start()
+                worker.join(remaining)
+                if worker.is_alive():
+                    attempts.append(
+                        {"backend": backend, "outcome": "timeout"}
+                    )
+                    last_error = TimeoutError(
+                        f"{backend} solve exceeded the remaining "
+                        f"{remaining:.3f}s of the {deadline}s plan budget"
+                    )
+                    continue
+            if "error" in box:
+                attempts.append(
+                    {
+                        "backend": backend,
+                        "outcome": type(box["error"]).__name__,
+                    }
+                )
+                last_error = box["error"]
+                continue
+            Y, used = box["result"]
+            attempts.append({"backend": used, "outcome": "ok"})
+            degraded = i > 0
+            self._last_ladder = {
+                "degraded": degraded,
+                "fallback_from": rungs[0] if degraded else None,
+                "attempts": attempts,
+            }
+            if degraded:
+                obs.counter(
+                    "shockwave_solver_degraded_total",
+                    "plan solves that fell down the degradation ladder",
+                ).inc(backend=used)
+                obs.instant(
+                    "solver_degraded", cat="plan", pid="solver",
+                    tid="planner",
+                    args={
+                        "round": self.round_index,
+                        "fallback_from": rungs[0],
+                        "backend": used,
+                        "attempts": len(attempts),
+                    },
+                )
+            recorder = obs.get_recorder()
+            for fault in faults_hit:
+                how = "ladder_fallback" if degraded else "ladder_absorbed"
+                injector.mark_recovered(
+                    fault.event_id, how=how, backend=used
+                )
+                if recorder.enabled:
+                    record = {
+                        "fault_id": fault.event_id,
+                        "kind": fault.kind,
+                        "round": self.round_index,
+                        "pool": self.pool_label,
+                    }
+                    recorder.record_fault(record)
+                    recorder.record_recovery(
+                        {**record, "how": how, "backend": used}
+                    )
+            return Y, used
+        if last_error is not None:
+            raise last_error
+        raise RuntimeError("degradation ladder produced no plan")
+
+    def _solve_backend(
+        self, backend: str, problem: EGProblem
+    ) -> "Tuple[np.ndarray, str]":
+        """One backend's solve (the ladder's rung body).
         ``_attempted_backend`` tracks the in-flight choice so a raising
         solver is attributed to the backend that actually raised, not
         the configured dispatch name."""
-        self._attempted_backend = self.backend
-        if self.backend == "reference":
+        self._attempted_backend = backend
+        if backend == "reference":
             from shockwave_tpu.solver.eg_milp import (
                 reorder_unfair_jobs_milp,
                 solve_eg_milp,
@@ -337,17 +538,17 @@ class ShockwavePlanner:
             )
         from shockwave_tpu.solver.rounding import reorder_rounds
 
-        used = self.backend
-        if self.backend == "native":
+        used = backend
+        if backend == "native":
             from shockwave_tpu.native import solve_eg_greedy_native
 
             Y = solve_eg_greedy_native(problem)
-        elif self.backend == "level":
+        elif backend == "level":
             # Forced JAX level-set solve (the device path of "tpu").
             from shockwave_tpu.solver.eg_jax import solve_eg_level
 
             Y = solve_eg_level(problem)
-        elif self.backend == "sharded":
+        elif backend == "sharded":
             # Forced multi-chip solve: ONE planning problem's job
             # dimension sharded over every visible device
             # (shockwave_tpu/solver/eg_sharded.py). Bit-identical
@@ -360,7 +561,7 @@ class ShockwavePlanner:
             )
 
             Y = solve_eg_level_sharded(problem)
-        elif self.backend == "relaxed":
+        elif backend == "relaxed":
             # Projected-gradient ascent on the exact continuous relaxation,
             # then integer rounding + per-round placement on host.
             from shockwave_tpu.solver.eg_jax import solve_eg_jax
@@ -445,6 +646,14 @@ class ShockwavePlanner:
         }
         if error is not None:
             record["error"] = error
+        ladder = self._last_ladder
+        if ladder is not None and ladder["degraded"]:
+            # A degraded round must be visible wherever operators look:
+            # tagged here, counted in shockwave_solver_degraded_total,
+            # and picked up by the watchdog's solver_degraded rule.
+            record["degraded"] = True
+            record["fallback_from"] = ladder["fallback_from"]
+            record["ladder"] = [dict(a) for a in ladder["attempts"]]
         self.solve_records.append(record)
         obs.histogram(
             "shockwave_solve_seconds",
@@ -514,6 +723,11 @@ class ShockwavePlanner:
                     job_ids[j] for j in range(len(job_ids)) if Y[j, r]
                 ]
             if pre_state is not None:
+                # Stamp the backend that ACTUALLY produced the plan into
+                # the snapshot: a degraded solve (ladder fallback) must
+                # replay through the same backend or the offline replan
+                # would re-derive the primary backend's different plan.
+                pre_state["backend"] = backend_used
                 recorder.record_plan(
                     planner_state=pre_state,
                     plan={
@@ -727,6 +941,14 @@ class PoolSetPlanner:
     def increment_round(self) -> None:
         for child in self.children.values():
             child.increment_round()
+
+    def set_pool_capacity(self, worker_type: str, num_gpus: int) -> None:
+        """Capacity change inside one pool (worker death / churn)."""
+        child = self.children.get(worker_type)
+        if child is None:
+            return
+        self.pools[worker_type] = max(1, int(num_gpus))
+        child.set_capacity(num_gpus)
 
     def set_recompute_flag(self) -> None:
         for child in self.children.values():

@@ -19,6 +19,7 @@ import signal
 import subprocess
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List
 
 from shockwave_tpu import obs
@@ -60,6 +61,12 @@ class Dispatcher:
         # several ranks on one multi-accelerator host.
         self._procs: Dict[tuple, subprocess.Popen] = {}
         self._kill_requested: set = set()
+        # RunJob idempotency: the scheduler's client retries with
+        # backoff, so a dispatch whose response was lost can arrive
+        # twice — launching the same micro-task twice would double its
+        # Done report AND its training processes. Bounded FIFO of seen
+        # dispatch keys.
+        self._seen_dispatches: "OrderedDict[tuple, None]" = OrderedDict()
         os.makedirs(self._run_dir, exist_ok=True)
         os.makedirs(self._checkpoint_dir, exist_ok=True)
 
@@ -91,6 +98,25 @@ class Dispatcher:
     def dispatch_jobs(self, job_descriptions, worker_id: int, round_id: int):
         """Asynchronously run a (possibly packed) set of jobs on one free
         accelerator (reference: dispatcher.py:447-553)."""
+        dispatch_key = (
+            tuple(int(d["job_id"]) for d in job_descriptions),
+            int(worker_id),
+            int(round_id),
+        )
+        with self._lock:
+            if dispatch_key in self._seen_dispatches:
+                LOG.warning(
+                    "duplicate RunJob %s dropped (client retransmit)",
+                    dispatch_key,
+                )
+                obs.counter(
+                    "worker_duplicate_dispatches_total",
+                    "RunJob retransmits dropped by the dedup gate",
+                ).inc()
+                return
+            self._seen_dispatches[dispatch_key] = None
+            while len(self._seen_dispatches) > 4096:
+                self._seen_dispatches.popitem(last=False)
         threading.Thread(
             target=self._dispatch_jobs_helper,
             args=(job_descriptions, worker_id, round_id),
@@ -140,12 +166,26 @@ class Dispatcher:
         finally:
             self._accelerator_queue.put(accel_id)
         try:
+            # The client retries with jittered backoff and per-call
+            # deadlines (runtime/retry.py), so a transient scheduler
+            # stall or dropped packet costs a retry here, not the
+            # round's training progress.
             self._worker_rpc_client.notify_scheduler(
                 worker_id, job_ids, steps, durations, logs
             )
         except Exception:
-            # Scheduler may already be gone during shutdown.
-            LOG.warning("Done notification failed", exc_info=True)
+            # Every retry exhausted: either the scheduler is gone for
+            # good (shutdown) or this result is genuinely lost — the
+            # scheduler's straggler-kill path will reconcile the
+            # outstanding micro-task, but the loss must be loud.
+            LOG.error(
+                "Done notification failed after retries (jobs %s)",
+                job_ids, exc_info=True,
+            )
+            obs.counter(
+                "worker_done_notify_giveups_total",
+                "Done reports dropped after exhausting every retry",
+            ).inc()
 
     def _launch_job(self, job, accel_id, worker_id, round_id):
         """Run one training subprocess to completion; returns

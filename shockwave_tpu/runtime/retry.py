@@ -1,0 +1,133 @@
+"""Jittered exponential retry/backoff for the runtime's RPC client paths.
+
+Every scheduler<->worker RPC used to be one-shot: a single dropped
+packet lost a Done report (and with it a round of training progress)
+or left a kill request unsent. This helper gives every client call the
+same disciplined shape:
+
+  * up to ``attempts`` tries, exponential backoff with full jitter
+    (0.5x-1x of the nominal delay, capped at ``max_delay_s``);
+  * a per-attempt gRPC deadline (``call_timeout_s``) so a black-holed
+    TCP connection cannot hang a dispatcher thread;
+  * an overall per-call deadline (``deadline_s``) across all attempts,
+    after which the last error is re-raised to the caller — callers
+    decide whether a final failure is fatal (registration) or
+    absorbable (a Done report the straggler-kill path will reconcile).
+
+Retries and final give-ups are visible as
+``rpc_client_retries_total{method}`` / ``rpc_client_giveups_total{method}``
+so a flaky network is observable before it becomes a lost-work incident.
+
+Defaults are env-tunable (``SHOCKWAVE_RPC_*``) so tests and chaos runs
+can tighten them without threading knobs through every constructor.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass, replace
+from typing import Callable, Optional, Tuple, Type
+
+from shockwave_tpu import obs
+
+_ENV_DEFAULTS = {
+    "attempts": ("SHOCKWAVE_RPC_ATTEMPTS", 4),
+    "base_delay_s": ("SHOCKWAVE_RPC_BASE_DELAY_S", 0.1),
+    "max_delay_s": ("SHOCKWAVE_RPC_MAX_DELAY_S", 2.0),
+    "deadline_s": ("SHOCKWAVE_RPC_DEADLINE_S", 20.0),
+    "call_timeout_s": ("SHOCKWAVE_RPC_TIMEOUT_S", 10.0),
+}
+
+# Module RNG for backoff jitter only — never part of replayable state.
+_JITTER_RNG = random.Random()
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    attempts: int = 4
+    base_delay_s: float = 0.1
+    max_delay_s: float = 2.0
+    # Total budget across attempts (sleeps included); None = unbounded.
+    deadline_s: Optional[float] = 20.0
+    # Per-attempt gRPC deadline handed to the stub call.
+    call_timeout_s: float = 10.0
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,)
+
+    @classmethod
+    def from_env(cls, env=None) -> "RetryPolicy":
+        env = os.environ if env is None else env
+        kwargs = {}
+        for field, (var, default) in _ENV_DEFAULTS.items():
+            raw = env.get(var)
+            if raw is None:
+                kwargs[field] = default
+            else:
+                kwargs[field] = (
+                    int(raw) if field == "attempts" else float(raw)
+                )
+        return cls(**kwargs)
+
+    def single_shot(self) -> "RetryPolicy":
+        """One attempt, same deadlines — for best-effort periodic calls
+        (heartbeats) where the next tick IS the retry."""
+        return replace(self, attempts=1)
+
+
+def call_with_retry(
+    attempt: Callable[[Optional[float]], object],
+    policy: RetryPolicy,
+    method: str = "",
+    sleep: Callable[[float], None] = time.sleep,
+    rng: Optional[random.Random] = None,
+):
+    """Run ``attempt(per_attempt_timeout_s)`` under ``policy``.
+
+    ``attempt`` receives the gRPC deadline to pass to the stub (clipped
+    to whatever remains of the overall deadline) and must raise on
+    failure. The last error is re-raised once attempts or the deadline
+    are exhausted.
+    """
+    rng = rng or _JITTER_RNG
+    deadline = (
+        time.monotonic() + policy.deadline_s
+        if policy.deadline_s is not None
+        else None
+    )
+    last_error: Optional[BaseException] = None
+    for i in range(max(policy.attempts, 1)):
+        timeout = policy.call_timeout_s
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            timeout = min(timeout, max(remaining, 1e-3))
+        try:
+            return attempt(timeout)
+        except policy.retry_on as e:  # noqa: BLE001 - policy-defined
+            last_error = e
+            if i >= policy.attempts - 1:
+                break
+            delay = min(
+                policy.max_delay_s, policy.base_delay_s * (2.0 ** i)
+            )
+            delay *= 0.5 + rng.random() * 0.5  # full jitter, never 0
+            if deadline is not None:
+                delay = min(delay, max(deadline - time.monotonic(), 0.0))
+            obs.counter(
+                "rpc_client_retries_total",
+                "RPC attempts that failed and were retried",
+            ).inc(method=method)
+            if delay > 0:
+                sleep(delay)
+    obs.counter(
+        "rpc_client_giveups_total",
+        "RPC calls that exhausted every retry attempt",
+    ).inc(method=method)
+    if last_error is None:
+        raise TimeoutError(
+            f"RPC {method or '<call>'}: deadline of {policy.deadline_s}s "
+            "exhausted before the first attempt"
+        )
+    raise last_error

@@ -1,21 +1,52 @@
 """Scheduler -> worker RPC client (reference:
 scheduler/runtime/rpc/scheduler_client.py; like the reference, a fresh
-channel per call keeps the client stateless against worker restarts)."""
+channel per call keeps the client stateless against worker restarts).
+
+All methods retry with jittered exponential backoff and per-call
+deadlines (:mod:`shockwave_tpu.runtime.retry`); a worker that stays
+unreachable past the deadline surfaces as an exception the scheduler's
+dead-worker handling converts into requeue + capacity shrink rather
+than a wedged round. Teardown RPCs (Reset/Shutdown) deliberately use a
+single attempt: their target is usually already gone.
+"""
 
 from __future__ import annotations
 
+from typing import Optional
+
 import grpc
 
+from shockwave_tpu.runtime import faults
 from shockwave_tpu.runtime.protobuf import common_pb2, scheduler_to_worker_pb2 as s2w_pb2
+from shockwave_tpu.runtime.retry import RetryPolicy, call_with_retry
 from shockwave_tpu.runtime.rpc.wiring import make_stubs
 
 
 class SchedulerRpcClient:
-    def __init__(self, server_ip_addr: str, port: int):
+    def __init__(
+        self,
+        server_ip_addr: str,
+        port: int,
+        retry: Optional[RetryPolicy] = None,
+    ):
         self._addr = f"{server_ip_addr}:{port}"
+        self._retry = retry or RetryPolicy.from_env()
+        self._teardown_retry = self._retry.single_shot()
 
     def _stubs(self, channel):
         return make_stubs(channel, "SchedulerToWorker")
+
+    def _call(self, method: str, send, policy: Optional[RetryPolicy] = None):
+        def attempt(timeout):
+            faults.check_rpc(method)
+            with grpc.insecure_channel(self._addr) as channel:
+                result = send(self._stubs(channel), timeout)
+            faults.note_rpc_success(method)
+            return result
+
+        return call_with_retry(
+            attempt, policy or self._retry, method=method
+        )
 
     def run_job(self, job_descriptions, worker_id: int, round_id: int) -> None:
         descriptions = [
@@ -32,23 +63,37 @@ class SchedulerRpcClient:
             )
             for d in job_descriptions
         ]
-        with grpc.insecure_channel(self._addr) as channel:
-            self._stubs(channel).RunJob(
-                s2w_pb2.RunJobRequest(
-                    job_descriptions=descriptions,
-                    worker_id=worker_id,
-                    round_id=round_id,
-                )
-            )
+        request = s2w_pb2.RunJobRequest(
+            job_descriptions=descriptions,
+            worker_id=worker_id,
+            round_id=round_id,
+        )
+        self._call(
+            "RunJob",
+            lambda stubs, timeout: stubs.RunJob(request, timeout=timeout),
+        )
 
     def kill_job(self, job_id: int) -> None:
-        with grpc.insecure_channel(self._addr) as channel:
-            self._stubs(channel).KillJob(s2w_pb2.KillJobRequest(job_id=job_id))
+        request = s2w_pb2.KillJobRequest(job_id=job_id)
+        self._call(
+            "KillJob",
+            lambda stubs, timeout: stubs.KillJob(request, timeout=timeout),
+        )
 
     def reset(self) -> None:
-        with grpc.insecure_channel(self._addr) as channel:
-            self._stubs(channel).Reset(common_pb2.Empty())
+        self._call(
+            "Reset",
+            lambda stubs, timeout: stubs.Reset(
+                common_pb2.Empty(), timeout=timeout
+            ),
+            policy=self._teardown_retry,
+        )
 
     def shutdown(self) -> None:
-        with grpc.insecure_channel(self._addr) as channel:
-            self._stubs(channel).Shutdown(common_pb2.Empty())
+        self._call(
+            "Shutdown",
+            lambda stubs, timeout: stubs.Shutdown(
+                common_pb2.Empty(), timeout=timeout
+            ),
+            policy=self._teardown_retry,
+        )

@@ -1,64 +1,112 @@
 """Worker -> scheduler RPC client (reference:
-scheduler/runtime/rpc/worker_client.py)."""
+scheduler/runtime/rpc/worker_client.py).
+
+Every method runs under the shared retry/backoff discipline
+(:mod:`shockwave_tpu.runtime.retry`): jittered exponential retries with
+a per-attempt gRPC deadline and an overall per-call deadline, so a
+scheduler restart or a dropped packet costs a retry, not a lost Done
+report. Fault injection (:mod:`shockwave_tpu.runtime.faults`) hooks
+each attempt when armed; both layers are no-ops by default.
+"""
 
 from __future__ import annotations
 
+from typing import Optional
+
 import grpc
 
+from shockwave_tpu.runtime import faults
 from shockwave_tpu.runtime.protobuf import worker_to_scheduler_pb2 as w2s_pb2
+from shockwave_tpu.runtime.retry import RetryPolicy, call_with_retry
 from shockwave_tpu.runtime.rpc.wiring import make_stubs
 
 
 class WorkerRpcClient:
-    def __init__(self, sched_ip_addr: str, sched_port: int):
+    def __init__(
+        self,
+        sched_ip_addr: str,
+        sched_port: int,
+        retry: Optional[RetryPolicy] = None,
+    ):
         self._addr = f"{sched_ip_addr}:{sched_port}"
+        self._retry = retry or RetryPolicy.from_env()
+        # Heartbeats are periodic: the next tick is the retry, and a
+        # backoff pile-up behind a dead scheduler helps nobody.
+        self._heartbeat_retry = self._retry.single_shot()
 
     def _stubs(self, channel):
         return make_stubs(channel, "WorkerToScheduler")
+
+    def _call(self, method: str, send, policy: Optional[RetryPolicy] = None):
+        """One retried unary call; ``send(stubs, timeout)`` does the
+        wire work on a fresh channel (stateless against scheduler
+        restarts, like the reference)."""
+
+        def attempt(timeout):
+            faults.check_rpc(method)
+            with grpc.insecure_channel(self._addr) as channel:
+                result = send(self._stubs(channel), timeout)
+            faults.note_rpc_success(method)
+            return result
+
+        return call_with_retry(
+            attempt, policy or self._retry, method=method
+        )
 
     def register_worker(
         self, worker_type: str, num_accelerators: int, ip_addr: str, port: int
     ):
         """Returns (worker_ids, round_duration, error_message)."""
-        with grpc.insecure_channel(self._addr) as channel:
-            response = self._stubs(channel).RegisterWorker(
-                w2s_pb2.RegisterWorkerRequest(
-                    worker_type=worker_type,
-                    num_accelerators=num_accelerators,
-                    ip_addr=ip_addr,
-                    port=port,
-                )
-            )
+        request = w2s_pb2.RegisterWorkerRequest(
+            worker_type=worker_type,
+            num_accelerators=num_accelerators,
+            ip_addr=ip_addr,
+            port=port,
+        )
+        response = self._call(
+            "RegisterWorker",
+            lambda stubs, timeout: stubs.RegisterWorker(
+                request, timeout=timeout
+            ),
+        )
         if not response.success:
             return None, None, response.error_message
         return list(response.worker_ids), response.round_duration, None
 
     def send_heartbeat(self, worker_id: int) -> None:
-        with grpc.insecure_channel(self._addr) as channel:
-            self._stubs(channel).SendHeartbeat(
-                w2s_pb2.Heartbeat(worker_id=worker_id)
-            )
+        self._call(
+            "SendHeartbeat",
+            lambda stubs, timeout: stubs.SendHeartbeat(
+                w2s_pb2.Heartbeat(worker_id=worker_id), timeout=timeout
+            ),
+            policy=self._heartbeat_retry,
+        )
 
     def dump_metrics(self) -> str:
         """Scrape the scheduler's metrics registry (Prometheus
         exposition text; the /metrics-style dump RPC)."""
         from shockwave_tpu.runtime.protobuf import common_pb2
 
-        with grpc.insecure_channel(self._addr) as channel:
-            response = self._stubs(channel).DumpMetrics(common_pb2.Empty())
+        response = self._call(
+            "DumpMetrics",
+            lambda stubs, timeout: stubs.DumpMetrics(
+                common_pb2.Empty(), timeout=timeout
+            ),
+        )
         return response.text
 
     def notify_scheduler(
         self, worker_id, job_ids, num_steps, execution_times, iterator_logs
     ) -> None:
         """Report completed micro-tasks (reference: worker_client.py:62-86)."""
-        with grpc.insecure_channel(self._addr) as channel:
-            self._stubs(channel).Done(
-                w2s_pb2.DoneRequest(
-                    worker_id=worker_id,
-                    job_id=[int(j) for j in job_ids],
-                    num_steps=[int(s) for s in num_steps],
-                    execution_time=[float(t) for t in execution_times],
-                    iterator_log=[str(x) for x in iterator_logs],
-                )
-            )
+        request = w2s_pb2.DoneRequest(
+            worker_id=worker_id,
+            job_id=[int(j) for j in job_ids],
+            num_steps=[int(s) for s in num_steps],
+            execution_time=[float(t) for t in execution_times],
+            iterator_log=[str(x) for x in iterator_logs],
+        )
+        self._call(
+            "Done",
+            lambda stubs, timeout: stubs.Done(request, timeout=timeout),
+        )

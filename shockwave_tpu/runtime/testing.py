@@ -51,10 +51,17 @@ def start_local_cluster(
     run_dir: str,
     checkpoint_dir: str,
     round_duration: float = 3.0,
+    wait_timeout_s: float = None,
     **sched_kwargs,
 ):
     """One PhysicalScheduler + one registered localhost worker; returns
-    the scheduler (the worker object lives in daemon threads)."""
+    the scheduler (the worker object lives in daemon threads).
+
+    ``wait_timeout_s`` bounds the registration wait (default: the
+    ``SHOCKWAVE_WORKER_WAIT_S`` env var, else 30 s — loaded CI hosts can
+    raise it without touching call sites); on expiry the scheduler's
+    TimeoutError lists exactly which workers did register so the
+    missing one is identifiable from the message alone."""
     from shockwave_tpu.core.physical import PhysicalScheduler
     from shockwave_tpu.data.default_oracle import generate_oracle
     from shockwave_tpu.policies import get_policy
@@ -84,7 +91,9 @@ def start_local_cluster(
         run_dir=run_dir,
         checkpoint_dir=checkpoint_dir,
     )
-    sched.wait_for_workers(num_accelerators, timeout=30)
+    if wait_timeout_s is None:
+        wait_timeout_s = float(os.environ.get("SHOCKWAVE_WORKER_WAIT_S", 30))
+    sched.wait_for_workers(num_accelerators, timeout=wait_timeout_s)
     return sched
 
 

@@ -25,6 +25,7 @@ class Worker:
         run_dir: str,
         checkpoint_dir: str,
         use_numactl: bool = False,
+        heartbeat_interval_s: float = 1.0,
     ):
         from shockwave_tpu.runtime.dispatcher import Dispatcher
         from shockwave_tpu.runtime.rpc import worker_server
@@ -72,11 +73,32 @@ class Worker:
             use_numactl=use_numactl,
         )
         self._shutdown_event = threading.Event()
+        # Liveness heartbeats: the scheduler's lease-expiry detection
+        # (core/physical.py) declares a silent worker dead, requeues its
+        # jobs, and shrinks capacity. Interval <= 0 disables.
+        self._heartbeat_interval = float(
+            os.environ.get("SHOCKWAVE_HEARTBEAT_S", heartbeat_interval_s)
+        )
+        if self._heartbeat_interval > 0:
+            threading.Thread(
+                target=self._heartbeat_loop, daemon=True
+            ).start()
         LOG.info(
             "Worker registered: ids=%s round_duration=%s",
             worker_ids,
             round_duration,
         )
+
+    def _heartbeat_loop(self):
+        while not self._shutdown_event.wait(self._heartbeat_interval):
+            for worker_id in self._worker_ids:
+                try:
+                    self._rpc_client.send_heartbeat(worker_id)
+                except Exception:
+                    # Single-shot by policy: the next tick is the retry,
+                    # and the scheduler being briefly unreachable is not
+                    # this worker's emergency.
+                    LOG.debug("heartbeat failed", exc_info=True)
 
     # -- RPC callbacks --------------------------------------------------
     def _run_job_callback(self, job_descriptions, worker_id, round_id):

@@ -1,0 +1,410 @@
+"""Fault injection, RPC retry/backoff, and churn-recovery coverage:
+the deterministic fault plan + injector, the retry helper's backoff
+math, simulator worker-death -> requeue -> replan, the solver
+degradation ladder, and the fault->recovery pairing in the flight
+recorder with exact replay.
+"""
+
+import json
+import random
+
+import pytest
+
+from shockwave_tpu.runtime import faults
+from shockwave_tpu.runtime.retry import RetryPolicy, call_with_retry
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ----------------------------------------------------------------------
+# Retry/backoff helper.
+# ----------------------------------------------------------------------
+def test_retry_backoff_retries_then_succeeds():
+    calls, sleeps = [], []
+
+    def attempt(timeout):
+        calls.append(timeout)
+        if len(calls) < 3:
+            raise ConnectionError("flaky")
+        return "ok"
+
+    policy = RetryPolicy(
+        attempts=4, base_delay_s=0.1, max_delay_s=1.0, deadline_s=30.0,
+        call_timeout_s=5.0,
+    )
+    result = call_with_retry(
+        attempt, policy, method="Test", sleep=sleeps.append,
+        rng=random.Random(0),
+    )
+    assert result == "ok"
+    assert len(calls) == 3
+    assert len(sleeps) == 2
+    # Full jitter keeps each delay within [0.5, 1.0] x nominal, and the
+    # nominal doubles per attempt.
+    assert 0.05 <= sleeps[0] <= 0.1
+    assert 0.1 <= sleeps[1] <= 0.2
+    # Per-attempt timeout is the policy's, clipped to the deadline.
+    assert all(t <= 5.0 for t in calls)
+
+
+def test_retry_exhausts_attempts_and_reraises():
+    def attempt(timeout):
+        raise ValueError("always")
+
+    policy = RetryPolicy(attempts=3, base_delay_s=0.0, deadline_s=None)
+    with pytest.raises(ValueError, match="always"):
+        call_with_retry(attempt, policy, sleep=lambda s: None)
+
+
+def test_retry_zero_deadline_raises_timeout():
+    policy = RetryPolicy(attempts=3, deadline_s=0.0)
+    with pytest.raises(TimeoutError, match="deadline"):
+        call_with_retry(
+            lambda t: (_ for _ in ()).throw(AssertionError("never runs")),
+            policy,
+            method="Never",
+        )
+
+
+def test_retry_policy_from_env(monkeypatch):
+    monkeypatch.setenv("SHOCKWAVE_RPC_ATTEMPTS", "7")
+    monkeypatch.setenv("SHOCKWAVE_RPC_BASE_DELAY_S", "0.25")
+    monkeypatch.setenv("SHOCKWAVE_RPC_DEADLINE_S", "3.5")
+    policy = RetryPolicy.from_env()
+    assert policy.attempts == 7
+    assert policy.base_delay_s == 0.25
+    assert policy.deadline_s == 3.5
+    assert policy.single_shot().attempts == 1
+
+
+# ----------------------------------------------------------------------
+# Fault plan + injector.
+# ----------------------------------------------------------------------
+def test_churn_plan_is_deterministic_and_roundtrips():
+    plan_a = faults.generate_churn_plan(11, 5000.0, 8, target_events=60)
+    plan_b = faults.generate_churn_plan(11, 5000.0, 8, target_events=60)
+    plan_c = faults.generate_churn_plan(12, 5000.0, 8, target_events=60)
+    assert plan_a.to_json() == plan_b.to_json()
+    assert plan_a.to_json() != plan_c.to_json()
+    restored = faults.FaultPlan.from_json(plan_a.to_json())
+    assert restored.to_json() == plan_a.to_json()
+    assert len(restored.events) >= 60
+    kinds = {e.kind for e in restored.events}
+    assert {"worker_add", "solver_timeout"} <= kinds
+    assert kinds & {"worker_crash", "capacity_reclaim"}
+
+
+def test_injector_rpc_matching_and_recovery_pairing():
+    plan = faults.FaultPlan(
+        seed=0,
+        events=[
+            faults.FaultEvent(0, "rpc_error", method="Done", count=2),
+            faults.FaultEvent(1, "rpc_delay", method="RunJob", delay_s=0.5),
+        ],
+    )
+    injector = faults.configure(plan)
+    # Two injected errors on Done, then clean.
+    for _ in range(2):
+        with pytest.raises(faults.InjectedRpcError):
+            faults.check_rpc("Done")
+    faults.check_rpc("Done")  # queue drained: goes through
+    faults.note_rpc_success("Done")  # the retry that landed
+    # Delay events sleep instead of raising, and self-recover.
+    slept = []
+    faults.check_rpc("RunJob", sleep=slept.append)
+    assert slept == [0.5]
+    summary = injector.summary()
+    assert summary["applied"] == 2
+    assert summary["recovered"] == 2
+    assert summary["unrecovered"] == []
+
+
+def test_env_gating_arms_injector(tmp_path, monkeypatch):
+    plan = faults.FaultPlan(
+        seed=3, events=[faults.FaultEvent(0, "rpc_error", method="Done")]
+    )
+    path = tmp_path / "plan.json"
+    path.write_text(plan.to_json())
+    monkeypatch.setenv("SHOCKWAVE_FAULTS", str(path))
+    faults._INJECTOR = None
+    faults._ENV_CHECKED = False  # simulate a fresh process
+    injector = faults.active()
+    assert injector is not None
+    assert injector.plan.seed == 3
+
+
+def test_injector_off_is_noop():
+    assert faults.active() is None
+    faults.check_rpc("Done")  # must not raise
+    faults.note_rpc_success("Done")
+
+
+# ----------------------------------------------------------------------
+# Simulator: worker death -> requeue -> replan.
+# ----------------------------------------------------------------------
+def _sim_jobs(n, epochs=2, gap=60.0, scale_factors=None):
+    from shockwave_tpu.core.job import Job
+    from shockwave_tpu.data.workload_info import steps_per_epoch
+
+    jobs, arrivals = [], []
+    for i in range(n):
+        model, bs = [("ResNet-18", 32), ("ResNet-50", 64)][i % 2]
+        sf = (scale_factors or [1])[i % len(scale_factors or [1])]
+        jobs.append(
+            Job(
+                job_type=f"{model} (batch size {bs})",
+                command="python3 main.py",
+                total_steps=steps_per_epoch(model, bs) * epochs,
+                scale_factor=sf,
+                mode="static",
+            )
+        )
+        arrivals.append(i * gap)
+    return jobs, arrivals
+
+
+def test_sim_worker_crash_requeues_and_completes():
+    """A mid-run worker crash loses the round's progress but no jobs:
+    capacity shrinks, the victims' micro-tasks are requeued, and every
+    job still completes — without charging the jobs failed attempts."""
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.policies import get_policy
+
+    plan = faults.FaultPlan(
+        seed=5,
+        events=[
+            faults.FaultEvent(0, "worker_crash", at_s=250.0, count=1),
+            faults.FaultEvent(1, "capacity_reclaim", at_s=450.0, count=1),
+        ],
+        min_capacity=2,
+    )
+    injector = faults.configure(plan)
+    jobs, arrivals = _sim_jobs(5, epochs=3)
+    sched = Scheduler(
+        get_policy("max_min_fairness"),
+        throughputs=generate_oracle(),
+        seed=0,
+        time_per_iteration=120,
+    )
+    sched.simulate({"v100": 4}, arrivals, jobs)
+    assert len(sched._worker_ids) == 2  # 4 registered, 2 lost
+    completed = [
+        t for t in sched._job_completion_times.values() if t is not None
+    ]
+    assert len(completed) == 5, "a job was lost to injected churn"
+    assert all(
+        count < 5 for count in sched._num_failures_per_job.values()
+    ), "fault completions were charged as job failures"
+    summary = injector.summary()
+    assert summary["applied"] == 2
+    assert summary["unrecovered"] == []
+
+
+def test_sim_churn_add_restores_capacity():
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.policies import get_policy
+
+    plan = faults.FaultPlan(
+        seed=6,
+        events=[
+            faults.FaultEvent(0, "capacity_reclaim", at_s=200.0, count=2),
+            faults.FaultEvent(
+                1, "worker_add", at_s=500.0, count=2, worker_type="v100"
+            ),
+        ],
+        min_capacity=1,
+        max_capacity=4,
+    )
+    faults.configure(plan)
+    jobs, arrivals = _sim_jobs(4)
+    sched = Scheduler(
+        get_policy("max_min_fairness"),
+        throughputs=generate_oracle(),
+        seed=0,
+        time_per_iteration=120,
+    )
+    sched.simulate({"v100": 4}, arrivals, jobs)
+    assert len(sched._worker_ids) == 4  # reclaimed 2, restored 2
+    assert all(
+        t is not None for t in sched._job_completion_times.values()
+    )
+
+
+def test_sim_shockwave_crash_shrinks_planner_capacity(tmp_path):
+    """Worker death under the Shockwave planner: capacity propagates
+    into the planner (set_capacity + recompute), every fault pairs with
+    a recovery record in the decision log, and the log replays exactly
+    — including solves that degraded through the ladder."""
+    from shockwave_tpu import obs
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.data.profiles import synthesize_profiles
+    from shockwave_tpu.obs.recorder import iter_records, replay_log
+    from shockwave_tpu.policies import get_policy
+
+    plan = faults.FaultPlan(
+        seed=7,
+        events=[
+            faults.FaultEvent(0, "worker_crash", at_s=300.0, count=1),
+            faults.FaultEvent(1, "solver_timeout", round=2),
+        ],
+        min_capacity=2,
+    )
+    injector = faults.configure(plan)
+    jobs, arrivals = _sim_jobs(4)
+    oracle = generate_oracle()
+    profiles = synthesize_profiles(jobs, oracle)
+    log_path = str(tmp_path / "decisions.jsonl")
+    obs.reset()
+    obs.configure_recorder(log_path)
+    try:
+        sched = Scheduler(
+            get_policy("shockwave_tpu"),
+            throughputs=oracle,
+            seed=0,
+            time_per_iteration=120,
+            profiles=profiles,
+            shockwave_config={
+                "num_gpus": 4,
+                "time_per_iteration": 120,
+                "future_rounds": 6,
+                "lambda": 2.0,
+                "k": 1e-3,
+                "plan_deadline_s": 30.0,
+            },
+        )
+        sched.simulate({"v100": 4}, arrivals, jobs)
+        assert sched._shockwave.num_gpus == 3, "planner kept dead capacity"
+        assert all(
+            t is not None for t in sched._job_completion_times.values()
+        )
+        degraded = [
+            r for r in sched._shockwave.solve_records if r.get("degraded")
+        ]
+        assert degraded, "injected solver timeout never degraded a solve"
+        assert degraded[0]["fallback_from"] == "tpu"
+        summary = injector.summary()
+        assert summary["applied"] == 2
+        assert summary["unrecovered"] == []
+        obs.get_recorder().close()
+        fault_ids = [
+            r.get("fault_id")
+            for r in iter_records(log_path)
+            if r.get("event") == "fault"
+        ]
+        recovery_ids = {
+            r.get("fault_id")
+            for r in iter_records(log_path)
+            if r.get("event") == "recovery"
+        }
+        assert sorted(fault_ids) == [0, 1]
+        assert set(fault_ids) <= recovery_ids
+        faults.reset()  # replay must not consume further events
+        replays = replay_log(log_path)
+        assert replays, "no plan records to replay"
+        diverged = [r for r in replays if r["diff"]]
+        assert not diverged, f"replay diverged: {diverged[0]}"
+    finally:
+        obs.reset()
+
+
+# ----------------------------------------------------------------------
+# Degradation ladder (planner-level, no simulator).
+# ----------------------------------------------------------------------
+def _tiny_planner(backend="tpu", plan_deadline_s=10.0):
+    from shockwave_tpu.policies.shockwave import ShockwavePlanner
+
+    planner = ShockwavePlanner(
+        {
+            "num_gpus": 2,
+            "time_per_iteration": 60.0,
+            "future_rounds": 4,
+            "lambda": 2.0,
+            "k": 1e-3,
+            "plan_deadline_s": plan_deadline_s,
+        },
+        backend=backend,
+    )
+    for j in range(3):
+        planner.add_job(
+            j,
+            {
+                "num_epochs": 4,
+                "num_samples_per_epoch": 64,
+                "scale_factor": 1,
+                "bs_every_epoch": [32] * 4,
+                "duration_every_epoch": [120.0] * 4,
+            },
+            60.0,
+            1,
+        )
+    return planner
+
+
+def test_ladder_clean_solve_is_not_degraded():
+    planner = _tiny_planner()
+    schedule = planner.current_round_schedule()
+    assert schedule is not None
+    assert planner.solve_records
+    assert not planner.solve_records[-1].get("degraded")
+
+
+def test_ladder_injected_timeout_falls_back_and_tags():
+    plan = faults.FaultPlan(
+        seed=0, events=[faults.FaultEvent(0, "solver_timeout", round=0)]
+    )
+    injector = faults.configure(plan)
+    planner = _tiny_planner()
+    schedule = planner.current_round_schedule()
+    assert schedule, "ladder fallback produced no plan"
+    record = planner.solve_records[-1]
+    assert record["ok"]
+    assert record["degraded"] is True
+    assert record["fallback_from"] == "tpu"
+    assert record["ladder"][0]["outcome"] == "timeout_injected"
+    assert record["backend"] != "tpu"
+    assert injector.summary()["unrecovered"] == []
+
+
+def test_ladder_set_capacity_triggers_replan():
+    planner = _tiny_planner(plan_deadline_s=None)
+    planner.current_round_schedule()
+    solves_before = len(planner.solve_records)
+    planner.set_capacity(1)
+    assert planner.recompute_flag
+    assert planner.num_gpus == 1
+    planner.current_round_schedule()
+    assert len(planner.solve_records) == solves_before + 1
+
+
+# ----------------------------------------------------------------------
+# wait_for_workers error detail (satellite).
+# ----------------------------------------------------------------------
+def test_wait_for_workers_error_lists_registered_workers():
+    from shockwave_tpu.core.physical import PhysicalScheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.policies import get_policy
+    from shockwave_tpu.utils.hostenv import free_port
+
+    sched = PhysicalScheduler(
+        get_policy("fifo"),
+        port=free_port(),
+        throughputs=generate_oracle(),
+        time_per_iteration=3.0,
+    )
+    try:
+        with pytest.raises(TimeoutError) as excinfo:
+            sched.wait_for_workers(2, timeout=0.2)
+        message = str(excinfo.value)
+        assert "0/2 workers" in message
+        assert "registered: [none]" in message
+        assert "RegisterWorker" in message
+    finally:
+        sched.shutdown()

@@ -246,6 +246,119 @@ def test_worker_reset_kills_running_jobs_and_job_recovers(cluster):
     assert sched._total_steps_run[job_id] >= 900
 
 
+def test_dead_worker_subprocess_is_reaped_and_jobs_recover(
+    tmp_path, monkeypatch
+):
+    """Worker-death recovery, against a REAL killed worker: one worker
+    agent runs as a subprocess, gets SIGKILLed mid-run, and the
+    scheduler's heartbeat lease-expiry must (1) declare it dead, (2)
+    requeue its outstanding micro-task without charging the job a
+    failed attempt, (3) shrink capacity to the surviving in-process
+    worker, and (4) finish every job there."""
+    import signal
+    import subprocess
+    import sys
+
+    from shockwave_tpu.runtime.worker import Worker
+
+    # Dispatches to the dead worker must give up quickly or the round
+    # loop spends its completion buffer inside RunJob retries.
+    monkeypatch.setenv("SHOCKWAVE_RPC_ATTEMPTS", "2")
+    monkeypatch.setenv("SHOCKWAVE_RPC_DEADLINE_S", "3")
+    monkeypatch.setenv("SHOCKWAVE_HEARTBEAT_S", "0.5")
+    sched_port = free_port()
+    victim_port, survivor_port = free_port(), free_port()
+    sched = PhysicalScheduler(
+        get_policy("fifo"),
+        port=sched_port,
+        throughputs=generate_oracle(),
+        time_per_iteration=3.0,
+        completion_buffer_seconds=6.0,
+        minimum_time_between_allocation_resets=0.0,
+        heartbeat_timeout_s=4.0,
+    )
+    victim = subprocess.Popen(
+        [
+            sys.executable, "-m", "shockwave_tpu.runtime.worker",
+            "-t", "v100", "-n", "1",
+            "-a", "127.0.0.1", "-s", str(sched_port),
+            "-p", str(victim_port),
+            "--run_dir", str(tmp_path / "victim_run"),
+            "--checkpoint_dir", str(tmp_path / "victim_ckpt"),
+        ],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        sched.wait_for_workers(1, timeout=30)
+        Worker(
+            "v100", 1, "127.0.0.1", sched_port, survivor_port,
+            run_dir=str(tmp_path / "run"),
+            checkpoint_dir=str(tmp_path / "ckpt"),
+        )
+        sched.wait_for_workers(2, timeout=30)
+        victim_wid = next(
+            wid
+            for wid, (_, port) in sched._worker_addrs.items()
+            if port == victim_port
+        )
+        job_ids = [sched.add_job(make_job(800)) for _ in range(2)]
+        runner = threading.Thread(
+            target=sched.run, kwargs={"max_rounds": 40}
+        )
+        runner.start()
+        # Let the victim receive work, then kill it dead (no cleanup).
+        deadline = time.time() + 30
+        while time.time() < deadline and not any(
+            victim_wid in ids
+            for ids in sched._dispatched_worker_ids.values()
+        ):
+            time.sleep(0.2)
+        victim.send_signal(signal.SIGKILL)
+        runner.join(timeout=300)
+        assert not runner.is_alive(), "round loop wedged on the dead worker"
+        assert victim_wid not in sched._worker_ids, "dead worker not reaped"
+        assert len(sched._worker_ids) == 1
+        for job_id in job_ids:
+            assert sched._job_completion_times.get(job_id) is not None, (
+                f"job {job_id} was lost with the dead worker"
+            )
+            assert sched._total_steps_run[job_id] >= 800
+    finally:
+        if victim.poll() is None:
+            victim.kill()
+        sched.shutdown()
+
+
+def test_injected_rpc_faults_are_retried_to_completion(cluster):
+    """Injected Done/RunJob failures (runtime/faults.py) must be
+    absorbed by the client retry layer: the job completes and every
+    applied fault pairs with a retry-success recovery."""
+    from shockwave_tpu.runtime import faults
+
+    plan = faults.FaultPlan(
+        seed=0,
+        events=[
+            faults.FaultEvent(0, "rpc_error", method="Done", count=2),
+            faults.FaultEvent(1, "rpc_delay", method="RunJob", delay_s=0.2),
+        ],
+    )
+    injector = faults.configure(plan)
+    try:
+        sched, tmp_path = cluster
+        job_id = sched.add_job(make_job(400))
+        runner = threading.Thread(target=sched.run, kwargs={"max_rounds": 20})
+        runner.start()
+        runner.join(timeout=120)
+        assert not runner.is_alive()
+        assert sched._job_completion_times.get(job_id) is not None
+        assert sched._total_steps_run[job_id] >= 400
+        summary = injector.summary()
+        assert summary["applied"] >= 1, "no fault was ever delivered"
+        assert summary["unrecovered"] == [], summary
+    finally:
+        faults.reset()
+
+
 @_needs_parallel_cpus
 def test_packed_pair_shares_accelerator(tmp_path):
     """Space-sharing, for real (VERDICT r03 missing #1): a packed policy
