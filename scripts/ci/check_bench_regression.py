@@ -8,8 +8,17 @@ PRIOR entry on the same platform and exits 1 if any tracked series
 regressed by more than ``--max-regression`` (default 10%).
 
 Tracked series (direction-aware):
-  value    warm-solve median seconds      lower is better
-  cold_s   fresh-process first solve      lower is better
+  value            warm-solve median seconds        lower is better
+  cold_s           fresh-process first solve        lower is better
+  pdhg10k_solve_s  warm PDHG solve at 10k jobs      lower is better
+
+``cold_s`` is bimodal by construction (serialized-executable hit vs
+full XLA compile — see the note in bench.py); records since PR 8 carry
+``cold_via_warm_cache`` naming their mode, and the gate only compares
+cold_s between records in the SAME mode — on a mode flip it walks the
+history back to the most recent same-platform same-mode entry (so
+alternating histories still gate), and skips with a notice only when
+no same-mode baseline exists yet.
 
 Usage (the standing gate; see docs/USAGE.md "Health & forensics"):
   python bench.py                      # appends to results/bench_history.json
@@ -30,7 +39,7 @@ REPO_ROOT = os.path.dirname(
 )
 
 # series name -> True when lower is better.
-TRACKED = {"value": True, "cold_s": True}
+TRACKED = {"value": True, "cold_s": True, "pdhg10k_solve_s": True}
 
 
 def load_history(path):
@@ -134,6 +143,50 @@ def main(argv=None):
         if cur is None or base is None or base == 0:
             print(f"  {series:<8} skipped (missing in current or baseline)")
             continue
+        series_base = baseline
+        if series == "cold_s":
+            cur_mode = current.get("cold_via_warm_cache")
+            base_mode = baseline.get("cold_via_warm_cache")
+            if (
+                cur_mode is not None
+                and base_mode is not None
+                and cur_mode != base_mode
+            ):
+                # Mode flip (compile vs blob-load are different
+                # measurements): walk back to the most recent
+                # same-platform entry in the SAME mode, so alternating
+                # histories still gate cold_s instead of skipping
+                # forever.
+                series_base = next(
+                    (
+                        e
+                        for e in reversed(history)
+                        if e is not current
+                        and e.get("ts") != current.get("ts")
+                        and e.get("platform") == current.get("platform")
+                        and e.get("cold_via_warm_cache") == cur_mode
+                    ),
+                    None,
+                )
+                if series_base is None:
+                    print(
+                        f"  {series:<8} skipped (warm-cache mode flip "
+                        f"and no prior cold_via_warm_cache={cur_mode} "
+                        "entry to compare against)"
+                    )
+                    continue
+                print(
+                    f"  {series:<8} baseline switched to "
+                    f"{series_base.get('ts')} (same warm-cache mode "
+                    f"{cur_mode})"
+                )
+            base = series_base.get(series)
+            if base is None or base == 0:
+                print(
+                    f"  {series:<8} skipped (missing in same-mode "
+                    "baseline)"
+                )
+                continue
         change = (cur - base) / base if lower_is_better else (base - cur) / base
         direction = "regression" if change > 0 else "improvement"
         print(

@@ -1,0 +1,154 @@
+#!/usr/bin/env python3
+"""Restarted-PDHG scale sweep: wall-clock vs objective at 10k-100k jobs.
+
+The evidence behind ROADMAP item 1 / ISSUE 8: the first-order backend
+(solver/eg_pdhg.py) solving one planning problem at 10k, 50k, and 100k
+jobs (cluster scaled proportionally from the 1k x 256 reference shape),
+per shape:
+
+  * warm solve wall-clock (median + all samples over distinct
+    same-shape problems, compile excluded and reported separately),
+  * solver diagnostics (cycles/iterations/restarts, convergence),
+  * the TRUE relaxed objective of the returned iterate (an upper bound
+    for the integer program) and the piecewise-log objective of the
+    rounded integer counts — the quality-vs-wall-clock pair the
+    RESULTS table cites,
+  * a self-audit at the smallest shape (and every shape with --full):
+    the default adaptive stop re-solved with the stall stop disabled
+    and the cycle cap maxed must round to an integer objective within
+    0.1% — evidence the early stop is not buying speed with quality.
+
+Writes one JSON artifact (-o, default results/pdhg_scale.json) and
+prints it. CPU note: numbers scale with the host; the committed
+artifact records platform + device count.
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+from shockwave_tpu.utils.fileio import atomic_write_json  # noqa: E402
+
+SHAPES = [(10000, 2560), (50000, 12800), (100000, 25600)]
+ROUNDS = 50
+WARM_RUNS = 3
+
+
+def objective_of_counts(problem, counts):
+    """Piecewise-log objective of integer round counts (the objective
+    depends on a schedule only through its row sums, so a left-packed
+    indicator matrix evaluates it without a placement pass)."""
+    R = problem.future_rounds
+    Y = (np.arange(R)[None, :] < np.asarray(counts)[:, None]).astype(float)
+    return problem.objective_value(Y)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "-o", "--out",
+        default=os.path.join(REPO, "results", "pdhg_scale.json"),
+    )
+    parser.add_argument(
+        "--full", action="store_true",
+        help="run the full-convergence quality self-audit at EVERY "
+        "shape (default: smallest shape only; the 100k audit re-runs "
+        "~96 cycles)",
+    )
+    args = parser.parse_args(argv)
+
+    import jax
+
+    import bench
+    from shockwave_tpu.solver.eg_pdhg import solve_pdhg_relaxed
+    from shockwave_tpu.solver.rounding import round_counts
+
+    shapes = []
+    for idx, (jobs, gpus) in enumerate(SHAPES):
+        problems = [
+            bench.make_problem(
+                num_jobs=jobs, future_rounds=ROUNDS, num_gpus=gpus, seed=s
+            )
+            for s in range(WARM_RUNS + 1)
+        ]
+        t0 = time.time()
+        solve_pdhg_relaxed(problems[WARM_RUNS])  # compile + first solve
+        cold_s = time.time() - t0
+        warm, infos = [], []
+        for p in problems[:WARM_RUNS]:
+            t0 = time.time()
+            _, _, info = solve_pdhg_relaxed(p)
+            warm.append(time.time() - t0)
+            infos.append(info)
+        p0 = problems[0]
+        s0, relaxed_obj, info0 = solve_pdhg_relaxed(p0)
+        t0 = time.time()
+        counts = round_counts(s0, p0.nworkers, p0.num_gpus, ROUNDS)
+        round_s = time.time() - t0
+        used = float(np.sum(counts * p0.nworkers))
+        budget = float(p0.num_gpus) * ROUNDS
+        assert used <= budget + 1e-6, (used, budget)
+        int_obj = objective_of_counts(p0, counts)
+        entry = {
+            "jobs": jobs,
+            "gpus": gpus,
+            "rounds": ROUNDS,
+            "solve_median_s": round(statistics.median(warm), 4),
+            "solve_all_s": [round(t, 4) for t in warm],
+            "cold_s": round(cold_s, 2),
+            "cycles": [i["cycles"] for i in infos],
+            "iterations": [i["iterations"] for i in infos],
+            "restarts": [i["restarts"] for i in infos],
+            "converged": all(i["converged"] for i in infos),
+            "relaxed_objective": round(relaxed_obj, 2),
+            "counts_objective": round(int_obj, 2),
+            "round_counts_s": round(round_s, 4),
+            "budget_utilization": round(used / budget, 4),
+        }
+        if args.full or idx == 0:
+            s_ref, _, info_ref = solve_pdhg_relaxed(
+                p0, stall_rel=-1.0, max_cycles=96, tol=1e-6
+            )
+            ref_counts = round_counts(s_ref, p0.nworkers, p0.num_gpus, ROUNDS)
+            ref_obj = objective_of_counts(p0, ref_counts)
+            gap = (
+                100.0 * (ref_obj - int_obj) / abs(ref_obj)
+                if abs(ref_obj) > 1e-9 else 0.0
+            )
+            entry["full_convergence_audit"] = {
+                "cycles": info_ref["cycles"],
+                "counts_objective": round(ref_obj, 2),
+                "gap_pct": round(gap, 5),
+                "ok": gap <= 0.1,
+            }
+            assert gap <= 0.1, (
+                f"adaptive stop lost {gap:.3f}% integer objective vs "
+                f"full convergence at {jobs} jobs"
+            )
+        shapes.append(entry)
+        print(json.dumps(entry))
+
+    record = {
+        "metric": "pdhg_scale_sweep",
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "platform": jax.devices()[0].platform,
+        "num_devices": len(jax.devices()),
+        "warm_runs": WARM_RUNS,
+        "shapes": shapes,
+    }
+    atomic_write_json(args.out, record)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -33,7 +33,14 @@ _PLANNER_FILE = "shockwave_tpu/policies/shockwave.py"
 _WARM_START_FILE = "shockwave_tpu/solver/eg_jax.py"
 
 # Dispatch branches the planner must keep: one per registered backend.
-REQUIRED_BACKENDS = ("reference", "native", "level", "sharded", "relaxed")
+REQUIRED_BACKENDS = (
+    "reference", "native", "level", "sharded", "relaxed", "pdhg",
+)
+
+# Fallback rungs the planner's degradation ladder must register (the
+# first-order PDHG rung sits between the primary backend and the PGD
+# relaxed solve; native is the mandatory host-only final rung).
+REQUIRED_LADDER_RUNGS = ("pdhg", "relaxed", "native")
 
 _SOLVE_ENTRY_RE = re.compile(r"^solve(_|$)")
 
@@ -178,3 +185,40 @@ class SolverBackendConformance(Rule):
                 "deliberate (update REQUIRED_BACKENDS in "
                 "analysis/rules/conformance.py alongside)",
             )
+        # (c) The degradation ladder keeps every registered fallback
+        # rung: a solver timeout must still have the cheap first-order
+        # and host-greedy recovery paths.
+        ladder_fn = None
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == "_ladder_rungs"
+            ):
+                ladder_fn = node
+                break
+        if ladder_fn is None:
+            yield self.finding(
+                ctx,
+                1,
+                "planner no longer defines _ladder_rungs() — the solver "
+                "degradation ladder (plan_deadline_s / fault-injection "
+                "recovery) has lost its fallback contract",
+            )
+        else:
+            rung_names = {
+                n.value
+                for n in ast.walk(ladder_fn)
+                if isinstance(n, ast.Constant) and isinstance(n.value, str)
+            }
+            for rung in REQUIRED_LADDER_RUNGS:
+                if rung not in rung_names:
+                    yield self.finding(
+                        ctx,
+                        ladder_fn,
+                        f"degradation ladder no longer registers the "
+                        f"{rung!r} fallback rung — a deadline-blown or "
+                        "faulted solve must be able to degrade through "
+                        "every registered rung (update "
+                        "REQUIRED_LADDER_RUNGS alongside a deliberate "
+                        "removal)",
+                    )

@@ -29,7 +29,10 @@ _SCOPE_PREFIXES = (
     "shockwave_tpu/models/",
     "shockwave_tpu/parallel/",
 )
-_SCOPE_FILES = ("shockwave_tpu/solver/eg_jax.py",)
+_SCOPE_FILES = (
+    "shockwave_tpu/solver/eg_jax.py",
+    "shockwave_tpu/solver/eg_pdhg.py",
+)
 
 # lax control-flow primitives whose callable operand is traced per step.
 _TRACED_LOOP_CALLS = {

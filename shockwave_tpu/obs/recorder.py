@@ -343,7 +343,10 @@ class FlightRecorder:
         slim_state["job_metadata"] = slim_md = OrderedDict()
         # The solve history is observability output, not planner input;
         # the plan cache is pure output too (_replan prunes then
-        # overwrites the whole window) — replay reads neither.
+        # overwrites the whole window) — replay reads neither. The one
+        # solver input derived from the pre-replan cache — the pdhg
+        # solution warm start — is recorded as its own slim vector
+        # (``pdhg_warm_start``, stamped by _replan) instead.
         slim_state["solve_times"] = []
         slim_state["solve_records"] = []
         slim_state["schedules"] = OrderedDict()

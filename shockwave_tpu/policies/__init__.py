@@ -155,6 +155,10 @@ def get_policy(
         from shockwave_tpu.policies.shockwave import ShockwavePolicy
 
         return ShockwavePolicy(backend="sharded")
+    if policy_name == "shockwave_tpu_pdhg":
+        from shockwave_tpu.policies.shockwave import ShockwavePolicy
+
+        return ShockwavePolicy(backend="pdhg")
     raise ValueError(f"Unknown policy: {policy_name!r}")
 
 
@@ -190,6 +194,7 @@ _ALL_POLICY_NAMES = [
     "shockwave_tpu_level",
     "shockwave_tpu_relaxed",
     "shockwave_tpu_sharded",
+    "shockwave_tpu_pdhg",
 ]
 
 _POLICY_MODULES = {

@@ -13,6 +13,11 @@ Interchangeable solver backends:
     C++ host greedy (small solves, where device round-trip latency
     dominates) and the jitted level-set solve on the accelerator
     (:func:`shockwave_tpu.solver.eg_jax.solve_eg_level`).
+  * ``pdhg`` — restarted primal-dual hybrid gradient on the exact
+    continuous relaxation (:mod:`shockwave_tpu.solver.eg_pdhg`):
+    matrix-free, one compile per fleet size, solution-warm-started from
+    the previous plan. The scaling backend for 10k-100k-job plans, and
+    the degradation ladder's first fallback rung.
   * ``level`` / ``native`` / ``relaxed`` — each of the above forced,
     for tests, benchmarks, and cross-checks.
 """
@@ -60,13 +65,16 @@ class ShockwavePlanner:
         self.solver_rel_gap = float(config.get("solver_rel_gap", 1e-3))
         self.solver_timeout = float(config.get("solver_timeout", 15.0))
         self.solver_num_steps = int(config.get("solver_num_steps", 256))
+        # Fixed-point tolerance of the restarted-PDHG backend (the
+        # objective-stall stop usually fires first; see eg_pdhg).
+        self.pdhg_tol = float(config.get("pdhg_tol", 1e-4))
         # Preemption-aware planning: scale on the per-job measured
         # relaunch overheads the scheduler threads through add_job. 0
         # disables the switching-cost term even when overheads are known.
         self.switch_cost_weight = float(config.get("switch_cost_weight", 1.0))
         # Per-round planning deadline (seconds) for the degradation
-        # ladder: primary backend -> relaxed PGD -> native greedy, each
-        # rung budgeted against what remains. None (default) keeps the
+        # ladder: primary backend -> restarted PDHG -> relaxed PGD ->
+        # native greedy, each rung budgeted against what remains. None (default) keeps the
         # single-backend behavior; the ladder also engages when fault
         # injection is armed so injected solver slowdowns/timeouts have
         # a recovery path instead of a wedged round.
@@ -208,6 +216,10 @@ class ShockwavePlanner:
         }
         planner.job_overheads = dict(state.get("job_overheads", {}))
         planner.last_round_jobs = list(state.get("last_round_jobs", []))
+        if state.get("pdhg_warm_start") is not None:
+            # Replayed snapshot: the plan cache this vector was derived
+            # from is not in the record — carry the vector itself.
+            planner._replay_warm_start = list(state["pdhg_warm_start"])
         planner.solve_times = list(state["solve_times"])
         planner.solve_records = [
             dict(r) for r in state.get("solve_records", [])
@@ -258,6 +270,10 @@ class ShockwavePlanner:
             for j, md in self.job_metadata.items()
             if md.completed_epochs < md.total_epochs
         ]
+        # Plan-order job ids of the problem being built (the PDHG
+        # backend's solution warm start maps cached future schedules
+        # back onto problem rows through this).
+        self._plan_job_ids = list(job_ids)
         if not job_ids:
             return None, []
         J = len(job_ids)
@@ -346,17 +362,35 @@ class ShockwavePlanner:
         injector = faults.active()
         self._last_ladder = None
         self._attempted_backend = self.backend
+        # Computed once per solve, BEFORE the plan cache is overwritten:
+        # consumed by the pdhg branch (primary or ladder rung) and
+        # stamped into the flight-recorder snapshot — the recorder slims
+        # the plan cache out of the log, so replay must carry the
+        # derived warm-start vector itself to re-enter the same solve.
+        # Skipped entirely when no pdhg solve can happen this round
+        # (non-pdhg backend, ladder unarmed): the counts walk over the
+        # cached window is pure-Python and the planner hot path should
+        # not pay it to produce a value nothing reads.
+        pdhg_possible = (
+            self.backend == "pdhg"
+            or self.plan_deadline_s is not None
+            or injector is not None
+        )
+        self._solve_warm_start = (
+            self._solution_warm_start() if pdhg_possible else None
+        )
         if self.plan_deadline_s is None and injector is None:
             return self._solve_backend(self.backend, problem)
         return self._solve_with_ladder(problem, injector)
 
     def _ladder_rungs(self) -> List[str]:
-        """Degradation ladder: configured backend, then the relaxed PGD
-        solve, then the native greedy (cheapest, host-only). Rungs the
-        host cannot run (no C++ toolchain) are dropped; the primary
-        always stays."""
+        """Degradation ladder: configured backend, then the restarted
+        PDHG first-order solve (cheapest device path with a quality
+        story at any fleet size), then the relaxed PGD solve, then the
+        native greedy (host-only). Rungs the host cannot run (no C++
+        toolchain) are dropped; the primary always stays."""
         rungs = [self.backend]
-        for fallback in ("relaxed", "native"):
+        for fallback in ("pdhg", "relaxed", "native"):
             if fallback not in rungs:
                 rungs.append(fallback)
         from shockwave_tpu import native as native_mod
@@ -431,11 +465,13 @@ class ShockwavePlanner:
             delay_s = fault.delay_s if fault is not None else 0.0
             box: dict = {}
 
-            def run_attempt(backend=backend, delay_s=delay_s):
+            def run_attempt(backend=backend, delay_s=delay_s, fb=(i > 0)):
                 try:
                     if delay_s:
                         time.sleep(delay_s)
-                    box["result"] = self._solve_backend(backend, problem)
+                    box["result"] = self._solve_backend(
+                        backend, problem, as_fallback=fb
+                    )
                 except Exception as e:  # noqa: BLE001 - re-raised below
                     box["error"] = e
 
@@ -509,12 +545,15 @@ class ShockwavePlanner:
         raise RuntimeError("degradation ladder produced no plan")
 
     def _solve_backend(
-        self, backend: str, problem: EGProblem
+        self, backend: str, problem: EGProblem, as_fallback: bool = False
     ) -> "Tuple[np.ndarray, str]":
         """One backend's solve (the ladder's rung body).
         ``_attempted_backend`` tracks the in-flight choice so a raising
         solver is attributed to the backend that actually raised, not
-        the configured dispatch name."""
+        the configured dispatch name. ``as_fallback`` marks a ladder
+        rung below the primary: the relaxed rung then skips its PDHG
+        polish, so a failing (or deadline-blowing) PDHG kernel cannot
+        take out the rung that exists to recover from it."""
         self._attempted_backend = backend
         if backend == "reference":
             from shockwave_tpu.solver.eg_milp import (
@@ -561,13 +600,29 @@ class ShockwavePlanner:
             )
 
             Y = solve_eg_level_sharded(problem)
+        elif backend == "pdhg":
+            # Restarted PDHG on the exact continuous relaxation
+            # (matrix-free first-order; routes itself to the sharded
+            # mesh at fleet scale), solution-warm-started from the
+            # previous plan's round counts when one is cached.
+            from shockwave_tpu.solver.eg_pdhg import solve_eg_pdhg
+
+            Y = solve_eg_pdhg(
+                problem,
+                s0=getattr(self, "_solve_warm_start", None),
+                tol=self.pdhg_tol,
+            )
         elif backend == "relaxed":
             # Projected-gradient ascent on the exact continuous relaxation,
             # then integer rounding + per-round placement on host.
             from shockwave_tpu.solver.eg_jax import solve_eg_jax
             from shockwave_tpu.solver.rounding import schedule_from_relaxed
 
-            s = solve_eg_jax(problem, num_steps=self.solver_num_steps)
+            s = solve_eg_jax(
+                problem,
+                num_steps=self.solver_num_steps,
+                pdhg_polish=not as_fallback,
+            )
             Y = schedule_from_relaxed(
                 s,
                 problem.priorities,
@@ -627,6 +682,43 @@ class ShockwavePlanner:
             ),
             used,
         )
+
+    def _solution_warm_start(self) -> "Optional[np.ndarray]":
+        """Previous-plan round counts per problem row, or None.
+
+        The cached schedules for rounds >= the cursor are the
+        still-valid tail of the last plan; counting each job's
+        occurrences gives the s-vector that plan chose, which is a
+        near-feasible saddle-point guess for the incremental replan
+        (arrivals/departures/capacity deltas move few coordinates).
+        The flight recorder slims the plan cache out of its snapshots,
+        so a replayed planner carries the derived vector instead
+        (``pdhg_warm_start`` in the record, restored by from_state) —
+        replay re-enters the exact solve the live round ran."""
+        override = getattr(self, "_replay_warm_start", None)
+        job_ids = getattr(self, "_plan_job_ids", None)
+        if override is not None:
+            # One recorded vector, one solve: clear on consumption so a
+            # restored planner that keeps planning (job set drifting)
+            # falls back to recomputing from its live plan cache, and
+            # drop it if it no longer matches the problem rows.
+            self._replay_warm_start = None
+            if job_ids is not None and len(override) == len(job_ids):
+                return np.asarray(override, dtype=np.float64)
+        if not job_ids:
+            return None
+        future = [
+            s for r, s in self.schedules.items() if r >= self.round_index
+        ]
+        if not future:
+            return None
+        counts = {j: 0 for j in job_ids}
+        for schedule in future:
+            for j in schedule:
+                if j in counts:
+                    counts[j] += 1
+        s0 = np.array([float(counts[j]) for j in job_ids])
+        return s0 if s0.any() else None
 
     def _record_solve(
         self, seconds: float, backend: str, num_jobs: int,
@@ -728,6 +820,13 @@ class ShockwavePlanner:
                 # replay through the same backend or the offline replan
                 # would re-derive the primary backend's different plan.
                 pre_state["backend"] = backend_used
+                # Likewise the pdhg solution warm start: derived from
+                # the pre-replan plan cache, which the recorder slims
+                # out of the log — record the vector itself.
+                warm = getattr(self, "_solve_warm_start", None)
+                pre_state["pdhg_warm_start"] = (
+                    None if warm is None else [float(x) for x in warm]
+                )
                 recorder.record_plan(
                     planner_state=pre_state,
                     plan={
@@ -1031,6 +1130,7 @@ class ShockwavePolicy(Policy):
             "level": "Shockwave_TPU_Level",
             "relaxed": "Shockwave_TPU_Relaxed",
             "sharded": "Shockwave_TPU_Sharded",
+            "pdhg": "Shockwave_TPU_PDHG",
         }.get(backend, "Shockwave_TPU")
 
     def make_planner(self, config: dict) -> ShockwavePlanner:

@@ -58,44 +58,58 @@ def cache_dir() -> str:
     )
 
 
-@functools.lru_cache(maxsize=1)
-def _solver_source_hash() -> str:
+@functools.lru_cache(maxsize=4)
+def _solver_source_hash(entry: str = "solve_level") -> str:
     # lru_cache: cache_key runs on every solve_level_counts call (the
-    # planner's per-round hot path) and the module file cannot change
-    # within a process.
+    # planner's per-round hot path) and the module files cannot change
+    # within a process. The PDHG entry hashes eg_pdhg.py AND eg_jax.py
+    # (it imports padding/constants from there).
     from shockwave_tpu.solver import eg_jax
 
-    with open(eg_jax.__file__, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()[:16]
+    modules = [eg_jax]
+    if entry == "solve_pdhg":
+        from shockwave_tpu.solver import eg_pdhg
+
+        modules = [eg_pdhg, eg_jax]
+    digest = hashlib.sha256()
+    for mod in modules:
+        with open(mod.__file__, "rb") as f:
+            digest.update(f.read())
+    return digest.hexdigest()[:16]
 
 
 def cache_key(
     slots: int, future_rounds: int, grid_size: int, with_bonus: bool,
-    num_bases: int = 6,
+    num_bases: int = 6, entry: str = "solve_level",
+    shape_tag: Optional[str] = None,
 ) -> str:
     """Executable identity: backend + versions + solver source + the
     static solve shape. Anything that can change the compiled program
     must be in here — a stale executable would silently compute with
-    old solver semantics."""
+    old solver semantics. ``entry`` selects which jitted solver entry
+    the blob holds (``solve_level`` / ``solve_pdhg``); ``shape_tag``
+    carries any extra static-arg identity that entry needs (e.g. the
+    PDHG cycle/iteration statics)."""
     import jax
     import jaxlib
 
     dev = jax.devices()[0]
     parts = (
         f"fmt{_CACHE_FORMAT}",
+        entry,
         f"jax{jax.__version__}",
         f"jaxlib{jaxlib.__version__}",
         dev.platform,
         getattr(dev, "device_kind", "unknown").replace(" ", "_"),
-        _solver_source_hash(),
+        _solver_source_hash(entry),
         f"s{slots}r{future_rounds}g{grid_size}b{int(with_bonus)}"
-        f"k{num_bases}",
+        f"k{num_bases}" + (f"t{shape_tag}" if shape_tag else ""),
     )
     return hashlib.sha256("|".join(parts).encode()).hexdigest()[:24]
 
 
-def _blob_path(key: str) -> str:
-    return os.path.join(cache_dir(), f"solve_level_{key}.bin")
+def _blob_path(key: str, entry: str = "solve_level") -> str:
+    return os.path.join(cache_dir(), f"{entry}_{key}.bin")
 
 
 def _dummy_call(
@@ -178,17 +192,104 @@ def warm(
     return written
 
 
+def warm_pdhg(
+    slots: int = 1024,
+    max_cycles: Optional[int] = None,
+    inner_iters: Optional[int] = None,
+) -> list:
+    """Compile the restarted-PDHG solve at the padded shape and persist
+    the serialized executable (counterpart of :func:`warm` for the
+    first-order backend). One blob covers EVERY planning config at the
+    slot count: nothing in the PDHG kernel shape-specializes on the
+    window length or breakpoint count."""
+    from jax.experimental import serialize_executable
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from shockwave_tpu.solver import eg_pdhg
+
+    if max_cycles is None:
+        max_cycles = eg_pdhg.DEFAULT_MAX_CYCLES
+    if inner_iters is None:
+        inner_iters = eg_pdhg.DEFAULT_INNER_ITERS
+    zeros = jnp.asarray(np.zeros(slots, np.float32))
+    ones = jnp.asarray(np.ones(slots, np.float32))
+    args = (
+        zeros,  # active
+        zeros,  # priorities
+        zeros,  # completed
+        ones,   # total
+        ones,   # epoch_dur
+        zeros,  # remaining
+        ones,   # nworkers
+        zeros,  # switch_bonus
+        zeros,  # s0
+        jnp.asarray(1.0),  # num_gpus
+    )
+    kwargs = dict(
+        round_duration=60.0,
+        future_rounds=50.0,
+        regularizer=1.0,
+        tol=float(eg_pdhg.DEFAULT_TOL),
+        stall_rel=float(eg_pdhg._STALL_REL),
+        max_cycles=int(max_cycles),
+        inner_iters=int(inner_iters),
+    )
+    compiled = eg_pdhg.solve_pdhg.lower(*args, **kwargs).compile()
+    payload = serialize_executable.serialize(compiled)
+    shape_tag = f"c{int(max_cycles)}i{int(inner_iters)}"
+    key = cache_key(
+        slots, 0, 0, True, num_bases=0, entry="solve_pdhg",
+        shape_tag=shape_tag,
+    )
+    os.makedirs(cache_dir(), exist_ok=True)
+    path = _blob_path(key, "solve_pdhg")
+    fd, tmp = tempfile.mkstemp(dir=cache_dir(), suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(payload, f)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    _LOADED.pop(key, None)
+    return [path]
+
+
+def available(
+    slots: int, future_rounds: int, grid_size: int, with_bonus: bool,
+    num_bases: int = 6, entry: str = "solve_level",
+    shape_tag: Optional[str] = None,
+) -> bool:
+    """True when a serialized executable exists on disk for this solve
+    signature. Pure stat — no deserialization, no memoization side
+    effects — so bench.py can attribute its cold-solve measurement to
+    the right mode (blob hit vs full XLA compile) without perturbing
+    the timing it is about to take."""
+    key = cache_key(
+        slots, future_rounds, grid_size, with_bonus, num_bases,
+        entry=entry, shape_tag=shape_tag,
+    )
+    return os.path.exists(_blob_path(key, entry))
+
+
 def load(
     slots: int, future_rounds: int, grid_size: int, with_bonus: bool,
-    num_bases: int = 6,
+    num_bases: int = 6, entry: str = "solve_level",
+    shape_tag: Optional[str] = None,
 ):
     """The precompiled executable for this solve signature, or None.
     Memoized per process; corrupt or incompatible blobs are removed and
     negatively cached so the jitted fallback isn't retried per solve."""
-    key = cache_key(slots, future_rounds, grid_size, with_bonus, num_bases)
+    key = cache_key(
+        slots, future_rounds, grid_size, with_bonus, num_bases,
+        entry=entry, shape_tag=shape_tag,
+    )
     if key in _LOADED:
         return _LOADED[key]
-    path = _blob_path(key)
+    path = _blob_path(key, entry)
     compiled = None
     if os.path.exists(path):
         try:
@@ -211,12 +312,16 @@ def load(
 
 def invalidate(
     slots: int, future_rounds: int, grid_size: int, with_bonus: bool,
-    num_bases: int = 6,
+    num_bases: int = 6, entry: str = "solve_level",
+    shape_tag: Optional[str] = None,
 ) -> None:
     """Negatively cache a signature for the rest of the process (used
     when a loaded executable fails at call time) so the jitted path
     runs without re-probing the blob on every solve."""
-    key = cache_key(slots, future_rounds, grid_size, with_bonus, num_bases)
+    key = cache_key(
+        slots, future_rounds, grid_size, with_bonus, num_bases,
+        entry=entry, shape_tag=shape_tag,
+    )
     _LOADED[key] = None
 
 
@@ -247,6 +352,10 @@ def main(argv=None) -> None:
         f"warmed solve_level at slots={slots} rounds={args.rounds} "
         f"in {dt:.2f}s"
     )
+    t0 = time.time()
+    for p in warm_pdhg(slots):
+        print(p)
+    print(f"warmed solve_pdhg at slots={slots} in {time.time() - t0:.2f}s")
 
 
 if __name__ == "__main__":

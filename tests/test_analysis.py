@@ -492,6 +492,96 @@ class TestSolverBackendConformance:
         assert not active(findings), [f.render() for f in findings]
 
 
+# Planner-facade fixtures: dispatch-table + degradation-ladder
+# registration (the backend count ratchet: 6 registered branches, and
+# the pdhg rung between primary and relaxed).
+
+_PLANNER_DISPATCH = """
+        if backend == "reference":
+            return 1
+        if backend == "native":
+            return 1
+        if backend == "level":
+            return 1
+        if backend == "sharded":
+            return 1
+        if backend == "relaxed":
+            return 1
+        if backend == "pdhg":
+            return 1
+        return 0
+"""
+
+_PLANNER_TEMPLATE = """
+from shockwave_tpu.solver.eg_problem import EGProblem
+
+
+class Planner:
+    def _build_problem(self, arrays):
+        return EGProblem(
+            priorities=arrays.p,
+            switch_cost=arrays.sc,
+            incumbent=arrays.inc,
+        )
+
+    def _ladder_rungs(self):
+        rungs = [self.backend]
+        for fallback in ({ladder}):
+            if fallback not in rungs:
+                rungs.append(fallback)
+        return rungs
+
+    def _solve_backend(self, backend, problem):
+{dispatch}
+"""
+
+PLANNER_CONFORMANT = _PLANNER_TEMPLATE.format(
+    ladder='"pdhg", "relaxed", "native"', dispatch=_PLANNER_DISPATCH
+)
+PLANNER_NO_PDHG_DISPATCH = _PLANNER_TEMPLATE.format(
+    ladder='"pdhg", "relaxed", "native"',
+    dispatch=_PLANNER_DISPATCH.replace(
+        '        if backend == "pdhg":\n            return 1\n', ""
+    ),
+)
+PLANNER_NO_PDHG_RUNG = _PLANNER_TEMPLATE.format(
+    ladder='"relaxed", "native"', dispatch=_PLANNER_DISPATCH
+)
+PLANNER_NO_LADDER = PLANNER_CONFORMANT.replace("_ladder_rungs", "_rungs")
+
+_PLANNER_PATH = "shockwave_tpu/policies/shockwave.py"
+
+
+class TestPlannerLadderConformance:
+    def test_conformant_planner_is_clean(self):
+        assert not findings_for(PLANNER_CONFORMANT, _PLANNER_PATH,
+                                "solver-backend-conformance")
+
+    def test_missing_pdhg_dispatch_branch(self):
+        hits = findings_for(PLANNER_NO_PDHG_DISPATCH, _PLANNER_PATH,
+                            "solver-backend-conformance")
+        assert len(hits) == 1
+        assert "'pdhg'" in hits[0].message
+        assert "dispatch" in hits[0].message
+
+    def test_missing_pdhg_ladder_rung(self):
+        hits = findings_for(PLANNER_NO_PDHG_RUNG, _PLANNER_PATH,
+                            "solver-backend-conformance")
+        assert len(hits) == 1
+        assert "ladder" in hits[0].message
+        assert "'pdhg'" in hits[0].message
+
+    def test_missing_ladder_function(self):
+        hits = findings_for(PLANNER_NO_LADDER, _PLANNER_PATH,
+                            "solver-backend-conformance")
+        assert any("_ladder_rungs" in f.message for f in hits)
+
+    def test_scoped_to_planner_file(self):
+        assert not findings_for(PLANNER_NO_PDHG_RUNG,
+                                "shockwave_tpu/policies/other.py",
+                                "solver-backend-conformance")
+
+
 # -- framework: suppressions, parse errors ------------------------------
 
 def test_suppression_line_above_and_trailing():

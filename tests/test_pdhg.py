@@ -1,0 +1,410 @@
+"""Restarted-PDHG solver backend (shockwave_tpu/solver/eg_pdhg.py).
+
+Coverage contract (ISSUE 8): convergence on small analytic EG instances
+and objective parity with the level backend, restart-triggering
+behavior, solution warm-start round trip (both the s0 path and the
+serialized-executable compile cache), ladder-rung fallback under an
+injected solver_timeout, and sharded-vs-single-device agreement on the
+8-virtual-device mesh.
+"""
+
+import numpy as np
+import pytest
+
+import bench
+from shockwave_tpu.runtime import faults
+from shockwave_tpu.solver import warm_start
+from shockwave_tpu.solver.eg_jax import num_slots_for, solve_eg_level
+from shockwave_tpu.solver.eg_pdhg import (
+    DEFAULT_INNER_ITERS,
+    DEFAULT_MAX_CYCLES,
+    polish_relaxed,
+    solve_eg_pdhg,
+    solve_pdhg_relaxed,
+    solve_pdhg_relaxed_sharded,
+)
+from shockwave_tpu.solver.eg_problem import EGProblem
+from shockwave_tpu.solver.rounding import round_counts
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _counts_objective(problem, counts):
+    R = problem.future_rounds
+    Y = (np.arange(R)[None, :] < np.asarray(counts)[:, None]).astype(float)
+    return problem.objective_value(Y)
+
+
+# -- convergence & parity ----------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 5])
+def test_matches_level_backend(seed):
+    """The full pdhg backend (device solve + rounding + polish +
+    placement) lands within 0.1% of the production level backend on the
+    mid-scale bench shape — the ISSUE 8 parity bar, at test scale."""
+    p = bench.make_problem(
+        num_jobs=100, future_rounds=20, num_gpus=64, seed=seed
+    )
+    Y = solve_eg_pdhg(p)
+    p.audit_schedule(Y)
+    o_pdhg = p.objective_value(Y)
+    o_level = p.objective_value(solve_eg_level(p))
+    assert o_pdhg >= o_level - 1e-3 * abs(o_level)
+
+
+def test_analytic_single_job_completes():
+    """One job, ample budget: the solve must grant at least the rounds
+    that finish the job (welfare saturated, zero lateness) and report a
+    near-zero objective (log(1) welfare, no makespan)."""
+    p = EGProblem(
+        priorities=np.array([2.0]),
+        completed_epochs=np.array([0.0]),
+        total_epochs=np.array([4.0]),
+        epoch_duration=np.array([60.0]),
+        remaining_runtime=np.array([240.0]),
+        nworkers=np.array([1.0]),
+        num_gpus=4,
+        round_duration=60.0,
+        future_rounds=10,
+        regularizer=10.0,
+        log_bases=np.array([0.0, 0.2, 0.4, 0.6, 0.8, 1.0]),
+    )
+    s, obj, info = solve_pdhg_relaxed(p)
+    assert info["converged"]
+    assert s[0] >= 4.0 - 1e-3
+    assert abs(obj) < 1e-3
+
+
+def test_analytic_symmetric_jobs_split_evenly():
+    """Identical jobs under half-demand budget: the unique optimum of
+    the strictly concave welfare is the even split s_j = budget / J."""
+    J = 8
+    p = EGProblem(
+        priorities=np.full(J, 3.0),
+        completed_epochs=np.zeros(J),
+        total_epochs=np.full(J, 10.0),
+        epoch_duration=np.full(J, 100.0),
+        remaining_runtime=np.full(J, 1000.0),
+        nworkers=np.ones(J),
+        num_gpus=4,
+        round_duration=100.0,
+        future_rounds=10,
+        regularizer=1e-3,
+        log_bases=np.array([0.0, 0.2, 0.4, 0.6, 0.8, 1.0]),
+    )
+    s, _, _ = solve_pdhg_relaxed(p)
+    assert np.all(np.abs(s - 5.0) < 0.35), s
+    assert float(np.sum(s)) <= 40.0 + 1e-3
+
+
+def test_switch_bonus_keeps_incumbent():
+    """A low-priority incumbent with a large relaunch overhead must keep
+    a round that the overhead-blind objective would hand to the
+    high-priority jobs (the conformance term, observed end to end)."""
+    J = 4
+    base = dict(
+        priorities=np.array([0.01, 10.0, 10.0, 10.0]),
+        completed_epochs=np.zeros(J),
+        total_epochs=np.full(J, 10.0),
+        epoch_duration=np.full(J, 100.0),
+        remaining_runtime=np.full(J, 1000.0),
+        nworkers=np.ones(J),
+        num_gpus=1,
+        round_duration=100.0,
+        future_rounds=4,
+        regularizer=1e-3,
+        log_bases=np.array([0.0, 0.2, 0.4, 0.6, 0.8, 1.0]),
+    )
+    blind = EGProblem(**base)
+    s_blind, _, _ = solve_pdhg_relaxed(blind)
+    c_blind = round_counts(s_blind, blind.nworkers, 1, 4)
+    assert c_blind[0] == 0, c_blind
+
+    # bonus = regularizer * switch_cost = 100: dwarfs the ~0.5/round
+    # welfare marginals of the other three jobs.
+    aware = EGProblem(
+        **base,
+        switch_cost=np.array([1e5, 0.0, 0.0, 0.0]),
+        incumbent=np.array([1.0, 0.0, 0.0, 0.0]),
+    )
+    s_aware, _, _ = solve_pdhg_relaxed(aware)
+    c_aware = round_counts(s_aware, aware.nworkers, 1, 4)
+    assert c_aware[0] >= 1, c_aware
+
+
+# -- restarts & warm starts --------------------------------------------
+
+
+def test_restarts_trigger_and_preserve_quality():
+    """With the objective-stall stop disabled the adaptive machinery
+    engages: restart-to-average fires, and the long run's rounded
+    objective matches the default adaptive stop (the early stop isn't
+    trading quality for wall clock)."""
+    p = bench.make_problem(
+        num_jobs=1000, future_rounds=50, num_gpus=256, seed=0
+    )
+    s_default, _, info_default = solve_pdhg_relaxed(p)
+    s_long, _, info_long = solve_pdhg_relaxed(
+        p, stall_rel=-1.0, tol=1e-6, max_cycles=40
+    )
+    assert info_long["restarts"] >= 1
+    assert info_long["cycles"] > info_default["cycles"]
+    o_default = _counts_objective(
+        p, round_counts(s_default, p.nworkers, p.num_gpus, p.future_rounds)
+    )
+    o_long = _counts_objective(
+        p, round_counts(s_long, p.nworkers, p.num_gpus, p.future_rounds)
+    )
+    assert o_default >= o_long - 1e-3 * abs(o_long)
+
+
+def test_solution_warm_start_roundtrip():
+    """Re-solving from the returned iterate terminates at least as fast
+    and never loses objective (best tracking starts at the projected
+    warm start); a garbage warm start is clipped into the box and still
+    converges to the same quality."""
+    p = bench.make_problem(
+        num_jobs=100, future_rounds=20, num_gpus=64, seed=2
+    )
+    s1, obj1, info1 = solve_pdhg_relaxed(p)
+    s2, obj2, info2 = solve_pdhg_relaxed(p, s0=s1)
+    assert obj2 >= obj1 - 1e-5 * (1.0 + abs(obj1))
+    assert info2["cycles"] <= info1["cycles"] + 1
+    s3, obj3, _ = solve_pdhg_relaxed(p, s0=np.full(p.num_jobs, -7.0))
+    assert obj3 >= obj1 - 1e-3 * (1.0 + abs(obj1))
+
+
+def test_polish_never_hurts():
+    """polish_relaxed is the PGD parity-gap closer: from ANY feasible
+    iterate it returns a point no worse in the true relaxed objective."""
+    p = bench.make_problem(
+        num_jobs=100, future_rounds=20, num_gpus=64, seed=3
+    )
+    rng = np.random.default_rng(0)
+    rough = rng.uniform(0.0, p.future_rounds, p.num_jobs)
+    _, obj_ref, _ = solve_pdhg_relaxed(p)
+    polished = polish_relaxed(p, rough)
+    _, obj_at_polished, _ = solve_pdhg_relaxed(p, s0=polished, max_cycles=0)
+    _, obj_at_rough, _ = solve_pdhg_relaxed(p, s0=rough, max_cycles=0)
+    assert obj_at_polished >= obj_at_rough - 1e-6 * (1 + abs(obj_at_rough))
+    assert obj_at_polished >= obj_ref - 1e-2 * (1 + abs(obj_ref))
+
+
+def test_warm_executable_roundtrip(tmp_path, monkeypatch):
+    """Compile warm start (warm_start.warm_pdhg): a serialized
+    executable for the pdhg entry loads under its own cache key and the
+    fast path produces bit-identical results to the jitted path."""
+    monkeypatch.setenv("SHOCKWAVE_SOLVER_CACHE_DIR", str(tmp_path))
+    saved = dict(warm_start._LOADED)
+    warm_start._LOADED.clear()
+    try:
+        p = bench.make_problem(
+            num_jobs=40, future_rounds=8, num_gpus=16, seed=0
+        )
+        slots = num_slots_for(p.num_jobs)
+        tag = f"c{DEFAULT_MAX_CYCLES}i{DEFAULT_INNER_ITERS}"
+        assert not warm_start.available(
+            slots, 0, 0, True, num_bases=0, entry="solve_pdhg",
+            shape_tag=tag,
+        )
+        s_ref, obj_ref, _ = solve_pdhg_relaxed(p)
+        warm_start.warm_pdhg(slots)
+        assert warm_start.available(
+            slots, 0, 0, True, num_bases=0, entry="solve_pdhg",
+            shape_tag=tag,
+        )
+        assert (
+            warm_start.load(
+                slots, 0, 0, True, num_bases=0, entry="solve_pdhg",
+                shape_tag=tag,
+            )
+            is not None
+        )
+        s, obj, _ = solve_pdhg_relaxed(p)
+        np.testing.assert_array_equal(s, s_ref)
+        assert obj == obj_ref
+        key = warm_start.cache_key(
+            slots, 0, 0, True, num_bases=0, entry="solve_pdhg",
+            shape_tag=tag,
+        )
+        assert warm_start._LOADED.get(key) is not None, (
+            "pdhg executable was invalidated at call time; the solve "
+            "silently fell back to the jitted path"
+        )
+    finally:
+        warm_start._LOADED.clear()
+        warm_start._LOADED.update(saved)
+
+
+def test_cache_key_separates_entries():
+    level = warm_start.cache_key(1024, 50, 64, True)
+    pdhg = warm_start.cache_key(
+        1024, 50, 64, True, entry="solve_pdhg"
+    )
+    tagged = warm_start.cache_key(
+        1024, 50, 64, True, entry="solve_pdhg", shape_tag="c96i40"
+    )
+    assert len({level, pdhg, tagged}) == 3
+
+
+# -- planner integration ------------------------------------------------
+
+
+PROFILE = {
+    "num_epochs": 4,
+    "num_samples_per_epoch": 64,
+    "scale_factor": 1,
+    "bs_every_epoch": [32] * 4,
+    "duration_every_epoch": [120.0] * 4,
+}
+
+
+def _tiny_planner(backend, plan_deadline_s=None):
+    from shockwave_tpu.policies.shockwave import ShockwavePlanner
+
+    config = {
+        "num_gpus": 2,
+        "time_per_iteration": 60.0,
+        "future_rounds": 4,
+        "lambda": 2.0,
+        "k": 1e-3,
+    }
+    if plan_deadline_s is not None:
+        config["plan_deadline_s"] = plan_deadline_s
+    planner = ShockwavePlanner(config, backend=backend)
+    for j in range(3):
+        planner.add_job(j, dict(PROFILE), 60.0, 1)
+    return planner
+
+
+def test_pdhg_backend_plans_and_warm_starts():
+    planner = _tiny_planner("pdhg")
+    schedule = planner.current_round_schedule()
+    assert schedule
+    assert planner.solve_records[-1]["backend"] == "pdhg"
+    # The cached plan seeds the next replan's solution warm start.
+    s0 = planner._solution_warm_start()
+    assert s0 is not None and s0.sum() > 0
+    planner.set_recompute_flag()
+    assert planner.current_round_schedule()
+    assert planner.solve_records[-1]["backend"] == "pdhg"
+
+
+def test_replay_reproduces_warm_started_plans(tmp_path):
+    """Flight-recorder exactness with the pdhg backend: the solution
+    warm start is derived from the pre-replan plan cache, which the
+    recorder slims out of its snapshots — the recorded
+    ``pdhg_warm_start`` vector must carry it, or replayed replans
+    re-enter the solve from the default start and diverge (the bug
+    this test pins)."""
+    from shockwave_tpu import obs
+    from shockwave_tpu.obs.recorder import replay_log
+
+    log_path = str(tmp_path / "decisions.jsonl")
+    obs.reset()
+    obs.configure_recorder(log_path)
+    try:
+        planner = _tiny_planner("pdhg")
+        planner.current_round_schedule()
+        # Second replan: warm-started from the first plan's cache.
+        planner.increment_round()
+        planner.set_recompute_flag()
+        planner.current_round_schedule()
+        obs.get_recorder().close()
+        results = replay_log(log_path)
+        assert len(results) == 2
+        diverged = [r for r in results if r["diff"]]
+        assert not diverged, diverged
+    finally:
+        obs.reset()
+
+
+def test_ladder_falls_back_to_pdhg_rung():
+    """Injected solver_timeout on the primary rung: the new pdhg rung
+    (between primary and relaxed) absorbs the fault, and the record
+    carries the full ladder attribution."""
+    plan = faults.FaultPlan(
+        seed=0, events=[faults.FaultEvent(0, "solver_timeout", round=0)]
+    )
+    injector = faults.configure(plan)
+    planner = _tiny_planner("tpu", plan_deadline_s=10.0)
+    schedule = planner.current_round_schedule()
+    assert schedule, "ladder fallback produced no plan"
+    record = planner.solve_records[-1]
+    assert record["ok"]
+    assert record["degraded"] is True
+    assert record["fallback_from"] == "tpu"
+    assert record["ladder"][0]["outcome"] == "timeout_injected"
+    assert record["ladder"][1] == {"backend": "pdhg", "outcome": "ok"}
+    assert record["backend"] == "pdhg"
+    assert injector.summary()["unrecovered"] == []
+
+
+def test_broken_pdhg_cannot_take_out_relaxed_rung(monkeypatch):
+    """Fallback isolation: with the PDHG kernel itself raising, the
+    ladder must still recover through the relaxed rung — which skips
+    its PDHG polish when running as a fallback, precisely so the
+    failing kernel cannot claim two of the three recovery rungs."""
+    import shockwave_tpu.solver.eg_pdhg as eg_pdhg
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("pdhg kernel down")
+
+    monkeypatch.setattr(eg_pdhg, "solve_pdhg_relaxed", boom)
+    monkeypatch.setattr(eg_pdhg, "solve_eg_pdhg", boom)
+    monkeypatch.setattr(eg_pdhg, "polish_relaxed", boom)
+    planner = _tiny_planner("pdhg", plan_deadline_s=10.0)
+    schedule = planner.current_round_schedule()
+    assert schedule, "ladder produced no plan with the pdhg kernel down"
+    record = planner.solve_records[-1]
+    assert record["ok"]
+    assert record["degraded"] is True
+    assert record["fallback_from"] == "pdhg"
+    # "relaxed", not "native": the relaxed rung succeeded WITHOUT
+    # touching the broken polish (a polish call would have raised).
+    assert record["backend"] == "relaxed"
+
+
+# -- sharded agreement --------------------------------------------------
+
+
+def test_sharded_matches_single_device():
+    """Same problem through the single-device and 8-virtual-device
+    shard_map paths: identical arithmetic up to float accumulation
+    order, so the iterates agree tightly and the rounded schedules
+    agree in objective."""
+    import jax
+
+    assert len(jax.devices()) == 8
+    p = bench.make_problem(
+        num_jobs=100, future_rounds=20, num_gpus=64, seed=0
+    )
+    s1, obj1, info1 = solve_pdhg_relaxed(p)
+    s8, obj8, info8 = solve_pdhg_relaxed_sharded(p)
+    assert abs(obj8 - obj1) <= 1e-3 * (1.0 + abs(obj1)), (obj1, obj8)
+    np.testing.assert_allclose(s8, s1, rtol=5e-3, atol=5e-3)
+    o1 = _counts_objective(
+        p, round_counts(s1, p.nworkers, p.num_gpus, p.future_rounds)
+    )
+    o8 = _counts_objective(
+        p, round_counts(s8, p.nworkers, p.num_gpus, p.future_rounds)
+    )
+    assert abs(o8 - o1) <= 2e-3 * (1.0 + abs(o1)), (o1, o8)
+
+
+def test_sharded_pad_not_divisible_by_mesh():
+    """129 jobs pad to 256 slots (divisible by 8 only after rounding up
+    from 129): the shard-padding arithmetic must not disturb results."""
+    p = bench.make_problem(
+        num_jobs=129, future_rounds=10, num_gpus=48, seed=4
+    )
+    s1, obj1, _ = solve_pdhg_relaxed(p)
+    s8, obj8, _ = solve_pdhg_relaxed_sharded(p)
+    assert s8.shape == (129,)
+    assert abs(obj8 - obj1) <= 1e-3 * (1.0 + abs(obj1))
