@@ -187,6 +187,58 @@ def main():
         pdhg10k_host.append(t2 - t1)
         pdhg10k_iters.append(info10["iterations"])
 
+    # Incremental delta-replan (streaming admission): one departure +
+    # one arrival patched onto the previous round's solution
+    # (warm_start.delta_patch_counts) vs the same churned problem
+    # solved from scratch — the per-round cost of absorbing churn
+    # without re-deriving the world. Both run at the SAME padded slot
+    # band, so neither side pays a compile; the delta is pure
+    # convergence work. Gated by check_bench_regression.py.
+    import dataclasses
+
+    from shockwave_tpu.solver import warm_start as warm_start_mod
+
+    base1k = make_problem(
+        num_jobs=1000, future_rounds=50, num_gpus=256, seed=RUNS + 1
+    )
+    s_prev, _, _ = solve_pdhg_relaxed(base1k)
+    donor = make_problem(
+        num_jobs=1000, future_rounds=50, num_gpus=256, seed=RUNS + 2
+    )
+
+    def churned_row(field):
+        arr = getattr(base1k, field)
+        return np.concatenate([arr[1:], getattr(donor, field)[:1]])
+
+    churned = dataclasses.replace(
+        base1k,
+        **{
+            field: churned_row(field)
+            for field in (
+                "priorities", "completed_epochs", "total_epochs",
+                "epoch_duration", "remaining_runtime", "nworkers",
+                "switch_cost", "incumbent",
+            )
+        },
+    )
+    prev_ids = list(range(1000))
+    new_ids = list(range(1, 1000)) + [9999]  # job 0 departs, 9999 arrives
+    s0_patched = warm_start_mod.delta_patch_counts(
+        prev_ids, s_prev, new_ids, churned.nworkers,
+        churned.num_gpus, churned.future_rounds,
+    )
+    delta_warm_t, delta_scratch_t = [], []
+    delta_warm_it, delta_scratch_it = [], []
+    for _ in range(3):
+        t0 = time.time()
+        _, _, info_w = solve_pdhg_relaxed(churned, s0=s0_patched)
+        delta_warm_t.append(time.time() - t0)
+        delta_warm_it.append(info_w["iterations"])
+        t0 = time.time()
+        _, _, info_c = solve_pdhg_relaxed(churned)
+        delta_scratch_t.append(time.time() - t0)
+        delta_scratch_it.append(info_c["iterations"])
+
     # Baseline: reference-formulation MILP on host CPU (seed-0 problem).
     t0 = time.time()
     Y_milp = solve_eg_milp_reference_formulation(
@@ -316,6 +368,19 @@ def main():
         "pdhg10k_cold_s": round(pdhg10k_cold_s, 2),
         "pdhg10k_iterations": int(statistics.median(pdhg10k_iters)),
         "pdhg10k_config": "10000 jobs x 2560 gpus x 50 rounds",
+        # Incremental replan under churn: delta-patched warm start vs
+        # from-scratch at the same (compiled) slot band.
+        "delta_replan_warm_s": round(statistics.median(delta_warm_t), 4),
+        "delta_replan_scratch_s": round(
+            statistics.median(delta_scratch_t), 4
+        ),
+        "delta_replan_warm_iters": int(statistics.median(delta_warm_it)),
+        "delta_replan_scratch_iters": int(
+            statistics.median(delta_scratch_it)
+        ),
+        "delta_replan_config": (
+            "1000 jobs x 256 gpus x 50 rounds, 1 departure + 1 arrival"
+        ),
         "runs": RUNS,
         "schedule_audit": "ok",
         "objective_tpu": round(objective_tpu, 4),

@@ -52,6 +52,19 @@ def collect(metrics_path, trace_path=None, decisions_path=None) -> dict:
         "makespan_s": m.value("run_makespan_seconds"),
         "calibration_fleet": calibration_fleet(m),
         "calibration_jobs": calibration_rows(m),
+        # Streaming-admission front door (None values = run predates /
+        # never used the front door; the section renders only when
+        # something moved through it).
+        "admission": {
+            "depth": m.value("admission_queue_depth"),
+            "capacity": m.value("admission_queue_capacity"),
+            "accepted_batches": m.value("admission_accepted_total"),
+            "rejected": m.labeled_values(
+                "admission_rejected_total", "reason"
+            ),
+            "deduped_batches": m.value("admission_deduped_total"),
+            "admitted_jobs": m.value("admission_jobs_admitted_total"),
+        },
         "health_events": [],
         "decisions": None,
     }
@@ -111,6 +124,20 @@ def render_text(data: dict) -> str:
                 f"  t={e['ts_s']:>10.1f}s round {e.get('round', '—'):>4} "
                 f" {e.get('rule', '?'):<18} {detail}"
             )
+    adm = data.get("admission") or {}
+    if adm.get("admitted_jobs") or adm.get("accepted_batches"):
+        rejected = adm.get("rejected") or {}
+        lines.append("")
+        lines.append(
+            "Admission front door: "
+            f"{_fmt(adm.get('admitted_jobs'))} jobs admitted over "
+            f"{_fmt(adm.get('accepted_batches'))} batches; "
+            f"queue depth {_fmt(adm.get('depth'))}/"
+            f"{_fmt(adm.get('capacity'))}, "
+            f"rejects {int(sum(rejected.values()))} "
+            f"({', '.join(f'{k}={int(v)}' for k, v in sorted(rejected.items())) or 'none'}), "
+            f"dedups {_fmt(adm.get('deduped_batches'))}"
+        )
     fleet = data["calibration_fleet"]
     if fleet:
         lines.append("")
@@ -190,6 +217,18 @@ def render_html(data: dict) -> str:
                 ["rule", "count"],
                 sorted(data["alerts_by_rule"].items()),
             )
+        )
+    adm = data.get("admission") or {}
+    if adm.get("admitted_jobs") or adm.get("accepted_batches"):
+        rejected = adm.get("rejected") or {}
+        parts.append("<h2>Admission front door</h2>")
+        parts.append(
+            "<p>"
+            f"{_fmt(adm.get('admitted_jobs'))} jobs admitted over "
+            f"{_fmt(adm.get('accepted_batches'))} batches; queue depth "
+            f"{_fmt(adm.get('depth'))}/{_fmt(adm.get('capacity'))}; "
+            f"rejects {int(sum(rejected.values()))}; "
+            f"dedups {_fmt(adm.get('deduped_batches'))}</p>"
         )
     if data["health_events"]:
         parts.append("<h2>Alert timeline</h2>")

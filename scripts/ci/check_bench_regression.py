@@ -8,9 +8,10 @@ PRIOR entry on the same platform and exits 1 if any tracked series
 regressed by more than ``--max-regression`` (default 10%).
 
 Tracked series (direction-aware):
-  value            warm-solve median seconds        lower is better
-  cold_s           fresh-process first solve        lower is better
-  pdhg10k_solve_s  warm PDHG solve at 10k jobs      lower is better
+  value               warm-solve median seconds        lower is better
+  cold_s              fresh-process first solve        lower is better
+  pdhg10k_solve_s     warm PDHG solve at 10k jobs      lower is better
+  delta_replan_warm_s delta-patched incremental replan lower is better
 
 ``cold_s`` is bimodal by construction (serialized-executable hit vs
 full XLA compile — see the note in bench.py); records since PR 8 carry
@@ -39,7 +40,12 @@ REPO_ROOT = os.path.dirname(
 )
 
 # series name -> True when lower is better.
-TRACKED = {"value": True, "cold_s": True, "pdhg10k_solve_s": True}
+TRACKED = {
+    "value": True,
+    "cold_s": True,
+    "pdhg10k_solve_s": True,
+    "delta_replan_warm_s": True,
+}
 
 
 def load_history(path):

@@ -1,0 +1,54 @@
+#!/usr/bin/env python3
+"""CI gate: short seeded streaming-admission churn run.
+
+A scaled-down :mod:`scripts.streaming_soak` campaign (fixed seed,
+Poisson+burst arrivals through the bounded admission queue with
+injected SubmitJobs faults, composed with reclaim/re-add churn)
+asserting the serving-system contract: no job lost or double-admitted
+(every submission token resolves exactly once), backpressure engages
+and drains, p99 replan latency stays under the round budget, every
+applied fault pairs with a recovery, and the decision log replays
+exactly. Regenerates ``results/streaming/churn_smoke.json``; exits 1
+on any violated invariant. Wired into the verify skill next to
+``chaos_smoke.py``.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from streaming_soak import build_parser, main  # noqa: E402  (scripts/ on path)
+
+
+def run(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    # The smoke shape: small, seeded, fast (< ~2 min on a CPU host).
+    # Capacity 4 against batch-4 bursts guarantees the backpressure
+    # path fires; 2 SubmitJobs faults guarantee the token-dedup path.
+    args.result_name = "churn_smoke.json"
+    args.num_jobs = 14
+    args.num_gpus = 4
+    args.epochs = 2
+    args.arrival_horizon_s = 1200.0
+    args.bursts = 2
+    args.batch_size = 4
+    args.admission_capacity = 4
+    args.target_churn_events = 80
+    args.submit_faults = 2
+    args.solver_faults = 2
+    args.min_events = 80
+    args.seed = 0
+    return main(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(run())

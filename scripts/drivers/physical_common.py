@@ -95,8 +95,21 @@ def run_physical_cluster(
     if watchdog_rules is not None:
         # {} = defaults; a dict = per-rule overrides. Calibration rides
         # along (as in obs.apply_telemetry_args): the watchdog's MAPE
-        # rule is dead without the tracker's series.
-        obs.configure_watchdog(watchdog_rules or None)
+        # rule is dead without the tracker's series. The replan-p99
+        # budget defaults to the round length — the replan budget any
+        # physical deployment actually has — unless overridden.
+        rules = dict(watchdog_rules or {})
+        if "replan_p99" not in rules:
+            rules["replan_p99"] = {"budget_s": round_s}
+        elif rules["replan_p99"] not in (False, None):
+            # Fill the budget INSIDE a partial override too — a caller
+            # tuning only the quantile must not silently lose the rule
+            # (budget_s=None keeps it inert). False/None stay as an
+            # explicit disable.
+            rules["replan_p99"] = {
+                "budget_s": round_s, **rules["replan_p99"]
+            }
+        obs.configure_watchdog(rules)
         obs.configure_calibration()
     worker_env = dict(worker_env)
     if metrics_out:
@@ -136,17 +149,40 @@ def run_physical_cluster(
     try:
         sched.wait_for_workers(accelerators, timeout=60)
 
+        # Arrivals ride the streaming admission front door (SubmitJobs
+        # RPC: batched, token-idempotent, backpressured) — the same
+        # path an external submitter takes; the close signal, not a
+        # static expected-job count, ends the stream.
         submitted = []
 
         def submit():
-            start = time.time()
-            for job, arrival in zip(jobs, arrivals):
-                delay = arrival * time_scale - (time.time() - start)
-                if delay > 0:
-                    time.sleep(delay)
-                submitted.append(sched.add_job(job))
+            from shockwave_tpu.runtime.rpc.submitter_client import (
+                SubmitterClient,
+            )
 
-        sched.expect_jobs(len(jobs))
+            client = SubmitterClient(
+                "127.0.0.1", sched_port, client_id="driver"
+            )
+            try:
+                # submit_trace sends the end-of-stream close in its own
+                # finally, so even a failing submitter lets the round
+                # loop finish what was admitted instead of idling
+                # forever on an unclosed stream.
+                client.submit_trace(
+                    jobs, arrivals, time_scale=time_scale,
+                    on_batch=submitted.extend,
+                )
+            except Exception:
+                import traceback
+
+                print(
+                    "ERROR: submitter thread failed after "
+                    f"{len(submitted)}/{len(jobs)} jobs:\n"
+                    f"{traceback.format_exc()}",
+                    file=sys.stderr,
+                )
+
+        sched.expect_stream()
         submitter = threading.Thread(target=submit, daemon=True)
         submitter.start()
         sched.run(max_rounds=max_rounds)
@@ -204,6 +240,11 @@ def run_physical_cluster(
                 for j, t in completed.items()
             },
         }
+        # Admission front-door health rides every physical summary:
+        # queue depth must be back to zero at the end of a clean run,
+        # and the reject/dedup counts are the backpressure/idempotency
+        # evidence an operator greps for first.
+        summary["admission"] = sched._admission.summary()
         if obs.get_watchdog().enabled:
             summary["scheduler_health"] = obs.get_watchdog().summary()
         if extra_summary is not None:

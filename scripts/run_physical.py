@@ -60,17 +60,32 @@ def main(args):
           f"{args.expected_workers} workers...")
     sched.wait_for_workers(args.expected_workers, timeout=args.worker_timeout)
 
-    # Replay arrivals on their own thread (reference:
-    # run_scheduler_with_trace.py:48-70).
+    # Replay arrivals on their own thread through the streaming
+    # admission front door (SubmitJobs RPC: batched, token-idempotent,
+    # backpressured); the close signal — not a static expected-job
+    # count — tells the round loop when the stream ends.
     def submit():
-        start = time.time()
-        for job, arrival in zip(jobs, arrival_times):
-            delay = arrival * args.time_scale - (time.time() - start)
-            if delay > 0:
-                time.sleep(delay)
-            sched.add_job(job)
+        from shockwave_tpu.runtime.rpc.submitter_client import (
+            SubmitterClient,
+        )
 
-    sched.expect_jobs(len(jobs))
+        client = SubmitterClient("127.0.0.1", args.port, client_id="driver")
+        try:
+            # submit_trace closes the stream in its own finally, so
+            # even a failing submitter ends the run cleanly.
+            client.submit_trace(
+                jobs, arrival_times, time_scale=args.time_scale
+            )
+        except Exception:
+            import traceback
+
+            print(
+                "ERROR: submitter thread failed:\n"
+                f"{traceback.format_exc()}",
+                file=sys.stderr,
+            )
+
+    sched.expect_stream()
     submitter = threading.Thread(target=submit, daemon=True)
     submitter.start()
     sched.run()

@@ -1,0 +1,353 @@
+#!/usr/bin/env python3
+"""Streaming-admission soak: a seeded arrival/churn/reclaim campaign
+through the admission front door, with the serving-system contract
+asserted.
+
+Runs a Poisson+burst arrival campaign (``generate_arrival_campaign``)
+through the bounded, token-deduplicated, backpressured admission queue
+— the same :class:`StreamingSubmitter` path the SubmitJobs RPC models
+— composed with a ``generate_churn_plan`` fault campaign (worker
+crashes, spot reclamations, churn re-adds, solver faults) and injected
+``SubmitJobs`` RPC faults (lost responses and pre-send errors, so
+retried submissions exercise the token ledger). Verifies:
+
+  * ZERO lost jobs and ZERO double admissions: every submitted job is
+    admitted exactly once (token ledger) and completes despite churn;
+  * backpressure ENGAGES (>= 1 explicit rejection during the bursts)
+    and DRAINS (final queue depth 0);
+  * p99 replan latency stays under the round budget;
+  * the flight-recorder decision log replays every planning round
+    exactly, and its admission/fault timelines pair up;
+  * the total event count (applied faults + admission records) meets
+    ``--min_events`` — the 10k-event acceptance campaign at full scale.
+
+Writes ``streaming_soak.json`` (+ fault plan + decision log) under
+``--out``; exits non-zero on any violated invariant, so the
+reduced-scale variant doubles as the CI gate
+(scripts/ci/churn_smoke.py).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from shockwave_tpu import obs
+from shockwave_tpu.core.job import Job
+from shockwave_tpu.core.scheduler import Scheduler
+from shockwave_tpu.data.default_oracle import generate_oracle
+from shockwave_tpu.data.profiles import synthesize_profiles
+from shockwave_tpu.data.workload_info import steps_per_epoch
+from shockwave_tpu.obs.recorder import replay_log, summarize_log
+from shockwave_tpu.policies import get_policy
+from shockwave_tpu.runtime import faults
+from shockwave_tpu.runtime.admission import StreamingSubmitter
+from shockwave_tpu.utils.fileio import atomic_write_json, atomic_write_text
+
+MODELS = [("ResNet-18", 32), ("ResNet-50", 64)]
+
+
+def make_jobs(num_jobs: int, epochs: int):
+    jobs = []
+    for i in range(num_jobs):
+        model, bs = MODELS[i % len(MODELS)]
+        jobs.append(
+            Job(
+                job_type=f"{model} (batch size {bs})",
+                command="python3 main.py",
+                total_steps=steps_per_epoch(model, bs) * epochs,
+                scale_factor=[1, 1, 2, 1][i % 4],
+                mode="static",
+            )
+        )
+    return jobs
+
+
+def run_stream(args, arrivals, jobs, profiles, oracle, decision_log=None):
+    """One streaming simulation through the admission front door."""
+    config = {
+        "num_gpus": args.num_gpus,
+        "time_per_iteration": args.round_s,
+        "future_rounds": args.future_rounds,
+        "lambda": 2.0,
+        "k": 1e-3,
+        "solver_rel_gap": 1e-3,
+        "solver_timeout": 15,
+        "plan_deadline_s": args.plan_deadline_s,
+    }
+    obs.reset()
+    if decision_log is not None:
+        obs.configure_recorder(decision_log)
+        obs.configure_watchdog(
+            {"replan_p99": {"budget_s": args.round_s}}
+        )
+    submitter = StreamingSubmitter(
+        arrivals, jobs, batch_size=args.batch_size
+    )
+    sched = Scheduler(
+        get_policy(args.policy),
+        throughputs=oracle,
+        seed=args.seed,
+        time_per_iteration=args.round_s,
+        profiles=profiles,
+        shockwave_config=config
+        if args.policy.startswith("shockwave")
+        else None,
+    )
+    makespan = sched.simulate(
+        {"v100": args.num_gpus},
+        submitter=submitter,
+        admission_capacity=args.admission_capacity,
+        admission_retry_s=args.round_s / 2.0,
+    )
+    ftf_list, unfair = sched.get_finish_time_fairness()
+    completed = sum(
+        1 for t in sched._job_completion_times.values() if t is not None
+    )
+    if decision_log is not None:
+        obs.get_recorder().close()
+    return {
+        "makespan_s": makespan,
+        "completed": completed,
+        "admitted": sched._num_jobs_in_trace,
+        "worst_ftf": max(ftf_list) if ftf_list else None,
+        "unfair_fraction": unfair,
+        "rounds": sched._num_completed_rounds,
+        "preemptions": sched.get_num_preemptions(),
+        "solve_records": list(
+            getattr(sched._shockwave, "solve_records", [])
+        )
+        if sched._shockwave is not None
+        else [],
+        "submitter": dict(submitter.stats),
+        "admission": sched._admission.summary(),
+        "watchdog_alerts": list(obs.get_watchdog().alerts),
+    }
+
+
+def main(args) -> int:
+    os.makedirs(args.out, exist_ok=True)
+    oracle = generate_oracle()
+    failures = []
+    stem = os.path.splitext(args.result_name)[0]
+
+    # -- phase 1: fault-free streaming baseline (sizes the horizon) -----
+    faults.reset()
+    # Bursts narrower than one round: the whole burst lands in ONE
+    # admission drain interval, so it MUST pile up against the queue
+    # bound and exercise backpressure regardless of round phasing.
+    arrivals = faults.generate_arrival_campaign(
+        args.seed, args.num_jobs, args.arrival_horizon_s,
+        burst_count=args.bursts,
+        burst_width_frac=args.burst_width_frac,
+    )
+    jobs = make_jobs(args.num_jobs, args.epochs)
+    profiles = synthesize_profiles(jobs, oracle)
+    baseline = run_stream(args, arrivals, jobs, profiles, oracle)
+    print(
+        f"baseline: makespan {baseline['makespan_s']:.0f}s, "
+        f"{baseline['rounds']} rounds, "
+        f"{baseline['admission']['rejected_batches']} rejects"
+    )
+
+    # -- phase 2: the full streaming churn campaign ---------------------
+    _, plan = faults.generate_streaming_plan(
+        args.seed,
+        args.num_jobs,
+        baseline["makespan_s"],
+        args.num_gpus,
+        target_churn_events=args.target_churn_events,
+        submit_faults=args.submit_faults,
+        round_s=args.round_s,
+        min_capacity=max(2, args.num_gpus // 4),
+        solver_faults=args.solver_faults,
+    )
+    plan_path = os.path.join(args.out, f"{stem}_fault_plan.json")
+    atomic_write_text(plan_path, plan.to_json())
+    injector = faults.configure(plan)
+    decision_log = os.path.join(args.out, f"{stem}_decision_log.jsonl")
+    if os.path.exists(decision_log):
+        os.remove(decision_log)
+    jobs = make_jobs(args.num_jobs, args.epochs)
+    profiles = synthesize_profiles(jobs, oracle)
+    chaos = run_stream(
+        args, arrivals, jobs, profiles, oracle, decision_log=decision_log
+    )
+    summary = injector.summary()
+    faults.reset()  # replay below must not consume leftover events
+    print(
+        f"streamed: makespan {chaos['makespan_s']:.0f}s, "
+        f"{chaos['rounds']} rounds, {summary['applied']} faults, "
+        f"{chaos['admission']['rejected_batches']} rejects, "
+        f"{chaos['admission']['deduped_batches']} dedups"
+    )
+
+    # -- invariants -----------------------------------------------------
+    adm = chaos["admission"]
+    if chaos["completed"] != args.num_jobs:
+        failures.append(
+            f"LOST JOBS: {args.num_jobs - chaos['completed']} of "
+            f"{args.num_jobs} never completed"
+        )
+    if chaos["admitted"] != args.num_jobs:
+        failures.append(
+            f"ADMISSION MISCOUNT: {chaos['admitted']} admitted for "
+            f"{args.num_jobs} submitted — a token resolved "
+            f"{'twice' if chaos['admitted'] > args.num_jobs else 'never'}"
+        )
+    if adm["accepted_jobs"] != args.num_jobs:
+        failures.append(
+            f"queue accepted {adm['accepted_jobs']} jobs for "
+            f"{args.num_jobs} submitted (token ledger leak)"
+        )
+    if args.submit_faults and chaos["submitter"]["rpc_faults"] < args.submit_faults:
+        failures.append(
+            f"only {chaos['submitter']['rpc_faults']} of "
+            f"{args.submit_faults} injected SubmitJobs faults fired"
+        )
+    if adm["rejected_batches"] < 1:
+        failures.append(
+            "backpressure never engaged (0 rejected batches — shrink "
+            "--admission_capacity or widen the bursts)"
+        )
+    if adm["depth"] != 0:
+        failures.append(
+            f"admission queue did not drain (final depth {adm['depth']})"
+        )
+    if not adm["closed"]:
+        failures.append("end-of-stream close never reached the queue")
+    solve_seconds = [
+        r["seconds"] for r in chaos["solve_records"] if r.get("ok")
+    ]
+    replan_p99 = (
+        float(np.percentile(solve_seconds, 99)) if solve_seconds else None
+    )
+    if replan_p99 is None:
+        failures.append("no successful plan solves recorded")
+    elif replan_p99 > args.round_s:
+        failures.append(
+            f"p99 replan latency {replan_p99:.2f}s exceeds the "
+            f"{args.round_s}s round budget"
+        )
+    if summary["unrecovered"]:
+        failures.append(
+            f"{len(summary['unrecovered'])} applied faults never "
+            f"recovered: {summary['unrecovered'][:10]}"
+        )
+    log_summary = summarize_log(decision_log)
+    admission_events = sum(log_summary.get("admissions", {}).values())
+    total_events = summary["applied"] + admission_events
+    if total_events < args.min_events:
+        failures.append(
+            f"only {total_events} total events "
+            f"({summary['applied']} faults + {admission_events} "
+            f"admissions); need >= {args.min_events}"
+        )
+    replays = replay_log(decision_log)
+    diverged = [r for r in replays if r["diff"]]
+    if not replays:
+        failures.append("decision log recorded no plan rounds")
+    if diverged:
+        failures.append(
+            f"replay diverged on {len(diverged)}/{len(replays)} plan "
+            f"records (first: round {diverged[0]['round']})"
+        )
+
+    result = {
+        "seed": args.seed,
+        "num_jobs": args.num_jobs,
+        "num_gpus": args.num_gpus,
+        "policy": args.policy,
+        "round_s": args.round_s,
+        "plan_deadline_s": args.plan_deadline_s,
+        "admission_capacity": args.admission_capacity,
+        "batch_size": args.batch_size,
+        "planned_fault_events": summary["planned_events"],
+        "applied_fault_events": summary["applied"],
+        "admission_events": log_summary.get("admissions", {}),
+        "total_events": total_events,
+        "submitter": chaos["submitter"],
+        "admission": adm,
+        "replan_p99_s": (
+            round(replan_p99, 4) if replan_p99 is not None else None
+        ),
+        "replan_count": len(solve_seconds),
+        "replayed_plans": len(replays),
+        "replay_exact": len(replays) - len(diverged),
+        "baseline": {
+            k: baseline[k]
+            for k in (
+                "makespan_s", "worst_ftf", "unfair_fraction", "rounds",
+                "preemptions",
+            )
+        },
+        "chaos": {
+            k: chaos[k]
+            for k in (
+                "makespan_s", "worst_ftf", "unfair_fraction", "rounds",
+                "preemptions",
+            )
+        },
+        "watchdog_alert_rules": sorted(
+            {a["rule"] for a in chaos["watchdog_alerts"]}
+        ),
+        "failures": failures,
+        "ok": not failures,
+    }
+    out_json = os.path.join(args.out, args.result_name)
+    atomic_write_json(out_json, result)
+    print(f"wrote {out_json}")
+    for line in failures:
+        print(f"FAIL: {line}")
+    if not failures:
+        print(
+            f"OK: {total_events} events "
+            f"({summary['applied']} faults + {admission_events} "
+            f"admissions), 0 lost/double-admitted jobs, "
+            f"{adm['rejected_batches']} backpressure rejects drained, "
+            f"p99 replan {replan_p99:.2f}s < {args.round_s}s budget, "
+            f"{len(replays)} plans replayed exactly"
+        )
+    return 1 if failures else 0
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--out", type=str, default="results/streaming")
+    parser.add_argument(
+        "--result_name", type=str, default="streaming_soak.json"
+    )
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--policy", type=str, default="shockwave_tpu_pdhg",
+        help="shockwave_tpu_pdhg exercises the delta-patched solution "
+        "warm start on every incremental replan",
+    )
+    parser.add_argument("--num_jobs", type=int, default=200)
+    parser.add_argument("--num_gpus", type=int, default=32)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--arrival_horizon_s", type=float, default=9000.0)
+    parser.add_argument("--bursts", type=int, default=3)
+    parser.add_argument(
+        "--burst_width_frac", type=float, default=0.005,
+        help="burst width as a fraction of the horizon; keep it under "
+        "one round so a burst cannot be split across drains",
+    )
+    parser.add_argument("--batch_size", type=int, default=4)
+    parser.add_argument("--admission_capacity", type=int, default=16)
+    parser.add_argument("--round_s", type=float, default=120.0)
+    parser.add_argument("--future_rounds", type=int, default=8)
+    parser.add_argument("--plan_deadline_s", type=float, default=30.0)
+    parser.add_argument("--target_churn_events", type=int, default=9800)
+    parser.add_argument("--submit_faults", type=int, default=6)
+    parser.add_argument("--solver_faults", type=int, default=6)
+    parser.add_argument("--min_events", type=int, default=10000)
+    return parser
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(build_parser().parse_args()))

@@ -20,6 +20,7 @@ JOB_COMPLETION_BUFFER_TIME=60):
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -30,6 +31,7 @@ from shockwave_tpu.analysis import sanitize
 from shockwave_tpu.core.ids import JobId
 from shockwave_tpu.core.scheduler import Scheduler
 from shockwave_tpu.data.workload_info import steps_per_epoch
+from shockwave_tpu.runtime import admission
 from shockwave_tpu.runtime.lease import INFINITY
 
 LOG = logging.getLogger("core.physical")
@@ -73,8 +75,32 @@ class PhysicalScheduler(Scheduler):
         self._worker_connections: Dict[int, object] = {}
         self._worker_addrs: Dict[int, Tuple[str, int]] = {}
         self._round_id = 0
+        # Legacy static-count contract (expect_jobs): still honored for
+        # in-process drivers, but the streaming front door below is the
+        # serving-system path — see _stream_done for the end-of-run
+        # decision.
         self._num_expected_jobs: Optional[int] = None
         self._shutdown_requested = threading.Event()
+
+        # Streaming admission front door: a bounded queue the SubmitJobs
+        # RPC (and in-process submitters) feed and the round loop drains
+        # at round boundaries. Timestamps ride the scheduler clock so
+        # queue-latency metrics line up with every other series.
+        self._admission = admission.AdmissionQueue(
+            capacity=int(
+                os.environ.get(
+                    "SHOCKWAVE_ADMISSION_QUEUE_CAP",
+                    admission.DEFAULT_CAPACITY,
+                )
+            ),
+            retry_delay_s=float(
+                os.environ.get(
+                    "SHOCKWAVE_ADMISSION_RETRY_S",
+                    max(1.0, self._time_per_iteration / 4.0),
+                )
+            ),
+            clock=self.get_current_timestamp,
+        )
 
         # Per-job runtime state.
         self._dispatch_times: Dict[JobId, float] = {}
@@ -131,6 +157,7 @@ class PhysicalScheduler(Scheduler):
                 "heartbeat": self._heartbeat_rpc,
                 "init_job": self._init_job_rpc,
                 "update_lease": self._update_lease_rpc,
+                "submit_jobs": self._submit_jobs_rpc,
                 # /metrics-style text dump: any client (or grpcurl-style
                 # tooling speaking the hand-rolled wire contract) can
                 # scrape the scheduler's live registry.
@@ -182,6 +209,138 @@ class PhysicalScheduler(Scheduler):
             worker_id = int(worker_id)
             if worker_id not in self._retired_workers:
                 self._last_heartbeat[worker_id] = time.monotonic()
+
+    def _submit_jobs_rpc(self, token, specs, close):
+        """Streaming-admission handler: validate the batch, offer it to
+        the bounded queue (idempotent on the token; RETRY_AFTER under
+        backpressure), and wake an idle round loop. Deliberately does
+        NOT take the round loop's condition lock for the queue work —
+        admission must stay cheap under a submission storm; only the
+        wakeup notify touches _cv."""
+        rpc_start = time.perf_counter()
+        try:
+            # A malformed spec raises ValueError here, BEFORE anything
+            # is queued — the whole batch is rejected as INVALID so a
+            # token never resolves to a partial admission. Validation
+            # must be at least as strict as what add_job will demand at
+            # drain time: a wire-valid batch that ACCEPTED and then
+            # blew up the round loop at the round boundary would kill
+            # the whole cluster for one bad submitter.
+            jobs = [admission.job_from_spec_dict(s) for s in specs]
+            for job in jobs:
+                self._validate_job_runnable(job)
+            status, retry_after_s, admitted = self._admission.submit(
+                token, jobs, close=close
+            )
+            if status == admission.STATUS_ACCEPTED:
+                with self._cv:
+                    self._cv.notify_all()
+            return status, retry_after_s, admitted, self._admission.depth()
+        finally:
+            self._observe_rpc("SubmitJobs", rpc_start)
+
+    def _validate_job_runnable(self, job) -> None:
+        """Reject (ValueError -> INVALID on the wire) any job add_job
+        would choke on at drain time: the job type must parse AND the
+        throughput oracle must know the (model, batch size, gang) on
+        this cluster's worker types — an oracle miss inside the round
+        loop would take down scheduling for every running job."""
+        from shockwave_tpu.data.profiles import synthesize_profile
+
+        if self._oracle_throughputs is None:
+            return
+        worker_type = self._worker_types[0] if self._worker_types else "v100"
+        try:
+            synthesize_profile(job, self._oracle_throughputs, worker_type)
+        except KeyError as e:
+            raise ValueError(
+                f"unrunnable job {job.job_type!r} x{job.scale_factor}: "
+                f"{e.args[0] if e.args else e}"
+            ) from None
+
+    def _drain_admission_queue(self) -> int:
+        """Caller holds the lock (_cv). Admit every queued submission
+        into the scheduler (batched admission: one replan covers the
+        whole drain). Returns the number of jobs admitted. A job that
+        add_job rejects despite the front-door validation is dropped
+        LOUDLY (logged, counted, recorded) — one bad job must never
+        kill the round loop under every running job."""
+        drained = self._admission.drain(now=self.get_current_timestamp())
+        recorder = obs.get_recorder()
+        admitted = 0
+        for token, job, enqueued_s in drained:
+            try:
+                # Re-validate before add_job mutates anything: add_job
+                # has no rollback, so a failure MID-insert would leave
+                # a half-registered job; the validation reproduces the
+                # oracle check cheaply and raises before any mutation.
+                self._validate_job_runnable(job)
+                job_id = self.add_job(job, timestamp=enqueued_s)
+            except Exception:
+                LOG.error(
+                    "admitted job %r (token %s) rejected at drain; "
+                    "dropping it rather than crashing the round loop",
+                    job.job_type, token, exc_info=True,
+                )
+                obs.counter(
+                    "admission_drain_failures_total",
+                    "queued jobs add_job rejected at the round "
+                    "boundary (dropped, not admitted)",
+                ).inc()
+                if recorder.enabled:
+                    recorder.record_admission(
+                        {
+                            "kind": "drain_failed",
+                            "token": token,
+                            "job_type": job.job_type,
+                            "round": self._round_id,
+                        }
+                    )
+                continue
+            admitted += 1
+            if recorder.enabled:
+                recorder.record_admission(
+                    {
+                        "kind": "admitted",
+                        "token": token,
+                        "job_id": job_id.integer,
+                        "round": self._round_id,
+                        "time": self.get_current_timestamp(),
+                    }
+                )
+        return admitted
+
+    def expect_stream(self) -> None:
+        """Declare that a streaming submitter WILL connect: the round
+        loop idles on an empty job table until the stream closes,
+        instead of exiting before the first SubmitJobs RPC lands (the
+        startup race of any out-of-process submitter)."""
+        self._admission.open()
+
+    def close_submissions(self) -> None:
+        """End-of-stream signal for in-process drivers (the RPC path
+        sends close on SubmitJobs): after the queue drains and every
+        admitted job completes, the round loop exits instead of idling
+        for more arrivals."""
+        self._admission.close()
+        with self._cv:
+            self._cv.notify_all()
+
+    def _stream_done(self) -> bool:
+        """Caller holds the lock (_cv). Whether an empty job table means
+        the run is over. Pending submissions always keep the loop
+        alive; an open stream (any front-door submit seen, no close
+        yet) idles; the legacy expected-count contract is honored for
+        drivers that still use it; with neither signal, empty means
+        done (the seed behavior)."""
+        if self._admission.depth() > 0:
+            return False
+        if self._admission.closed:
+            return True
+        expected = self._num_expected_jobs
+        if expected is not None:
+            return self._num_jobs_in_trace >= expected
+        return not self._admission.opened
 
     # -- worker death ---------------------------------------------------
     def _dead_workers(self) -> list:
@@ -606,9 +765,12 @@ class PhysicalScheduler(Scheduler):
                 if fault_injector is not None:
                     self._apply_physical_fault_events(fault_injector)
                 self._reap_dead_workers()
+                # Batched admission: drain the streaming front door at
+                # the round boundary, so a burst of arrivals costs one
+                # replan, not one per job.
+                self._drain_admission_queue()
                 if len(self._jobs) == 0:
-                    expected = self._num_expected_jobs
-                    if expected is None or self._num_jobs_in_trace >= expected:
+                    if self._stream_done():
                         break
                     # Arrival gap: wait for the next submission.
                     self._cv.wait(timeout=1.0)

@@ -589,6 +589,23 @@ class Scheduler:
         self._need_to_update_allocation = True
         self._bs_scale[job_id] = None
         if self._shockwave is not None:
+            if (
+                job_id.integer not in self._profiles
+                and self._oracle_throughputs is not None
+            ):
+                # Streaming admission: jobs arriving through the front
+                # door carry no pre-computed profile (the static-trace
+                # drivers synthesized the whole table up front) — derive
+                # one from the throughput oracle at admission, the same
+                # math synthesize_profiles applies to a static trace.
+                from shockwave_tpu.data.profiles import synthesize_profile
+
+                worker_type = (
+                    self._worker_types[0] if self._worker_types else "v100"
+                )
+                self._profiles[job_id.integer] = synthesize_profile(
+                    job, self._oracle_throughputs, worker_type
+                )
             self._maybe_upgrade_shockwave_to_pools()
             pool_kwargs = {}
             if self._shockwave_is_pool_set():
@@ -1772,6 +1789,9 @@ class Scheduler:
         max_rounds: Optional[int] = None,
         checkpoint_threshold: Optional[int] = None,
         checkpoint_file: Optional[str] = None,
+        submitter=None,
+        admission_capacity: Optional[int] = None,
+        admission_retry_s: Optional[float] = None,
     ) -> float:
         """Trace-driven simulation; returns the makespan
         (reference: scheduler.py:1365-1796, from_trace path).
@@ -1787,9 +1807,19 @@ class Scheduler:
         ShockwavePlanner.state_dict), so fast-forward works with the
         flagship policy; a resumed run's metrics match an unbroken one
         (tests/test_simulator.py::test_checkpoint_resume_shockwave).
+
+        Streaming admission: with ``submitter`` (a
+        :class:`shockwave_tpu.runtime.admission.StreamingSubmitter`),
+        jobs arrive through the same bounded, token-deduplicated,
+        backpressured admission queue the physical SubmitJobs RPC
+        feeds, in virtual time — the loop idles through arrival gaps
+        and ends when the submitter closed the stream, the queue
+        drained, and every admitted job completed. ``arrival_times`` /
+        ``jobs`` are then ignored (the submitter carries the trace).
         """
         import os as _os
 
+        from shockwave_tpu.runtime import admission as admission_mod
         from shockwave_tpu.runtime import faults
 
         # Armed fault injection (chaos runs): churn/reclaim events from
@@ -1797,9 +1827,35 @@ class Scheduler:
         # default — costs one check per round.
         fault_injector = faults.active()
 
-        assert arrival_times is not None and jobs is not None
-        remaining_jobs = len(jobs)
-        queued_jobs = list(zip(arrival_times, jobs))
+        if submitter is not None:
+            if checkpoint_threshold is not None or checkpoint_file is not None:
+                # Both directions: saving (the queue/ledger is not part
+                # of the checkpoint contract) AND resuming (a restored
+                # queued_jobs list would be silently orphaned — the
+                # streaming admit path never pops it).
+                raise ValueError(
+                    "checkpointing is not supported with a streaming "
+                    "submitter (the admission queue is not part of the "
+                    "checkpoint contract)"
+                )
+            remaining_jobs = submitter.total_jobs
+            queued_jobs: list = []
+            # Virtual-time admission queue: the simulator owns the
+            # clock, so enqueue/latency stamps ride _current_timestamp.
+            self._admission = admission_mod.AdmissionQueue(
+                capacity=admission_capacity
+                or admission_mod.DEFAULT_CAPACITY,
+                retry_delay_s=(
+                    admission_retry_s
+                    if admission_retry_s is not None
+                    else max(1.0, self._time_per_iteration / 4.0)
+                ),
+                clock=lambda: self._current_timestamp,
+            )
+        else:
+            assert arrival_times is not None and jobs is not None
+            remaining_jobs = len(jobs)
+            queued_jobs = list(zip(arrival_times, jobs))
         running_jobs: list = []
         consecutive_idle_rounds = 0
         checkpoint_saved = False
@@ -1824,6 +1880,9 @@ class Scheduler:
                 "Resumed from checkpoint %s at t=%.1f (%d jobs queued)",
                 checkpoint_file, self._current_timestamp, len(queued_jobs),
             )
+        elif submitter is not None:
+            first = submitter.next_due_time()
+            self._current_timestamp = first if first is not None else 0.0
         else:
             self._current_timestamp = arrival_times[0]
 
@@ -1863,7 +1922,11 @@ class Scheduler:
                 break
             if max_rounds is not None and self._num_completed_rounds >= max_rounds:
                 break
-            next_job_arrival_time = queued_jobs[0][0] if queued_jobs else None
+            next_job_arrival_time = (
+                submitter.next_due_time()
+                if submitter is not None
+                else (queued_jobs[0][0] if queued_jobs else None)
+            )
             if next_job_arrival_time is None and not running_jobs:
                 self._last_reset_time = 0
 
@@ -1937,12 +2000,46 @@ class Scheduler:
             if self._shockwave is not None and self._num_completed_rounds >= 1:
                 self._shockwave_scheduler_update()
 
-            # Admit arrivals due by now.
-            while queued_jobs and queued_jobs[0][0] <= self._current_timestamp:
-                arrival_time, job = queued_jobs.pop(0)
-                self.add_job(job, timestamp=arrival_time)
+            # Admit arrivals due by now. The streaming path pumps the
+            # submitter (batched submits with idempotent tokens,
+            # backpressure honored, injected SubmitJobs faults retried)
+            # and drains the admission queue; the static path pops the
+            # pre-known trace directly.
+            if submitter is not None:
+                for token, job, enqueued in submitter.pump(
+                    self._admission, self._current_timestamp
+                ):
+                    job_id = self.add_job(
+                        job,
+                        timestamp=getattr(job, "arrival_time", enqueued),
+                    )
+                    recorder = obs.get_recorder()
+                    if recorder.enabled:
+                        recorder.record_admission(
+                            {
+                                "kind": "admitted",
+                                "token": token,
+                                "job_id": job_id.integer,
+                                "round": self._num_completed_rounds,
+                                "time": self._current_timestamp,
+                            }
+                        )
+            else:
+                while (
+                    queued_jobs
+                    and queued_jobs[0][0] <= self._current_timestamp
+                ):
+                    arrival_time, job = queued_jobs.pop(0)
+                    self.add_job(job, timestamp=arrival_time)
 
             if len(self._jobs) == 0:
+                if submitter is not None:
+                    if (
+                        submitter.exhausted()
+                        and self._admission.depth() == 0
+                    ):
+                        break
+                    continue
                 if not queued_jobs:
                     break
                 continue
@@ -1950,7 +2047,15 @@ class Scheduler:
             scheduled_jobs = self._schedule_jobs_on_workers()
             if self._is_shockwave and len(scheduled_jobs) == 0:
                 break
-            if not scheduled_jobs and not running_jobs and not queued_jobs:
+            stream_pending = submitter is not None and not (
+                submitter.exhausted() and self._admission.depth() == 0
+            )
+            if (
+                not scheduled_jobs
+                and not running_jobs
+                and not queued_jobs
+                and not stream_pending
+            ):
                 # One idle iteration is recoverable: the reset-time trick at
                 # the top of the loop forces an allocation recompute next
                 # time around. Two in a row is a real deadlock.

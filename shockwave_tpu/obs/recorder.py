@@ -476,6 +476,17 @@ class FlightRecorder:
             return
         self._append({"event": "recovery", **detail})
 
+    def record_admission(self, detail: dict) -> None:
+        """One streaming-admission front-door event: an accepted or
+        rejected (backpressure) submission batch, a token-ledger dedup,
+        a drained admission, or the end-of-stream close. ``detail``
+        carries ``kind`` plus plain JSON data (token, jobs, depth), so
+        a 10k-event streaming run's admission timeline replays from the
+        log alone."""
+        if not self.enabled:
+            return
+        self._append({"event": "admission", **detail})
+
 
 # ----------------------------------------------------------------------
 # Reading + replay.
@@ -483,8 +494,12 @@ class FlightRecorder:
 def iter_records(path: str) -> Iterator[dict]:
     """Yield records, skipping a truncated (crash-interrupted) final
     line; a non-final corrupt line raises — that is data loss, not an
-    interrupted append."""
-    with open(path) as f:
+    interrupted append. ``.gz`` logs (committed large-campaign
+    artifacts) are read transparently."""
+    import gzip
+
+    opener = gzip.open if str(path).endswith(".gz") else open
+    with opener(path, "rt") as f:
         lines = f.readlines()
     for i, line in enumerate(lines):
         if not line.strip():
@@ -637,6 +652,7 @@ def summarize_log(path: str) -> dict:
     contexts = 0
     faults = 0
     recoveries = 0
+    admissions = {}
     rounds = []
     backends = {}
     objectives = []
@@ -656,11 +672,15 @@ def summarize_log(path: str) -> dict:
             faults += 1
         elif event == "recovery":
             recoveries += 1
+        elif event == "admission":
+            kind = record.get("kind", "unknown")
+            admissions[kind] = admissions.get(kind, 0) + 1
     return {
         "plans": plans,
         "round_contexts": contexts,
         "faults": faults,
         "recoveries": recoveries,
+        "admissions": admissions,
         "first_round": min(rounds) if rounds else None,
         "last_round": max(rounds) if rounds else None,
         "backends": backends,

@@ -33,6 +33,18 @@ Rules (all thresholds overridable via a config dict, e.g. the
 ``worker_death``     workers lost to crash/reclamation/heartbeat expiry
                      (``scheduler_worker_deaths_total`` advanced by
                      >= ``min_workers``).
+``admission_backlog`` the streaming-admission queue is filling faster
+                     than the round loop drains it: depth at or above
+                     ``fraction`` of ``admission_queue_capacity`` (and
+                     at least ``min_depth``) — the signal that
+                     backpressure is about to reject submitters.
+``replan_p99``       the p99 of ``shockwave_solve_seconds`` (from the
+                     histogram's cumulative buckets, all backends)
+                     exceeds ``budget_s`` once ``min_solves`` solves
+                     were observed. ``budget_s`` has no universal
+                     default — drivers configure it from the round
+                     duration (the replan budget); the rule is inert
+                     until they do.
 
 A rule re-fires only when its value worsens past the last fired value
 (no per-round alert spam while a breach persists). Disabled by default
@@ -63,6 +75,8 @@ DEFAULT_RULES: Dict[str, dict] = {
     },
     "solver_degraded": {"min_events": 1},
     "worker_death": {"min_workers": 1},
+    "admission_backlog": {"fraction": 0.9, "min_depth": 8},
+    "replan_p99": {"budget_s": None, "min_solves": 5, "quantile": 0.99},
 }
 
 
@@ -201,6 +215,10 @@ class Watchdog:
                     self.rules["worker_death"]["min_workers"],
                     round_index, fired,
                 )
+            if "admission_backlog" in self.rules:
+                self._check_admission_backlog(metrics, round_index, fired)
+            if "replan_p99" in self.rules:
+                self._check_replan_p99(metrics, round_index, fired)
 
             for alert in fired:
                 alert["time_s"] = float(now_s)
@@ -261,6 +279,79 @@ class Watchdog:
             self._fire(fired, rule, round_index, delta, min_delta)
         else:
             self._rearm(rule)
+
+    @staticmethod
+    def _merged_buckets(metrics: dict, name: str):
+        """(total_count, {le_str: cumulative_count} summed over every
+        label series, overall_max) for a histogram metric."""
+        metric = metrics.get(name)
+        if not metric or not metric["series"]:
+            return 0, {}, None
+        merged: Dict[str, int] = {}
+        count = 0
+        maxes = []
+        for series in metric["series"]:
+            count += series["count"]
+            if series.get("max") is not None:
+                maxes.append(series["max"])
+            for le, cum in (series.get("buckets") or {}).items():
+                merged[le] = merged.get(le, 0) + cum
+        return count, merged, max(maxes) if maxes else None
+
+    @classmethod
+    def _histogram_quantile(cls, metrics, name, q):
+        """Upper-bound quantile estimate from cumulative buckets: the
+        smallest bucket bound whose cumulative count covers the
+        quantile (the +Inf bucket resolves to the observed max).
+        Returns (value, count) or (None, count)."""
+        count, merged, observed_max = cls._merged_buckets(metrics, name)
+        if count <= 0 or not merged:
+            return None, count
+        need = q * count
+        finite = sorted(
+            ((float(le), cum) for le, cum in merged.items()
+             if le != "+Inf"),
+            key=lambda item: item[0],
+        )
+        for bound, cum in finite:
+            if cum >= need:
+                return bound, count
+        return observed_max, count
+
+    def _check_admission_backlog(self, metrics, round_index, fired) -> None:
+        """Caller holds the lock (check_round)."""
+        cfg = self.rules["admission_backlog"]
+        depth = self._gauge_value(metrics, "admission_queue_depth")
+        capacity = self._gauge_value(metrics, "admission_queue_capacity")
+        if depth is None or not capacity:
+            return
+        threshold = max(cfg["fraction"] * capacity, cfg["min_depth"])
+        if depth >= threshold:
+            self._fire(
+                fired, "admission_backlog", round_index, depth, threshold,
+                capacity=int(capacity),
+            )
+        else:
+            self._rearm("admission_backlog")
+
+    def _check_replan_p99(self, metrics, round_index, fired) -> None:
+        """Caller holds the lock (check_round)."""
+        cfg = self.rules["replan_p99"]
+        budget = cfg.get("budget_s")
+        if budget is None:
+            return  # inert until a driver supplies the replan budget
+        p99, count = self._histogram_quantile(
+            metrics, "shockwave_solve_seconds", cfg.get("quantile", 0.99)
+        )
+        if p99 is None or count < cfg["min_solves"]:
+            return
+        if p99 > budget:
+            self._fire(
+                fired, "replan_p99", round_index, p99, budget,
+                solves=int(count),
+            )
+        else:
+            self._rearm("replan_p99")
 
     def _check_worst_ftf(self, metrics, round_index, fired) -> None:
         """Caller holds the lock (check_round)."""

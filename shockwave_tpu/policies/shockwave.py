@@ -684,13 +684,19 @@ class ShockwavePlanner:
         )
 
     def _solution_warm_start(self) -> "Optional[np.ndarray]":
-        """Previous-plan round counts per problem row, or None.
+        """Previous-plan round counts delta-patched onto the new job
+        set, or None.
 
         The cached schedules for rounds >= the cursor are the
         still-valid tail of the last plan; counting each job's
         occurrences gives the s-vector that plan chose, which is a
-        near-feasible saddle-point guess for the incremental replan
-        (arrivals/departures/capacity deltas move few coordinates).
+        near-feasible saddle-point guess for the incremental replan.
+        :func:`shockwave_tpu.solver.warm_start.delta_patch_counts`
+        aligns it across the churn delta — departures/reclaims drop
+        rows, survivors keep their counts, arrivals are seeded at an
+        even split of the plan's free budget — so a 1-job delta costs
+        a few moved coordinates, not a cold solve (and never a
+        recompile: the job axis is padded to a fleet-size band).
         The flight recorder slims the plan cache out of its snapshots,
         so a replayed planner carries the derived vector instead
         (``pdhg_warm_start`` in the record, restored by from_state) —
@@ -712,13 +718,31 @@ class ShockwavePlanner:
         ]
         if not future:
             return None
-        counts = {j: 0 for j in job_ids}
+        prev_counts: Dict[object, int] = {}
         for schedule in future:
             for j in schedule:
-                if j in counts:
-                    counts[j] += 1
-        s0 = np.array([float(counts[j]) for j in job_ids])
-        return s0 if s0.any() else None
+                prev_counts[j] = prev_counts.get(j, 0) + 1
+        if not prev_counts:
+            return None
+        from shockwave_tpu.solver import warm_start
+
+        prev_ids = list(prev_counts)
+        nworkers = np.array(
+            [
+                float(self.job_metadata[j].nworkers)
+                if j in self.job_metadata
+                else 1.0
+                for j in job_ids
+            ]
+        )
+        return warm_start.delta_patch_counts(
+            prev_ids,
+            np.array([float(prev_counts[j]) for j in prev_ids]),
+            job_ids,
+            nworkers,
+            self.num_gpus,
+            self.future_rounds,
+        )
 
     def _record_solve(
         self, seconds: float, backend: str, num_jobs: int,

@@ -1,0 +1,479 @@
+"""Streaming admission front door: bounded queue, idempotent tokens,
+backpressure, and the end-of-stream close signal.
+
+The scheduler used to assume the whole job trace was known up front
+(``expect_jobs(count)`` + an in-process submit thread). This module is
+the serving-system replacement: submitters push batches through the
+``SubmitJobs`` RPC (or, in simulation, a :class:`StreamingSubmitter`
+in virtual time) into one :class:`AdmissionQueue` per scheduler, and
+the round loop drains it at round boundaries — batched admission, so a
+burst of arrivals costs one replan, not one per job.
+
+Contract:
+
+  * **Idempotent tokens.** Every batch carries a client-supplied token.
+    The queue keeps a token ledger; a retried submit (lost response,
+    injected ``rpc_drop``) re-offers the same token and is acknowledged
+    without re-admitting — a token resolves to admission exactly once.
+  * **Backpressure.** The queue is bounded. A batch that would overflow
+    it is rejected with ``RETRY_AFTER`` and a queue-depth-derived delay;
+    the submitter resubmits the SAME token after the delay. Nothing is
+    silently dropped — rejection is explicit and observable
+    (``admission_rejected_total``).
+  * **End of stream.** ``close()`` replaces the static expected-job
+    count: the scheduler idles through arrival gaps while the stream is
+    open and exits once it is closed, the queue is drained, and every
+    admitted job completed.
+
+Admission, rejection, dedup, and close events are stamped into the
+flight recorder (when enabled) so a streaming run's timeline is
+replayable forensic data, and surfaced as metrics for the
+``admission_backlog`` watchdog rule.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict, deque
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from shockwave_tpu import obs
+from shockwave_tpu.analysis import sanitize
+from shockwave_tpu.core.job import Job
+
+STATUS_ACCEPTED = "ACCEPTED"
+STATUS_RETRY_AFTER = "RETRY_AFTER"
+STATUS_CLOSED = "CLOSED"
+
+# Default bound on pending (accepted-but-not-admitted) jobs; the env
+# knob SHOCKWAVE_ADMISSION_QUEUE_CAP overrides it in physical mode.
+DEFAULT_CAPACITY = 1024
+
+
+def job_to_spec_dict(job: Job) -> dict:
+    """Wire-facing dict for one job (the SubmitterClient turns these
+    into admission_pb2.JobSpec messages)."""
+    return {
+        "job_type": job.job_type,
+        "command": job.command,
+        "working_directory": job.working_directory,
+        "num_steps_arg": job.num_steps_arg,
+        "total_steps": int(job.total_steps),
+        "scale_factor": int(job.scale_factor),
+        "mode": job.mode,
+        "priority_weight": float(job.priority_weight),
+        "slo": float(job.SLO) if job.SLO is not None else 0.0,
+        "duration": float(job.duration) if job.duration else 0.0,
+        "needs_data_dir": bool(job.needs_data_dir),
+    }
+
+
+def job_from_spec_dict(spec: dict) -> Job:
+    """Validated Job from a wire-facing spec dict; raises ValueError on
+    specs the scheduler could not run (the RPC handler reports these
+    back to the submitter instead of poisoning the queue)."""
+    from shockwave_tpu.data.workload_info import parse_job_type
+
+    job_type = str(spec.get("job_type", ""))
+    try:
+        model, batch_size = parse_job_type(job_type)
+        if not model or batch_size <= 0:
+            raise ValueError(job_type)
+    except ValueError:
+        raise ValueError(
+            f"job_type {job_type!r} is not of the form "
+            "'Model (batch size N)'"
+        ) from None
+    total_steps = int(spec.get("total_steps", 0))
+    if total_steps <= 0:
+        raise ValueError(f"total_steps must be positive, got {total_steps}")
+    scale_factor = int(spec.get("scale_factor", 1)) or 1
+    if scale_factor < 1:
+        raise ValueError(f"scale_factor must be >= 1, got {scale_factor}")
+    slo = float(spec.get("slo", 0.0))
+    duration = float(spec.get("duration", 0.0))
+    return Job(
+        job_type=job_type,
+        command=str(spec.get("command", "")),
+        working_directory=str(spec.get("working_directory", "")),
+        num_steps_arg=str(spec.get("num_steps_arg", "-n")) or "-n",
+        total_steps=total_steps,
+        scale_factor=scale_factor,
+        mode=str(spec.get("mode", "static")) or "static",
+        priority_weight=float(spec.get("priority_weight", 1.0)) or 1.0,
+        SLO=slo if slo > 0 else None,
+        duration=duration if duration > 0 else None,
+        needs_data_dir=bool(spec.get("needs_data_dir", False)),
+    )
+
+
+class AdmissionQueue:
+    """Bounded, token-deduplicated buffer between submitters and the
+    scheduler's round loop.
+
+    ``submit`` runs on RPC handler threads (or the simulated
+    submitter), ``drain``/``depth``/state reads on the round loop; all
+    state is guarded by one leaf lock (no calls out while held except
+    the obs registry, an established leaf)."""
+
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        retry_delay_s: float = 1.0,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self.capacity = max(1, int(capacity))
+        # Base unit of the queue-depth-derived backpressure delay: a
+        # rejected submitter waits retry_delay_s scaled by how full the
+        # queue is (full queue => one whole unit, plus a term for how
+        # far over the batch would have gone).
+        self.retry_delay_s = float(retry_delay_s)
+        self._clock = clock or time.monotonic
+        self._lock = sanitize.make_lock(
+            "runtime.admission.AdmissionQueue._lock"
+        )
+        # (token, job, enqueue_time) in arrival order.
+        self._pending: deque = deque()
+        # token -> number of jobs recorded under it (the idempotency
+        # ledger; retained for the queue's lifetime so a token can
+        # never be admitted twice, even long after its batch drained).
+        self._token_jobs: "OrderedDict[str, int]" = OrderedDict()
+        self._closed = False
+        self._opened = False  # any submit ever arrived
+        # Counters mirrored into the metrics registry (kept here too so
+        # summaries don't depend on metrics being enabled).
+        self.stats = {
+            "accepted_batches": 0,
+            "accepted_jobs": 0,
+            "rejected_batches": 0,
+            "deduped_batches": 0,
+            "closed_rejects": 0,
+            "admitted_jobs": 0,
+        }
+        # Published once so the admission_backlog watchdog rule can
+        # judge depth as a fraction of the bound.
+        obs.gauge(
+            "admission_queue_capacity",
+            "bound on pending jobs in the admission queue",
+        ).set(float(self.capacity))
+
+    # -- submitter side -------------------------------------------------
+    def submit(
+        self,
+        token: str,
+        jobs: Sequence[Job],
+        close: bool = False,
+        now: Optional[float] = None,
+    ) -> Tuple[str, float, int]:
+        """Offer one batch. Returns ``(status, retry_after_s, admitted)``
+        where ``admitted`` is the number of jobs recorded under the
+        token (0 on rejection). Close may ride any accepted batch (or
+        an empty one) and is idempotent."""
+        token = str(token)
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._opened = True
+            if token and token in self._token_jobs:
+                # Retried submit: the token already resolved — ack
+                # without re-admitting. Close still applies (the retry
+                # may be the close-carrying resend).
+                if close:
+                    self._close_locked()
+                self.stats["deduped_batches"] += 1
+                obs.counter(
+                    "admission_deduped_total",
+                    "retried submissions acknowledged via the token "
+                    "ledger without re-admitting",
+                ).inc()
+                return STATUS_ACCEPTED, 0.0, self._token_jobs[token]
+            if self._closed:
+                self.stats["closed_rejects"] += 1
+                obs.counter(
+                    "admission_rejected_total",
+                    "submissions rejected (backpressure or closed "
+                    "stream)",
+                ).inc(reason="closed")
+                return STATUS_CLOSED, 0.0, 0
+            depth = len(self._pending)
+            # The bound is on BACKLOG, not on a single batch: an empty
+            # queue admits any batch (otherwise a batch larger than
+            # the capacity could never be admitted and its submitter
+            # would retry the same token forever — a livelock, since
+            # rejection never shrinks the batch).
+            if jobs and depth and depth + len(jobs) > self.capacity:
+                overflow = depth + len(jobs) - self.capacity
+                # Depth-derived delay: how full the queue already is,
+                # plus how far over this batch would push it — a deeper
+                # backlog earns a longer wait, so a thundering herd
+                # spreads out instead of hammering a full queue.
+                retry_after = self.retry_delay_s * (
+                    depth / self.capacity + overflow / max(len(jobs), 1)
+                )
+                self.stats["rejected_batches"] += 1
+                obs.counter(
+                    "admission_rejected_total",
+                    "submissions rejected (backpressure or closed "
+                    "stream)",
+                ).inc(reason="backpressure")
+                self._record_event_locked(
+                    "rejected", token, len(jobs), depth,
+                    retry_after_s=round(retry_after, 3),
+                )
+                return STATUS_RETRY_AFTER, retry_after, 0
+            for job in jobs:
+                self._pending.append((token, job, now))
+            if token:
+                self._token_jobs[token] = len(jobs)
+            self.stats["accepted_batches"] += 1
+            self.stats["accepted_jobs"] += len(jobs)
+            obs.counter(
+                "admission_accepted_total", "submission batches accepted"
+            ).inc()
+            obs.gauge(
+                "admission_queue_depth",
+                "jobs accepted but not yet admitted by the round loop",
+            ).set(float(len(self._pending)))
+            self._record_event_locked(
+                "accepted", token, len(jobs), len(self._pending)
+            )
+            if close:
+                self._close_locked()
+            return STATUS_ACCEPTED, 0.0, len(jobs)
+
+    def close(self, token: str = "") -> None:
+        """End of stream: no further submissions will be accepted.
+        Idempotent."""
+        with self._lock:
+            self._opened = True
+            self._close_locked(token)
+
+    def open(self) -> None:
+        """Declare the stream open before the first submit arrives, so
+        a round loop started ahead of its submitter idles instead of
+        concluding the run is empty (the startup race every
+        out-of-process front door has)."""
+        with self._lock:
+            self._opened = True
+
+    def _close_locked(self, token: str = "") -> None:
+        """Caller holds the lock."""
+        if self._closed:
+            return
+        self._closed = True
+        obs.instant(
+            "admission_closed", cat="admission", tid="admission",
+            args={"pending": len(self._pending)},
+        )
+        recorder = obs.get_recorder()
+        if recorder.enabled:
+            recorder.record_admission(
+                {"kind": "close", "token": token,
+                 "pending": len(self._pending)}
+            )
+
+    def _record_event_locked(
+        self, kind: str, token: str, jobs: int, depth: int, **detail
+    ) -> None:
+        """Caller holds the lock."""
+        obs.instant(
+            f"admission_{kind}", cat="admission", tid="admission",
+            args={"token": token, "jobs": jobs, "depth": depth, **detail},
+        )
+        recorder = obs.get_recorder()
+        if recorder.enabled:
+            recorder.record_admission(
+                {"kind": kind, "token": token, "jobs": jobs,
+                 "depth": depth, **detail}
+            )
+
+    # -- scheduler side -------------------------------------------------
+    def drain(
+        self, max_jobs: Optional[int] = None, now: Optional[float] = None
+    ) -> List[Tuple[str, Job, float]]:
+        """Pop up to ``max_jobs`` pending jobs (all of them by default)
+        in arrival order for admission into the scheduler. Observes
+        per-job queue latency."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            budget = len(self._pending) if max_jobs is None else max_jobs
+            out = []
+            latency = obs.histogram(
+                "admission_queue_latency_seconds",
+                "time a job waited in the admission queue before the "
+                "round loop admitted it",
+            )
+            while self._pending and len(out) < budget:
+                token, job, enqueued = self._pending.popleft()
+                out.append((token, job, enqueued))
+                latency.observe(max(now - enqueued, 0.0))
+            if out:
+                self.stats["admitted_jobs"] += len(out)
+                obs.counter(
+                    "admission_jobs_admitted_total",
+                    "jobs drained from the admission queue into the "
+                    "scheduler",
+                ).inc(len(out))
+            obs.gauge(
+                "admission_queue_depth",
+                "jobs accepted but not yet admitted by the round loop",
+            ).set(float(len(self._pending)))
+            return out
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    @property
+    def opened(self) -> bool:
+        """True once any submit/close ever arrived — the signal that a
+        run is using the streaming front door (and the round loop
+        should idle on an empty job table instead of exiting)."""
+        with self._lock:
+            return self._opened
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "depth": len(self._pending),
+                "closed": self._closed,
+                "tokens": len(self._token_jobs),
+                **dict(self.stats),
+            }
+
+
+class StreamingSubmitter:
+    """Deterministic virtual-time submitter over an (arrival_time, job)
+    trace, for driving the simulator through the admission front door.
+
+    Batches due arrivals, offers each batch to the queue under a
+    deterministic token, honors backpressure by resubmitting the SAME
+    token after the returned delay, and exercises the fault-injection
+    hooks for ``SubmitJobs`` so injected ``rpc_error``/``rpc_drop``
+    events force retried (and therefore deduplicated) submissions —
+    the same exactly-once path a real network client takes.
+    """
+
+    def __init__(
+        self,
+        arrivals: Sequence[float],
+        jobs: Sequence[Job],
+        batch_size: int = 4,
+        token_prefix: str = "sub",
+    ):
+        if len(arrivals) != len(jobs):
+            raise ValueError(
+                f"{len(arrivals)} arrival times for {len(jobs)} jobs"
+            )
+        order = sorted(range(len(jobs)), key=lambda i: (arrivals[i], i))
+        self._queue_in: deque = deque(
+            (float(arrivals[i]), jobs[i]) for i in order
+        )
+        self.total_jobs = len(jobs)
+        self.batch_size = max(1, int(batch_size))
+        self._token_prefix = token_prefix
+        self._seq = 0
+        # Batch awaiting (re)submission: (token, jobs, arrival, not_before).
+        self._inflight: Optional[tuple] = None
+        self._close_sent = False
+        self.stats = {
+            "submit_attempts": 0,
+            "batches_accepted": 0,
+            "rpc_faults": 0,
+            "backpressure_retries": 0,
+        }
+
+    def exhausted(self) -> bool:
+        """Every job handed to the queue and the close signal sent."""
+        return (
+            not self._queue_in and self._inflight is None and self._close_sent
+        )
+
+    def next_due_time(self) -> Optional[float]:
+        """The next virtual time this submitter needs the clock to reach
+        (next arrival, or a backpressure retry)."""
+        if self._inflight is not None:
+            return self._inflight[3]
+        if self._queue_in:
+            return self._queue_in[0][0]
+        return None
+
+    def _next_batch(self, now: float) -> Optional[tuple]:
+        """Caller ensured no batch is in flight. Collect due arrivals
+        into one batch under a fresh token."""
+        if not self._queue_in or self._queue_in[0][0] > now:
+            return None
+        batch, arrival = [], self._queue_in[0][0]
+        while (
+            self._queue_in
+            and self._queue_in[0][0] <= now
+            and len(batch) < self.batch_size
+        ):
+            _, job = self._queue_in.popleft()
+            batch.append(job)
+        token = f"{self._token_prefix}-{self._seq:06d}"
+        self._seq += 1
+        return (token, batch, arrival, now)
+
+    def pump(
+        self, queue: AdmissionQueue, now: float
+    ) -> List[Tuple[str, Job, float]]:
+        """Advance the submitter to virtual time ``now``: submit every
+        due batch (with fault-injected retries and backpressure
+        honored), send close when the trace is exhausted, and return
+        ``queue.drain(now=now)`` — the jobs the scheduler should admit
+        this iteration, as (token, job, arrival_time) tuples."""
+        from shockwave_tpu.runtime import faults
+
+        while True:
+            if self._inflight is None:
+                self._inflight = self._next_batch(now)
+                if self._inflight is None:
+                    break
+            token, batch, arrival, not_before = self._inflight
+            if not_before > now:
+                break  # backpressure delay still running
+            self.stats["submit_attempts"] += 1
+            try:
+                # Pre-send faults (rpc_error/rpc_delay): the request
+                # never reaches the queue; the retry re-sends the same
+                # token. Injected delays are virtual here (the sim owns
+                # the clock), so they only count, not sleep.
+                faults.check_rpc(
+                    "SubmitJobs", kinds=("rpc_error", "rpc_delay"),
+                    sleep=lambda s: None,
+                )
+                status, retry_after, _ = queue.submit(
+                    token, batch, now=now
+                )
+                # Post-send faults (rpc_drop): the queue DID record the
+                # token but the response is lost — the retry must be
+                # deduplicated by the ledger.
+                faults.check_rpc("SubmitJobs", kinds=("rpc_drop",))
+                faults.note_rpc_success("SubmitJobs")
+            except faults.InjectedRpcError:
+                self.stats["rpc_faults"] += 1
+                continue  # immediate retry, same token
+            if status == STATUS_RETRY_AFTER:
+                self.stats["backpressure_retries"] += 1
+                self._inflight = (token, batch, arrival, now + retry_after)
+                break
+            # ACCEPTED (fresh or deduplicated): stamp each job's true
+            # arrival time for JCT accounting, then move on.
+            for job in batch:
+                job.arrival_time = arrival
+            self.stats["batches_accepted"] += 1
+            self._inflight = None
+        if (
+            not self._queue_in
+            and self._inflight is None
+            and not self._close_sent
+        ):
+            queue.close(token=f"{self._token_prefix}-close")
+            self._close_sent = True
+        return queue.drain(now=now)

@@ -35,7 +35,7 @@ import os
 import random
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from shockwave_tpu.analysis import sanitize
 
@@ -135,7 +135,12 @@ class FaultPlan:
 
     @classmethod
     def from_file(cls, path: str) -> "FaultPlan":
-        with open(path) as f:
+        """Load a plan; ``.gz`` files (committed large-campaign
+        artifacts) are read transparently."""
+        import gzip
+
+        opener = gzip.open if str(path).endswith(".gz") else open
+        with opener(path, "rt") as f:
             return cls.from_json(f.read())
 
 
@@ -206,6 +211,86 @@ def generate_churn_plan(
     )
 
 
+def generate_arrival_campaign(
+    seed: int,
+    num_jobs: int,
+    horizon_s: float,
+    burst_count: int = 3,
+    burst_fraction: float = 0.5,
+    burst_width_frac: float = 0.02,
+) -> List[float]:
+    """A streaming arrival-time campaign: Poisson background traffic
+    composed with short high-rate bursts (the front-door load shape a
+    production scheduler actually sees — steady trickle punctuated by
+    campaign launches that must hit backpressure, not OOM the queue).
+
+    ``burst_fraction`` of the jobs land inside ``burst_count`` bursts,
+    each ``burst_width_frac`` of the horizon wide; the rest arrive as a
+    Poisson process over the whole horizon. Fully deterministic from
+    ``seed``; returns sorted arrival seconds.
+    """
+    rng = random.Random(seed ^ 0x5EED)
+    num_jobs = int(num_jobs)
+    n_burst = int(num_jobs * burst_fraction) if burst_count > 0 else 0
+    n_background = num_jobs - n_burst
+    arrivals: List[float] = []
+    # Poisson background: exponential inter-arrival gaps, rate sized so
+    # the expected span fills the horizon.
+    rate = n_background / max(horizon_s, 1e-9)
+    t = 0.0
+    for _ in range(n_background):
+        t += rng.expovariate(max(rate, 1e-12))
+        arrivals.append(min(t, horizon_s))
+    # Bursts: uniformly placed windows, arrivals uniform inside each.
+    per_burst = [n_burst // max(burst_count, 1)] * max(burst_count, 0)
+    for i in range(n_burst - sum(per_burst)):
+        per_burst[i % len(per_burst)] += 1
+    width = horizon_s * burst_width_frac
+    for count in per_burst:
+        start = rng.uniform(0.0, max(horizon_s - width, 0.0))
+        for _ in range(count):
+            arrivals.append(start + rng.uniform(0.0, width))
+    arrivals.sort()
+    return [round(a, 3) for a in arrivals]
+
+
+def generate_streaming_plan(
+    seed: int,
+    num_jobs: int,
+    horizon_s: float,
+    num_workers: int,
+    target_churn_events: int = 1000,
+    submit_faults: int = 4,
+    round_s: float = 120.0,
+    burst_count: int = 3,
+    burst_fraction: float = 0.5,
+    **churn_kwargs,
+) -> "Tuple[List[float], FaultPlan]":
+    """One seeded streaming scenario: an arrival campaign (Poisson +
+    bursts) composed with the reclaim/re-add churn plan of
+    :func:`generate_churn_plan`, plus ``submit_faults`` injected RPC
+    faults on the ``SubmitJobs`` front door (alternating lost-response
+    drops and pre-send errors) so the run exercises token-idempotent
+    retries. Returns ``(arrival_times, FaultPlan)``."""
+    arrivals = generate_arrival_campaign(
+        seed, num_jobs, horizon_s, burst_count=burst_count,
+        burst_fraction=burst_fraction,
+    )
+    plan = generate_churn_plan(
+        seed, horizon_s, num_workers,
+        target_events=target_churn_events, round_s=round_s,
+        **churn_kwargs,
+    )
+    for i in range(submit_faults):
+        kind = "rpc_drop" if i % 2 == 0 else "rpc_error"
+        plan.events.append(
+            FaultEvent(
+                event_id=len(plan.events), kind=kind, method="SubmitJobs"
+            )
+        )
+    return arrivals, plan
+
+
 def select_victims(plan: FaultPlan, event: FaultEvent, live_ids) -> list:
     """Deterministic victim choice for a worker_crash/capacity_reclaim
     event, shared by the simulator and physical appliers so the two
@@ -264,14 +349,21 @@ class FaultInjector:
             return None
 
     # -- rpc events (client call sites) ---------------------------------
-    def rpc_fault(self, method: str) -> Optional[FaultEvent]:
+    def rpc_fault(self, method: str, kinds=None) -> Optional[FaultEvent]:
         """Match (and consume one count of) the next fault armed for
-        ``method``; None when the call should go through clean."""
+        ``method``; None when the call should go through clean.
+        ``kinds`` restricts which fault kinds this call site can
+        consume — e.g. the SubmitJobs client checks ``rpc_error``/
+        ``rpc_delay`` BEFORE the wire send and ``rpc_drop`` AFTER it,
+        so a drop models a lost *response* (the server processed the
+        batch; the retry must be deduplicated), not a lost request."""
         with self._lock:
             queue = self._rpc.get(method)
             if not queue:
                 return None
             event = queue[0]
+            if kinds is not None and event.kind not in kinds:
+                return None
             self._rpc_remaining[event.event_id] -= 1
             if self._rpc_remaining[event.event_id] <= 0:
                 queue.pop(0)
@@ -363,15 +455,16 @@ def active() -> Optional[FaultInjector]:
     return _INJECTOR
 
 
-def check_rpc(method: str, sleep=time.sleep) -> None:
+def check_rpc(method: str, sleep=time.sleep, kinds=None) -> None:
     """Client-side injection hook: no-op when injection is off;
     otherwise may sleep (``rpc_delay``) or raise
     :class:`InjectedRpcError` (``rpc_error`` / ``rpc_drop``) according
-    to the armed plan."""
+    to the armed plan. ``kinds`` restricts which fault kinds this call
+    site consumes (see :meth:`FaultInjector.rpc_fault`)."""
     injector = active()
     if injector is None:
         return
-    event = injector.rpc_fault(method)
+    event = injector.rpc_fault(method, kinds=kinds)
     if event is None:
         return
     from shockwave_tpu import obs

@@ -1,0 +1,250 @@
+"""Hand-rolled protobuf for admission.proto (no protoc in this build).
+
+Implements the three messages of the streaming-admission front door —
+``JobSpec``, ``SubmitJobsRequest``, ``SubmitJobsResponse`` — with
+exactly the two entry points the hand-rolled gRPC wiring
+(:mod:`shockwave_tpu.runtime.rpc.wiring`) uses, ``SerializeToString``
+and ``FromString``, emitting/consuming canonical proto3 wire format
+(defaults omitted, repeated submessages length-delimited, doubles as
+64-bit little-endian) so a protoc-generated counterpart interoperates
+byte-for-byte. Unknown fields are skipped per proto3 rules, keeping
+the parser forward-compatible with a widened schema. Field numbers are
+documented in admission.proto.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List
+
+
+def _encode_varint(value: int) -> bytes:
+    out = bytearray()
+    value = int(value)
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def _decode_varint(data: bytes, pos: int):
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated varint")
+        byte = data[pos]
+        pos += 1
+        result |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def _tag(field: int, wire_type: int) -> bytes:
+    return _encode_varint((field << 3) | wire_type)
+
+
+def _put_str(out: bytearray, field: int, value: str) -> None:
+    payload = value.encode("utf-8")
+    if payload:
+        out += _tag(field, 2) + _encode_varint(len(payload)) + payload
+
+
+def _put_varint(out: bytearray, field: int, value: int) -> None:
+    if value:
+        out += _tag(field, 0) + _encode_varint(int(value))
+
+
+def _put_double(out: bytearray, field: int, value: float) -> None:
+    if value:
+        out += _tag(field, 1) + struct.pack("<d", float(value))
+
+
+def _put_msg(out: bytearray, field: int, payload: bytes) -> None:
+    out += _tag(field, 2) + _encode_varint(len(payload)) + payload
+
+
+def _scan_fields(data: bytes):
+    """Yield (field, wire_type, value) over a message's wire bytes;
+    length-delimited values come back as raw ``bytes``."""
+    pos = 0
+    while pos < len(data):
+        tag, pos = _decode_varint(data, pos)
+        field, wire_type = tag >> 3, tag & 0x07
+        if wire_type == 0:
+            value, pos = _decode_varint(data, pos)
+        elif wire_type == 1:
+            if pos + 8 > len(data):
+                raise ValueError("truncated 64-bit field")
+            value = struct.unpack("<d", data[pos : pos + 8])[0]
+            pos += 8
+        elif wire_type == 2:
+            length, pos = _decode_varint(data, pos)
+            if pos + length > len(data):
+                raise ValueError("truncated length-delimited field")
+            value = data[pos : pos + length]
+            pos += length
+        elif wire_type == 5:
+            pos += 4
+            continue  # 32-bit (unknown field: skip)
+        else:
+            raise ValueError(f"unsupported wire type {wire_type}")
+        yield field, wire_type, value
+
+
+class JobSpec:
+    """message JobSpec — one job of a submission batch."""
+
+    def __init__(
+        self,
+        job_type: str = "",
+        command: str = "",
+        working_directory: str = "",
+        num_steps_arg: str = "",
+        total_steps: int = 0,
+        scale_factor: int = 0,
+        mode: str = "",
+        priority_weight: float = 0.0,
+        slo: float = 0.0,
+        duration: float = 0.0,
+        needs_data_dir: bool = False,
+    ):
+        self.job_type = job_type
+        self.command = command
+        self.working_directory = working_directory
+        self.num_steps_arg = num_steps_arg
+        self.total_steps = int(total_steps)
+        self.scale_factor = int(scale_factor)
+        self.mode = mode
+        self.priority_weight = float(priority_weight)
+        self.slo = float(slo)
+        self.duration = float(duration)
+        self.needs_data_dir = bool(needs_data_dir)
+
+    def SerializeToString(self) -> bytes:  # noqa: N802 (protobuf API)
+        out = bytearray()
+        _put_str(out, 1, self.job_type)
+        _put_str(out, 2, self.command)
+        _put_str(out, 3, self.working_directory)
+        _put_str(out, 4, self.num_steps_arg)
+        _put_varint(out, 5, self.total_steps)
+        _put_varint(out, 6, self.scale_factor)
+        _put_str(out, 7, self.mode)
+        _put_double(out, 8, self.priority_weight)
+        _put_double(out, 9, self.slo)
+        _put_double(out, 10, self.duration)
+        _put_varint(out, 11, int(self.needs_data_dir))
+        return bytes(out)
+
+    @classmethod
+    def FromString(cls, data: bytes) -> "JobSpec":  # noqa: N802
+        spec = cls()
+        for field, wire_type, value in _scan_fields(data):
+            if field == 1 and wire_type == 2:
+                spec.job_type = value.decode("utf-8")
+            elif field == 2 and wire_type == 2:
+                spec.command = value.decode("utf-8")
+            elif field == 3 and wire_type == 2:
+                spec.working_directory = value.decode("utf-8")
+            elif field == 4 and wire_type == 2:
+                spec.num_steps_arg = value.decode("utf-8")
+            elif field == 5 and wire_type == 0:
+                spec.total_steps = int(value)
+            elif field == 6 and wire_type == 0:
+                spec.scale_factor = int(value)
+            elif field == 7 and wire_type == 2:
+                spec.mode = value.decode("utf-8")
+            elif field == 8 and wire_type == 1:
+                spec.priority_weight = value
+            elif field == 9 and wire_type == 1:
+                spec.slo = value
+            elif field == 10 and wire_type == 1:
+                spec.duration = value
+            elif field == 11 and wire_type == 0:
+                spec.needs_data_dir = bool(value)
+        return spec
+
+
+class SubmitJobsRequest:
+    """message SubmitJobsRequest { token, repeated JobSpec jobs, close }"""
+
+    def __init__(
+        self,
+        token: str = "",
+        jobs: List[JobSpec] = None,
+        close: bool = False,
+    ):
+        self.token = token
+        self.jobs = list(jobs) if jobs else []
+        self.close = bool(close)
+
+    def SerializeToString(self) -> bytes:  # noqa: N802
+        out = bytearray()
+        _put_str(out, 1, self.token)
+        for spec in self.jobs:
+            _put_msg(out, 2, spec.SerializeToString())
+        _put_varint(out, 3, int(self.close))
+        return bytes(out)
+
+    @classmethod
+    def FromString(cls, data: bytes) -> "SubmitJobsRequest":  # noqa: N802
+        request = cls()
+        for field, wire_type, value in _scan_fields(data):
+            if field == 1 and wire_type == 2:
+                request.token = value.decode("utf-8")
+            elif field == 2 and wire_type == 2:
+                request.jobs.append(JobSpec.FromString(value))
+            elif field == 3 and wire_type == 0:
+                request.close = bool(value)
+        return request
+
+
+class SubmitJobsResponse:
+    """message SubmitJobsResponse { status, retry_after_s, admitted,
+    error, queue_depth }"""
+
+    def __init__(
+        self,
+        status: str = "",
+        retry_after_s: float = 0.0,
+        admitted: int = 0,
+        error: str = "",
+        queue_depth: int = 0,
+    ):
+        self.status = status
+        self.retry_after_s = float(retry_after_s)
+        self.admitted = int(admitted)
+        self.error = error
+        self.queue_depth = int(queue_depth)
+
+    def SerializeToString(self) -> bytes:  # noqa: N802
+        out = bytearray()
+        _put_str(out, 1, self.status)
+        _put_double(out, 2, self.retry_after_s)
+        _put_varint(out, 3, self.admitted)
+        _put_str(out, 4, self.error)
+        _put_varint(out, 5, self.queue_depth)
+        return bytes(out)
+
+    @classmethod
+    def FromString(cls, data: bytes) -> "SubmitJobsResponse":  # noqa: N802
+        response = cls()
+        for field, wire_type, value in _scan_fields(data):
+            if field == 1 and wire_type == 2:
+                response.status = value.decode("utf-8")
+            elif field == 2 and wire_type == 1:
+                response.retry_after_s = value
+            elif field == 3 and wire_type == 0:
+                response.admitted = int(value)
+            elif field == 4 and wire_type == 2:
+                response.error = value.decode("utf-8")
+            elif field == 5 and wire_type == 0:
+                response.queue_depth = int(value)
+        return response

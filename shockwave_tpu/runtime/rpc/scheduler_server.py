@@ -8,6 +8,9 @@ Callbacks supplied by the scheduler:
   init_job(job_id) -> (max_steps, max_duration, extra_time)
   update_lease(job_id, worker_id, steps, duration, max_steps, max_duration)
       -> (max_steps, max_duration, extra_time)
+  submit_jobs(token, specs, close)
+      -> (status, retry_after_s, admitted, queue_depth)
+      (the streaming-admission front door; see runtime/admission.py)
 """
 
 from __future__ import annotations
@@ -107,6 +110,50 @@ def _iterator_to_scheduler_handlers(callbacks):
     return {"InitJob": InitJob, "UpdateLease": UpdateLease}
 
 
+def _admission_handlers(callbacks):
+    from shockwave_tpu.runtime.protobuf import admission_pb2 as adm_pb2
+
+    def SubmitJobs(request, context):
+        try:
+            specs = [
+                {
+                    "job_type": spec.job_type,
+                    "command": spec.command,
+                    "working_directory": spec.working_directory,
+                    "num_steps_arg": spec.num_steps_arg,
+                    "total_steps": spec.total_steps,
+                    "scale_factor": spec.scale_factor,
+                    "mode": spec.mode,
+                    "priority_weight": spec.priority_weight,
+                    "slo": spec.slo,
+                    "duration": spec.duration,
+                    "needs_data_dir": spec.needs_data_dir,
+                }
+                for spec in request.jobs
+            ]
+            status, retry_after_s, admitted, depth = callbacks[
+                "submit_jobs"
+            ](request.token, specs, bool(request.close))
+            return adm_pb2.SubmitJobsResponse(
+                status=status,
+                retry_after_s=float(retry_after_s),
+                admitted=int(admitted),
+                queue_depth=int(depth),
+            )
+        except ValueError as e:
+            # A malformed spec is the SUBMITTER's bug: report it on the
+            # response instead of burning its retry budget — retrying
+            # an unrunnable job can never succeed.
+            return adm_pb2.SubmitJobsResponse(
+                status="INVALID", error=str(e)
+            )
+        except Exception as e:  # noqa: BLE001 - reported to the caller
+            LOG.exception("SubmitJobs failed")
+            return adm_pb2.SubmitJobsResponse(status="ERROR", error=str(e))
+
+    return {"SubmitJobs": SubmitJobs}
+
+
 def serve(port: int, callbacks: dict, max_workers: int = 32) -> grpc.Server:
     """Start (and return) the scheduler's gRPC server; non-blocking."""
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
@@ -118,6 +165,10 @@ def serve(port: int, callbacks: dict, max_workers: int = 32) -> grpc.Server:
         "IteratorToScheduler",
         _iterator_to_scheduler_handlers(callbacks),
     )
+    if "submit_jobs" in callbacks:
+        add_servicer(
+            server, "AdmissionToScheduler", _admission_handlers(callbacks)
+        )
     server.add_insecure_port(f"[::]:{port}")
     server.start()
     return server

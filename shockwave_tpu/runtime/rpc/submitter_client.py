@@ -1,0 +1,236 @@
+"""Submitter -> scheduler RPC client: the streaming-admission front
+door's network side.
+
+Each :meth:`SubmitterClient.submit` call is one ``SubmitJobs`` RPC
+under the shared retry/backoff discipline
+(:mod:`shockwave_tpu.runtime.retry`). Idempotency is the client's
+responsibility to EXPLOIT and the server's to provide: every batch
+carries a token (caller-supplied or generated once per batch), every
+transport retry re-sends the SAME token, and the scheduler's admission
+queue deduplicates — so a lost response can never double-admit a
+batch.
+
+Fault injection hooks both sides of the wire: ``rpc_error``/
+``rpc_delay`` events fire BEFORE the send (request lost), ``rpc_drop``
+AFTER it (response lost — the server processed the batch; the retry
+exercises the token ledger). See runtime/faults.py.
+
+:meth:`submit_stream` is the convenience loop a driver uses: batches a
+whole trace, honors ``RETRY_AFTER`` backpressure by sleeping and
+resubmitting the same token, and sends the end-of-stream close.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from typing import Callable, List, Optional, Sequence
+
+import grpc
+
+LOG = logging.getLogger("runtime.submitter_client")
+
+from shockwave_tpu import obs
+from shockwave_tpu.runtime import faults
+from shockwave_tpu.runtime.admission import job_to_spec_dict
+from shockwave_tpu.runtime.protobuf import admission_pb2 as adm_pb2
+from shockwave_tpu.runtime.retry import RetryPolicy, call_with_retry
+from shockwave_tpu.runtime.rpc.wiring import make_stubs
+
+
+class SubmissionRejected(RuntimeError):
+    """The scheduler refused a batch for a non-retryable reason
+    (malformed spec or an internal error it reported back)."""
+
+    def __init__(self, status: str, error: str):
+        super().__init__(f"submission rejected ({status}): {error}")
+        self.status = status
+        self.error = error
+
+
+class SubmitterClient:
+    def __init__(
+        self,
+        sched_ip_addr: str,
+        sched_port: int,
+        retry: Optional[RetryPolicy] = None,
+        client_id: Optional[str] = None,
+    ):
+        self._addr = f"{sched_ip_addr}:{sched_port}"
+        self._retry = retry or RetryPolicy.from_env()
+        # Token namespace: unique per client so two submitters can
+        # never collide in the scheduler's ledger.
+        self.client_id = client_id or uuid.uuid4().hex[:12]
+        self._seq = 0
+
+    def next_token(self) -> str:
+        token = f"{self.client_id}-{self._seq:06d}"
+        self._seq += 1
+        return token
+
+    def submit(
+        self,
+        jobs: Sequence,
+        token: Optional[str] = None,
+        close: bool = False,
+    ):
+        """One SubmitJobs RPC (with transport retries under the shared
+        policy, every attempt carrying the same token). ``jobs`` are
+        :class:`~shockwave_tpu.core.job.Job` objects or spec dicts.
+        Returns the response (status/retry_after_s/admitted/
+        queue_depth); raises :class:`SubmissionRejected` on INVALID/
+        ERROR statuses."""
+        token = token if token is not None else self.next_token()
+        specs = [
+            adm_pb2.JobSpec(**(j if isinstance(j, dict) else job_to_spec_dict(j)))
+            for j in jobs
+        ]
+        request = adm_pb2.SubmitJobsRequest(
+            token=token, jobs=specs, close=close
+        )
+
+        def attempt(timeout):
+            # Pre-send faults: the request never reaches the wire.
+            faults.check_rpc(
+                "SubmitJobs", kinds=("rpc_error", "rpc_delay")
+            )
+            with grpc.insecure_channel(self._addr) as channel:
+                stubs = make_stubs(channel, "AdmissionToScheduler")
+                response = stubs.SubmitJobs(request, timeout=timeout)
+            # Post-send faults: the scheduler processed the batch but
+            # the response is lost — the retry re-sends the SAME token
+            # and must be deduplicated server-side.
+            faults.check_rpc("SubmitJobs", kinds=("rpc_drop",))
+            faults.note_rpc_success("SubmitJobs")
+            return response
+
+        response = call_with_retry(attempt, self._retry, method="SubmitJobs")
+        if response.status in ("INVALID", "ERROR"):
+            raise SubmissionRejected(response.status, response.error)
+        if response.status == "CLOSED" and jobs:
+            # The stream is closed and this batch was NOT admitted;
+            # returning it as a normal response would silently drop the
+            # jobs (a second submitter racing a close, or a late batch
+            # after close_stream). An empty close-only request getting
+            # CLOSED is just an idempotent re-close and stays benign.
+            raise SubmissionRejected(
+                "CLOSED",
+                f"stream already closed; batch of {len(jobs)} not "
+                "admitted",
+            )
+        return response
+
+    def close_stream(self, token: Optional[str] = None):
+        """Send the end-of-stream close (an empty batch with close=True);
+        idempotent — safe to retry and safe to repeat."""
+        return self.submit(
+            [], token=token or f"{self.client_id}-close", close=True
+        )
+
+    def submit_stream(
+        self,
+        jobs: Sequence,
+        batch_size: int = 8,
+        close: bool = True,
+        max_backpressure_s: float = 300.0,
+        sleep=time.sleep,
+    ) -> List[str]:
+        """Submit a whole trace in batches, honoring backpressure:
+        a ``RETRY_AFTER`` response sleeps the advertised delay and
+        resubmits the SAME token. Returns the tokens used (one per
+        batch). ``max_backpressure_s`` bounds the total time spent
+        backing off on one batch so a wedged scheduler surfaces as an
+        error instead of an infinite loop."""
+        tokens: List[str] = []
+        batch_size = max(1, int(batch_size))
+        for start in range(0, len(jobs), batch_size):
+            batch = list(jobs[start : start + batch_size])
+            token = self.next_token()
+            tokens.append(token)
+            waited = 0.0
+            while True:
+                response = self.submit(batch, token=token)
+                if response.status != "RETRY_AFTER":
+                    break
+                delay = max(float(response.retry_after_s), 0.05)
+                waited += delay
+                if waited > max_backpressure_s:
+                    raise TimeoutError(
+                        f"batch {token} backpressured for "
+                        f"{waited:.1f}s (> {max_backpressure_s}s); "
+                        "the scheduler is not draining its admission "
+                        "queue"
+                    )
+                obs.counter(
+                    "admission_client_backpressure_total",
+                    "RETRY_AFTER responses honored by the submitter",
+                ).inc()
+                sleep(delay)
+        if close:
+            self.close_stream()
+        return tokens
+
+    def submit_trace(
+        self,
+        jobs: Sequence,
+        arrivals: Sequence[float],
+        time_scale: float = 1.0,
+        max_batch: int = 64,
+        close: bool = True,
+        on_batch: Optional[Callable[[list], None]] = None,
+        sleep=time.sleep,
+        clock=time.time,
+    ) -> int:
+        """Replay a whole trace's arrival schedule in (scaled) wall
+        clock through the front door: sleep until each arrival is due,
+        coalesce every due arrival into one batch (capped at
+        ``max_batch`` so a compressed schedule cannot build a batch the
+        queue bound would bounce forever), and submit with
+        backpressure honored. The close signal is sent in a finally —
+        even a failing submitter ends the stream, so the scheduler's
+        round loop finishes what it admitted instead of idling forever
+        on a stream nobody will close. Returns the number of jobs
+        submitted; ``on_batch`` sees each batch after it is accepted."""
+        if len(jobs) != len(arrivals):
+            raise ValueError(
+                f"{len(jobs)} jobs for {len(arrivals)} arrival times"
+            )
+        max_batch = max(1, int(max_batch))
+        start = clock()
+        i = 0
+        submitted = 0
+        try:
+            while i < len(jobs):
+                delay = arrivals[i] * time_scale - (clock() - start)
+                if delay > 0:
+                    sleep(delay)
+                batch = [jobs[i]]
+                i += 1
+                now_virtual = (clock() - start) / max(time_scale, 1e-9)
+                while (
+                    i < len(jobs)
+                    and arrivals[i] <= now_virtual
+                    and len(batch) < max_batch
+                ):
+                    batch.append(jobs[i])
+                    i += 1
+                self.submit_stream(
+                    batch, batch_size=len(batch), close=False,
+                    sleep=sleep,
+                )
+                submitted += len(batch)
+                if on_batch is not None:
+                    on_batch(batch)
+        finally:
+            if close:
+                try:
+                    self.close_stream()
+                except Exception:
+                    # Best effort only: the primary error (if any) is
+                    # already propagating; a close that cannot reach a
+                    # dead scheduler must not mask it.
+                    LOG.warning(
+                        "end-of-stream close failed", exc_info=True
+                    )
+        return submitted

@@ -15,6 +15,7 @@ from types import SimpleNamespace
 import grpc
 
 from shockwave_tpu.runtime.protobuf import (
+    admission_pb2 as adm_pb2,
     common_pb2,
     iterator_to_scheduler_pb2 as it_pb2,
     scheduler_to_worker_pb2 as s2w_pb2,
@@ -45,6 +46,15 @@ SERVICES = {
     "IteratorToScheduler": {
         "InitJob": (it_pb2.InitJobRequest, it_pb2.UpdateLeaseResponse),
         "UpdateLease": (it_pb2.UpdateLeaseRequest, it_pb2.UpdateLeaseResponse),
+    },
+    # Streaming admission front door: batched job submission with
+    # idempotent tokens, backpressure, and the end-of-stream close
+    # (see runtime/admission.py for the queue semantics).
+    "AdmissionToScheduler": {
+        "SubmitJobs": (
+            adm_pb2.SubmitJobsRequest,
+            adm_pb2.SubmitJobsResponse,
+        ),
     },
 }
 

@@ -1,0 +1,479 @@
+"""Streaming admission front door: the bounded token-deduplicated
+queue, backpressure math, the wire codec, the streaming submitter's
+exactly-once contract under injected SubmitJobs faults, the warm-start
+delta patcher, and the admission/replan watchdog rules."""
+
+import numpy as np
+import pytest
+
+from shockwave_tpu import obs
+from shockwave_tpu.core.job import Job
+from shockwave_tpu.data.workload_info import steps_per_epoch
+from shockwave_tpu.runtime import admission, faults
+from shockwave_tpu.runtime.protobuf import admission_pb2 as adm_pb2
+
+
+@pytest.fixture(autouse=True)
+def _reset_state():
+    faults.reset()
+    obs.reset()
+    yield
+    faults.reset()
+    obs.reset()
+
+
+def _job(steps=100, scale_factor=1, model="ResNet-18", bs=32):
+    return Job(
+        job_type=f"{model} (batch size {bs})",
+        command="python3 main.py",
+        total_steps=steps,
+        scale_factor=scale_factor,
+        mode="static",
+    )
+
+
+# ----------------------------------------------------------------------
+# AdmissionQueue semantics.
+# ----------------------------------------------------------------------
+def test_queue_accepts_and_drains_in_arrival_order():
+    q = admission.AdmissionQueue(capacity=8, clock=lambda: 0.0)
+    q.submit("a", [_job(1), _job(2)], now=1.0)
+    q.submit("b", [_job(3)], now=2.0)
+    drained = q.drain(now=5.0)
+    assert [t for t, _, _ in drained] == ["a", "a", "b"]
+    assert [j.total_steps for _, j, _ in drained] == [1, 2, 3]
+    assert [e for _, _, e in drained] == [1.0, 1.0, 2.0]
+    assert q.depth() == 0
+    assert q.summary()["admitted_jobs"] == 3
+
+
+def test_queue_token_retry_is_idempotent():
+    q = admission.AdmissionQueue(capacity=8, clock=lambda: 0.0)
+    status, _, admitted = q.submit("tok", [_job(), _job()])
+    assert (status, admitted) == (admission.STATUS_ACCEPTED, 2)
+    # Retried before the drain: nothing new queued.
+    status, _, admitted = q.submit("tok", [_job(), _job()])
+    assert (status, admitted) == (admission.STATUS_ACCEPTED, 2)
+    assert q.depth() == 2
+    q.drain()
+    # Retried AFTER the drain (arbitrarily late retransmit): the ledger
+    # still remembers — a token can never be admitted twice.
+    status, _, admitted = q.submit("tok", [_job(), _job()])
+    assert (status, admitted) == (admission.STATUS_ACCEPTED, 2)
+    assert q.depth() == 0
+    assert q.summary()["deduped_batches"] == 2
+    assert q.summary()["accepted_jobs"] == 2
+
+
+def test_queue_backpressure_rejects_then_admits_after_drain():
+    q = admission.AdmissionQueue(
+        capacity=3, retry_delay_s=2.0, clock=lambda: 0.0
+    )
+    assert q.submit("a", [_job(), _job()])[0] == admission.STATUS_ACCEPTED
+    status, retry_after, admitted = q.submit("b", [_job(), _job()])
+    assert status == admission.STATUS_RETRY_AFTER
+    assert admitted == 0
+    assert retry_after > 0
+    # The rejected token is NOT in the ledger: the honored retry after
+    # the drain admits it for real.
+    q.drain()
+    assert q.submit("b", [_job(), _job()])[0] == admission.STATUS_ACCEPTED
+    assert q.depth() == 2
+    summary = q.summary()
+    assert summary["rejected_batches"] == 1
+    assert summary["accepted_jobs"] == 4
+
+
+def test_queue_backpressure_delay_grows_with_depth():
+    q = admission.AdmissionQueue(
+        capacity=10, retry_delay_s=1.0, clock=lambda: 0.0
+    )
+    q.submit("a", [_job() for _ in range(4)])
+    _, shallow, _ = q.submit("x", [_job() for _ in range(8)])
+    q.submit("b", [_job() for _ in range(5)])
+    _, deep, _ = q.submit("y", [_job() for _ in range(8)])
+    assert deep > shallow
+
+
+def test_queue_oversized_batch_admits_when_empty():
+    """The bound is on backlog, not on a single batch: a batch larger
+    than the capacity must be admitted from an empty queue (rejection
+    never shrinks the batch, so bouncing it would livelock the
+    submitter retrying the same token forever) — but against a
+    backlog it waits for the drain like everything else."""
+    q = admission.AdmissionQueue(capacity=4, clock=lambda: 0.0)
+    status, _, admitted = q.submit("big", [_job() for _ in range(10)])
+    assert (status, admitted) == (admission.STATUS_ACCEPTED, 10)
+    status, _, _ = q.submit("big2", [_job() for _ in range(10)])
+    assert status == admission.STATUS_RETRY_AFTER
+    q.drain()
+    assert (
+        q.submit("big2", [_job() for _ in range(10)])[0]
+        == admission.STATUS_ACCEPTED
+    )
+    assert q.summary()["accepted_jobs"] == 20
+
+
+def test_queue_close_is_idempotent_and_rejects_after():
+    q = admission.AdmissionQueue(capacity=8, clock=lambda: 0.0)
+    q.submit("a", [_job()], close=True)
+    assert q.closed
+    q.close()  # idempotent
+    status, _, admitted = q.submit("b", [_job()])
+    assert (status, admitted) == (admission.STATUS_CLOSED, 0)
+    # The close-carrying token still dedups.
+    assert q.submit("a", [_job()])[0] == admission.STATUS_ACCEPTED
+    assert q.summary()["closed_rejects"] == 1
+
+
+def test_queue_open_marks_stream_without_submissions():
+    q = admission.AdmissionQueue(capacity=8)
+    assert not q.opened
+    q.open()
+    assert q.opened
+    assert not q.closed
+
+
+# ----------------------------------------------------------------------
+# Wire codec + spec validation.
+# ----------------------------------------------------------------------
+def test_job_spec_roundtrip_through_wire():
+    job = Job(
+        job_type="ResNet-50 (batch size 64)",
+        command="python3 main.py --x 1",
+        working_directory="/tmp/w",
+        num_steps_arg="--steps",
+        total_steps=1234,
+        scale_factor=4,
+        mode="accordion",
+        priority_weight=2.5,
+        SLO=3.0,
+        duration=456.0,
+        needs_data_dir=True,
+    )
+    spec = adm_pb2.JobSpec(**admission.job_to_spec_dict(job))
+    wire = adm_pb2.SubmitJobsRequest(
+        token="t-9", jobs=[spec], close=True
+    ).SerializeToString()
+    back = adm_pb2.SubmitJobsRequest.FromString(wire)
+    assert back.token == "t-9" and back.close
+    restored = admission.job_from_spec_dict(
+        {
+            "job_type": back.jobs[0].job_type,
+            "command": back.jobs[0].command,
+            "working_directory": back.jobs[0].working_directory,
+            "num_steps_arg": back.jobs[0].num_steps_arg,
+            "total_steps": back.jobs[0].total_steps,
+            "scale_factor": back.jobs[0].scale_factor,
+            "mode": back.jobs[0].mode,
+            "priority_weight": back.jobs[0].priority_weight,
+            "slo": back.jobs[0].slo,
+            "duration": back.jobs[0].duration,
+            "needs_data_dir": back.jobs[0].needs_data_dir,
+        }
+    )
+    for field in (
+        "job_type", "command", "working_directory", "num_steps_arg",
+        "total_steps", "scale_factor", "mode", "priority_weight", "SLO",
+        "duration", "needs_data_dir",
+    ):
+        assert getattr(restored, field) == getattr(job, field), field
+
+
+def test_wire_parser_skips_unknown_fields():
+    # A widened future schema must not break this parser: append an
+    # unknown varint field (field 63) and an unknown length-delimited
+    # field (field 62) to a valid message.
+    base = adm_pb2.SubmitJobsResponse(
+        status="ACCEPTED", queue_depth=3
+    ).SerializeToString()
+    unknown = (
+        adm_pb2._tag(63, 0) + adm_pb2._encode_varint(42)
+        + adm_pb2._tag(62, 2) + adm_pb2._encode_varint(2) + b"hi"
+    )
+    parsed = adm_pb2.SubmitJobsResponse.FromString(base + unknown)
+    assert parsed.status == "ACCEPTED"
+    assert parsed.queue_depth == 3
+
+
+@pytest.mark.parametrize(
+    "patch",
+    [
+        {"job_type": "garbage"},
+        {"job_type": "ResNet-18 (batch size x)"},
+        {"total_steps": 0},
+        {"scale_factor": -1},
+    ],
+)
+def test_invalid_specs_are_rejected(patch):
+    spec = admission.job_to_spec_dict(_job())
+    spec.update(patch)
+    with pytest.raises(ValueError):
+        admission.job_from_spec_dict(spec)
+
+
+def test_unknown_model_rejected_at_rpc_not_crashing_drain():
+    """A wire-valid job the oracle has never heard of must be INVALID
+    at the front door (per-batch ValueError), not an ACCEPTED batch
+    that kills the round loop at drain time; and even if a bad job
+    somehow reaches the queue, the drain drops it loudly instead of
+    crashing."""
+    from shockwave_tpu.core.physical import PhysicalScheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.policies import get_policy
+    from shockwave_tpu.utils.hostenv import free_port
+
+    sched = PhysicalScheduler(
+        get_policy("fifo"),
+        port=free_port(),
+        throughputs=generate_oracle(),
+        time_per_iteration=3.0,
+    )
+    try:
+        spec = admission.job_to_spec_dict(_job(model="FooNet"))
+        with pytest.raises(ValueError, match="FooNet"):
+            sched._submit_jobs_rpc("tok-bad", [spec], False)
+        assert sched._admission.depth() == 0
+        # Defense in depth: a bad job smuggled into the queue is
+        # dropped at drain, the loop survives, the drop is counted.
+        # (A registered worker type makes add_job actually consult the
+        # oracle — the crash path the isolation exists for.)
+        sched.register_worker("v100", num_gpus=1)
+        sched._admission.submit("tok-smuggled", [_job(model="FooNet")])
+        with sched._cv:
+            admitted = sched._drain_admission_queue()
+        assert admitted == 0
+        assert sched._admission.depth() == 0
+        assert len(sched._jobs) == 0
+    finally:
+        sched.shutdown()
+
+
+# ----------------------------------------------------------------------
+# Delta patcher (solver/warm_start.py).
+# ----------------------------------------------------------------------
+def test_align_rows_insert_delete():
+    from shockwave_tpu.solver import warm_start
+
+    out = warm_start.align_rows(
+        ["a", "b", "c"], [1.0, 2.0, 3.0], ["c", "new", "a"], fill=-5.0
+    )
+    assert out.tolist() == [3.0, -5.0, 1.0]
+
+
+def test_delta_patch_keeps_survivors_and_seeds_arrivals():
+    from shockwave_tpu.solver import warm_start
+
+    # Previous plan: a holds 4 rounds, b holds 2 on a 4-gpu x 8-round
+    # budget (32 gang-rounds; 6 used). c arrives, b departs.
+    s0 = warm_start.delta_patch_counts(
+        prev_ids=["a", "b"],
+        prev_counts=[4.0, 2.0],
+        new_ids=["a", "c"],
+        nworkers=[1.0, 1.0],
+        num_gpus=4,
+        future_rounds=8,
+    )
+    assert s0[0] == 4.0  # survivor keeps its counts
+    # Arrival seeded at the free budget (32 - 4 = 28), clipped to the
+    # 8-round window.
+    assert s0[1] == 8.0
+
+
+def test_delta_patch_splits_free_budget_across_gangs():
+    from shockwave_tpu.solver import warm_start
+
+    s0 = warm_start.delta_patch_counts(
+        prev_ids=["a"],
+        prev_counts=[4.0],
+        new_ids=["a", "g1", "g2"],
+        nworkers=[1.0, 2.0, 2.0],  # two 2-gpu gang arrivals
+        num_gpus=2,
+        future_rounds=10,
+    )
+    # Budget 20, used 4, free 16 across 4 gang-gpus -> 4 rounds each.
+    assert s0.tolist() == [4.0, 4.0, 4.0]
+
+
+def test_delta_patch_degenerate_cases():
+    from shockwave_tpu.solver import warm_start
+
+    assert warm_start.delta_patch_counts([], [], [], [], 4, 8) is None
+    # All-zero survivors and no arrivals: nothing useful to warm from.
+    assert (
+        warm_start.delta_patch_counts(
+            ["a"], [0.0], ["a"], [1.0], 4, 8
+        )
+        is None
+    )
+
+
+def test_planner_warm_start_survives_arrival_and_departure():
+    """The planner's pdhg warm start must stay row-aligned across job
+    churn: survivors keep their previous-plan counts, the arrival gets
+    a non-negative seed, the departure's row is gone."""
+    from shockwave_tpu.policies.shockwave import ShockwavePlanner
+
+    planner = ShockwavePlanner(
+        {
+            "num_gpus": 2,
+            "time_per_iteration": 60.0,
+            "future_rounds": 4,
+            "lambda": 2.0,
+            "k": 1e-3,
+        },
+        backend="pdhg",
+    )
+    profile = {
+        "num_epochs": 4,
+        "num_samples_per_epoch": 64,
+        "scale_factor": 1,
+        "bs_every_epoch": [32] * 4,
+        "duration_every_epoch": [120.0] * 4,
+    }
+    for j in range(3):
+        planner.add_job(j, dict(profile), 60.0, 1)
+    planner.current_round_schedule()  # first solve fills the cache
+    counts_before = {}
+    for r, schedule in planner.schedules.items():
+        if r >= planner.round_index:
+            for j in schedule:
+                counts_before[j] = counts_before.get(j, 0) + 1
+    planner.remove_job(2)
+    planner.add_job(7, dict(profile), 60.0, 1)
+    planner._plan_job_ids = [0, 1, 7]
+    s0 = planner._solution_warm_start()
+    assert s0 is not None and len(s0) == 3
+    assert s0[0] == counts_before.get(0, 0)
+    assert s0[1] == counts_before.get(1, 0)
+    assert s0[2] >= 0.0
+
+
+def test_job_axis_band_covers_arrivals_without_new_shapes():
+    """One compile covers a fleet-size band: the padded slot count is
+    constant across arrivals within the band, so an incremental replan
+    never recompiles."""
+    from shockwave_tpu.solver.eg_jax import num_slots_for
+
+    assert num_slots_for(65) == num_slots_for(128) == 128
+    assert num_slots_for(129) == 256
+
+
+# ----------------------------------------------------------------------
+# Streaming simulator path: exactly-once + backpressure end to end.
+# ----------------------------------------------------------------------
+def test_streaming_sim_exactly_once_under_submit_faults():
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.policies import get_policy
+
+    plan = faults.FaultPlan(
+        seed=0,
+        events=[
+            faults.FaultEvent(0, "rpc_drop", method="SubmitJobs"),
+            faults.FaultEvent(1, "rpc_error", method="SubmitJobs"),
+        ],
+    )
+    injector = faults.configure(plan)
+    jobs = [_job(steps_per_epoch("ResNet-18", 32) * 2) for _ in range(8)]
+    arrivals = [0.0] * 6 + [400.0] * 2  # burst of 6 against capacity 4
+    submitter = admission.StreamingSubmitter(arrivals, jobs, batch_size=2)
+    sched = Scheduler(
+        get_policy("max_min_fairness"),
+        throughputs=generate_oracle(),
+        seed=0,
+        time_per_iteration=120,
+    )
+    sched.simulate(
+        {"v100": 4},
+        submitter=submitter,
+        admission_capacity=4,
+        admission_retry_s=30.0,
+    )
+    assert sched._num_jobs_in_trace == 8, "double admission or lost job"
+    assert all(
+        t is not None for t in sched._job_completion_times.values()
+    )
+    adm = sched._admission.summary()
+    assert adm["rejected_batches"] >= 1, "backpressure never engaged"
+    assert adm["depth"] == 0, "queue did not drain"
+    assert adm["deduped_batches"] >= 1, "rpc_drop retry was not deduped"
+    assert adm["closed"]
+    assert submitter.stats["rpc_faults"] == 2
+    assert injector.summary()["unrecovered"] == []
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        {"checkpoint_threshold": 1, "checkpoint_file": "/tmp/never.pkl"},
+        {"checkpoint_file": "/tmp/never.pkl"},  # resume-only is unsafe too
+    ],
+)
+def test_streaming_sim_rejects_checkpointing(kwargs):
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.policies import get_policy
+
+    submitter = admission.StreamingSubmitter([0.0], [_job()])
+    sched = Scheduler(
+        get_policy("max_min_fairness"),
+        throughputs=generate_oracle(),
+        seed=0,
+    )
+    with pytest.raises(ValueError, match="checkpoint"):
+        sched.simulate({"v100": 2}, submitter=submitter, **kwargs)
+
+
+# ----------------------------------------------------------------------
+# Watchdog rules.
+# ----------------------------------------------------------------------
+def test_watchdog_admission_backlog_fires_and_rearms():
+    from shockwave_tpu.obs.watchdog import Watchdog
+
+    wd = Watchdog(enabled=True)
+    obs.configure(metrics=True)
+    obs.gauge("admission_queue_capacity", "t").set(10.0)
+    obs.gauge("admission_queue_depth", "t").set(9.0)
+    fired = wd.check_round(0, 0.0)
+    assert [a["rule"] for a in fired] == ["admission_backlog"]
+    # Drained: quiet round re-arms, a later deeper breach fires again.
+    obs.gauge("admission_queue_depth", "t").set(0.0)
+    assert wd.check_round(1, 1.0) == []
+    obs.gauge("admission_queue_depth", "t").set(10.0)
+    assert [a["rule"] for a in wd.check_round(2, 2.0)] == [
+        "admission_backlog"
+    ]
+
+
+def test_watchdog_replan_p99_needs_budget_and_fires_over_it():
+    from shockwave_tpu.obs.watchdog import Watchdog
+
+    obs.configure(metrics=True)
+    h = obs.histogram("shockwave_solve_seconds", "t")
+    for _ in range(20):
+        h.observe(0.02, backend="pdhg", ok="True")
+    h.observe(40.0, backend="pdhg", ok="True")  # the p99 tail
+    # No budget configured: the rule is inert.
+    wd = Watchdog(enabled=True)
+    assert wd.check_round(0, 0.0) == []
+    # Budgeted at the round length: the 40s tail breaches.
+    wd = Watchdog(
+        enabled=True, rules={"replan_p99": {"budget_s": 30.0}}
+    )
+    fired = wd.check_round(0, 0.0)
+    assert [a["rule"] for a in fired] == ["replan_p99"]
+    assert fired[0]["value"] > 30.0
+
+
+def test_watchdog_replan_p99_quiet_under_budget():
+    from shockwave_tpu.obs.watchdog import Watchdog
+
+    obs.configure(metrics=True)
+    h = obs.histogram("shockwave_solve_seconds", "t")
+    for _ in range(50):
+        h.observe(0.02, backend="pdhg", ok="True")
+    wd = Watchdog(
+        enabled=True, rules={"replan_p99": {"budget_s": 30.0}}
+    )
+    assert wd.check_round(0, 0.0) == []

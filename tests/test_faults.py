@@ -124,6 +124,66 @@ def test_injector_rpc_matching_and_recovery_pairing():
     assert summary["unrecovered"] == []
 
 
+def test_rpc_fault_kinds_filter_models_lost_response():
+    """The SubmitJobs client checks rpc_error/rpc_delay BEFORE the wire
+    send and rpc_drop AFTER it; the kinds filter must hold the drop
+    event back for the post-send site instead of letting the pre-send
+    check consume it as a lost request."""
+    plan = faults.FaultPlan(
+        seed=0,
+        events=[faults.FaultEvent(0, "rpc_drop", method="SubmitJobs")],
+    )
+    injector = faults.configure(plan)
+    # Pre-send site: must NOT consume the armed drop.
+    faults.check_rpc("SubmitJobs", kinds=("rpc_error", "rpc_delay"))
+    assert injector.summary()["pending_rpc"] == 1
+    # Post-send site: the drop fires as a lost response.
+    with pytest.raises(faults.InjectedRpcError):
+        faults.check_rpc("SubmitJobs", kinds=("rpc_drop",))
+    faults.note_rpc_success("SubmitJobs")  # the deduplicated retry
+    assert injector.summary()["unrecovered"] == []
+
+
+def test_arrival_campaign_is_deterministic_and_bursty():
+    a1 = faults.generate_arrival_campaign(3, 100, 5000.0)
+    a2 = faults.generate_arrival_campaign(3, 100, 5000.0)
+    a3 = faults.generate_arrival_campaign(4, 100, 5000.0)
+    assert a1 == a2
+    assert a1 != a3
+    assert len(a1) == 100
+    assert a1 == sorted(a1)
+    assert all(0.0 <= t <= 5000.0 for t in a1)
+    # Bursts: some window of 2% of the horizon holds far more than the
+    # uniform share of arrivals.
+    width = 5000.0 * 0.02
+    densest = max(
+        sum(1 for t in a1 if start <= t <= start + width) for start in a1
+    )
+    assert densest >= 10, "no burst found in the campaign"
+
+
+def test_streaming_plan_composes_churn_and_submit_faults():
+    arrivals, plan = faults.generate_streaming_plan(
+        5, 40, 4000.0, 8, target_churn_events=60, submit_faults=4
+    )
+    assert len(arrivals) == 40
+    kinds = [e.kind for e in plan.events]
+    assert kinds.count("rpc_drop") == 2
+    assert kinds.count("rpc_error") == 2
+    assert all(
+        e.method == "SubmitJobs"
+        for e in plan.events
+        if e.kind in faults.RPC_KINDS
+    )
+    assert {"worker_add", "solver_timeout"} <= set(kinds)
+    # Deterministic end to end (the committed-artifact contract).
+    arrivals_b, plan_b = faults.generate_streaming_plan(
+        5, 40, 4000.0, 8, target_churn_events=60, submit_faults=4
+    )
+    assert arrivals == arrivals_b
+    assert plan.to_json() == plan_b.to_json()
+
+
 def test_env_gating_arms_injector(tmp_path, monkeypatch):
     plan = faults.FaultPlan(
         seed=3, events=[faults.FaultEvent(0, "rpc_error", method="Done")]

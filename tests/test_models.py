@@ -66,9 +66,19 @@ def test_family_train_steps_reduce_loss(family):
         variables, opt_state, loss = step(variables, opt_state, batch)
         losses.append(float(loss))
     assert np.all(np.isfinite(losses))
-    if family != "A3C":
-        # The A3C surrogate (policy gradient + entropy bonus) is not a
-        # monotone-descent objective; finiteness is the contract there.
+    if family not in ("A3C", "CycleGAN"):
+        # Two families are NOT monotone-descent objectives, and
+        # asserting descent on them was a category error (pre-existing
+        # flaky debt since PR 3, burned down here):
+        #   * A3C — policy gradient + entropy bonus, a surrogate whose
+        #     scalar moves with the sampled advantage;
+        #   * CycleGAN — the recorded scalar is gen_loss + disc_loss of
+        #     an adversarial minimax game: every generator improvement
+        #     RAISES the discriminator's loss on the better fakes (and
+        #     vice versa), so the sum oscillates by construction even
+        #     when both players are training correctly.
+        # Finiteness is the contract for both; the dense families keep
+        # the strict descent gate.
         assert losses[-1] < losses[0]
 
 
