@@ -1,0 +1,110 @@
+#!/usr/bin/env python3
+"""CI gate: reduced-scale cell-decomposed-market smoke.
+
+Runs the cells-vs-global quality A/B at a small seeded shape and a
+small :class:`CellPlanner` churn run with the flight recorder on, then
+asserts the decomposition contract:
+
+  * objective gap of the merged cell schedule vs the global solve
+    within tolerance (0.5% — the committed full-scale A/B sits at
+    ~1e-6%),
+  * capacity conservation (the merged schedule audits feasible against
+    the GLOBAL problem every round),
+  * the cell-decomposed decision log replays EXACTLY, record by record
+    (coordinated replans, warm starts, reconciliation state).
+
+Regenerates ``results/cells/cells_smoke.json``; exits 1 on any
+violated invariant. Wired into the verify skill next to
+``chaos_smoke.py`` / ``churn_smoke.py``.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "microbenchmarks",
+    ),
+)
+
+GAP_TOLERANCE_PCT = 0.5
+
+
+def run() -> int:
+    from bench_cells_scale import quality_ab, scale_run  # noqa: E402
+
+    from shockwave_tpu.utils.fileio import atomic_write_json
+
+    failures = []
+    t0 = time.time()
+    ab = quality_ab(num_cells=4, jobs=256, gpus=64, rounds=20)
+    if ab["objective_gap_pct"] > GAP_TOLERANCE_PCT:
+        failures.append(
+            f"cells-vs-global objective gap {ab['objective_gap_pct']}% "
+            f"> {GAP_TOLERANCE_PCT}%"
+        )
+    if not ab["capacity_conserved"]:
+        failures.append("merged cell schedule violated fleet capacity")
+
+    log = "/tmp/cells_smoke_decisions.jsonl"
+    if os.path.exists(log):
+        os.unlink(log)
+    try:
+        scale = scale_run(
+            jobs=800,
+            num_cells=4,
+            gpus=256,
+            churn_rounds=3,
+            churn_jobs=6,
+            baseline_jobs=400,
+            decision_log=log,
+            replay=True,
+        )
+    except AssertionError as e:
+        failures.append(str(e))
+        scale = {"error": str(e)}
+    else:
+        replay = scale.get("replay") or {}
+        if replay.get("exact") != replay.get("records"):
+            failures.append(
+                f"replay inexact: {replay}"
+            )
+
+    record = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "gate": "cells_smoke",
+        "wall_s": round(time.time() - t0, 1),
+        "quality_ab": ab,
+        "churn_run": scale,
+        "failures": failures,
+        "status": "PASS" if not failures else "FAIL",
+    }
+    out = os.path.join(
+        os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ),
+        "results", "cells", "cells_smoke.json",
+    )
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    atomic_write_json(out, record)
+    print(json.dumps(record, indent=2))
+    if failures:
+        print("cells smoke gate FAIL:", "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    print("cells smoke gate PASS", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(run())

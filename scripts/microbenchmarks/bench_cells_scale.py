@@ -1,0 +1,345 @@
+#!/usr/bin/env python3
+"""Cell-decomposed market: quality A/B + the scale story, committed.
+
+Two experiments, one artifact (``results/cells/cells_scale.json``):
+
+**A. Quality A/B at the 1k reference shape.** The bench stress problem
+(1000 jobs x 256 gpus x 50 rounds) solved globally (pdhg backend) vs
+decomposed into cells; the merged cell schedule is audited for
+feasibility against the GLOBAL problem (capacity conservation proof)
+and its objective gap vs the global solve is reported.
+
+**B. Scale run: 10x the 10k bench shape at flat per-round latency.**
+A 100k-job fleet partitioned into cells, driven through the
+:class:`CellPlanner` with the flight recorder on. Round 0 pays the
+one-time cold coordinated solve (every cell stale); every following
+round applies churn to ONE cell (departures + arrivals) and replans —
+the selective-replan property means the per-round plan solve touches
+only the churned cell's lanes, which is the whole point of the
+decomposition: per-round planning cost is bounded by the churned
+cells, not the fleet. The baseline is the single-market planner at the
+10k bench shape taking the same churn (a global solve re-derives the
+whole fleet every round, whatever churned). The decision log is then
+replayed record-by-record and must reproduce every plan exactly.
+
+Honesty notes recorded in the artifact: this host is a 2-core CPU
+box, so the COLD full-fleet solve (all lanes stale) cannot be
+wall-clock flat — 10x the rows is 10x the flops on fixed hardware;
+flat cold solves need the cells sharded over their own devices (the
+``cell_mesh`` knob; no multi-chip host here). The steady-state
+per-round number IS the serving-path latency, and it is measured, not
+modeled.
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+import numpy as np  # noqa: E402
+
+from shockwave_tpu.utils.fileio import atomic_write_json  # noqa: E402
+
+
+def quality_ab(num_cells=8, jobs=1000, gpus=256, rounds=50, seed=0):
+    """Experiment A: cells-vs-global objective gap at the 1k shape."""
+    import dataclasses
+
+    import bench
+    from shockwave_tpu.cells import batched, partition
+    from shockwave_tpu.solver.eg_pdhg import solve_eg_pdhg
+
+    g = bench.make_problem(
+        num_jobs=jobs, future_rounds=rounds, num_gpus=gpus, seed=seed
+    )
+    t0 = time.time()
+    Y_global = solve_eg_pdhg(g)
+    global_s = time.time() - t0
+    g.audit_schedule(Y_global)
+    obj_global = g.objective_value(Y_global)
+
+    caps = partition.partition_capacity(g.num_gpus, num_cells)
+    cells, indices = [], []
+    for c in range(num_cells):
+        idx = np.arange(c, g.num_jobs, num_cells)
+        fields = {
+            f: getattr(g, f)[idx]
+            for f in (
+                "priorities", "completed_epochs", "total_epochs",
+                "epoch_duration", "remaining_runtime", "nworkers",
+                "switch_cost", "incumbent",
+            )
+        }
+        cells.append(dataclasses.replace(g, num_gpus=caps[c], **fields))
+        indices.append(idx)
+    batched.solve_cells_pdhg(cells)  # compile
+    t0 = time.time()
+    s_list, _, diags = batched.solve_cells_pdhg(cells)
+    cells_s = time.time() - t0
+    merged = np.zeros_like(Y_global)
+    for cell, idx, s in zip(cells, indices, s_list):
+        merged[idx] = batched.schedule_cell(cell, s)
+    # Feasibility against the GLOBAL problem: capacity conserved.
+    g.audit_schedule(merged)
+    obj_cells = g.objective_value(merged)
+    gap_pct = 100.0 * (obj_global - obj_cells) / abs(obj_global)
+    return {
+        "config": f"{jobs} jobs x {gpus} gpus x {rounds} rounds",
+        "num_cells": num_cells,
+        "objective_global": round(obj_global, 4),
+        "objective_cells": round(obj_cells, 4),
+        "objective_gap_pct": round(gap_pct, 6),
+        "capacity_conserved": True,  # audit_schedule raised otherwise
+        "global_solve_s": round(global_s, 4),
+        "cells_batched_solve_s": round(cells_s, 4),
+        "max_cell_cycles": max(d["cycles"] for d in diags),
+    }
+
+
+def _profile(rng, epochs=4):
+    return {
+        "num_epochs": epochs,
+        "num_samples_per_epoch": 64,
+        "scale_factor": 1,
+        "bs_every_epoch": [32] * epochs,
+        "duration_every_epoch": [
+            float(rng.uniform(60.0, 2000.0))
+        ] * epochs,
+    }
+
+
+def _drive(planner, rng, churn_rounds, churn_jobs, next_id, capacity):
+    """Apply per-round churn + replan to either planner kind; returns
+    (per-round solve seconds, per-round wall seconds, stale counts)."""
+    from shockwave_tpu.cells.planner import CellPlanner
+
+    solve_s, wall_s, stale = [], [], []
+    is_cells = isinstance(planner, CellPlanner)
+    for _ in range(churn_rounds):
+        planner.increment_round()
+        # Churn: departures then arrivals (the arrivals land in the
+        # drained cell — least loaded — so ONE cell goes stale).
+        jobs = list(planner.job_cell) if is_cells else list(
+            planner.job_metadata
+        )
+        victims = [jobs[int(i)] for i in
+                   rng.choice(len(jobs), size=churn_jobs, replace=False)]
+        for v in victims:
+            planner.remove_job(v)
+        # Only ARRIVALS stale a cell (a new job must be planned in);
+        # departures ride the cached window until it goes dead — the
+        # same trigger discipline the streaming admission path uses.
+        # Arrivals concentrate in the least-loaded (just-drained)
+        # cells, so the stale set stays small: that bounded set is the
+        # selective-replan property under measurement.
+        touched = set()
+        for _ in range(churn_jobs):
+            planner.add_job(next_id[0], _profile(rng), 120.0, 1)
+            if is_cells:
+                touched.add(planner.job_cell[next_id[0]])
+            next_id[0] += 1
+        if is_cells:
+            for name in touched:
+                planner.children[name].set_recompute_flag()
+        else:
+            planner.set_recompute_flag()
+        t0 = time.time()
+        schedule = planner.current_round_schedule()
+        wall_s.append(time.time() - t0)
+        assert schedule is not None
+        if is_cells:
+            record = planner.coord_solve_records[-1]
+            solve_s.append(record["seconds"])
+            stale.append(record["stale_cells"])
+            # Capacity conservation every round: merged usage <= fleet.
+            used = sum(
+                1
+                for child in planner.children.values()
+                for _ in child.schedules.get(child.round_index, [])
+            )
+            assert used <= capacity, (used, capacity)
+        else:
+            solve_s.append(planner.solve_records[-1]["seconds"])
+            stale.append(1)
+    return solve_s, wall_s, stale
+
+
+def scale_run(
+    jobs=100_000,
+    num_cells=16,
+    gpus=25_600,
+    churn_rounds=6,
+    churn_jobs=20,
+    baseline_jobs=10_000,
+    decision_log=None,
+    replay=True,
+):
+    """Experiment B: the 10x-job-count scale run + exact replay."""
+    from shockwave_tpu import obs
+    from shockwave_tpu.cells.planner import CellPlanner
+    from shockwave_tpu.obs.recorder import replay_log
+    from shockwave_tpu.policies.shockwave import ShockwavePlanner
+
+    config = {
+        "num_gpus": gpus,
+        "time_per_iteration": 120.0,
+        "future_rounds": 50,
+        "lambda": 5.0,
+        "k": 10.0,
+        "cells": num_cells,
+    }
+    rng = np.random.default_rng(0)
+    obs.reset()
+    if decision_log:
+        if os.path.exists(decision_log):
+            os.unlink(decision_log)  # the recorder appends
+        obs.configure_recorder(decision_log)
+    planner = CellPlanner(config, backend="cells")
+    t0 = time.time()
+    for j in range(jobs):
+        planner.add_job(j, _profile(rng), 120.0, 1)
+    admit_s = time.time() - t0
+    t0 = time.time()
+    assert planner.current_round_schedule()
+    cold_wall_s = time.time() - t0
+    cold_solve_s = planner.coord_solve_records[-1]["seconds"]
+    next_id = [jobs]
+    solve_s, wall_s, stale = _drive(
+        planner, rng, churn_rounds, churn_jobs, next_id, gpus
+    )
+    if decision_log:
+        obs.get_recorder().close()
+    obs.reset()
+
+    # Baseline: the single global market at the 10k bench shape, same
+    # churn pattern — every round re-derives the whole fleet.
+    rng_b = np.random.default_rng(1)
+    base = ShockwavePlanner(
+        {**{k: v for k, v in config.items() if k != "cells"},
+         "num_gpus": baseline_jobs // 4},
+        backend="pdhg",
+    )
+    for j in range(baseline_jobs):
+        base.add_job(f"b{j}", _profile(rng_b), 120.0, 1)
+    t0 = time.time()
+    assert base.current_round_schedule()
+    base_cold_s = time.time() - t0
+    base_next = [baseline_jobs]
+    base_solve_s, base_wall_s, _ = _drive(
+        base, rng_b, churn_rounds, churn_jobs, base_next,
+        baseline_jobs // 4,
+    )
+
+    replay_result = None
+    if decision_log and replay:
+        t0 = time.time()
+        results = replay_log(decision_log)
+        replay_result = {
+            "records": len(results),
+            "exact": sum(1 for r in results if not r["diff"]),
+            "replay_s": round(time.time() - t0, 2),
+        }
+        assert replay_result["exact"] == replay_result["records"], (
+            "cell-decomposed decision log did NOT replay exactly: "
+            f"{[r['diff'] for r in results if r['diff']]}"
+        )
+
+    steady = statistics.median(solve_s)
+    base_steady = statistics.median(base_solve_s)
+    return {
+        "config": (
+            f"{jobs} jobs x {gpus} gpus x 50 rounds in {num_cells} "
+            f"cells; churn {churn_jobs} jobs/round x {churn_rounds} "
+            "rounds"
+        ),
+        "jobs": jobs,
+        "num_cells": num_cells,
+        "job_count_multiple_vs_baseline": round(jobs / baseline_jobs, 1),
+        "admit_100k_s": round(admit_s, 2),
+        "cold_solve_s": round(cold_solve_s, 3),
+        "cold_wall_s": round(cold_wall_s, 2),
+        "steady_state_solve_s": [round(t, 4) for t in solve_s],
+        "steady_state_solve_median_s": round(steady, 4),
+        "steady_state_wall_median_s": round(
+            statistics.median(wall_s), 3
+        ),
+        "stale_cells_per_round": stale,
+        "baseline_config": (
+            f"{baseline_jobs} jobs x {baseline_jobs // 4} gpus, single "
+            "global pdhg market, same churn"
+        ),
+        "baseline_cold_wall_s": round(base_cold_s, 2),
+        "baseline_steady_state_solve_s": [
+            round(t, 4) for t in base_solve_s
+        ],
+        "baseline_steady_state_solve_median_s": round(base_steady, 4),
+        "per_round_latency_ratio_vs_10k_baseline": round(
+            steady / max(base_steady, 1e-9), 3
+        ),
+        "latency_flat_within_2x": bool(steady <= 2.0 * base_steady),
+        "replay": replay_result,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--jobs", type=int, default=100_000)
+    ap.add_argument("--cells", type=int, default=16)
+    ap.add_argument("--gpus", type=int, default=25_600)
+    ap.add_argument("--churn-rounds", type=int, default=6)
+    ap.add_argument("--churn-jobs", type=int, default=20)
+    ap.add_argument("--out", default="results/cells/cells_scale.json")
+    # The full-scale decision log is ~300 MB (7 federation snapshots of
+    # a 100k-job fleet) — replayed in-process for the exactness proof,
+    # not committed.
+    ap.add_argument("--decision-log",
+                    default="/tmp/cells_scale_decisions.jsonl")
+    ap.add_argument("--skip-replay", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    print("== A: quality A/B at the 1k reference shape ==", flush=True)
+    ab = quality_ab()
+    print(json.dumps(ab, indent=2), flush=True)
+    print("== B: 10x scale run ==", flush=True)
+    scale = scale_run(
+        jobs=args.jobs,
+        num_cells=args.cells,
+        gpus=args.gpus,
+        churn_rounds=args.churn_rounds,
+        churn_jobs=args.churn_jobs,
+        decision_log=args.decision_log,
+        replay=not args.skip_replay,
+    )
+    print(json.dumps(scale, indent=2), flush=True)
+    entry = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "platform": jax.devices()[0].platform,
+        "physical_cores": os.cpu_count(),
+        "quality_ab_1k": ab,
+        "scale_run": scale,
+        "honesty": (
+            "steady-state per-round latency is the measured serving-"
+            "path number (selective replan: only churned cells "
+            "re-solve); the cold full-fleet solve scales with total "
+            "rows on this fixed 2-core host — flat cold solves need "
+            "cells sharded over their own devices (cell_mesh)"
+        ),
+    }
+    atomic_write_json(args.out, entry)
+    print(f"wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,154 @@
+#!/usr/bin/env python3
+"""Sharded-PDHG mesh measurement (ROADMAP PR-8 follow-on): single- vs
+multi-device restarted-PDHG at fleet scale, and the dispatch-threshold
+recommendation folded into ``solve_eg_pdhg``'s latency-aware routing.
+
+For each job count, times :func:`solve_pdhg_relaxed` (single device)
+against :func:`solve_pdhg_relaxed_sharded` over 2/4/8-shard meshes,
+cross-checking the iterates agree within the sharded-solver tolerance
+tests pin. Emits ``results/pdhg_sharded_mesh.json`` with a
+``recommended_min_jobs`` crossover: the smallest measured job count at
+which the full mesh beats the single device (``null`` when it never
+does — the honest outcome on a shared-core virtual mesh, where the
+default ``SHARDED_PDHG_MIN_JOBS`` stays a memory-headroom bound, not a
+latency bound). Deployments on real multi-chip hosts re-run this and
+export ``SHOCKWAVE_PDHG_SHARDED_MIN_JOBS`` from the measured
+crossover.
+
+Usage:
+  python scripts/microbenchmarks/sweep_pdhg_sharded.py          # CPU mesh
+  python scripts/microbenchmarks/sweep_pdhg_sharded.py --tpu    # real chips
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+from shockwave_tpu.utils.fileio import atomic_write_json  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tpu", action="store_true",
+                    help="run on the real accelerator(s) instead of the "
+                         "8-virtual-device CPU mesh")
+    ap.add_argument("--jobs", type=int, nargs="*",
+                    default=[8192, 16384, 32768])
+    ap.add_argument("--rounds", type=int, default=50)
+    ap.add_argument("--out", default="results/pdhg_sharded_mesh.json")
+    args = ap.parse_args()
+
+    if not args.tpu:
+        from shockwave_tpu.utils.virtual_devices import force_cpu_device_env
+
+        force_cpu_device_env(8)
+
+    import jax
+
+    if not args.tpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    from jax.sharding import Mesh
+
+    import bench
+    from shockwave_tpu.solver.eg_pdhg import (
+        SHARDED_PDHG_MIN_JOBS,
+        solve_pdhg_relaxed,
+        solve_pdhg_relaxed_sharded,
+    )
+
+    def timed(fn, reps=3):
+        fn()  # warm / compile
+        t0 = time.time()
+        out = None
+        for _ in range(reps):
+            out = fn()
+        return (time.time() - t0) / reps, out
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    shard_counts = [n for n in (2, 4, 8) if n <= n_dev]
+    rows = []
+    recommended = None
+    for jobs in sorted(args.jobs):
+        p = bench.make_problem(
+            num_jobs=jobs, future_rounds=args.rounds, num_gpus=jobs // 4
+        )
+        t_single, (s1, obj1, _) = timed(lambda: solve_pdhg_relaxed(p))
+        row = {
+            "jobs": jobs,
+            "single_device_s": round(t_single, 4),
+            "sharded": [],
+        }
+        for n in shard_counts:
+            mesh = Mesh(np.array(jax.devices()[:n]), ("solve",))
+            t_shard, (s_n, obj_n, _) = timed(
+                lambda: solve_pdhg_relaxed_sharded(p, mesh=mesh)
+            )
+            agree = bool(
+                abs(obj_n - obj1) <= 1e-3 * (1.0 + abs(obj1))
+                and np.allclose(s_n, s1, rtol=5e-3, atol=5e-3)
+            )
+            row["sharded"].append(
+                {
+                    "shards": n,
+                    "wall_s": round(t_shard, 4),
+                    "agrees_with_single": agree,
+                    "speedup": round(t_single / max(t_shard, 1e-9), 3),
+                }
+            )
+            print(
+                f"jobs={jobs} shards={n}: {t_shard:.3f}s vs single "
+                f"{t_single:.3f}s agree={agree}"
+            )
+            assert agree, "sharded PDHG diverged from single device"
+        best = min(row["sharded"], key=lambda r: r["wall_s"])
+        if best["wall_s"] < row["single_device_s"] and recommended is None:
+            recommended = jobs
+        rows.append(row)
+
+    entry = {
+        "config": f"jobs x (jobs/4) gpus x {args.rounds} rounds",
+        "platform": platform,
+        "physical_cores": os.cpu_count(),
+        "devices": n_dev,
+        "rows": rows,
+        "recommended_min_jobs": recommended,
+        "default_min_jobs": SHARDED_PDHG_MIN_JOBS,
+        "dispatch_note": (
+            "solve_eg_pdhg routes to the mesh at "
+            "sharded_min_jobs() jobs; export "
+            "SHOCKWAVE_PDHG_SHARDED_MIN_JOBS=<recommended_min_jobs> on "
+            "hosts where the crossover is measured"
+        ),
+        "caveat": (
+            "virtual CPU shards time-slice the same core(s): wall-clock "
+            "cannot beat single-device here; the number that matters is "
+            "agreement plus the collective profile (scalar psums/pmax "
+            "only), which scales on real ICI"
+        )
+        if platform == "cpu"
+        else "real accelerator timing",
+    }
+
+    out = {}
+    if os.path.exists(args.out):
+        with open(args.out) as f:
+            out = json.load(f)
+    out[platform] = entry
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    atomic_write_json(args.out, out)
+    print(f"wrote {args.out} [{platform}]", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

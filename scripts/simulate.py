@@ -94,6 +94,11 @@ def main(args):
         shockwave_config["num_gpus"] = cluster_spec.get(
             "v100", sum(cluster_spec.values())
         )
+        if args.cells:
+            # Cell-decomposed market: partition the fleet into N cells
+            # (shockwave_tpu/cells/), selective per-cell replanning +
+            # reconciling coordinator.
+            shockwave_config["cells"] = int(args.cells)
 
     preemption_overheads = None
     if args.preemption_overheads:
@@ -239,6 +244,11 @@ if __name__ == "__main__":
     parser.add_argument("-s", "--window-start", type=int, default=None)
     parser.add_argument("-e", "--window-end", type=int, default=None)
     parser.add_argument("--config", type=str, default=None, help="Shockwave JSON config")
+    parser.add_argument(
+        "--cells", type=int, default=0,
+        help="partition the shockwave fleet into N cells (cell-"
+        "decomposed market; 0/1 = one global solve)",
+    )
     parser.add_argument("--output_pickle", type=str, default=None)
     parser.add_argument(
         "--round_log",

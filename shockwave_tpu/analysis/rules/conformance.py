@@ -31,10 +31,14 @@ _BACKEND_GLOBS = (
 _NON_BACKEND_FILES = {"shockwave_tpu/solver/eg_problem.py"}
 _PLANNER_FILE = "shockwave_tpu/policies/shockwave.py"
 _WARM_START_FILE = "shockwave_tpu/solver/eg_jax.py"
+_CELLS_FILE = "shockwave_tpu/cells/planner.py"
+_CELLS_COORD_FILE = "shockwave_tpu/cells/coordinator.py"
 
-# Dispatch branches the planner must keep: one per registered backend.
+# Dispatch branches the planner must keep: one per registered backend
+# ("cells" routes to the partitioned-market CellPlanner federation).
 REQUIRED_BACKENDS = (
     "reference", "native", "level", "sharded", "relaxed", "pdhg",
+    "cells",
 )
 
 # Fallback rungs the planner's degradation ladder must register (the
@@ -68,6 +72,8 @@ class SolverBackendConformance(Rule):
         return _is_backend_module(relpath) or relpath in (
             _PLANNER_FILE,
             _WARM_START_FILE,
+            _CELLS_FILE,
+            _CELLS_COORD_FILE,
         )
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
@@ -77,6 +83,10 @@ class SolverBackendConformance(Rule):
             yield from self._check_warm_start(ctx)
         if ctx.relpath == _PLANNER_FILE:
             yield from self._check_planner(ctx)
+        if ctx.relpath == _CELLS_FILE:
+            yield from self._check_cells(ctx)
+        if ctx.relpath == _CELLS_COORD_FILE:
+            yield from self._check_cells_coordinator(ctx)
 
     # -- backend modules ------------------------------------------------
 
@@ -139,6 +149,58 @@ class SolverBackendConformance(Rule):
                 "solver/eg_jax.py no longer references the warm_start "
                 "cache — the sub-2s cold-start contract "
                 "(solve_level_counts) is broken",
+            )
+
+    # -- cell federation ------------------------------------------------
+
+    def _check_cells(self, ctx: FileContext):
+        """The cell-decomposed coordinator's own contract: it must keep
+        a coordinated ``_replan`` (the flight-recorder replay entry
+        point), price migrations through the switching-cost term, and
+        route per-cell solves through the child planner's solve path so
+        each cell keeps the degradation ladder (a cell-solver timeout
+        degrades that cell only)."""
+        has_replan = any(
+            isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name == "_replan"
+            for n in ast.walk(ctx.tree)
+        )
+        if not has_replan:
+            yield self.finding(
+                ctx,
+                1,
+                "cells/planner.py no longer defines _replan() — the "
+                "coordinated replan is the flight-recorder replay "
+                "contract for cell-decomposed runs",
+            )
+        if not (
+            self._references(ctx, "_solve")
+            and self._references(ctx, "_solve_backend")
+        ):
+            yield self.finding(
+                ctx,
+                1,
+                "cells/planner.py no longer routes per-cell solves "
+                "through the child planner's _solve/_solve_backend "
+                "path — cells would lose the degradation ladder (and "
+                "replay could not re-enter a degraded cell's backend)",
+            )
+
+    def _check_cells_coordinator(self, ctx: FileContext):
+        """Migration pricing: the coordinator must keep weighing the
+        switching-cost term when it plans cross-cell moves."""
+        has_switch_term = self._references(ctx, "switch_bonus") or (
+            self._references(ctx, "switch_cost")
+            and self._references(ctx, "incumbent")
+        )
+        if not has_switch_term:
+            yield self.finding(
+                ctx,
+                1,
+                "cells/coordinator.py never references the "
+                "switching-cost term — cross-cell migrations would be "
+                "free, thrashing incumbents the objective exists to "
+                "protect",
             )
 
     # -- planner facade -------------------------------------------------

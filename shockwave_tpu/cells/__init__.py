@@ -1,0 +1,28 @@
+"""Cell-decomposed market: partitioned EG solves with a reconciling
+coordinator (ROADMAP item 2, CvxCluster direction).
+
+One global Eisenberg-Gale solve is a single latency and failure domain:
+every job rides one solve, one compile, one timeout. This package
+splits the fleet into *cells* — independent EG markets over disjoint
+job sets and capacity slices — and recovers the coupling (total
+capacity, cross-cell load balance) with a cheap top-level coordinator:
+
+  * :mod:`shockwave_tpu.cells.partition` — capacity partitioning and
+    least-loaded cell assignment at admission.
+  * :mod:`shockwave_tpu.cells.batched` — the whole fleet of cells
+    solved as ONE batched ``vmap`` dispatch of the restarted-PDHG
+    kernel (one compile per (lane-band, slot-band); optionally
+    ``shard_map``-ed over the cell axis so each device owns its cells
+    with zero collectives).
+  * :mod:`shockwave_tpu.cells.coordinator` — congestion prices from
+    each cell's solved allocation, the capacity-reconciliation step
+    (chips flow from cheap cells to congested ones), and migration
+    planning priced through the PR-1 switching-cost term.
+  * :mod:`shockwave_tpu.cells.planner` — :class:`CellPlanner`, the
+    scheduler-facing federation conforming to the single-planner
+    contract, with selective replanning (only stale cells re-solve),
+    per-cell degradation (a cell-solver timeout degrades that cell
+    only), and coordinator-level flight-recorder exactness.
+"""
+
+from shockwave_tpu.cells.planner import CellPlanner  # noqa: F401

@@ -1,0 +1,255 @@
+"""Batched per-cell PDHG: the whole fleet of cells in ONE dispatch.
+
+Every cell's market is the same J-slot restarted-PDHG saddle-point
+solve (:func:`shockwave_tpu.solver.eg_pdhg._pdhg_core`); ``vmap`` over
+a leading cell axis turns C independent cell solves into one device
+program — one compile covers the fleet, and each lane early-stops on
+its own residual/stall criterion (vmap's while_loop batching masks
+finished lanes, so the batch runs for the SLOWEST cell's cycles, not
+the sum).
+
+Lane-count banding: the number of lanes is padded to a power of two
+with inert lanes (all-inactive job masks, 1-chip capacity), so
+selective replanning — this round 2 stale cells, next round 5 — reuses
+at most log2(C)+1 compiled programs instead of one per stale-count.
+
+Mesh path: with ``mesh`` set the SAME kernel runs under ``shard_map``
+with the cell axis split over devices. There are no cross-cell
+collectives — cells are independent by construction, the coordinator
+handles coupling on host — so each device computes its own cells'
+markets concurrently. This is the planet-scale shape: per-device work
+is one cell's rows regardless of fleet size.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from shockwave_tpu.analysis import sanitize
+from shockwave_tpu.solver.eg_pdhg import (
+    DEFAULT_INNER_ITERS,
+    DEFAULT_MAX_CYCLES,
+    DEFAULT_TOL,
+    _STALL_REL,
+    _default_s0,
+    _packed_args,
+    _pdhg_core,
+)
+from shockwave_tpu.solver.eg_jax import num_slots_for
+from shockwave_tpu.solver.eg_problem import EGProblem
+
+
+def lane_band(num_lanes: int) -> int:
+    """Next power-of-two lane count >= num_lanes (bounds recompiles
+    across varying stale-cell sets)."""
+    n = 1
+    while n < int(num_lanes):
+        n *= 2
+    return n
+
+
+@functools.partial(jax.jit, static_argnames=("max_cycles", "inner_iters"))
+def _solve_cells_kernel(
+    active,  # [C, J]
+    priorities,
+    completed,
+    total,
+    epoch_dur,
+    remaining,
+    nworkers,
+    switch_bonus,
+    s0,
+    num_gpus,  # [C]: per-cell capacity — the only per-cell scalar
+    round_duration,
+    future_rounds,
+    regularizer,
+    tol,
+    stall_rel,
+    max_cycles: int = DEFAULT_MAX_CYCLES,
+    inner_iters: int = DEFAULT_INNER_ITERS,
+):
+    core = functools.partial(
+        _pdhg_core,
+        max_cycles=max_cycles,
+        inner_iters=inner_iters,
+        axis_name=None,
+    )
+    return jax.vmap(
+        lambda *a: core(*a), in_axes=(0,) * 10 + (None,) * 5
+    )(
+        active, priorities, completed, total, epoch_dur, remaining,
+        nworkers, switch_bonus, s0, num_gpus,
+        round_duration, future_rounds, regularizer, tol, stall_rel,
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _build_cells_sharded(mesh: Mesh, axis: str, max_cycles, inner_iters):
+    """shard_map the batched kernel over the cell axis: no collectives
+    (cells are independent), so this is a pure split of lanes across
+    devices."""
+    from shockwave_tpu.utils.compat import shard_map
+
+    def kernel(*args):
+        return _solve_cells_kernel(
+            *args, max_cycles=max_cycles, inner_iters=inner_iters
+        )
+
+    spec_c = P(axis)
+    spec_rep = P()
+    diag_spec = {
+        k: spec_c
+        for k in (
+            "cycles", "iterations", "restarts", "residual", "residual0",
+            "converged", "welfare_filled",
+        )
+    }
+    fn = shard_map(
+        kernel,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(spec_c,) * 10 + (spec_rep,) * 5,
+        out_specs=(spec_c, spec_c, diag_spec),
+    )
+    return jax.jit(fn)
+
+
+def _stack_cells(
+    problems: Sequence[EGProblem],
+    s0s: Sequence[Optional[np.ndarray]],
+    slots: int,
+    lanes: int,
+):
+    """Pack C cell problems into [lanes, slots] arrays; lanes past C
+    are inert (no active jobs, 1-chip capacity)."""
+    per_cell = [
+        _packed_args(p, slots, s0s[i]) for i, p in enumerate(problems)
+    ]
+    stacked = []
+    for field in range(9):
+        rows = [np.asarray(args[field]) for args in per_cell]
+        rows += [np.zeros(slots, np.float32)] * (lanes - len(per_cell))
+        stacked.append(jnp.asarray(np.stack(rows)))
+    gpus = [float(p.num_gpus) for p in problems]
+    gpus += [1.0] * (lanes - len(problems))
+    stacked.append(jnp.asarray(np.asarray(gpus, np.float32)))
+    return stacked
+
+
+def solve_cells_pdhg(
+    problems: Sequence[EGProblem],
+    s0s: Optional[Sequence[Optional[np.ndarray]]] = None,
+    tol: float = DEFAULT_TOL,
+    stall_rel: float = _STALL_REL,
+    max_cycles: int = DEFAULT_MAX_CYCLES,
+    inner_iters: int = DEFAULT_INNER_ITERS,
+    slots: Optional[int] = None,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "cells",
+) -> Tuple[List[np.ndarray], List[float], List[dict]]:
+    """Solve every cell's relaxed EG market in one batched dispatch.
+
+    All problems must share ``round_duration`` / ``future_rounds`` /
+    ``regularizer`` (one fleet, one planning config — asserted).
+    Returns per-cell ``(s [num_jobs] float64, objective, diagnostics)``
+    lists; lane results are bit-identical to the single-cell
+    :func:`shockwave_tpu.solver.eg_pdhg.solve_pdhg_relaxed` on the
+    same inputs (pinned by tests), so a cell's market does not change
+    meaning by being solved next to its neighbors.
+    """
+    if not problems:
+        return [], [], []
+    ref = problems[0]
+    for p in problems[1:]:
+        assert (
+            p.round_duration == ref.round_duration
+            and p.future_rounds == ref.future_rounds
+            and p.regularizer == ref.regularizer
+        ), "cells must share the fleet planning config"
+    if s0s is None:
+        s0s = [None] * len(problems)
+    s0s = [
+        s0 if s0 is not None else _default_s0(p)
+        for p, s0 in zip(problems, s0s)
+    ]
+    if slots is None:
+        slots = num_slots_for(max(p.num_jobs for p in problems))
+    lanes = lane_band(len(problems))
+    args = _stack_cells(problems, s0s, slots, lanes)
+    scalars = (
+        jnp.float32(ref.round_duration),
+        jnp.float32(ref.future_rounds),
+        jnp.float32(ref.regularizer),
+        jnp.float32(tol),
+        jnp.float32(stall_rel),
+    )
+    if mesh is not None and lanes % int(np.prod(mesh.devices.shape)) == 0:
+        fn = _build_cells_sharded(
+            mesh, axis_name, int(max_cycles), int(inner_iters)
+        )
+        shard_c = NamedSharding(mesh, P(axis_name))
+        rep = NamedSharding(mesh, P())
+        placed = [jax.device_put(a, shard_c) for a in args]
+        placed += [jax.device_put(v, rep) for v in scalars]
+        with sanitize.jax_entry("cells.solve_cells_pdhg_sharded"):
+            s, obj, diag = fn(*placed)
+    else:
+        with sanitize.jax_entry("cells.solve_cells_pdhg"):
+            s, obj, diag = _solve_cells_kernel(
+                *args, *scalars,
+                max_cycles=int(max_cycles), inner_iters=int(inner_iters),
+            )
+        sanitize.check_recompiles(
+            "cells.solve_cells_pdhg",
+            _solve_cells_kernel,
+            (lanes, slots, int(max_cycles), int(inner_iters)),
+        )
+    s = np.asarray(s)
+    obj = np.asarray(obj)
+    diags = []
+    for i, p in enumerate(problems):
+        diags.append(
+            {
+                "cycles": int(np.asarray(diag["cycles"])[i]),
+                "iterations": int(np.asarray(diag["iterations"])[i]),
+                "restarts": int(np.asarray(diag["restarts"])[i]),
+                "residual": float(np.asarray(diag["residual"])[i]),
+                "converged": bool(np.asarray(diag["converged"])[i]),
+                "welfare_filled": bool(
+                    np.asarray(diag["welfare_filled"])[i]
+                ),
+            }
+        )
+    return (
+        [
+            s[i, : p.num_jobs].astype(np.float64)
+            for i, p in enumerate(problems)
+        ],
+        [float(o) for o in obj[: len(problems)]],
+        diags,
+    )
+
+
+def schedule_cell(
+    problem: EGProblem, s: np.ndarray, polish: bool = True
+) -> np.ndarray:
+    """Host tail of one cell's solve: the same integer rounding +
+    placement + reorder every counts-producing backend shares, so a
+    cell's boolean plan is exactly what the standalone pdhg backend
+    would emit for the same relaxed iterate."""
+    from shockwave_tpu.solver.eg_jax import counts_to_schedule
+    from shockwave_tpu.solver.rounding import reorder_rounds, round_counts
+
+    counts = round_counts(
+        s, problem.nworkers, problem.num_gpus, problem.future_rounds
+    )
+    Y = counts_to_schedule(counts, problem, polish=polish)
+    return reorder_rounds(
+        Y, problem.priorities, problem.nworkers, problem.num_gpus
+    )

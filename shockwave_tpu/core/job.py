@@ -29,6 +29,12 @@ class Job:
     SLO: Optional[float] = None
     needs_data_dir: bool = False
     job_id: Optional[int] = None
+    # Admission-side multi-tenancy: the submitting tenant's identity,
+    # carried on the SubmitJobs wire (admission_pb2.JobSpec.tenant) and
+    # judged against per-tenant queue quotas at the front door. Empty =
+    # the anonymous tenant (no quota applies). Not part of the trace
+    # format — single-tenant traces stay byte-identical.
+    tenant: str = ""
 
     def __post_init__(self):
         if self.SLO is not None and self.SLO < 0:

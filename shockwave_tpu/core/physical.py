@@ -85,8 +85,11 @@ class PhysicalScheduler(Scheduler):
         # Streaming admission front door: a bounded queue the SubmitJobs
         # RPC (and in-process submitters) feed and the round loop drains
         # at round boundaries. Timestamps ride the scheduler clock so
-        # queue-latency metrics line up with every other series.
-        self._admission = admission.AdmissionQueue(
+        # queue-latency metrics line up with every other series. With a
+        # cell-decomposed planner the queue is sharded (one slice per
+        # cell, coordinator-rebalanced); priority-aware drain and
+        # per-tenant quotas ride env knobs (see admission.build_queue).
+        self._admission = admission.build_queue(
             capacity=int(
                 os.environ.get(
                     "SHOCKWAVE_ADMISSION_QUEUE_CAP",
@@ -100,6 +103,7 @@ class PhysicalScheduler(Scheduler):
                 )
             ),
             clock=self.get_current_timestamp,
+            shards=getattr(self._shockwave, "num_cells", 1) or 1,
         )
 
         # Per-job runtime state.

@@ -1157,6 +1157,18 @@ class Scheduler:
         )
 
         if not isinstance(self._shockwave, ShockwavePlanner):
+            # A CellPlanner (or pool set already in place) is not
+            # upgraded; say so instead of silently ignoring the flag —
+            # cells x hetero pools is an unimplemented composition.
+            if getattr(self._shockwave, "config", {}).get(
+                "hetero_pools", False
+            ):
+                self._logger.warning(
+                    "hetero_pools requested but the planner is %s; "
+                    "per-worker-type pools are not composed with it — "
+                    "keeping the existing planner",
+                    type(self._shockwave).__name__,
+                )
             return
         if not self._shockwave.config.get("hetero_pools", False):
             return
@@ -1676,7 +1688,9 @@ class Scheduler:
 
         self._bs_scale[job_id] = None
         if self._shockwave is not None:
-            self._shockwave.set_recompute_flag()
+            # Only this job changed shape: a federated planner stales
+            # just the cell/pool owning it, not the whole fleet.
+            self._shockwave.set_recompute_flag(jobs=[job_id])
 
     def _round_observability(
         self, assignments, preempted=None
@@ -1842,7 +1856,9 @@ class Scheduler:
             queued_jobs: list = []
             # Virtual-time admission queue: the simulator owns the
             # clock, so enqueue/latency stamps ride _current_timestamp.
-            self._admission = admission_mod.AdmissionQueue(
+            # A cell-decomposed planner shards the queue (one slice per
+            # cell, coordinator-rebalanced backlog).
+            self._admission = admission_mod.build_queue(
                 capacity=admission_capacity
                 or admission_mod.DEFAULT_CAPACITY,
                 retry_delay_s=(
@@ -1851,6 +1867,7 @@ class Scheduler:
                     else max(1.0, self._time_per_iteration / 4.0)
                 ),
                 clock=lambda: self._current_timestamp,
+                shards=getattr(self._shockwave, "num_cells", 1) or 1,
             )
         else:
             assert arrival_times is not None and jobs is not None

@@ -338,7 +338,15 @@ class FlightRecorder:
         (appended to ``lines`` ahead of the plan record, once per job),
         delta-encode the append-only throughput schedules, pack tuple
         histories into scalar lists, and drop pure-output fields.
-        Caller holds the lock."""
+        A cell-set (federated) snapshot slims each child planner's
+        state the same way. Caller holds the lock."""
+        if "children" in planner_state:
+            slim_state = dict(planner_state)
+            slim_state["children"] = OrderedDict(
+                (name, self._slim_planner_state(child_state, lines))
+                for name, child_state in planner_state["children"].items()
+            )
+            return slim_state
         slim_state = dict(planner_state)
         slim_state["job_metadata"] = slim_md = OrderedDict()
         # The solve history is observability output, not planner input;
@@ -407,15 +415,29 @@ class FlightRecorder:
         # state_dict() hands over is either a fresh copy or immutable by
         # construction EXCEPT each job's throughput_schedule, which the
         # scheduler keeps appending to — shallow-copy those now; all
-        # slimming/encoding happens at flush().
-        raw = dict(planner_state)
-        raw["job_metadata"] = {
-            job_id: {
-                **md_state,
-                "throughput_schedule": dict(md_state["throughput_schedule"]),
+        # slimming/encoding happens at flush(). A cell-set snapshot
+        # carries its job metadata inside per-cell child states.
+        def _copy_flat(state: dict) -> dict:
+            out = dict(state)
+            out["job_metadata"] = {
+                job_id: {
+                    **md_state,
+                    "throughput_schedule": dict(
+                        md_state["throughput_schedule"]
+                    ),
+                }
+                for job_id, md_state in state["job_metadata"].items()
             }
-            for job_id, md_state in planner_state["job_metadata"].items()
-        }
+            return out
+
+        raw = dict(planner_state)
+        if "children" in raw:
+            raw["children"] = OrderedDict(
+                (name, _copy_flat(child_state))
+                for name, child_state in raw["children"].items()
+            )
+        else:
+            raw = _copy_flat(raw)
         record = {
             "event": "plan",
             "round": int(planner_state.get("round_index", 0)),
@@ -515,12 +537,27 @@ def iter_records(path: str) -> Iterator[dict]:
             )
 
 
+def _iter_flat_states(planner_state: dict):
+    """The flat (single-market) states inside one recorded snapshot:
+    itself, or — for a cell-set record — each cell child's state."""
+    if "children" in planner_state:
+        for child_state in planner_state["children"].values():
+            yield child_state
+    else:
+        yield planner_state
+
+
 def accumulate_schedules(record: dict, schedules: dict) -> None:
     """Fold one (already decoded) plan record's delta-encoded
     throughput tails into the per-job full schedules ``schedules``
     (job key -> {round: (tput, bs)}). Must be applied to every plan
     record in file order, including ones the caller will not replay."""
-    for job_id, md_state in record["planner_state"]["job_metadata"].items():
+    for flat in _iter_flat_states(record["planner_state"]):
+        _accumulate_flat(flat, schedules)
+
+
+def _accumulate_flat(flat_state: dict, schedules: dict) -> None:
+    for job_id, md_state in flat_state["job_metadata"].items():
         ref = md_state.get("__profile_ref__")
         if ref is None:
             continue
@@ -540,27 +577,19 @@ def accumulate_schedules(record: dict, schedules: dict) -> None:
             full[r] = (t, b)
 
 
-def replay_plan_record(
-    record: dict,
-    profiles: Optional[dict] = None,
-    schedules: Optional[dict] = None,
+def _resolve_recorded_state(
+    flat_state: dict,
+    profiles: Optional[dict],
+    schedules: Optional[dict],
 ) -> dict:
-    """Re-run one recorded planning round offline and diff the plan.
-
-    ``record`` must be pre-decoded (:func:`decode`) with
-    :func:`accumulate_schedules` already applied; ``profiles`` maps job
-    keys to decoded ``job_profile`` payloads and ``schedules`` to the
-    accumulated full throughput schedules (:func:`replay_log` maintains
-    both while scanning). Returns ``{"round", "recorded", "replayed",
-    "diff"}`` where ``diff`` maps round offsets whose job sets disagree
-    to the two sides; an empty ``diff`` means the replay reproduced the
-    decision exactly.
-    """
+    """Rebuild one flat planner state from its slimmed record form:
+    profile references resolved against the ``job_profile`` records,
+    delta-encoded throughput tails replaced by the accumulated full
+    schedules, finish-time history unpacked. Also strips any child
+    ``plan_deadline_s`` so replay never re-rolls a ladder on timing."""
     import copy
 
-    from shockwave_tpu.policies.shockwave import planner_from_state
-
-    state = dict(record["planner_state"])
+    state = dict(flat_state)
     resolved = OrderedDict()
     for job_id, md_state in state["job_metadata"].items():
         md_state = dict(md_state)
@@ -586,11 +615,43 @@ def replay_plan_record(
         )
         for job, history in state["finish_time_estimates"].items()
     }
+    state["config"] = dict(state["config"])
+    state["config"].pop("plan_deadline_s", None)
+    return state
+
+
+def replay_plan_record(
+    record: dict,
+    profiles: Optional[dict] = None,
+    schedules: Optional[dict] = None,
+) -> dict:
+    """Re-run one recorded planning round offline and diff the plan.
+
+    ``record`` must be pre-decoded (:func:`decode`) with
+    :func:`accumulate_schedules` already applied; ``profiles`` maps job
+    keys to decoded ``job_profile`` payloads and ``schedules`` to the
+    accumulated full throughput schedules (:func:`replay_log` maintains
+    both while scanning). Returns ``{"round", "recorded", "replayed",
+    "diff"}`` where ``diff`` maps round offsets whose job sets disagree
+    to the two sides; an empty ``diff`` means the replay reproduced the
+    decision exactly.
+    """
+    from shockwave_tpu.policies.shockwave import planner_from_state
+
+    state = dict(record["planner_state"])
+    if "children" in state:
+        state["children"] = OrderedDict(
+            (name, _resolve_recorded_state(child_state, profiles, schedules))
+            for name, child_state in state["children"].items()
+        )
+    else:
+        state = _resolve_recorded_state(state, profiles, schedules)
     # Replay is offline math, not a timing re-enactment: disable the
     # degradation ladder's deadline so a slow replay host cannot fall
     # down a different rung than the recorded solve. The snapshot's
     # backend is already stamped with the backend that actually
-    # produced the plan (including ladder fallbacks).
+    # produced the plan (including ladder fallbacks; a cell-set record
+    # carries per-cell backends in its ``cells_replay`` stamp).
     state["config"] = dict(state["config"])
     state["config"].pop("plan_deadline_s", None)
     planner = planner_from_state(state)

@@ -45,6 +45,12 @@ Rules (all thresholds overridable via a config dict, e.g. the
                      default — drivers configure it from the round
                      duration (the replan budget); the rule is inert
                      until they do.
+``cell_failure``     a cell-decomposed planner isolated a cell whose
+                     solve exhausted every recovery rung
+                     (``cells_cell_failures_total`` advanced by >=
+                     ``min_events`` — the cell keeps its cached plan
+                     while the rest of the fleet proceeds, but an
+                     operator must know).
 
 A rule re-fires only when its value worsens past the last fired value
 (no per-round alert spam while a breach persists). Disabled by default
@@ -77,6 +83,7 @@ DEFAULT_RULES: Dict[str, dict] = {
     "worker_death": {"min_workers": 1},
     "admission_backlog": {"fraction": 0.9, "min_depth": 8},
     "replan_p99": {"budget_s": None, "min_solves": 5, "quantile": 0.99},
+    "cell_failure": {"min_events": 1},
 }
 
 
@@ -219,6 +226,13 @@ class Watchdog:
                 self._check_admission_backlog(metrics, round_index, fired)
             if "replan_p99" in self.rules:
                 self._check_replan_p99(metrics, round_index, fired)
+            if "cell_failure" in self.rules:
+                self._check_counter_delta(
+                    metrics, "cell_failure",
+                    "cells_cell_failures_total",
+                    self.rules["cell_failure"]["min_events"],
+                    round_index, fired,
+                )
 
             for alert in fired:
                 alert["time_s"] = float(now_s)

@@ -159,6 +159,10 @@ def get_policy(
         from shockwave_tpu.policies.shockwave import ShockwavePolicy
 
         return ShockwavePolicy(backend="pdhg")
+    if policy_name == "shockwave_tpu_cells":
+        from shockwave_tpu.policies.shockwave import ShockwavePolicy
+
+        return ShockwavePolicy(backend="cells")
     raise ValueError(f"Unknown policy: {policy_name!r}")
 
 
@@ -195,6 +199,7 @@ _ALL_POLICY_NAMES = [
     "shockwave_tpu_relaxed",
     "shockwave_tpu_sharded",
     "shockwave_tpu_pdhg",
+    "shockwave_tpu_cells",
 ]
 
 _POLICY_MODULES = {

@@ -39,8 +39,22 @@ DEFAULT_LOG_BASES = [0.0, 0.2, 0.4, 0.6, 0.8, 1.0]
 
 # Fleet scale at which the production backend routes one planning solve
 # to the multi-chip sharded path (when >1 device is visible) instead of
-# the single-device level solve / native greedy.
+# the single-device level solve / native greedy. Anchored by the
+# committed mesh sweeps (results/sharded_solve_scaling.json,
+# results/pdhg_sharded_mesh.json): on shared-core virtual meshes the
+# sharded path never wins wall-clock, so the default stays at the
+# memory-headroom scale; override per deployment (from a measured
+# crossover on real chips) via SHOCKWAVE_SHARDED_MIN_JOBS.
 SHARDED_DISPATCH_MIN_JOBS = 8192
+
+
+def sharded_dispatch_min_jobs() -> int:
+    """Live threshold for the "tpu" backend's sharded dispatch:
+    SHOCKWAVE_SHARDED_MIN_JOBS when set, else the module default."""
+    import os
+
+    raw = os.environ.get("SHOCKWAVE_SHARDED_MIN_JOBS", "").strip()
+    return int(raw) if raw else SHARDED_DISPATCH_MIN_JOBS
 
 
 class ShockwavePlanner:
@@ -165,7 +179,11 @@ class ShockwavePlanner:
         self.config["num_gpus"] = num_gpus
         self.recompute_flag = True
 
-    def set_recompute_flag(self) -> None:
+    def set_recompute_flag(self, jobs=None) -> None:
+        """Force a replan. ``jobs`` names the jobs whose state changed;
+        a single global market replans fully either way, but federated
+        planners (pool set, cells) use it to stale only the children
+        owning them."""
         self.recompute_flag = True
 
     @property
@@ -644,7 +662,7 @@ class ShockwavePlanner:
             # in one batched launch. Both paths optimize the identical
             # objective and are cross-checked by tests.
             Y = None
-            if problem.num_jobs >= SHARDED_DISPATCH_MIN_JOBS:
+            if problem.num_jobs >= sharded_dispatch_min_jobs():
                 # Fleet scale trumps the native fast path: shard the
                 # single solve over every chip (counts bit-identical
                 # to the single-device path).
@@ -1073,7 +1091,21 @@ class PoolSetPlanner:
         self.pools[worker_type] = max(1, int(num_gpus))
         child.set_capacity(num_gpus)
 
-    def set_recompute_flag(self) -> None:
+    def set_recompute_flag(self, jobs=None) -> None:
+        if jobs is not None:
+            owners = [
+                child
+                for child in self.children.values()
+                if any(j in child.job_metadata for j in jobs)
+            ]
+            if all(
+                any(j in c.job_metadata for c in self.children.values())
+                for j in jobs
+            ):
+                for child in owners:
+                    child.set_recompute_flag(jobs)
+                return
+        # Bare call, or a job no child owns: stale everything.
         for child in self.children.values():
             child.set_recompute_flag()
 
@@ -1138,6 +1170,10 @@ def planner_from_state(state: dict):
     """Restore whichever planner kind a checkpoint carries."""
     if state.get("kind") == "pool_set":
         return PoolSetPlanner.from_state(state)
+    if state.get("kind") == "cell_set":
+        from shockwave_tpu.cells.planner import CellPlanner
+
+        return CellPlanner.from_state(state)
     return ShockwavePlanner.from_state(state)
 
 
@@ -1155,9 +1191,18 @@ class ShockwavePolicy(Policy):
             "relaxed": "Shockwave_TPU_Relaxed",
             "sharded": "Shockwave_TPU_Sharded",
             "pdhg": "Shockwave_TPU_PDHG",
+            "cells": "Shockwave_TPU_Cells",
         }.get(backend, "Shockwave_TPU")
 
-    def make_planner(self, config: dict) -> ShockwavePlanner:
+    def make_planner(self, config: dict):
+        # Cell-decomposed dispatch: the "cells" backend — or any
+        # backend with a "cells" count in the config — plans through
+        # the partitioned-market federation (shockwave_tpu/cells/)
+        # instead of one global solve.
+        if self.backend == "cells" or int(config.get("cells", 0) or 0) >= 2:
+            from shockwave_tpu.cells.planner import CellPlanner
+
+            return CellPlanner(config, backend=self.backend)
         return ShockwavePlanner(config, backend=self.backend)
 
     def get_allocation(self, *args, **kwargs):

@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import time
 from collections import OrderedDict, deque
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from shockwave_tpu import obs
 from shockwave_tpu.analysis import sanitize
@@ -44,6 +44,14 @@ from shockwave_tpu.core.job import Job
 STATUS_ACCEPTED = "ACCEPTED"
 STATUS_RETRY_AFTER = "RETRY_AFTER"
 STATUS_CLOSED = "CLOSED"
+# Hard (non-retryable-as-is) rejection: the batch would push its
+# tenant past its admission quota. Deciding WHO gets queued when the
+# cluster is full is policy, not backpressure — the submitter must
+# shed or wait for its tenant's backlog to drain, not hammer retries.
+# Rejection is batch-granular (the token ledger is), so submitters
+# keep batches single-tenant — both in-repo submitters do — and one
+# tenant's quota never sheds another tenant's jobs.
+STATUS_QUOTA = "QUOTA"
 
 # Default bound on pending (accepted-but-not-admitted) jobs; the env
 # knob SHOCKWAVE_ADMISSION_QUEUE_CAP overrides it in physical mode.
@@ -65,6 +73,7 @@ def job_to_spec_dict(job: Job) -> dict:
         "slo": float(job.SLO) if job.SLO is not None else 0.0,
         "duration": float(job.duration) if job.duration else 0.0,
         "needs_data_dir": bool(job.needs_data_dir),
+        "tenant": str(getattr(job, "tenant", "") or ""),
     }
 
 
@@ -104,7 +113,69 @@ def job_from_spec_dict(spec: dict) -> Job:
         SLO=slo if slo > 0 else None,
         duration=duration if duration > 0 else None,
         needs_data_dir=bool(spec.get("needs_data_dir", False)),
+        tenant=str(spec.get("tenant", "") or ""),
     )
+
+
+class _TenantLedger:
+    """Pending-job counts per tenant. One private instance per plain
+    queue; ONE SHARED instance across every shard of a sharded front
+    door, so a tenant's quota bounds the FLEET's pending backlog — not
+    per-shard backlog (which would multiply the quota by the shard
+    count) — and rebalancing moves between shards net to zero.
+    ``reserve`` is check-and-increment in a single critical section, so
+    two handler threads racing a tenant's last quota slot cannot both
+    win. Always acquired under a shard's queue lock (queue -> ledger,
+    never the reverse)."""
+
+    def __init__(self):
+        self._lock = sanitize.make_lock(
+            "runtime.admission._TenantLedger._lock"
+        )
+        self._pending: Dict[str, int] = {}
+
+    @staticmethod
+    def batch_counts(jobs: Sequence[Job]) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for job in jobs:
+            tenant = str(getattr(job, "tenant", "") or "")
+            if tenant:
+                counts[tenant] = counts.get(tenant, 0) + 1
+        return counts
+
+    def reserve(
+        self, counts: Dict[str, int], quotas: Dict[str, int]
+    ) -> Optional[str]:
+        """Atomically add ``counts`` to the pending tallies; returns
+        the first tenant the batch would push past ``quotas`` (and
+        reserves nothing), else None."""
+        with self._lock:
+            for tenant, count in counts.items():
+                if (
+                    tenant in quotas
+                    and self._pending.get(tenant, 0) + count
+                    > quotas[tenant]
+                ):
+                    return tenant
+            for tenant, count in counts.items():
+                self._pending[tenant] = self._pending.get(tenant, 0) + count
+            return None
+
+    def release(self, counts: Dict[str, int]) -> None:
+        """Undo a ``reserve`` whose batch was then rejected."""
+        with self._lock:
+            for tenant, count in counts.items():
+                self._dec_locked(tenant, count)
+
+    def dec(self, tenant: str, count: int = 1) -> None:
+        with self._lock:
+            self._dec_locked(tenant, count)
+
+    def _dec_locked(self, tenant: str, count: int) -> None:
+        if tenant in self._pending:
+            self._pending[tenant] -= count
+            if self._pending[tenant] <= 0:
+                del self._pending[tenant]
 
 
 class AdmissionQueue:
@@ -121,6 +192,10 @@ class AdmissionQueue:
         capacity: int = DEFAULT_CAPACITY,
         retry_delay_s: float = 1.0,
         clock: Optional[Callable[[], float]] = None,
+        priority_aware: bool = False,
+        tenant_quotas: Optional[dict] = None,
+        shard_label: Optional[str] = None,
+        tenant_ledger: Optional[_TenantLedger] = None,
     ):
         self.capacity = max(1, int(capacity))
         # Base unit of the queue-depth-derived backpressure delay: a
@@ -128,12 +203,32 @@ class AdmissionQueue:
         # queue is (full queue => one whole unit, plus a term for how
         # far over the batch would have gone).
         self.retry_delay_s = float(retry_delay_s)
+        # Priority-aware drain: highest Job.priority_weight first
+        # (FIFO within a weight class). Off by default — arrival order
+        # is the historical contract.
+        self.priority_aware = bool(priority_aware)
+        # Per-tenant bound on PENDING jobs (who gets queued when the
+        # cluster is full): tenant -> max pending. Tenants not listed
+        # (and the anonymous "" tenant) are unbounded short of the
+        # queue capacity itself.
+        self.tenant_quotas = {
+            str(t): max(0, int(q)) for t, q in (tenant_quotas or {}).items()
+        }
         self._clock = clock or time.monotonic
         self._lock = sanitize.make_lock(
             "runtime.admission.AdmissionQueue._lock"
         )
-        # (token, job, enqueue_time) in arrival order.
+        # (token, job, enqueue_time, seq) in arrival order; seq breaks
+        # priority ties deterministically.
         self._pending: deque = deque()
+        self._seq = 0
+        # Shared across all shards of a sharded front door so quotas
+        # bound fleet-wide pending, not per-shard pending.
+        self._tenants = tenant_ledger or _TenantLedger()
+        # Sharded front door: this queue's shard identity, used only to
+        # label its metrics series (the ShardedAdmissionQueue owns the
+        # unlabeled aggregate the watchdog's backlog rule reads).
+        self._shard_label = shard_label
         # token -> number of jobs recorded under it (the idempotency
         # ledger; retained for the queue's lifetime so a token can
         # never be admitted twice, even long after its batch drained).
@@ -148,14 +243,32 @@ class AdmissionQueue:
             "rejected_batches": 0,
             "deduped_batches": 0,
             "closed_rejects": 0,
+            "quota_rejects": 0,
             "admitted_jobs": 0,
         }
         # Published once so the admission_backlog watchdog rule can
         # judge depth as a fraction of the bound.
-        obs.gauge(
-            "admission_queue_capacity",
-            "bound on pending jobs in the admission queue",
-        ).set(float(self.capacity))
+        if shard_label is None:
+            obs.gauge(
+                "admission_queue_capacity",
+                "bound on pending jobs in the admission queue",
+            ).set(float(self.capacity))
+        else:
+            obs.gauge(
+                "admission_queue_capacity",
+                "bound on pending jobs in the admission queue",
+            ).set(float(self.capacity), shard=shard_label)
+
+    def _set_depth_gauge_locked(self) -> None:
+        """Caller holds the lock."""
+        gauge = obs.gauge(
+            "admission_queue_depth",
+            "jobs accepted but not yet admitted by the round loop",
+        )
+        if self._shard_label is None:
+            gauge.set(float(len(self._pending)))
+        else:
+            gauge.set(float(len(self._pending)), shard=self._shard_label)
 
     # -- submitter side -------------------------------------------------
     def submit(
@@ -190,10 +303,31 @@ class AdmissionQueue:
                 self.stats["closed_rejects"] += 1
                 obs.counter(
                     "admission_rejected_total",
-                    "submissions rejected (backpressure or closed "
-                    "stream)",
+                    "submissions rejected (backpressure, quota, or "
+                    "closed stream)",
                 ).inc(reason="closed")
                 return STATUS_CLOSED, 0.0, 0
+            # Check-and-reserve in one ledger critical section: the
+            # reservation is released below if backpressure then
+            # bounces the batch.
+            batch_counts = _TenantLedger.batch_counts(jobs)
+            over_quota = (
+                self._tenants.reserve(batch_counts, self.tenant_quotas)
+                if batch_counts
+                else None
+            )
+            if over_quota is not None:
+                self.stats["quota_rejects"] += 1
+                obs.counter(
+                    "admission_rejected_total",
+                    "submissions rejected (backpressure, quota, or "
+                    "closed stream)",
+                ).inc(reason="quota")
+                self._record_event_locked(
+                    "rejected", token, len(jobs), len(self._pending),
+                    reason="quota", tenant=over_quota,
+                )
+                return STATUS_QUOTA, 0.0, 0
             depth = len(self._pending)
             # The bound is on BACKLOG, not on a single batch: an empty
             # queue admits any batch (otherwise a batch larger than
@@ -201,6 +335,8 @@ class AdmissionQueue:
             # would retry the same token forever — a livelock, since
             # rejection never shrinks the batch).
             if jobs and depth and depth + len(jobs) > self.capacity:
+                if batch_counts:
+                    self._tenants.release(batch_counts)
                 overflow = depth + len(jobs) - self.capacity
                 # Depth-derived delay: how full the queue already is,
                 # plus how far over this batch would push it — a deeper
@@ -221,7 +357,8 @@ class AdmissionQueue:
                 )
                 return STATUS_RETRY_AFTER, retry_after, 0
             for job in jobs:
-                self._pending.append((token, job, now))
+                self._pending.append((token, job, now, self._seq))
+                self._seq += 1
             if token:
                 self._token_jobs[token] = len(jobs)
             self.stats["accepted_batches"] += 1
@@ -229,10 +366,7 @@ class AdmissionQueue:
             obs.counter(
                 "admission_accepted_total", "submission batches accepted"
             ).inc()
-            obs.gauge(
-                "admission_queue_depth",
-                "jobs accepted but not yet admitted by the round loop",
-            ).set(float(len(self._pending)))
+            self._set_depth_gauge_locked()
             self._record_event_locked(
                 "accepted", token, len(jobs), len(self._pending)
             )
@@ -296,6 +430,23 @@ class AdmissionQueue:
         now = self._clock() if now is None else now
         with self._lock:
             budget = len(self._pending) if max_jobs is None else max_jobs
+            if self.priority_aware and len(self._pending) > 1:
+                # Highest priority_weight first; FIFO within a weight
+                # class by ARRIVAL time (seq breaks exact-time ties) —
+                # enqueue_time is the stamp that stays comparable when
+                # the sharded front door rebalances entries between
+                # shards, where per-shard seq counters are not, and it
+                # is the key _peek_priority reports for the cross-shard
+                # merge drain.
+                ordered = sorted(
+                    self._pending,
+                    key=lambda e: (
+                        -float(getattr(e[1], "priority_weight", 1.0) or 1.0),
+                        e[2],
+                        e[3],
+                    ),
+                )
+                self._pending = deque(ordered)
             out = []
             latency = obs.histogram(
                 "admission_queue_latency_seconds",
@@ -303,7 +454,10 @@ class AdmissionQueue:
                 "round loop admitted it",
             )
             while self._pending and len(out) < budget:
-                token, job, enqueued = self._pending.popleft()
+                token, job, enqueued, _seq = self._pending.popleft()
+                tenant = str(getattr(job, "tenant", "") or "")
+                if tenant:
+                    self._tenants.dec(tenant)
                 out.append((token, job, enqueued))
                 latency.observe(max(now - enqueued, 0.0))
             if out:
@@ -313,11 +467,51 @@ class AdmissionQueue:
                     "jobs drained from the admission queue into the "
                     "scheduler",
                 ).inc(len(out))
-            obs.gauge(
-                "admission_queue_depth",
-                "jobs accepted but not yet admitted by the round loop",
-            ).set(float(len(self._pending)))
+            self._set_depth_gauge_locked()
             return out
+
+    # -- sharded-front-door internals (ShardedAdmissionQueue only) -----
+    def _peek_priority(self) -> Optional[Tuple[float, float]]:
+        """Drain key ``(-priority_weight, enqueue_time)`` of the entry
+        a priority-aware ``drain(max_jobs=1)`` would pop next, or None
+        when empty — lets the sharded front door merge-drain across
+        shards in global priority order."""
+        with self._lock:
+            if not self._pending:
+                return None
+            return min(
+                (
+                    -float(getattr(e[1], "priority_weight", 1.0) or 1.0),
+                    e[2],
+                )
+                for e in self._pending
+            )
+
+    def _take_newest(self, count: int) -> list:
+        """Pop up to ``count`` NEWEST pending entries (for backlog
+        rebalancing: the oldest jobs keep their position in their home
+        shard, the freshest spill to an emptier one)."""
+        with self._lock:
+            out = []
+            while self._pending and len(out) < count:
+                out.append(self._pending.pop())
+            self._set_depth_gauge_locked()
+            return list(reversed(out))
+
+    def _give(self, entries: list) -> int:
+        """Accept entries rebalanced from a sibling shard (bypasses
+        the token ledger — the routing shard keeps dedup ownership).
+        Tenant tallies don't move either: shards share one fleet-wide
+        :class:`_TenantLedger`, and a rebalanced job is still pending."""
+        with self._lock:
+            for entry in entries:
+                self._pending.append(entry)
+            self._set_depth_gauge_locked()
+            return len(entries)
+
+    def _free_space(self) -> int:
+        with self._lock:
+            return max(0, self.capacity - len(self._pending))
 
     def depth(self) -> int:
         with self._lock:
@@ -345,6 +539,306 @@ class AdmissionQueue:
                 "tokens": len(self._token_jobs),
                 **dict(self.stats),
             }
+
+
+class ShardedAdmissionQueue:
+    """The admission front door sharded for the cell-decomposed
+    planner: N :class:`AdmissionQueue` shards behind the single-queue
+    interface, each owning a slice of the total bound.
+
+    * **Routing.** A batch routes to ``crc32(token) % shards`` — a
+      retried token always lands on the shard holding its ledger
+      entry, so exactly-once admission survives sharding.
+    * **Coordinator rebalancing.** A shard that would reject a batch
+      under backpressure first pulls the coordinator: backlog spills
+      from the fullest shards into the emptiest (newest entries move;
+      the token ledger stays with the routing shard), so one hot
+      submitter cannot brown out its shard while the fleet has queue
+      room. The same rebalance runs before every drain.
+    * **Aggregate observability.** Shards label their gauges
+      (``shard=sN``); this wrapper maintains the unlabeled
+      ``admission_queue_depth``/``capacity`` series the
+      ``admission_backlog`` watchdog rule reads.
+
+    Same submit/drain/close/depth/opened/closed/summary vocabulary as
+    :class:`AdmissionQueue` — the scheduler cannot tell them apart.
+    """
+
+    def __init__(
+        self,
+        num_shards: int,
+        capacity: int = DEFAULT_CAPACITY,
+        retry_delay_s: float = 1.0,
+        clock: Optional[Callable[[], float]] = None,
+        priority_aware: bool = False,
+        tenant_quotas: Optional[dict] = None,
+    ):
+        self.num_shards = max(1, int(num_shards))
+        self.capacity = max(self.num_shards, int(capacity))
+        # Shard capacities sum EXACTLY to the configured bound (first
+        # `extra` shards take the remainder) — a ceil split would let
+        # the fleet hold up to shards-1 jobs more than the capacity
+        # the aggregate gauge and the backlog watchdog advertise.
+        base, extra = divmod(self.capacity, self.num_shards)
+        # ONE ledger for all shards: a tenant's quota bounds the
+        # fleet's pending jobs, however the batches hash across shards
+        # and wherever rebalancing later moves them.
+        ledger = _TenantLedger()
+        self.shards: List[AdmissionQueue] = [
+            AdmissionQueue(
+                capacity=base + (1 if i < extra else 0),
+                retry_delay_s=retry_delay_s,
+                clock=clock,
+                priority_aware=priority_aware,
+                tenant_quotas=tenant_quotas,
+                shard_label=f"s{i:02d}",
+                tenant_ledger=ledger,
+            )
+            for i in range(self.num_shards)
+        ]
+        self.priority_aware = bool(priority_aware)
+        obs.gauge(
+            "admission_queue_capacity",
+            "bound on pending jobs in the admission queue",
+        ).set(float(self.capacity))
+        obs.gauge(
+            "admission_queue_shards", "admission front-door shard count"
+        ).set(float(self.num_shards))
+
+    def _shard_of(self, token: str) -> AdmissionQueue:
+        import zlib
+
+        return self.shards[
+            zlib.crc32(str(token).encode("utf-8")) % self.num_shards
+        ]
+
+    def _set_depth_gauge(self) -> None:
+        obs.gauge(
+            "admission_queue_depth",
+            "jobs accepted but not yet admitted by the round loop",
+        ).set(float(self.depth()))
+
+    def rebalance(self) -> int:
+        """Coordinator-level backlog rebalancing: move the newest
+        pending entries from over-full shards into shards with free
+        space until depths are within one batch of even. Returns the
+        number of jobs moved. Token ledgers do not move — dedup
+        ownership stays with the routing shard."""
+        moved = 0
+        for _ in range(self.num_shards * 2):
+            depths = [q.depth() for q in self.shards]
+            hi = max(range(self.num_shards), key=lambda i: depths[i])
+            lo = min(range(self.num_shards), key=lambda i: depths[i])
+            excess = depths[hi] - depths[lo]
+            space = self.shards[lo]._free_space()
+            if excess <= 1 or space <= 0:
+                break
+            count = min(excess // 2, space)
+            if count <= 0:
+                break
+            entries = self.shards[hi]._take_newest(count)
+            if not entries:
+                break
+            moved += self.shards[lo]._give(entries)
+        if moved:
+            obs.counter(
+                "admission_rebalanced_total",
+                "pending jobs moved between admission shards by the "
+                "coordinator",
+            ).inc(moved)
+        return moved
+
+    # -- submitter side -------------------------------------------------
+    def submit(
+        self,
+        token: str,
+        jobs: Sequence[Job],
+        close: bool = False,
+        now: Optional[float] = None,
+    ) -> Tuple[str, float, int]:
+        shard = self._shard_of(token)
+        status, retry_after, admitted = shard.submit(
+            token, jobs, close=close, now=now
+        )
+        if status == STATUS_RETRY_AFTER:
+            # The shard is full but the fleet may not be: spill the
+            # routing shard's newest backlog into siblings with free
+            # space until this batch fits, then offer it once more
+            # before bouncing the submitter.
+            if self._make_room(shard, len(jobs)):
+                status, retry_after, admitted = shard.submit(
+                    token, jobs, close=close, now=now
+                )
+        if close and status == STATUS_ACCEPTED:
+            # Propagate end-of-stream to the sibling shards only once
+            # the close-carrying batch is actually in (the routing
+            # shard closed inside submit). A rejected close-carrying
+            # batch keeps the fleet open — its backoff retry is the
+            # close-carrying resend, and closing now would turn that
+            # retry into a permanently lost final batch.
+            self.close(token)
+        self._set_depth_gauge()
+        return status, retry_after, admitted
+
+    def _make_room(self, shard: AdmissionQueue, incoming: int) -> int:
+        """Spill backlog out of ``shard`` until ``incoming`` more jobs
+        fit (or the fleet is genuinely full). Returns jobs moved."""
+        needed = shard.depth() + int(incoming) - shard.capacity
+        if needed <= 0:
+            return 0
+        moved = 0
+        order = sorted(
+            (s for s in self.shards if s is not shard),
+            key=lambda s: s.depth(),
+        )
+        for sibling in order:
+            space = sibling._free_space()
+            if space <= 0:
+                continue
+            entries = shard._take_newest(min(space, needed - moved))
+            if not entries:
+                break
+            moved += sibling._give(entries)
+            if moved >= needed:
+                break
+        if moved:
+            obs.counter(
+                "admission_rebalanced_total",
+                "pending jobs moved between admission shards by the "
+                "coordinator",
+            ).inc(moved)
+        return moved
+
+    def close(self, token: str = "") -> None:
+        for shard in self.shards:
+            shard.close(token)
+
+    def open(self) -> None:
+        for shard in self.shards:
+            shard.open()
+
+    # -- scheduler side -------------------------------------------------
+    def drain(
+        self, max_jobs: Optional[int] = None, now: Optional[float] = None
+    ) -> List[Tuple[str, Job, float]]:
+        self.rebalance()
+        out: List[Tuple[str, Job, float]] = []
+        if self.priority_aware:
+            # Global priority order, not shard order: a weight-10 job
+            # must not wait behind a sibling shard's weight-1 backlog
+            # just because of where its token hashed. Whole-fleet
+            # drains merge-sort; budgeted drains pop the best shard
+            # head one job at a time (shard index breaks exact ties
+            # deterministically).
+            total = self.depth()
+            budget = total if max_jobs is None else min(int(max_jobs), total)
+            if budget >= total:
+                for shard in self.shards:
+                    out.extend(shard.drain(max_jobs=None, now=now))
+                out.sort(
+                    key=lambda e: (
+                        -float(
+                            getattr(e[1], "priority_weight", 1.0) or 1.0
+                        ),
+                        e[2],
+                    )
+                )
+            else:
+                while len(out) < budget:
+                    best = None
+                    best_shard = None
+                    for shard in self.shards:
+                        head = shard._peek_priority()
+                        if head is not None and (
+                            best is None or head < best
+                        ):
+                            best, best_shard = head, shard
+                    if best_shard is None:
+                        break
+                    out.extend(best_shard.drain(max_jobs=1, now=now))
+        else:
+            budget = max_jobs
+            for shard in self.shards:
+                take = None if budget is None else budget - len(out)
+                if take is not None and take <= 0:
+                    break
+                out.extend(shard.drain(max_jobs=take, now=now))
+        self._set_depth_gauge()
+        return out
+
+    def depth(self) -> int:
+        return sum(q.depth() for q in self.shards)
+
+    @property
+    def closed(self) -> bool:
+        return all(q.closed for q in self.shards)
+
+    @property
+    def opened(self) -> bool:
+        return any(q.opened for q in self.shards)
+
+    def summary(self) -> dict:
+        merged: dict = {
+            "capacity": self.capacity,
+            "depth": self.depth(),
+            "closed": self.closed,
+            "shards": self.num_shards,
+            "tokens": 0,
+        }
+        for key in self.shards[0].stats:
+            merged[key] = 0
+        for shard in self.shards:
+            s = shard.summary()
+            merged["tokens"] += s["tokens"]
+            for key in shard.stats:
+                merged[key] += s[key]
+        merged["per_shard_depth"] = [q.depth() for q in self.shards]
+        return merged
+
+
+def build_queue(
+    capacity: int,
+    retry_delay_s: float,
+    clock: Optional[Callable[[], float]] = None,
+    shards: int = 1,
+    priority_aware: Optional[bool] = None,
+    tenant_quotas: Optional[dict] = None,
+):
+    """Front-door factory: one queue, or a sharded one when the planner
+    is cell-decomposed. Env knobs fill unset policy arguments:
+    ``SHOCKWAVE_ADMISSION_PRIORITY=1`` turns on priority-aware drain,
+    ``SHOCKWAVE_ADMISSION_QUOTAS="teamA=32,teamB=8"`` sets per-tenant
+    pending quotas."""
+    import os
+
+    if priority_aware is None:
+        priority_aware = os.environ.get(
+            "SHOCKWAVE_ADMISSION_PRIORITY", ""
+        ).strip() in ("1", "true", "yes")
+    if tenant_quotas is None:
+        raw = os.environ.get("SHOCKWAVE_ADMISSION_QUOTAS", "").strip()
+        if raw:
+            tenant_quotas = {}
+            for part in raw.split(","):
+                tenant, _, quota = part.partition("=")
+                if tenant.strip() and quota.strip().isdigit():
+                    tenant_quotas[tenant.strip()] = int(quota.strip())
+    if int(shards) > 1:
+        return ShardedAdmissionQueue(
+            int(shards),
+            capacity=capacity,
+            retry_delay_s=retry_delay_s,
+            clock=clock,
+            priority_aware=priority_aware,
+            tenant_quotas=tenant_quotas,
+        )
+    return AdmissionQueue(
+        capacity=capacity,
+        retry_delay_s=retry_delay_s,
+        clock=clock,
+        priority_aware=priority_aware,
+        tenant_quotas=tenant_quotas,
+    )
 
 
 class StreamingSubmitter:
@@ -386,6 +880,7 @@ class StreamingSubmitter:
             "batches_accepted": 0,
             "rpc_faults": 0,
             "backpressure_retries": 0,
+            "quota_rejects": 0,
         }
 
     def exhausted(self) -> bool:
@@ -409,10 +904,16 @@ class StreamingSubmitter:
         if not self._queue_in or self._queue_in[0][0] > now:
             return None
         batch, arrival = [], self._queue_in[0][0]
+        # Batches never mix tenants: a QUOTA rejection is batch-
+        # granular (the token ledger is), so one over-quota tenant in
+        # a mixed batch would shed compliant tenants' jobs with it.
+        tenant = str(getattr(self._queue_in[0][1], "tenant", "") or "")
         while (
             self._queue_in
             and self._queue_in[0][0] <= now
             and len(batch) < self.batch_size
+            and str(getattr(self._queue_in[0][1], "tenant", "") or "")
+            == tenant
         ):
             _, job = self._queue_in.popleft()
             batch.append(job)
@@ -463,6 +964,13 @@ class StreamingSubmitter:
                 self.stats["backpressure_retries"] += 1
                 self._inflight = (token, batch, arrival, now + retry_after)
                 break
+            if status == STATUS_QUOTA:
+                # Hard policy rejection: the batch's tenant is over its
+                # pending quota. Retrying the same batch would spin —
+                # the jobs are shed (counted, never silently).
+                self.stats["quota_rejects"] += 1
+                self._inflight = None
+                continue
             # ACCEPTED (fresh or deduplicated): stamp each job's true
             # arrival time for JCT accounting, then move on.
             for job in batch:

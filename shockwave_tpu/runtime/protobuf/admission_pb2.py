@@ -115,6 +115,7 @@ class JobSpec:
         slo: float = 0.0,
         duration: float = 0.0,
         needs_data_dir: bool = False,
+        tenant: str = "",
     ):
         self.job_type = job_type
         self.command = command
@@ -127,6 +128,7 @@ class JobSpec:
         self.slo = float(slo)
         self.duration = float(duration)
         self.needs_data_dir = bool(needs_data_dir)
+        self.tenant = tenant
 
     def SerializeToString(self) -> bytes:  # noqa: N802 (protobuf API)
         out = bytearray()
@@ -141,6 +143,7 @@ class JobSpec:
         _put_double(out, 9, self.slo)
         _put_double(out, 10, self.duration)
         _put_varint(out, 11, int(self.needs_data_dir))
+        _put_str(out, 12, self.tenant)
         return bytes(out)
 
     @classmethod
@@ -169,6 +172,8 @@ class JobSpec:
                 spec.duration = value
             elif field == 11 and wire_type == 0:
                 spec.needs_data_dir = bool(value)
+            elif field == 12 and wire_type == 2:
+                spec.tenant = value.decode("utf-8")
         return spec
 
 

@@ -128,6 +128,7 @@ def _admission_handlers(callbacks):
                     "slo": spec.slo,
                     "duration": spec.duration,
                     "needs_data_dir": spec.needs_data_dir,
+                    "tenant": spec.tenant,
                 }
                 for spec in request.jobs
             ]

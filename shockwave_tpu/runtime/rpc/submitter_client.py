@@ -49,6 +49,27 @@ class SubmissionRejected(RuntimeError):
         self.error = error
 
 
+def _tenant_batches(jobs: Sequence, batch_size: int):
+    """Contiguous batches that never mix tenants, each at most
+    ``batch_size`` jobs. Quota rejection is batch-granular (the token
+    ledger is), so a mixed batch would let one over-quota tenant shed
+    compliant tenants' jobs along with its own."""
+    batch: list = []
+    tenant: Optional[str] = None
+    for job in jobs:
+        t = str(
+            (job.get("tenant") if isinstance(job, dict) else getattr(job, "tenant", ""))
+            or ""
+        )
+        if batch and (t != tenant or len(batch) >= batch_size):
+            yield batch
+            batch = []
+        tenant = t
+        batch.append(job)
+    if batch:
+        yield batch
+
+
 class SubmitterClient:
     def __init__(
         self,
@@ -108,6 +129,16 @@ class SubmitterClient:
         response = call_with_retry(attempt, self._retry, method="SubmitJobs")
         if response.status in ("INVALID", "ERROR"):
             raise SubmissionRejected(response.status, response.error)
+        if response.status == "QUOTA":
+            # Per-tenant admission quota: retrying the same batch as-is
+            # would spin (the quota frees only as the tenant's backlog
+            # drains) — surface it to the caller's shedding policy.
+            raise SubmissionRejected(
+                "QUOTA",
+                response.error
+                or f"tenant over admission quota; batch of {len(jobs)} "
+                "not queued",
+            )
         if response.status == "CLOSED" and jobs:
             # The stream is closed and this batch was NOT admitted;
             # returning it as a normal response would silently drop the
@@ -144,31 +175,55 @@ class SubmitterClient:
         error instead of an infinite loop."""
         tokens: List[str] = []
         batch_size = max(1, int(batch_size))
-        for start in range(0, len(jobs), batch_size):
-            batch = list(jobs[start : start + batch_size])
-            token = self.next_token()
-            tokens.append(token)
-            waited = 0.0
-            while True:
-                response = self.submit(batch, token=token)
-                if response.status != "RETRY_AFTER":
-                    break
-                delay = max(float(response.retry_after_s), 0.05)
-                waited += delay
-                if waited > max_backpressure_s:
-                    raise TimeoutError(
-                        f"batch {token} backpressured for "
-                        f"{waited:.1f}s (> {max_backpressure_s}s); "
-                        "the scheduler is not draining its admission "
-                        "queue"
+        try:
+            for batch in _tenant_batches(jobs, batch_size):
+                token = self.next_token()
+                tokens.append(token)
+                waited = 0.0
+                while True:
+                    try:
+                        response = self.submit(batch, token=token)
+                    except SubmissionRejected as e:
+                        if e.status != "QUOTA":
+                            raise
+                        # Shed THIS tenant's batch and keep going:
+                        # quota is that tenant's problem, not the
+                        # stream's — aborting here would drop every
+                        # later batch and leave the stream unclosed.
+                        LOG.warning("batch %s shed: %s", token, e)
+                        obs.counter(
+                            "admission_client_quota_shed_total",
+                            "batches shed by the submitter on a QUOTA "
+                            "rejection",
+                        ).inc()
+                        break
+                    if response.status != "RETRY_AFTER":
+                        break
+                    delay = max(float(response.retry_after_s), 0.05)
+                    waited += delay
+                    if waited > max_backpressure_s:
+                        raise TimeoutError(
+                            f"batch {token} backpressured for "
+                            f"{waited:.1f}s (> {max_backpressure_s}s); "
+                            "the scheduler is not draining its "
+                            "admission queue"
+                        )
+                    obs.counter(
+                        "admission_client_backpressure_total",
+                        "RETRY_AFTER responses honored by the submitter",
+                    ).inc()
+                    sleep(delay)
+        finally:
+            # Even a failing submitter ends the stream — the round
+            # loop must finish what was admitted, not idle forever on
+            # a stream nobody will close.
+            if close:
+                try:
+                    self.close_stream()
+                except Exception:
+                    LOG.warning(
+                        "end-of-stream close failed", exc_info=True
                     )
-                obs.counter(
-                    "admission_client_backpressure_total",
-                    "RETRY_AFTER responses honored by the submitter",
-                ).inc()
-                sleep(delay)
-        if close:
-            self.close_stream()
         return tokens
 
     def submit_trace(

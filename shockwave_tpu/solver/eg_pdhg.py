@@ -97,8 +97,25 @@ _STALL_CYCLES = 3
 
 # Fleet scale at which solve_eg_pdhg routes the solve to the sharded
 # mesh path when more than one device is visible (mirrors the planner's
-# SHARDED_DISPATCH_MIN_JOBS for the level backend).
+# SHARDED_DISPATCH_MIN_JOBS for the level backend). The default is
+# anchored by the committed 8-virtual-device mesh sweep
+# (results/pdhg_sharded_mesh.json, scripts/microbenchmarks/
+# sweep_pdhg_sharded.py): on a shared-core CPU mesh the sharded path
+# never wins wall-clock (every shard time-slices the same cores), so
+# the threshold stays at the memory-headroom scale where sharding is
+# about fitting the fleet at all; on a real multi-chip mesh, re-run
+# the sweep and lower it via SHOCKWAVE_PDHG_SHARDED_MIN_JOBS.
 SHARDED_PDHG_MIN_JOBS = 8192
+
+
+def sharded_min_jobs() -> int:
+    """The live dispatch threshold: SHOCKWAVE_PDHG_SHARDED_MIN_JOBS
+    when set (a measured-crossover override from sweep_pdhg_sharded),
+    else :data:`SHARDED_PDHG_MIN_JOBS`."""
+    import os
+
+    raw = os.environ.get("SHOCKWAVE_PDHG_SHARDED_MIN_JOBS", "").strip()
+    return int(raw) if raw else SHARDED_PDHG_MIN_JOBS
 
 
 def _pdhg_core(
@@ -805,7 +822,7 @@ def solve_eg_pdhg(
 
     with obs.backend_phases("pdhg", problem.num_jobs) as bp:
         if (
-            problem.num_jobs >= SHARDED_PDHG_MIN_JOBS
+            problem.num_jobs >= sharded_min_jobs()
             and len(jax.devices()) > 1
         ):
             s, _, _ = solve_pdhg_relaxed_sharded(

@@ -493,8 +493,9 @@ class TestSolverBackendConformance:
 
 
 # Planner-facade fixtures: dispatch-table + degradation-ladder
-# registration (the backend count ratchet: 6 registered branches, and
-# the pdhg rung between primary and relaxed).
+# registration (the backend count ratchet: 7 registered branches
+# including the cell-decomposed "cells" dispatch, and the pdhg rung
+# between primary and relaxed).
 
 _PLANNER_DISPATCH = """
         if backend == "reference":
@@ -508,6 +509,8 @@ _PLANNER_DISPATCH = """
         if backend == "relaxed":
             return 1
         if backend == "pdhg":
+            return 1
+        if backend == "cells":
             return 1
         return 0
 """

@@ -1,0 +1,800 @@
+"""Cell-decomposed market: partitioned EG solves + reconciling
+coordinator (shockwave_tpu/cells/).
+
+Pins the federation's contracts: capacity conservation across cells,
+batched-lane bit-exactness against the single-cell solve, bounded
+cell-vs-global objective gap on a fixed problem, per-cell fault
+isolation (an injected solver_timeout degrades ONE cell while the
+others' plans stay bit-identical), migration carrying
+incumbency/switch-cost state, flight-recorder replay exactness of
+coordinated (and degraded) replans, checkpoint round-trips, and the
+sharded admission front door (routing dedup, coordinator rebalancing,
+per-tenant quotas, priority-aware drain).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import bench
+from shockwave_tpu import obs
+from shockwave_tpu.cells import batched, coordinator, partition
+from shockwave_tpu.cells.planner import CellPlanner
+from shockwave_tpu.core.job import Job
+from shockwave_tpu.data.workload_info import steps_per_epoch
+from shockwave_tpu.obs.recorder import replay_log
+from shockwave_tpu.policies.shockwave import planner_from_state
+from shockwave_tpu.runtime import admission, faults
+from shockwave_tpu.solver.eg_pdhg import solve_eg_pdhg, solve_pdhg_relaxed
+
+PROFILE = {
+    "num_epochs": 4,
+    "num_samples_per_epoch": 64,
+    "scale_factor": 1,
+    "bs_every_epoch": [32] * 4,
+    "duration_every_epoch": [120.0] * 4,
+}
+
+CONFIG = {
+    "num_gpus": 4,
+    "time_per_iteration": 60.0,
+    "future_rounds": 4,
+    "lambda": 2.0,
+    "k": 1e-3,
+    "cells": 2,
+}
+
+
+def tiny_cell_planner(num_jobs=6, config=None, backend="cells"):
+    planner = CellPlanner(dict(config or CONFIG), backend=backend)
+    for j in range(num_jobs):
+        planner.add_job(j, dict(PROFILE), 60.0, 1)
+    return planner
+
+
+# -- partitioning -------------------------------------------------------
+
+
+def test_partition_capacity_even_and_floored():
+    assert partition.partition_capacity(8, 3) == [3, 3, 2]
+    assert partition.partition_capacity(2, 5) == [1, 1]  # clamped
+    assert sum(partition.partition_capacity(257, 16)) == 257
+
+
+def test_spread_capacity_delta_respects_floors():
+    grown = partition.spread_capacity_delta([2, 2], 3)
+    assert sum(grown) == 7 and min(grown) >= 2
+    shrunk = partition.spread_capacity_delta([4, 4], -5, floors=[2, 2])
+    # Only 4 chips are above the floors; the 5th shrink is dropped.
+    assert shrunk == [2, 2]
+
+
+def test_pick_cell_least_loaded_and_gang_fit():
+    # Cell 1 is emptier per chip; a 4-wide gang only fits cell 0.
+    assert partition.pick_cell(1, [6.0, 1.0], [4, 4]) == 1
+    assert partition.pick_cell(4, [6.0, 1.0], [4, 2]) == 0
+
+
+def test_pick_cell_sticky_hysteresis():
+    """A burst sticks to the previous cell while it stays within the
+    hysteresis of the fleet minimum — 1-job load deltas (the bucket-
+    boundary pathology) must not round-robin arrivals across cells."""
+    caps = [100, 100, 100]
+    loads = [50.0, 49.0, 50.0]  # cell 1 cheaper by 1 job
+    # Without stickiness the argmin flips to cell 1...
+    assert partition.pick_cell(1, loads, caps) == 1
+    # ...but a sticky cell within the hysteresis band keeps the burst
+    # (band = max(1, 2% of fair share) = 1 job short of 51 here).
+    assert partition.pick_cell(1, loads, caps, sticky=0) == 0
+    # Until it is genuinely above the band.
+    assert partition.pick_cell(1, [52.0, 49.0, 50.0], caps, sticky=0) == 1
+    # A sticky cell too narrow for the gang is abandoned.
+    assert partition.pick_cell(8, loads, [100, 4, 100], sticky=1) == 0
+
+
+def test_burst_admission_touches_one_cell():
+    """End-to-end stickiness at contention depth: after a balanced
+    fill of 1000 jobs/cell, an 18-job burst lands in at most 2 cells
+    (the stale-set bound that keeps per-round replanning flat). This
+    is a SCALE property — the hysteresis band is 2% of a cell's fair
+    share, so deep cells absorb whole bursts while tiny fleets keep
+    plain balancing."""
+    planner = CellPlanner(
+        {**CONFIG, "num_gpus": 64, "cells": 4}, backend="cells"
+    )
+    for j in range(4000):
+        planner.add_job(j, dict(PROFILE), 60.0, 1)
+    loads = [planner._cell_load(n) for n in planner.cells]
+    assert max(loads) - min(loads) <= 0.03 * (sum(loads) / 4), loads
+    touched = set()
+    for j in range(4000, 4018):
+        planner.add_job(j, dict(PROFILE), 60.0, 1)
+        touched.add(planner.job_cell[j])
+    assert len(touched) <= 2, touched
+
+
+# -- batched solve ------------------------------------------------------
+
+
+def _split_global(problem, num_cells):
+    """Partition a bench problem row-wise into cells (round-robin),
+    capacity split evenly."""
+    caps = partition.partition_capacity(problem.num_gpus, num_cells)
+    cells, indices = [], []
+    for c in range(num_cells):
+        idx = np.arange(c, problem.num_jobs, num_cells)
+        fields = {
+            f: getattr(problem, f)[idx]
+            for f in (
+                "priorities", "completed_epochs", "total_epochs",
+                "epoch_duration", "remaining_runtime", "nworkers",
+                "switch_cost", "incumbent",
+            )
+        }
+        cells.append(
+            dataclasses.replace(problem, num_gpus=caps[c], **fields)
+        )
+        indices.append(idx)
+    return cells, indices
+
+
+def test_batched_lane_bit_identical_to_single_solve():
+    """A cell's market must not change meaning by being solved next to
+    its neighbors: every vmap lane reproduces the standalone PDHG
+    solve bit-for-bit, and the lane band (batch size) doesn't matter."""
+    g = bench.make_problem(num_jobs=48, future_rounds=10, num_gpus=12, seed=1)
+    cells, _ = _split_global(g, 2)
+    s_pair, _, _ = batched.solve_cells_pdhg(cells)
+    s_single, _, _ = solve_pdhg_relaxed(cells[0])
+    np.testing.assert_array_equal(s_pair[0], s_single)
+    s_alone, _, _ = batched.solve_cells_pdhg([cells[0]])
+    np.testing.assert_array_equal(s_alone[0], s_pair[0])
+
+
+def test_cells_vs_global_objective_gap_and_capacity():
+    """The decomposition quality bar on a fixed problem: the merged
+    cell schedule, audited against the GLOBAL problem, stays within
+    0.1% of the global solve's objective and conserves capacity."""
+    g = bench.make_problem(num_jobs=64, future_rounds=20, num_gpus=16, seed=3)
+    Y_global = solve_eg_pdhg(g)
+    g.audit_schedule(Y_global)
+    cells, indices = _split_global(g, 2)
+    s_list, _, _ = batched.solve_cells_pdhg(cells)
+    merged = np.zeros_like(Y_global)
+    for cell, idx, s in zip(cells, indices, s_list):
+        merged[idx] = batched.schedule_cell(cell, s)
+    # Capacity conservation: the merged schedule is feasible for the
+    # GLOBAL problem (per-round usage <= fleet capacity) because each
+    # cell respected its slice.
+    g.audit_schedule(merged)
+    obj_g = g.objective_value(Y_global)
+    obj_m = g.objective_value(merged)
+    gap = (obj_g - obj_m) / abs(obj_g)
+    assert gap <= 1e-3, (obj_g, obj_m, gap)
+
+
+# -- coordinator math ---------------------------------------------------
+
+
+def test_congestion_price_zero_when_slack():
+    g = bench.make_problem(num_jobs=8, future_rounds=10, num_gpus=512, seed=0)
+    s, _, _ = solve_pdhg_relaxed(g)
+    assert coordinator.congestion_price(g, s) == 0.0
+
+
+def test_congestion_price_positive_under_contention():
+    g = bench.make_problem(num_jobs=64, future_rounds=10, num_gpus=4, seed=0)
+    s, _, _ = solve_pdhg_relaxed(g)
+    assert coordinator.congestion_price(g, s) > 0.0
+
+
+def test_capacity_move_flows_cheap_to_congested():
+    move = coordinator.propose_capacity_move(
+        ["a", "b"],
+        {"a": 0.0, "b": 5.0},
+        {"a": 3, "b": 0},
+        {"a": 8, "b": 8},
+        {"a": 1, "b": 1},
+    )
+    assert move is not None and move.src == "a" and move.dst == "b"
+    assert 1 <= move.chips <= 3
+    # Balanced prices: fixed point.
+    assert (
+        coordinator.propose_capacity_move(
+            ["a", "b"], {"a": 5.0, "b": 5.0}, {"a": 3, "b": 3},
+            {"a": 8, "b": 8}, {"a": 1, "b": 1},
+        )
+        is None
+    )
+
+
+def test_migration_priced_through_switch_cost():
+    """An incumbent whose relaunch overhead exceeds the cross-cell gain
+    must NOT migrate; an identical non-incumbent (free move) must."""
+    g = bench.make_problem(num_jobs=16, future_rounds=10, num_gpus=4, seed=2)
+    g = dataclasses.replace(
+        g,
+        incumbent=np.array([1.0] * 8 + [0.0] * 8),
+        switch_cost=np.array([1e9] * 8 + [0.0] * 8),
+    )
+    s, _, _ = solve_pdhg_relaxed(g)
+    ids = [f"job{i}" for i in range(16)]
+    plan = coordinator.plan_migrations(
+        ["hot", "cold"],
+        {"hot": g, "cold": g},
+        {"hot": s, "cold": s},
+        {"hot": ids, "cold": ids},
+        {"hot": 10.0, "cold": 0.0},
+        {"hot": 4, "cold": 4},
+        max_moves=4,
+    )
+    assert plan, "no migrations out of a congested cell"
+    moved = {m.job for m in plan}
+    assert moved <= set(ids[8:]), (
+        "an incumbent with a prohibitive switch cost was migrated: "
+        f"{moved}"
+    )
+    assert all(m.cost == 0.0 and not m.incumbent for m in plan)
+
+
+# -- CellPlanner --------------------------------------------------------
+
+
+def test_cell_planner_plans_and_conserves_capacity():
+    planner = tiny_cell_planner(num_jobs=8)
+    schedule = planner.current_round_schedule()
+    assert schedule
+    # Every job landed in exactly one cell.
+    assert len(planner.job_cell) == 8
+    assert sum(planner.assignments.values()) == 8
+    # Merged per-round usage across the window never exceeds the fleet.
+    for r in range(planner.round_index, planner.round_index + 4):
+        used = sum(
+            1
+            for child in planner.children.values()
+            for _ in child.schedules.get(r, [])
+        )
+        assert used <= CONFIG["num_gpus"]
+    record = planner.coord_solve_records[-1]
+    assert record["backend"] == "cells"
+    assert set(record["cells"]) == {"c00", "c01"}
+
+
+def test_selective_replan_only_touches_stale_cells():
+    """Churn in one cell must not re-solve the others: the coordinated
+    replan's stale set — and the untouched cell's cached plan — prove
+    the flat-latency property."""
+    planner = tiny_cell_planner(num_jobs=8)
+    planner.current_round_schedule()
+    first = planner.coord_solve_records[-1]
+    assert first["stale_cells"] == 2  # cold start: everyone solves
+    victim = 0
+    cell = planner.job_cell[victim]
+    other = [n for n in planner.cells if n != cell][0]
+    cached = {
+        r: list(s)
+        for r, s in planner.children[other].schedules.items()
+    }
+    planner.remove_job(victim)
+    planner.children[cell].set_recompute_flag()
+    planner.current_round_schedule()
+    second = planner.coord_solve_records[-1]
+    assert second["stale_cells"] == 1
+    assert list(second["cells"]) == [cell]
+    assert {
+        r: list(s)
+        for r, s in planner.children[other].schedules.items()
+    } == cached, "a non-stale cell's plan was disturbed"
+
+
+def test_fleet_capacity_change_spreads_with_floors():
+    planner = tiny_cell_planner(num_jobs=4)
+    planner.current_round_schedule()
+    planner.set_capacity(2)
+    assert sum(planner.cells.values()) == 2
+    assert all(c >= 1 for c in planner.cells.values())
+    planner.set_capacity(6)
+    assert sum(planner.cells.values()) == 6
+
+
+def test_migration_carries_incumbency_and_switch_cost():
+    planner = tiny_cell_planner(num_jobs=6)
+    planner.current_round_schedule()
+    job = 0
+    src_name = planner.job_cell[job]
+    dst_name = [n for n in planner.cells if n != src_name][0]
+    src = planner.children[src_name]
+    # Make the job an incumbent with a measured relaunch overhead.
+    src.job_overheads[job] = 42.0
+    src.last_round_jobs = [job]
+    planner._move_job(
+        coordinator.Migration(
+            job=job, src=src_name, dst=dst_name, gain=1.0, cost=0.0,
+            incumbent=True,
+        )
+    )
+    dst = planner.children[dst_name]
+    assert planner.job_cell[job] == dst_name
+    assert job in dst.job_metadata and job not in src.job_metadata
+    assert dst.job_overheads[job] == 42.0, "switch-cost state lost"
+    assert job in dst.last_round_jobs, "incumbency lost in migration"
+    assert job not in src.last_round_jobs
+    assert planner.migrations_total == 1
+    # The destination's next problem prices the migrated incumbent.
+    problem, job_ids = dst._build_problem()
+    i = job_ids.index(job)
+    assert problem.incumbent[i] == 1.0
+    assert problem.switch_cost[i] == 42.0
+
+
+def test_single_cell_timeout_degrades_that_cell_only():
+    """The fault-isolation contract: an injected solver_timeout charges
+    one cell's ladder; the other cell's plan is BIT-IDENTICAL to the
+    no-fault run."""
+    config = {**CONFIG, "plan_deadline_s": 10.0}
+    baseline = tiny_cell_planner(num_jobs=6, config=config)
+    baseline.current_round_schedule()
+    base_plans = {
+        n: dict(c.schedules) for n, c in baseline.children.items()
+    }
+    plan = faults.FaultPlan(
+        seed=0, events=[faults.FaultEvent(0, "solver_timeout", round=0)]
+    )
+    injector = faults.configure(plan)
+    try:
+        planner = tiny_cell_planner(num_jobs=6, config=config)
+        schedule = planner.current_round_schedule()
+        assert schedule
+        records = {
+            n: c.solve_records[-1] for n, c in planner.children.items()
+        }
+        assert records["c00"].get("degraded") is True
+        assert records["c00"]["backend"] != "pdhg"
+        assert records["c01"].get("degraded") is None
+        assert records["c01"]["backend"] == "pdhg"
+        assert dict(planner.children["c01"].schedules) == base_plans["c01"]
+        assert injector.summary()["unrecovered"] == []
+    finally:
+        faults.reset()
+
+
+def test_coordinated_replay_is_exact(tmp_path):
+    """Flight-recorder exactness for the federation: warm-started
+    coordinated replans (including reconciliation state) replay
+    bit-for-bit from the cell_set records."""
+    log = str(tmp_path / "cells.jsonl")
+    obs.reset()
+    obs.configure_recorder(log)
+    try:
+        planner = tiny_cell_planner(num_jobs=8)
+        planner.current_round_schedule()
+        planner.increment_round()
+        planner.set_recompute_flag()
+        planner.current_round_schedule()
+        obs.get_recorder().close()
+        results = replay_log(log)
+        assert len(results) == 2
+        assert all(not r["diff"] for r in results), [
+            r["diff"] for r in results
+        ]
+    finally:
+        obs.reset()
+
+
+def test_degraded_cell_replay_is_exact(tmp_path):
+    """A degraded cell's record stamps the per-cell backend + fallback
+    flag; replay re-enters the same rung instead of re-rolling the
+    ladder."""
+    log = str(tmp_path / "cells_degraded.jsonl")
+    plan = faults.FaultPlan(
+        seed=0, events=[faults.FaultEvent(0, "solver_timeout", round=0)]
+    )
+    faults.configure(plan)
+    obs.reset()
+    obs.configure_recorder(log)
+    try:
+        planner = tiny_cell_planner(
+            num_jobs=6, config={**CONFIG, "plan_deadline_s": 10.0}
+        )
+        planner.current_round_schedule()
+        obs.get_recorder().close()
+        faults.reset()
+        results = replay_log(log)
+        assert len(results) == 1
+        assert not results[0]["diff"], results[0]["diff"]
+    finally:
+        faults.reset()
+        obs.reset()
+
+
+def test_checkpoint_roundtrip_preserves_federation():
+    planner = tiny_cell_planner(num_jobs=6)
+    planner.current_round_schedule()
+    state = planner.state_dict()
+    assert state["kind"] == "cell_set"
+    restored = planner_from_state(state)
+    assert isinstance(restored, CellPlanner)
+    assert restored.cells == planner.cells
+    assert restored.job_cell == planner.job_cell
+    assert restored.num_jobs == planner.num_jobs
+    # The restored planner keeps planning (fresh replan, same jobs).
+    restored.set_recompute_flag()
+    assert restored.current_round_schedule()
+
+
+def test_policy_dispatch_builds_cell_planner():
+    from shockwave_tpu.policies import get_policy
+
+    policy = get_policy("shockwave_tpu_cells")
+    assert policy.name == "Shockwave_TPU_Cells"
+    planner = policy.make_planner(dict(CONFIG))
+    assert isinstance(planner, CellPlanner)
+    # Config-driven: any backend with cells >= 2 federates too.
+    policy = get_policy("shockwave_tpu_pdhg")
+    planner = policy.make_planner(dict(CONFIG))
+    assert isinstance(planner, CellPlanner)
+    assert not isinstance(
+        policy.make_planner({**CONFIG, "cells": 0}), CellPlanner
+    )
+
+
+# -- end-to-end simulation ---------------------------------------------
+
+
+def _stream_job(steps, tenant="", priority=1.0):
+    return Job(
+        job_type="ResNet-18 (batch size 32)",
+        command="python3 main.py --data_dir=%s/cifar10 --batch_size 32",
+        num_steps_arg="--num_steps",
+        total_steps=steps,
+        scale_factor=1,
+        mode="static",
+        tenant=tenant,
+        priority_weight=priority,
+    )
+
+
+def test_streaming_sim_with_cells_end_to_end():
+    """Full loop: cell-decomposed policy + sharded admission front door
+    through the virtual-time streaming submitter — every job admitted
+    exactly once, planned in a cell, completed."""
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.policies import get_policy
+
+    jobs = [
+        _stream_job(steps_per_epoch("ResNet-18", 32) * 2) for _ in range(8)
+    ]
+    arrivals = [0.0] * 4 + [400.0] * 4
+    submitter = admission.StreamingSubmitter(arrivals, jobs, batch_size=2)
+    sched = Scheduler(
+        get_policy("shockwave_tpu_cells"),
+        throughputs=generate_oracle(),
+        seed=0,
+        time_per_iteration=120,
+        shockwave_config={
+            "num_gpus": 4,
+            "time_per_iteration": 120,
+            "future_rounds": 8,
+            "lambda": 2.0,
+            "k": 1e-3,
+            "cells": 2,
+        },
+    )
+    sched.simulate({"v100": 4}, submitter=submitter, admission_capacity=8)
+    assert isinstance(sched._shockwave, CellPlanner)
+    assert isinstance(sched._admission, admission.ShardedAdmissionQueue)
+    assert sched._admission.num_shards == 2
+    assert sched._num_jobs_in_trace == 8
+    assert all(
+        t is not None for t in sched._job_completion_times.values()
+    )
+    assert sched._admission.depth() == 0
+    assert sum(sched._shockwave.assignments.values()) == 8
+
+
+# -- sharded admission front door --------------------------------------
+
+
+def test_sharded_queue_routes_and_dedups():
+    q = admission.ShardedAdmissionQueue(4, capacity=64)
+    job = _stream_job(100)
+    status, _, admitted = q.submit("tok-1", [job, job])
+    assert status == admission.STATUS_ACCEPTED and admitted == 2
+    # Retried token lands on the same shard's ledger: deduped.
+    status, _, admitted = q.submit("tok-1", [job, job])
+    assert status == admission.STATUS_ACCEPTED and admitted == 2
+    assert q.depth() == 2
+    assert q.summary()["deduped_batches"] == 1
+    drained = q.drain()
+    assert len(drained) == 2 and q.depth() == 0
+
+
+def test_sharded_queue_rebalances_hot_shard():
+    """A burst landing on one shard spills into the fleet's free space
+    instead of bouncing the submitter while other shards sit empty."""
+    q = admission.ShardedAdmissionQueue(2, capacity=8)  # 4 per shard
+    hot = q.shards[0]
+    jobs = [_stream_job(100) for _ in range(4)]
+    hot.submit("a", jobs)
+    assert hot.depth() == 4
+    # Another 3-job batch routed to the full shard: the coordinator
+    # rebalances (fleet has 4 free slots on the other shard).
+    token = "x"
+    while q._shard_of(token) is not hot:
+        token += "x"
+    status, _, _ = q.submit(token, [_stream_job(100) for _ in range(3)])
+    assert status == admission.STATUS_ACCEPTED
+    assert q.depth() == 7
+    assert q.summary()["per_shard_depth"][1] > 0, "no backlog moved"
+    # Everything drains exactly once.
+    assert len(q.drain()) == 7
+
+
+def test_tenant_quota_rejects_with_reason():
+    obs.reset()
+    obs.configure(metrics=True)
+    try:
+        q = admission.AdmissionQueue(
+            capacity=64, tenant_quotas={"teamA": 2}
+        )
+        a1 = _stream_job(100, tenant="teamA")
+        status, _, _ = q.submit("t1", [a1, a1])
+        assert status == admission.STATUS_ACCEPTED
+        status, _, admitted = q.submit("t2", [a1])
+        assert status == admission.STATUS_QUOTA and admitted == 0
+        assert q.summary()["quota_rejects"] == 1
+        # Unquota'd tenants ride free.
+        status, _, _ = q.submit("t3", [_stream_job(100, tenant="teamB")])
+        assert status == admission.STATUS_ACCEPTED
+        # Draining teamA's backlog frees the quota.
+        q.drain()
+        status, _, _ = q.submit("t4", [a1])
+        assert status == admission.STATUS_ACCEPTED
+        snapshot = obs.get_registry().snapshot()
+        series = snapshot["metrics"]["admission_rejected_total"]["series"]
+        assert any(
+            s["labels"].get("reason") == "quota" and s["value"] == 1
+            for s in series
+        ), series
+    finally:
+        obs.reset()
+
+
+def test_priority_aware_drain_orders_by_weight():
+    q = admission.AdmissionQueue(capacity=16, priority_aware=True)
+    low1 = _stream_job(100, priority=1.0)
+    high = _stream_job(100, priority=4.0)
+    low2 = _stream_job(100, priority=1.0)
+    q.submit("t1", [low1])
+    q.submit("t2", [high])
+    q.submit("t3", [low2])
+    drained = [job for _, job, _ in q.drain()]
+    assert drained[0] is high
+    # FIFO within a weight class.
+    assert drained[1] is low1 and drained[2] is low2
+
+
+def test_jobspec_wire_roundtrip_carries_tenant():
+    job = _stream_job(100, tenant="teamZ", priority=2.5)
+    spec = admission.job_to_spec_dict(job)
+    assert spec["tenant"] == "teamZ"
+    from shockwave_tpu.runtime.protobuf import admission_pb2 as pb
+
+    wire = pb.JobSpec(**spec).SerializeToString()
+    decoded = pb.JobSpec.FromString(wire)
+    assert decoded.tenant == "teamZ"
+    rebuilt = admission.job_from_spec_dict(decoded.__dict__)
+    assert rebuilt.tenant == "teamZ"
+    assert rebuilt.priority_weight == 2.5
+
+
+def test_quota_shed_batch_in_streaming_submitter():
+    """A quota-rejected batch is shed (counted) instead of spinning the
+    virtual-time submitter forever."""
+    q = admission.AdmissionQueue(capacity=16, tenant_quotas={"teamA": 1})
+    jobs = [_stream_job(100, tenant="teamA") for _ in range(3)]
+    sub = admission.StreamingSubmitter([0.0, 0.0, 0.0], jobs, batch_size=2)
+    drained = sub.pump(q, now=0.0)
+    # First batch of 2 exceeds quota 1 -> shed; the single-job batch
+    # fits.
+    assert sub.stats["quota_rejects"] == 1
+    assert len(drained) == 1
+    assert sub.exhausted()
+
+
+# -- sharded front-door contracts (fleet-wide quota, global priority,
+# close-on-accept) and coordinator demand units ------------------------
+
+
+def test_demand_rounds_converts_epochs_through_epoch_duration():
+    """A job's remaining work is epochs x epoch seconds: the rounds of
+    demand the coordinator prices (and migration gains scale by) must
+    carry epoch_duration, not the raw epoch count."""
+    g = bench.make_problem(num_jobs=6, future_rounds=10, num_gpus=4, seed=0)
+    need = np.maximum(g.total_epochs - g.completed_epochs, 0.0)
+    expected = need * g.epoch_duration / g.round_duration
+    np.testing.assert_allclose(coordinator.demand_rounds(g), expected)
+    g2 = dataclasses.replace(g, epoch_duration=g.epoch_duration * 2.0)
+    np.testing.assert_allclose(coordinator.demand_rounds(g2), expected * 2.0)
+
+
+def test_sharded_tenant_quota_is_fleet_wide():
+    """A tenant's quota bounds the FLEET's pending jobs: batches that
+    hash to different shards share one ledger, so sharding cannot
+    multiply the quota by the shard count."""
+    q = admission.ShardedAdmissionQueue(
+        4, capacity=64, tenant_quotas={"teamA": 2}
+    )
+    a = _stream_job(100, tenant="teamA")
+    tokens, shards_seen, i = [], set(), 0
+    while len(tokens) < 3:
+        tok = f"tok-{i}"
+        i += 1
+        shard = q._shard_of(tok)
+        if id(shard) not in shards_seen:
+            shards_seen.add(id(shard))
+            tokens.append(tok)
+    s1, _, _ = q.submit(tokens[0], [a])
+    s2, _, _ = q.submit(tokens[1], [a])
+    assert s1 == s2 == admission.STATUS_ACCEPTED
+    s3, _, admitted = q.submit(tokens[2], [a])
+    assert s3 == admission.STATUS_QUOTA and admitted == 0
+    # Rebalancing pending jobs between shards does not free quota.
+    q.rebalance()
+    s4, _, _ = q.submit("tok-after-rebalance", [a])
+    assert s4 == admission.STATUS_QUOTA
+    # Draining genuinely does.
+    q.drain()
+    s5, _, _ = q.submit("tok-after-drain", [a])
+    assert s5 == admission.STATUS_ACCEPTED
+
+
+def test_sharded_priority_drain_is_global():
+    """Priority-aware drain merges across shards: a high-weight job is
+    admitted ahead of lower-weight jobs that happened to hash to an
+    earlier shard."""
+    q = admission.ShardedAdmissionQueue(2, capacity=16, priority_aware=True)
+    low = [_stream_job(100, priority=1.0) for _ in range(3)]
+    high = _stream_job(100, priority=4.0)
+    # Place backlogs on specific shards directly — where a token hashed
+    # is incidental to the contract under test.
+    q.shards[0].submit("t-low", low)
+    q.shards[1].submit("t-high", [high])
+    first = q.drain(max_jobs=1)
+    assert len(first) == 1 and first[0][1] is high
+    rest = [job for _, job, _ in q.drain()]
+    assert rest == low
+
+
+def test_sharded_close_rides_only_accepted_batches():
+    """A close-carrying batch bounced by backpressure must NOT close
+    the fleet: the submitter's backoff retry IS the close-carrying
+    resend, and it must still be admittable after the backlog drains."""
+    q = admission.ShardedAdmissionQueue(2, capacity=4)  # 2 per shard
+    for i, shard in enumerate(q.shards):
+        shard.submit(f"fill-{i}", [_stream_job(100), _stream_job(100)])
+    tok = "final-batch"
+    status, _, _ = q.submit(tok, [_stream_job(100)], close=True)
+    assert status == admission.STATUS_RETRY_AFTER
+    assert not q.closed, "rejected close-carrying batch closed the fleet"
+    assert len(q.drain()) == 4
+    status, _, admitted = q.submit(tok, [_stream_job(100)], close=True)
+    assert status == admission.STATUS_ACCEPTED and admitted == 1
+    assert q.closed
+    assert len(q.drain()) == 1
+
+
+def test_sharded_capacity_sums_exactly_to_configured_bound():
+    """ceil-splitting per-shard capacity would let the fleet hold up
+    to shards-1 more pending jobs than the bound the aggregate gauge
+    (and the backlog watchdog's denominator) advertises."""
+    q = admission.ShardedAdmissionQueue(8, capacity=10)
+    caps = [s.capacity for s in q.shards]
+    assert sum(caps) == 10 and min(caps) >= 1
+    assert q.capacity == 10
+
+
+def test_streaming_submitter_batches_are_single_tenant():
+    """One over-quota tenant must not shed another tenant's jobs that
+    arrived in the same burst: batches never mix tenants."""
+    q = admission.AdmissionQueue(capacity=16, tenant_quotas={"teamA": 0})
+    jobs = [
+        _stream_job(100, tenant="teamA"),
+        _stream_job(100, tenant="teamB"),
+    ]
+    sub = admission.StreamingSubmitter([0.0, 0.0], jobs, batch_size=8)
+    drained = sub.pump(q, now=0.0)
+    assert sub.stats["quota_rejects"] == 1
+    assert [job.tenant for _, job, _ in drained] == ["teamB"]
+    assert sub.exhausted()
+
+
+def test_submit_stream_sheds_quota_batches_and_closes():
+    """A QUOTA rejection sheds that tenant's batch only: later batches
+    still submit and the end-of-stream close is still sent (no wedged
+    round loop waiting on a close that never comes)."""
+    from shockwave_tpu.runtime.rpc import submitter_client as sc
+
+    client = sc.SubmitterClient("127.0.0.1", 0, client_id="t")
+    calls = []
+
+    class _Resp:
+        status = "ACCEPTED"
+        retry_after_s = 0.0
+
+    def fake_submit(jobs, token=None, close=False):
+        calls.append((list(jobs), close))
+        if jobs and getattr(jobs[0], "tenant", "") == "teamA":
+            raise sc.SubmissionRejected("QUOTA", "over quota")
+        return _Resp()
+
+    client.submit = fake_submit
+    a1, a2 = (_stream_job(100, tenant="teamA") for _ in range(2))
+    b = _stream_job(100, tenant="teamB")
+    tokens = client.submit_stream([a1, a2, b], batch_size=8)
+    assert len(tokens) == 2  # teamA's run + teamB's run
+    submitted = [jobs for jobs, _ in calls if jobs]
+    assert submitted == [[a1, a2], [b]], "tenants shared a batch"
+    assert calls[-1] == ([], True), "end-of-stream close not sent"
+
+
+def test_set_recompute_flag_with_jobs_stales_only_owning_cell():
+    """One job's state change (requeue, batch-size adaptation) re-
+    solves its cell, not the fleet; an unmapped job falls back to the
+    safe full stale."""
+    planner = tiny_cell_planner(num_jobs=8)
+    planner.current_round_schedule()
+    for child in planner.children.values():
+        child.recompute_flag = False
+    job = next(iter(planner.job_cell))
+    owner = planner.job_cell[job]
+    planner.set_recompute_flag(jobs=[job])
+    for name, child in planner.children.items():
+        assert child.recompute_flag == (name == owner), name
+    planner.set_recompute_flag(jobs=["no-such-job"])
+    assert all(c.recompute_flag for c in planner.children.values())
+
+
+def test_rpc_handler_carries_tenant_to_admission():
+    """The wire path must not strip JobSpec.tenant — per-tenant quotas
+    are meaningless if the RPC handler launders every job into the
+    anonymous unbounded tenant."""
+    from shockwave_tpu.runtime.protobuf import admission_pb2 as pb
+    from shockwave_tpu.runtime.rpc.scheduler_server import (
+        _admission_handlers,
+    )
+
+    seen = {}
+
+    def submit_jobs(token, specs, close):
+        seen["specs"] = specs
+        return ("ACCEPTED", 0.0, len(specs), len(specs))
+
+    handler = _admission_handlers({"submit_jobs": submit_jobs})[
+        "SubmitJobs"
+    ]
+    spec = admission.job_to_spec_dict(_stream_job(100, tenant="teamA"))
+    request = pb.SubmitJobsRequest(
+        token="t", jobs=[pb.JobSpec(**spec)], close=False
+    )
+    wire = pb.SubmitJobsRequest.FromString(request.SerializeToString())
+    response = handler(wire, None)
+    assert response.status == "ACCEPTED"
+    assert seen["specs"][0]["tenant"] == "teamA"
+
+
+def test_priority_fifo_by_arrival_survives_rebalance():
+    """Equal-weight jobs drain in arrival order even after the
+    coordinator moved one between shards: per-shard seq counters are
+    not comparable across shards, arrival stamps are."""
+    q = admission.ShardedAdmissionQueue(2, capacity=16, priority_aware=True)
+    early = _stream_job(100)
+    late = _stream_job(100)
+    q.shards[0].submit("t-early", [early], now=10.0)
+    q.shards[1].submit("t-late", [late], now=20.0)
+    q.shards[0]._give(q.shards[1]._take_newest(1))
+    drained = [job for _, job, _ in q.drain(max_jobs=1, now=30.0)]
+    drained += [job for _, job, _ in q.drain(max_jobs=1, now=30.0)]
+    assert drained == [early, late]
