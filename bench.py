@@ -70,6 +70,60 @@ def make_problem(num_jobs, future_rounds, num_gpus, seed=0, regularizer=10.0):
     )
 
 
+def pipelining_phase():
+    """Plan-ahead pipelining A/B (one small end-to-end sim pair): the
+    same static 8-job trace run serial and pipelined. Reports the
+    fraction of the serial boundary planning bill the pipelined run
+    still exposes (``effective_overhead_pct``, lower is better; the
+    rest is hidden behind round execution by the speculative solve) and
+    the reconcile hit rate (higher is better; a no-churn trace should
+    hit every boundary). Both series are gated by
+    scripts/ci/check_bench_regression.py."""
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.data.generate import smoke_trace_jobs
+    from shockwave_tpu.data.profiles import synthesize_profiles
+    from shockwave_tpu.policies import get_policy
+
+    def run(speculate):
+        oracle = generate_oracle()
+        jobs, _ = smoke_trace_jobs(8)
+        profiles = synthesize_profiles(jobs, oracle)
+        sched = Scheduler(
+            get_policy("shockwave_tpu_pdhg"),
+            throughputs=oracle,
+            seed=0,
+            time_per_iteration=120,
+            profiles=profiles,
+            shockwave_config={
+                "num_gpus": 4,
+                "time_per_iteration": 120,
+                "future_rounds": 6,
+                "lambda": 2.0,
+                "k": 1e-3,
+                "speculate": speculate,
+            },
+        )
+        sched.simulate({"v100": 4}, [0.0] * len(jobs), jobs)
+        return sched._shockwave
+
+    serial = run(False)
+    pipelined = run(True)
+    serial_exposed = sum(serial.exposed_plan_times)
+    pipelined_exposed = sum(pipelined.exposed_plan_times)
+    stats = pipelined.spec_stats
+    reconciles = max(1, sum(stats.values()))
+    return {
+        "effective_overhead_pct": round(
+            100.0 * pipelined_exposed / max(serial_exposed, 1e-9), 2
+        ),
+        "speculation_hit_rate": round(stats["hit"] / reconciles, 4),
+        "pipelining_serial_exposed_s": round(serial_exposed, 4),
+        "pipelining_exposed_s": round(pipelined_exposed, 4),
+        "pipelining_spec_stats": dict(stats),
+    }
+
+
 def main():
     from shockwave_tpu.solver.eg_jax import (
         counts_to_schedule,
@@ -408,6 +462,11 @@ def main():
             if within_tenth_pct_s is not None
             else None
         ),
+        # Plan-ahead pipelining A/B: % of the serial boundary planning
+        # bill still exposed when round r+1 is solved speculatively
+        # behind round r, and the reconcile hit rate on a no-churn
+        # trace (both gated by check_bench_regression.py).
+        **pipelining_phase(),
         "config": "1000 jobs x 256 gpus x 50 rounds",
     }
 

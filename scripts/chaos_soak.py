@@ -61,9 +61,15 @@ def make_jobs(num_jobs: int, epochs: int, arrival_gap_s: float, seed: int):
     return jobs, arrivals
 
 
-def run_sim(args, jobs, arrivals, profiles, oracle, decision_log=None):
+def run_sim(
+    args, jobs, arrivals, profiles, oracle, decision_log=None,
+    extra_config=None,
+):
     """One simulation; jobs/profiles are rebuilt per run by the caller
-    (the scheduler mutates Job objects)."""
+    (the scheduler mutates Job objects). ``extra_config`` merges extra
+    shockwave-config keys — the stickiness/hysteresis sweep
+    (scripts/sweeps/sweep_chaos_stickiness.py) drives the same soak
+    through it."""
     config = {
         "num_gpus": args.num_gpus,
         "time_per_iteration": args.round_s,
@@ -74,6 +80,8 @@ def run_sim(args, jobs, arrivals, profiles, oracle, decision_log=None):
         "solver_timeout": 15,
         "plan_deadline_s": args.plan_deadline_s,
     }
+    if extra_config:
+        config.update(extra_config)
     obs.reset()  # fresh metrics/recorder/watchdog state per run
     if decision_log is not None:
         obs.configure_recorder(decision_log)

@@ -1,0 +1,230 @@
+#!/usr/bin/env python3
+"""CI smoke gate for plan-ahead pipelining (speculative next-round
+solves; shockwave_tpu/policies/speculation.py).
+
+Three sims, minutes total on CPU, with the full contract asserted:
+
+1. **No-churn bit-identity** — a static all-at-t0 trace run serial and
+   pipelined must produce IDENTICAL makespans and per-round schedules
+   (every boundary a speculation hit), with the pipelined run's exposed
+   boundary planning time a small fraction of the serial solve bill.
+2. **Reconcile under churn** — staggered arrivals churn boundaries
+   between snapshot and reconcile: every job still completes, at least
+   one boundary repairs or misses, and pipelining never re-plans more
+   eagerly than serial (live solve count <= serial solve count + the
+   repair count).
+3. **Replay exactness** — the churny pipelined run records a decision
+   log whose every plan record (speculative and repaired included)
+   replays bit-exact, and the cells federation passes the same churny
+   A/B with exact replay.
+
+Writes ``results/pipelining/smoke.json``; exits non-zero on any
+violated invariant. Wired into the verify skill next to the
+chaos/churn/cells smokes.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from shockwave_tpu import obs
+from shockwave_tpu.core.scheduler import Scheduler
+from shockwave_tpu.data.default_oracle import generate_oracle
+from shockwave_tpu.data.generate import smoke_trace_jobs
+from shockwave_tpu.data.profiles import synthesize_profiles
+from shockwave_tpu.obs.recorder import replay_log, summarize_log
+from shockwave_tpu.policies import get_policy
+from shockwave_tpu.utils.fileio import atomic_write_json
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+OUT = os.path.join(REPO, "results", "pipelining")
+
+
+def run(policy, speculate, arrival_gap_s, cells=None, log=None,
+        num_jobs=8, epochs=2, num_gpus=4):
+    obs.reset()
+    if log:
+        if os.path.exists(log):
+            os.remove(log)
+        obs.configure_recorder(log)
+    oracle = generate_oracle()
+    jobs, arrivals = smoke_trace_jobs(num_jobs, epochs, arrival_gap_s)
+    profiles = synthesize_profiles(jobs, oracle)
+    config = {
+        "num_gpus": num_gpus,
+        "time_per_iteration": 120,
+        "future_rounds": 6,
+        "lambda": 2.0,
+        "k": 1e-3,
+        "solver_rel_gap": 1e-3,
+        "solver_timeout": 15,
+        "speculate": speculate,
+    }
+    if cells:
+        config["cells"] = cells
+    sched = Scheduler(
+        get_policy(policy),
+        throughputs=oracle,
+        seed=0,
+        time_per_iteration=120,
+        profiles=profiles,
+        shockwave_config=config,
+    )
+    makespan = sched.simulate({"v100": num_gpus}, arrivals, jobs)
+    if log:
+        obs.get_recorder().close()
+    planner = sched._shockwave
+    return {
+        "makespan_s": makespan,
+        "rounds": [
+            r for r in sched._round_log if r["event"] == "round"
+        ],
+        "completed": sum(
+            1
+            for t in sched._job_completion_times.values()
+            if t is not None
+        ),
+        "spec_stats": dict(planner.spec_stats),
+        "exposed_s": sum(planner.exposed_plan_times),
+        "solves": len(
+            [r for r in planner.solve_records if r.get("ok", True)]
+        ),
+        "repairs": len(
+            [r for r in planner.solve_records if r.get("repair")]
+        ),
+    }
+
+
+def main():
+    failures = []
+    result = {}
+
+    # 1. no-churn bit-identity --------------------------------------
+    serial = run("shockwave_tpu_pdhg", False, 0.0)
+    pipelined = run("shockwave_tpu_pdhg", True, 0.0)
+    hits = pipelined["spec_stats"]["hit"]
+    result["no_churn"] = {
+        "serial_makespan_s": serial["makespan_s"],
+        "pipelined_makespan_s": pipelined["makespan_s"],
+        "spec_stats": pipelined["spec_stats"],
+        "serial_exposed_s": round(serial["exposed_s"], 4),
+        "pipelined_exposed_s": round(pipelined["exposed_s"], 4),
+    }
+    if pipelined["makespan_s"] != serial["makespan_s"]:
+        failures.append(
+            "no-churn makespan diverged: serial "
+            f"{serial['makespan_s']} vs pipelined "
+            f"{pipelined['makespan_s']}"
+        )
+    if pipelined["rounds"] != serial["rounds"]:
+        failures.append("no-churn per-round schedules diverged")
+    if hits < 1:
+        failures.append(f"no-churn run recorded {hits} hits (need >=1)")
+    if pipelined["spec_stats"]["repair"] or pipelined["spec_stats"]["miss"]:
+        failures.append(
+            "no-churn run should reconcile hit-only, got "
+            f"{pipelined['spec_stats']}"
+        )
+    if pipelined["exposed_s"] > 0.5 * serial["exposed_s"]:
+        failures.append(
+            "pipelining hid too little: exposed "
+            f"{pipelined['exposed_s']:.3f}s vs serial "
+            f"{serial['exposed_s']:.3f}s"
+        )
+
+    # 2. reconcile under churn --------------------------------------
+    churn_log = os.path.join(OUT, "smoke_decision_log.jsonl")
+    os.makedirs(OUT, exist_ok=True)
+    churn_serial = run("shockwave_tpu_pdhg", False, 60.0)
+    churn = run("shockwave_tpu_pdhg", True, 60.0, log=churn_log)
+    result["churn"] = {
+        "completed": churn["completed"],
+        "spec_stats": churn["spec_stats"],
+        "repair_solves": churn["repairs"],
+        "serial_solves": churn_serial["solves"],
+        "pipelined_solves": churn["solves"],
+    }
+    if churn["completed"] != 8:
+        failures.append(
+            f"churn run lost jobs: {churn['completed']}/8 completed"
+        )
+    if churn["spec_stats"]["repair"] + churn["spec_stats"]["miss"] < 1:
+        failures.append(
+            "churn run never repaired/missed — arrivals did not "
+            f"churn any boundary: {churn['spec_stats']}"
+        )
+    if churn["solves"] > churn_serial["solves"]:
+        failures.append(
+            "pipelining re-planned more eagerly than serial "
+            f"({churn['solves']} vs {churn_serial['solves']} solves)"
+        )
+
+    # 3. replay exactness (flat + cells) ----------------------------
+    replays = replay_log(churn_log)
+    diverged = [r for r in replays if r["diff"]]
+    summary = summarize_log(churn_log)
+    result["replay"] = {
+        "plans": summary["plans"],
+        "speculative_plans": summary["speculative_plans"],
+        "speculations": summary["speculations"],
+        "replayed": len(replays),
+        "diverged": len(diverged),
+    }
+    if not replays:
+        failures.append("churn decision log replayed no plan records")
+    if summary["speculative_plans"] < 1:
+        failures.append("decision log carries no speculative plan record")
+    if diverged:
+        failures.append(
+            f"replay diverged on {len(diverged)}/{len(replays)} plan "
+            f"records (first: round {diverged[0]['round']})"
+        )
+
+    cells_log = os.path.join(OUT, "smoke_cells_decision_log.jsonl")
+    cells_serial = run("shockwave_tpu_cells", False, 60.0, cells=2)
+    cells_pipe = run(
+        "shockwave_tpu_cells", True, 60.0, cells=2, log=cells_log
+    )
+    creplays = replay_log(cells_log)
+    cdiverged = [r for r in creplays if r["diff"]]
+    result["cells"] = {
+        "serial_makespan_s": cells_serial["makespan_s"],
+        "pipelined_makespan_s": cells_pipe["makespan_s"],
+        "completed": cells_pipe["completed"],
+        "spec_stats": cells_pipe["spec_stats"],
+        "replayed": len(creplays),
+        "diverged": len(cdiverged),
+    }
+    if cells_pipe["completed"] != 8:
+        failures.append(
+            f"cells churn run lost jobs: {cells_pipe['completed']}/8"
+        )
+    if sum(cells_pipe["spec_stats"].values()) < 1:
+        failures.append("cells run never reconciled a speculation")
+    if cdiverged:
+        failures.append(
+            f"cells replay diverged on {len(cdiverged)}/{len(creplays)}"
+        )
+
+    result["failures"] = failures
+    result["ok"] = not failures
+    atomic_write_json(os.path.join(OUT, "smoke.json"), result)
+    print(json.dumps(result, indent=1, default=str))
+    for line in failures:
+        print(f"FAIL: {line}")
+    if not failures:
+        print(
+            f"OK: no-churn bit-identical over {hits} hits, churn "
+            f"reconciled {churn['spec_stats']}, "
+            f"{len(replays)}+{len(creplays)} plan records replayed "
+            "exactly"
+        )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

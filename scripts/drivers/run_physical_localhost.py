@@ -122,6 +122,21 @@ def main(argv=None):
         help="auto-size the round so the relaunch overhead costs at most "
         "this fraction of it",
     )
+    parser.add_argument(
+        "--speculate",
+        action="store_true",
+        help="plan-ahead pipelining: solve round r+1 speculatively on a "
+        "background thread while round r executes, reconciling at the "
+        "boundary (shockwave policies only; see docs/USAGE.md)",
+    )
+    parser.add_argument(
+        "--speculate_epoch_tolerance",
+        type=int,
+        default=1,
+        help="epochs of per-job progress drift a speculation survives "
+        "before the boundary repairs instead of installing (physical "
+        "default 1: measured step counts race epoch boundaries)",
+    )
     obs.add_telemetry_args(parser)
     args = parser.parse_args(argv)
 
@@ -140,6 +155,8 @@ def main(argv=None):
             "future_rounds": 8,
             "lambda": 5.0,
             "k": 10.0,
+            "speculate": args.speculate,
+            "speculate_epoch_tolerance": args.speculate_epoch_tolerance,
         }
 
     # Worker as a real subprocess (the deployment shape), payloads on

@@ -186,6 +186,20 @@ def main(argv=None):
         help="auto-size the round so the worst measured relaunch "
         "overhead costs at most this fraction of it",
     )
+    parser.add_argument(
+        "--speculate",
+        action="store_true",
+        help="plan-ahead pipelining: solve round r+1 speculatively on a "
+        "background thread while round r executes, reconciling at the "
+        "boundary (shockwave policies only; see docs/USAGE.md)",
+    )
+    parser.add_argument(
+        "--speculate_epoch_tolerance",
+        type=int,
+        default=1,
+        help="epochs of per-job progress drift a speculation survives "
+        "before the boundary repairs instead of installing",
+    )
     obs.add_telemetry_args(parser)
     args = parser.parse_args(argv)
 
@@ -217,6 +231,8 @@ def main(argv=None):
             "future_rounds": 8,
             "lambda": 5.0,
             "k": 10.0,
+            "speculate": args.speculate,
+            "speculate_epoch_tolerance": args.speculate_epoch_tolerance,
         }
 
     # Worker subprocess with the real chip visible (unlike the CPU

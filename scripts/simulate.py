@@ -99,6 +99,13 @@ def main(args):
             # (shockwave_tpu/cells/), selective per-cell replanning +
             # reconciling coordinator.
             shockwave_config["cells"] = int(args.cells)
+        if args.speculate:
+            # Plan-ahead pipelining: speculative next-round solves
+            # reconciled at the boundary (policies/speculation.py). In
+            # simulation the overlap is free by construction; the flag
+            # exercises the identical reconcile machinery and pins
+            # no-churn runs bit-identical to serial.
+            shockwave_config["speculate"] = True
 
     preemption_overheads = None
     if args.preemption_overheads:
@@ -307,5 +314,12 @@ if __name__ == "__main__":
         type=str,
         default=None,
         help="Checkpoint path; resumes from it if it already exists",
+    )
+    parser.add_argument(
+        "--speculate",
+        action="store_true",
+        help="Plan-ahead pipelining: speculatively solve round r+1 "
+        "while round r runs, reconciling at the boundary "
+        "(shockwave policies only; see docs/USAGE.md)",
     )
     main(parser.parse_args())

@@ -51,6 +51,7 @@ import numpy as np
 from shockwave_tpu import obs
 from shockwave_tpu.cells import batched, coordinator, partition
 from shockwave_tpu.policies.shockwave import ShockwavePlanner
+from shockwave_tpu.policies.speculation import SpeculativePlannerMixin
 
 # Solve knobs default to the single-pdhg backend's; config keys
 # ("cell_*") override per deployment.
@@ -60,7 +61,7 @@ DEFAULT_MIGRATION_PATIENCE = 2
 DEFAULT_MAX_MIGRATIONS = 8
 
 
-class CellPlanner:
+class CellPlanner(SpeculativePlannerMixin):
     """Cell-decomposed planner (see module docstring). Config keys:
 
     ``cells`` (required, int >= 2)
@@ -155,6 +156,11 @@ class CellPlanner:
         self.schedules: "OrderedDict[int, list]" = OrderedDict()
         self._replay_stamp: Optional[dict] = None
         self._failed_cells: set = set()
+        # Plan-ahead pipelining (shockwave_tpu/policies/speculation.py):
+        # the federation speculates as a whole and reconciles per cell —
+        # only churned cells re-solve at the boundary. Shared
+        # scaffolding from SpeculativePlannerMixin.
+        self._init_speculation(config)
         obs.gauge(
             "cells_count", "number of cells the fleet partitions into"
         ).set(float(len(self.cells)))
@@ -329,16 +335,186 @@ class CellPlanner:
         return any(self._cell_stale(c) for c in self.children.values())
 
     def current_round_schedule(self) -> list:
+        """This round's fleet-wide job list. With plan-ahead pipelining
+        armed, a pending speculative solve for this boundary reconciles
+        first (see the hooks below); the wall time spent here on
+        reconcile + any coordinated replan is the run's EXPOSED
+        planning time."""
+        start = time.perf_counter()
+        reconciled = self._reconcile_speculation()
         if self._needs_replan():
             self._replan()
             for name, child in self.children.items():
                 if name not in self._failed_cells:
                     child.recompute_flag = False
+            self._observe_boundary(time.perf_counter() - start)
+        elif reconciled is not None:
+            self._observe_boundary(time.perf_counter() - start)
         return [
             j
             for child in self.children.values()
             for j in child.schedules.get(child.round_index, [])
         ]
+
+    # -- plan-ahead pipelining ------------------------------------------
+    # The federation speculates as a whole (one clone, one coordinated
+    # replan over the predicted stale set) and reconciles per cell:
+    # cells whose predicted state matches reality adopt their
+    # speculative windows, churned cells alone re-solve at the boundary
+    # warm-started from the speculative windows.
+    # speculate_next_round / _reconcile_speculation / _observe_boundary
+    # come from SpeculativePlannerMixin; the hooks below are the
+    # federation's reconcile semantics.
+    def _spec_solve_base(self) -> dict:
+        return {
+            "coord": len(self.coord_solve_records),
+            "cells": {
+                n: len(c.solve_records) for n, c in self.children.items()
+            },
+        }
+
+    def _augment_mismatch(self, mismatch: dict) -> dict:
+        """A recompute-flagged cell is churned even when the fingerprint
+        math cannot see why (batch-size switch, capacity event)."""
+        flagged = [
+            n for n, c in self.children.items() if c.recompute_flag
+        ]
+        if flagged:
+            mismatch = dict(mismatch)
+            for name in flagged:
+                mismatch.setdefault(name, []).append("recompute_flagged")
+        return mismatch
+
+    def _install_speculation(self, spec) -> None:
+        """No-churn boundary: adopt the clone's coordinated-replan
+        outputs wholesale — including any cross-cell decisions the
+        speculative coordinator made (capacity moves, job migrations),
+        which are replicated on the live federation so its topology
+        matches the installed windows. The live children's measured
+        predictor state stays authoritative (in simulation it equals
+        the clone's by exact prediction)."""
+        clone = spec.clone
+        if not spec.solved:
+            return  # the boundary serves every cell's cache either way
+        # Migrations first (a move may be the reason capacities differ).
+        for job_id, dst in list(clone.job_cell.items()):
+            src = self.job_cell.get(job_id)
+            if src is not None and src != dst:
+                self._install_migration(job_id, src, dst)
+        for name, cap in clone.cells.items():
+            if name in self.cells and self.cells[name] != int(cap):
+                # Direct field writes, NOT set_capacity: the installed
+                # windows were solved at this capacity, so the change
+                # must not re-flag the cell for another replan.
+                self.cells[name] = int(cap)
+                child = self.children[name]
+                child.num_gpus = int(cap)
+                child.config["num_gpus"] = int(cap)
+        self.num_gpus = sum(self.cells.values())
+        self.config["num_gpus"] = self.num_gpus
+        base = spec.base_solve_records
+        for name, child in self.children.items():
+            cchild = clone.children.get(name)
+            if cchild is None:
+                continue
+            child.schedules = OrderedDict(
+                (r, list(s)) for r, s in cchild.schedules.items()
+            )
+            child.finish_time_estimates = {
+                j: list(h)
+                for j, h in cchild.finish_time_estimates.items()
+            }
+            cell_base = base["cells"].get(name, 0)
+            child.solve_times.extend(cchild.solve_times[cell_base:])
+            child.solve_records.extend(
+                dict(r) for r in cchild.solve_records[cell_base:]
+            )
+            child.recompute_flag = bool(cchild.recompute_flag)
+        self.coord_solve_times.extend(
+            clone.coord_solve_times[base["coord"]:]
+        )
+        self.coord_solve_records.extend(
+            dict(r) for r in clone.coord_solve_records[base["coord"]:]
+        )
+        self.schedules = OrderedDict(
+            (r, list(s)) for r, s in clone.schedules.items()
+        )
+        self.prices = dict(clone.prices)
+        self.spares = dict(clone.spares)
+        self.imbalance_rounds = int(clone.imbalance_rounds)
+        self.migrations_total = int(self.migrations_total) + max(
+            0, int(clone.migrations_total) - int(self.migrations_total)
+        )
+        self._failed_cells = set(clone._failed_cells)
+
+    def _install_migration(self, job_id, src: str, dst: str) -> None:
+        """Replicate one speculative migration on the live federation
+        (same mechanics as :meth:`_move_job`, but the recompute flags
+        are governed by the install — the migrated job's window is
+        already part of the installed plan)."""
+        src_child, dst_child = self.children[src], self.children[dst]
+        md = src_child.job_metadata.pop(job_id, None)
+        if md is None:
+            return
+        dst_child.job_metadata[job_id] = md
+        history = src_child.finish_time_estimates.pop(job_id, None)
+        if history is not None:
+            dst_child.finish_time_estimates[job_id] = history
+        dst_child.job_overheads[job_id] = src_child.job_overheads.pop(
+            job_id, 0.0
+        )
+        if job_id in src_child.last_round_jobs:
+            src_child.last_round_jobs = [
+                j for j in src_child.last_round_jobs if j != job_id
+            ]
+            dst_child.last_round_jobs = list(
+                dst_child.last_round_jobs
+            ) + [job_id]
+        gang = self._cell_jobs.get(src, {}).pop(job_id, None)
+        if gang is not None:
+            self._load[src] = max(0.0, self._load[src] - gang)
+            self._cell_jobs[dst][job_id] = gang
+            self._load[dst] = self._load.get(dst, 0.0) + gang
+        self.job_cell[job_id] = dst
+        self.migrations_total += 1
+        obs.counter(
+            "cells_migrations_total", "jobs migrated between cells"
+        ).inc(src=src, dst=dst)
+
+    def _prepare_repair(self, spec, mismatch: dict) -> bool:
+        """Churned boundary. Only when the federation was going to
+        replan anyway: each STALE cell adopts the speculative window as
+        its plan-cache warm basis (the batched boundary re-solve
+        warm-starts from it through the existing
+        ``_solution_warm_start`` -> ``delta_patch_counts`` path) and is
+        re-flagged so it definitely re-solves against reality. Cells
+        that are not stale keep their live caches untouched — the
+        repair never re-plans a cell the serial boundary would have
+        served from cache. The clone's cross-cell moves are discarded:
+        the boundary coordinator re-decides them from live prices."""
+        if not self._needs_replan():
+            return False
+        if spec.solved:
+            for name, child in self.children.items():
+                if not self._cell_stale(child):
+                    continue
+                cchild = spec.clone.children.get(name)
+                if cchild is None:
+                    continue
+                # Only the window rows of jobs still owned by this live
+                # cell form a valid warm basis (the clone may have
+                # migrated jobs; delta_patch_counts drops strays, but
+                # keeping the filter here makes the basis exact).
+                child.schedules = OrderedDict(
+                    (
+                        r,
+                        [j for j in s if j in child.job_metadata],
+                    )
+                    for r, s in cchild.schedules.items()
+                )
+                child.recompute_flag = True
+        self._last_repair = True
+        return True
 
     def current_round_schedule_by_cell(self) -> "OrderedDict[str, list]":
         self.current_round_schedule()
@@ -379,7 +555,15 @@ class CellPlanner:
 
         recorder = obs.get_recorder()
         pre_state = self.state_dict() if recorder.enabled else None
-        injector = faults.active()
+        self._replan_epoch += 1
+        # A speculative clone must not CONSUME injected solver faults
+        # (they belong to the live ladder) but must take the same
+        # individual-vs-batched path the live boundary would, so a
+        # no-churn install is decision-identical to the serial solve.
+        armed = faults.active() is not None
+        injector = (
+            None if getattr(self, "_speculative", False) else faults.active()
+        )
         replay = self._replay_stamp
         self._replay_stamp = None
         if replay is not None:
@@ -391,9 +575,7 @@ class CellPlanner:
                 for n, c in self.children.items()
                 if self._cell_stale(c)
             ] or list(self.children)
-            individual = (
-                injector is not None or self.plan_deadline_s is not None
-            )
+            individual = armed or self.plan_deadline_s is not None
         self._failed_cells = set()
 
         with obs.span(
@@ -787,6 +969,11 @@ class CellPlanner:
             "reconcile": reconcile,
             "migrations": migrations,
         }
+        if self._last_repair:
+            # Pipelining repair: this coordinated replan re-planned the
+            # churned stale cells warm-started from speculative windows.
+            record["repair"] = True
+            self._last_repair = False
         self.coord_solve_records.append(record)
         self.coord_solve_times.append(solve_seconds)
         obs.histogram(
@@ -839,6 +1026,7 @@ class CellPlanner:
                 "num_gpus": int(self.num_gpus),
                 "future_rounds": int(self.future_rounds),
             },
+            tags=self._plan_record_tags,
         )
 
     # -- serialization --------------------------------------------------

@@ -785,6 +785,17 @@ class PhysicalScheduler(Scheduler):
                 self._round_end_time = round_start + self._time_per_iteration
                 if self._shockwave is not None and self._round_id >= 1:
                     self._shockwave_scheduler_update()
+                # Plan-ahead pipelining: reconcile the previous round's
+                # speculative solve at the boundary, BEFORE this round's
+                # speculation is kicked below (a hit installs the plan
+                # window for the schedule passes; a repair arms the
+                # warm-started re-solve they will run).
+                if (
+                    self._speculate
+                    and self._shockwave is not None
+                    and hasattr(self._shockwave, "reconcile_at_boundary")
+                ):
+                    self._shockwave.reconcile_at_boundary()
                 assignments = (
                     self._next_assignments or self._schedule_jobs_on_workers()
                 )
@@ -914,6 +925,22 @@ class PhysicalScheduler(Scheduler):
                     if key in extended:
                         continue  # still running under an extended lease
                     self._dispatch(key, worker_ids)
+                # Plan-ahead pipelining: with this round dispatched,
+                # solve the NEXT round speculatively on a background
+                # thread while the workers execute — the solve bill is
+                # hidden behind the round instead of landing under the
+                # condition lock at the boundary / mid-round pass.
+                # Snapshot+clone happens here, under _cv, so the clone
+                # sees a consistent planner; the solve itself shares
+                # nothing mutable with the live planner.
+                if self._shockwave_can_speculate():
+                    outcome = self._predict_physical_round_outcome(
+                        assignments
+                    )
+                    if outcome is not None:
+                        self._shockwave.speculate_next_round(
+                            outcome, background=True
+                        )
 
             # Mid-round: plan the next round so in-flight lease updates can
             # be extended (reference: _mid_round scheduler.py:1839-1965).
@@ -1076,6 +1103,45 @@ class PhysicalScheduler(Scheduler):
                     "reset of reclaimed worker failed (already gone)",
                     exc_info=True,
                 )
+
+    def _predict_physical_round_outcome(self, assignments):
+        """Physical-mode round-outcome prediction for the speculative
+        next-round solve. Unlike simulation (exact by construction),
+        this is an ESTIMATE — each dispatched job is predicted to run
+        measured-EMA-throughput x round-length steps — so the boundary
+        reconcile's epoch tolerance absorbs benign drift (an epoch
+        boundary racing the measured step count) and real churn takes
+        the warm-started repair path. Jobs with no usable throughput
+        estimate yet (first dispatch) are predicted as zero-progress;
+        their first measurement diverging is exactly the repair case.
+        Only the per-job (steps, throughput) estimate is physical-mode
+        specific; the outcome itself is built by the shared
+        :meth:`Scheduler._spec_outcome_from_steps`.
+        """
+        steps_pred: Dict[JobId, tuple] = {}
+        for key, worker_ids in assignments.items():
+            worker_type = self._worker_id_to_worker_type[worker_ids[0]]
+            for single in key.singletons():
+                job = self._jobs.get(single)
+                if job is None:
+                    continue
+                tput = self._throughputs.get(single, {}).get(worker_type)
+                if (
+                    not isinstance(tput, (int, float))
+                    or tput <= 0
+                    or tput >= INFINITY
+                ):
+                    continue
+                steps = min(
+                    int(tput * self._time_per_iteration),
+                    max(
+                        0,
+                        job.total_steps - self._total_steps_run[single],
+                    ),
+                )
+                if steps > 0:
+                    steps_pred[single] = (steps, float(tput))
+        return self._spec_outcome_from_steps(steps_pred)
 
     def _micro_task_scale_factor(self, job_id) -> int:
         ids = self._dispatched_worker_ids.get(job_id)

@@ -290,6 +290,15 @@ class Scheduler:
         # variant; see shockwave_tpu.policies.shockwave).
         self._shockwave = None
         self._is_shockwave = policy.name.startswith("Shockwave")
+        # Plan-ahead pipelining (shockwave_config["speculate"]): while
+        # round r executes, solve round r+1 speculatively from a
+        # snapshot + the predicted round outcome, then reconcile at the
+        # boundary (see shockwave_tpu/policies/speculation.py). The
+        # SCHEDULER owns the execution model, so it supplies the
+        # predicted outcome; the planner snapshots/solves/reconciles.
+        self._speculate = bool(
+            (shockwave_config or {}).get("speculate", False)
+        )
         if self._is_shockwave:
             if shockwave_config is None:
                 raise ValueError("Shockwave policies require shockwave_config")
@@ -1758,6 +1767,100 @@ class Scheduler:
                 ],
             )
 
+    # ------------------------------------------------------------------
+    # Plan-ahead pipelining (shockwave_tpu/policies/speculation.py).
+    # ------------------------------------------------------------------
+    def _shockwave_can_speculate(self) -> bool:
+        return (
+            self._speculate
+            and self._shockwave is not None
+            and hasattr(self._shockwave, "speculate_next_round")
+            and not self._shockwave_is_pool_set()
+            and bool(self._current_round_scheduled_jobs)
+        )
+
+    def _predict_round_outcome(self, dispatch_preview):
+        """The planner delta the scheduler predicts between now (round
+        r's micro-tasks just dispatched) and the next round boundary:
+        the throughput records the completion merge will append, each
+        scheduled job's epoch progress after the boundary's
+        ``set_progress`` pass, and the jobs that will finish and leave
+        the planner. In simulation the prediction is EXACT — the
+        dispatched step counts and finish times below are precisely
+        what ``_done_callback`` will merge — so a no-churn speculative
+        plan is bit-identical to the serial boundary solve.
+
+        ``dispatch_preview`` maps each dispatched single job to its
+        (num_steps, execution_seconds). Returns None when the boundary
+        is already known to churn: a dispatched job with a pending
+        batch-size switch will have its steps rescaled and the planner
+        re-flagged at the merge, so speculating could only buy a
+        repair against state this prediction cannot express."""
+        steps_map: dict = {}
+        for job_id in self._current_round_scheduled_jobs:
+            if self._jobs.get(job_id) is None:
+                continue
+            if (
+                self._bs_scale.get(job_id) is not None
+                and job_id in dispatch_preview
+            ):
+                return None
+            steps_add, exec_s = dispatch_preview.get(job_id, (0, 0.0))
+            steps_map[job_id] = (
+                steps_add,
+                steps_add / exec_s if exec_s > 0 else 0.0,
+            )
+        return self._spec_outcome_from_steps(steps_map)
+
+    def _spec_outcome_from_steps(self, steps_map):
+        """Shared tail of the sim/physical round-outcome prediction:
+        from each scheduled single job's predicted (steps_run,
+        throughput) for this round, build the
+        :class:`~shockwave_tpu.policies.speculation.SpecOutcome` — the
+        throughput records the completion merge will append (stamped
+        with the CURRENT completed-round counter, which both modes
+        increment at iteration end), each surviving job's epoch
+        progress after the boundary's ``set_progress`` pass, and the
+        predicted completions. One builder for both modes so the
+        outcome shape can never desynchronize sim from physical."""
+        from shockwave_tpu.policies.speculation import SpecOutcome
+
+        pool = self._shockwave_pool_type()
+        next_round = self._num_completed_rounds
+        progress: dict = {}
+        throughputs: list = []
+        completions: list = []
+        for job_id in self._current_round_scheduled_jobs:
+            job = self._jobs.get(job_id)
+            if job is None:
+                continue
+            steps_add, tput = steps_map.get(job_id, (0, 0.0))
+            if steps_add > 0:
+                throughputs.append(
+                    (job_id, next_round, tput, job.batch_size)
+                )
+            if (
+                steps_add > 0
+                and self._total_steps_run[job_id] + steps_add
+                >= job.total_steps
+            ):
+                completions.append(job_id)
+            else:
+                steps_after = (
+                    self._steps_run_so_far.get(job_id, {}).get(pool, 0)
+                    + steps_add
+                )
+                progress[job_id] = steps_after // steps_per_epoch(
+                    job.model, job.batch_size
+                )
+        return SpecOutcome(
+            target_round=self._shockwave.round_index + 1,
+            progress=progress,
+            throughputs=throughputs,
+            completions=completions,
+            capacity=self._shockwave.num_gpus,
+        )
+
     def _shockwave_scheduler_update(self) -> None:
         """Push epoch progress into the planner and advance its round
         (reference: scheduler.py:3598-3621)."""
@@ -2154,6 +2257,7 @@ class Scheduler:
                 scheduled_jobs, preempted=preempted_this_round
             )
 
+            dispatch_preview: dict = {}
             for job_id, worker_ids in scheduled_jobs.items():
                 worker_type = self._worker_id_to_worker_type[worker_ids[0]]
                 for wid in worker_ids:
@@ -2161,6 +2265,14 @@ class Scheduler:
                 all_num_steps, max_finish_time = self._get_job_steps_and_finish_times(
                     job_id, worker_type
                 )
+                for i, single in enumerate(job_id.singletons()):
+                    # Exactly what the drain loop will merge for this
+                    # micro-task: total steps per singleton, execution
+                    # time = micro-task finish - round start.
+                    dispatch_preview[single] = (
+                        all_num_steps[i],
+                        max_finish_time - self._current_timestamp,
+                    )
                 obs.complete(
                     f"run job {job_id}",
                     ts_s=self._current_timestamp,
@@ -2186,6 +2298,17 @@ class Scheduler:
                 )
 
             self._num_completed_rounds += 1
+
+            # Plan-ahead pipelining: with this round's execution fully
+            # determined, speculatively solve the NEXT round from a
+            # snapshot + the predicted outcome. Inline here — solver
+            # wall time never advances virtual time, so the overlap is
+            # free by construction and the reconcile machinery runs
+            # identically to physical mode.
+            if self._shockwave_can_speculate():
+                outcome = self._predict_round_outcome(dispatch_preview)
+                if outcome is not None:
+                    self._shockwave.speculate_next_round(outcome)
 
         self._logger.info(
             "Total duration: %.3f seconds (%.2f hours)",

@@ -180,6 +180,37 @@ def generate_trace_jobs(
     return jobs, arrivals
 
 
+def smoke_trace_jobs(
+    num_jobs: int,
+    epochs: int = 2,
+    arrival_gap_s: float = 0.0,
+) -> Tuple[List[Job], List[float]]:
+    """The deterministic alternating ResNet-18/50 smoke trace
+    (scale-factor pattern 1,1,2,1; ``epochs`` epochs each; arrivals
+    every ``arrival_gap_s`` seconds) shared by bench.py's pipelining
+    phase, scripts/ci/pipelining_smoke.py, and tests/test_pipelining.py
+    — one definition, so the bench-gated pipelining series always
+    measures the same workload the smoke gate asserts invariants on."""
+    from shockwave_tpu.data.workload_info import steps_per_epoch
+
+    jobs: List[Job] = []
+    arrivals: List[float] = []
+    for i in range(num_jobs):
+        model = ["ResNet-18", "ResNet-50"][i % 2]
+        bs = 32 if model == "ResNet-18" else 64
+        jobs.append(
+            Job(
+                job_type=f"{model} (batch size {bs})",
+                command="python3 main.py",
+                total_steps=steps_per_epoch(model, bs) * epochs,
+                scale_factor=[1, 1, 2, 1][i % 4],
+                mode="static",
+            )
+        )
+        arrivals.append(i * arrival_gap_s)
+    return jobs, arrivals
+
+
 def generate_trace_file(
     path: str,
     num_jobs: int,

@@ -324,7 +324,8 @@ class FlightRecorder:
                 raw = record.pop("planner_state_raw", None)
                 if raw is not None:
                     record["planner_state"] = self._slim_planner_state(
-                        raw, lines
+                        raw, lines,
+                        advance=not record.get("speculative"),
                     )
                 for field in ("planner_state", "profile", "problem"):
                     if field in record:
@@ -332,18 +333,39 @@ class FlightRecorder:
                 lines.append(json.dumps(record, separators=(",", ":")))
             atomic_append_text(self.path, "\n".join(lines) + "\n")
 
-    def _slim_planner_state(self, planner_state: dict, lines: list) -> dict:
+    def _slim_planner_state(
+        self, planner_state: dict, lines: list, advance: bool = True
+    ) -> dict:
         """Compact a raw planner snapshot for one plan record: factor
         each job's immutable profile out into a ``job_profile`` record
         (appended to ``lines`` ahead of the plan record, once per job),
         delta-encode the append-only throughput schedules, pack tuple
         histories into scalar lists, and drop pure-output fields.
         A cell-set (federated) snapshot slims each child planner's
-        state the same way. Caller holds the lock."""
+        state the same way. Caller holds the lock.
+
+        ``advance=False`` (speculative plan records) slims as a
+        SELF-CONTAINED overlay: the full throughput schedule is
+        emitted (base 0) and the accumulation base is NOT advanced — a
+        speculative clone's tails carry PREDICTED entries the live
+        planner may never see (physical mode measures different
+        values), folding them in would corrupt every downstream live
+        record's delta encoding, and delta-encoding them against the
+        live base would race mid-round live plan records queued
+        between the speculation snapshot and this flush (the live
+        record advances the base past measured entries the clone's
+        snapshot predates, silently shifting the slice). Replay
+        rebuilds these records from the overlay alone (see
+        :func:`replay_log`)."""
         if "children" in planner_state:
             slim_state = dict(planner_state)
             slim_state["children"] = OrderedDict(
-                (name, self._slim_planner_state(child_state, lines))
+                (
+                    name,
+                    self._slim_planner_state(
+                        child_state, lines, advance=advance
+                    ),
+                )
                 for name, child_state in planner_state["children"].items()
             )
             return slim_state
@@ -370,9 +392,11 @@ class FlightRecorder:
         for job_id, md_state in planner_state["job_metadata"].items():
             key = _job_key(job_id)
             static, dynamic, emitted = _split_metadata_state(
-                md_state, self._tput_emitted.get(key, 0)
+                md_state,
+                self._tput_emitted.get(key, 0) if advance else 0,
             )
-            self._tput_emitted[key] = emitted
+            if advance:
+                self._tput_emitted[key] = emitted
             fingerprint = _profile_fingerprint(md_state)
             if self._profiles_emitted.get(key) != fingerprint:
                 lines.append(
@@ -403,12 +427,16 @@ class FlightRecorder:
         solve_record: Optional[dict] = None,
         problem_summary: Optional[dict] = None,
         pool: Optional[str] = None,
+        tags: Optional[dict] = None,
     ) -> None:
         """One planning decision: ``planner_state`` is the PRE-replan
         :meth:`ShockwavePlanner.state_dict` snapshot (replay re-enters
         ``_replan`` from it), ``plan`` maps round offset -> scheduled
         job keys, ``problem_summary`` the solver-facing arrays (job
-        order, forecasts, priorities, switching costs, incumbents)."""
+        order, forecasts, priorities, switching costs, incumbents).
+        ``tags`` merges extra envelope fields — a speculative clone
+        stamps ``{"speculative": True}``, which switches the record to
+        overlay slimming (see :meth:`_slim_planner_state`)."""
         if not self.enabled:
             return
         # Hot path: queue the snapshot with minimal copying. Everything
@@ -452,7 +480,19 @@ class FlightRecorder:
             record["problem"] = problem_summary
         if pool is not None:
             record["pool"] = pool
+        if tags:
+            record.update(tags)
         self._append(record)
+
+    def record_speculation(self, detail: dict) -> None:
+        """One plan-ahead-pipelining reconcile outcome (``kind`` is
+        hit/repair/miss plus the round and churn detail) — the boundary
+        decision that pairs with the preceding ``speculative`` plan
+        record, so a log replays the pipelined run's control flow, not
+        just its solves."""
+        if not self.enabled:
+            return
+        self._append({"event": "speculation", **detail})
 
     def record_round_context(
         self,
@@ -682,7 +722,14 @@ def replay_log(path: str, round_index: Optional[int] = None) -> List[dict]:
     one planning round) and return the per-record replay results.
     ``job_profile`` records and the delta-encoded throughput tails are
     applied in file order — every plan record is scanned even when only
-    one round is replayed."""
+    one round is replayed.
+
+    Speculative plan records (plan-ahead pipelining) are
+    self-contained overlays: they carry the clone's full throughput
+    schedules (base 0) and never advanced the recorder's accumulation
+    base, so they rebuild into a throwaway empty base for that
+    record's replay alone and the shared accumulation continues from
+    the measured history."""
     results = []
     profiles: dict = {}
     schedules: dict = {}
@@ -695,12 +742,16 @@ def replay_log(path: str, round_index: Optional[int] = None) -> List[dict]:
             continue
         record = dict(record)
         record["planner_state"] = decode(record["planner_state"])
-        accumulate_schedules(record, schedules)
+        if record.get("speculative"):
+            record_schedules: dict = {}
+        else:
+            record_schedules = schedules
+        accumulate_schedules(record, record_schedules)
         if round_index is not None and record.get("round") != round_index:
             continue
         results.append(
             replay_plan_record(
-                record, profiles=profiles, schedules=schedules
+                record, profiles=profiles, schedules=record_schedules
             )
         )
     return results
@@ -710,10 +761,12 @@ def summarize_log(path: str) -> dict:
     """Cheap structural summary (no replay): record counts, round span,
     backends, objective range."""
     plans = 0
+    speculative_plans = 0
     contexts = 0
     faults = 0
     recoveries = 0
     admissions = {}
+    speculations = {}
     rounds = []
     backends = {}
     objectives = []
@@ -721,6 +774,8 @@ def summarize_log(path: str) -> dict:
         event = record.get("event")
         if event == "plan":
             plans += 1
+            if record.get("speculative"):
+                speculative_plans += 1
             rounds.append(record.get("round"))
             backends[record.get("backend")] = (
                 backends.get(record.get("backend"), 0) + 1
@@ -736,12 +791,17 @@ def summarize_log(path: str) -> dict:
         elif event == "admission":
             kind = record.get("kind", "unknown")
             admissions[kind] = admissions.get(kind, 0) + 1
+        elif event == "speculation":
+            kind = record.get("kind", "unknown")
+            speculations[kind] = speculations.get(kind, 0) + 1
     return {
         "plans": plans,
+        "speculative_plans": speculative_plans,
         "round_contexts": contexts,
         "faults": faults,
         "recoveries": recoveries,
         "admissions": admissions,
+        "speculations": speculations,
         "first_round": min(rounds) if rounds else None,
         "last_round": max(rounds) if rounds else None,
         "backends": backends,

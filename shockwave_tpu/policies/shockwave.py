@@ -32,6 +32,7 @@ import numpy as np
 
 from shockwave_tpu import obs
 from shockwave_tpu.policies.base import Policy
+from shockwave_tpu.policies.speculation import SpeculativePlannerMixin
 from shockwave_tpu.predictor import JobMetadata
 from shockwave_tpu.solver.eg_problem import EGProblem
 
@@ -57,7 +58,7 @@ def sharded_dispatch_min_jobs() -> int:
     return int(raw) if raw else SHARDED_DISPATCH_MIN_JOBS
 
 
-class ShockwavePlanner:
+class ShockwavePlanner(SpeculativePlannerMixin):
     """Plans a boolean (job x future-round) schedule each planning window.
 
     State: per-job predictor metadata, finish-time-estimate history, the
@@ -86,6 +87,17 @@ class ShockwavePlanner:
         # relaunch overheads the scheduler threads through add_job. 0
         # disables the switching-cost term even when overheads are known.
         self.switch_cost_weight = float(config.get("switch_cost_weight", 1.0))
+        # Migration hysteresis for the stickiness pass: the round-0
+        # swap that keeps an incumbent running must beat the fairness
+        # reorder regression by this factor. 1.0 (default) is the
+        # original break-even rule, bit-identical to before the knob;
+        # <1 pulls incumbents more aggressively (stickier placements
+        # under churn), >1 demands a larger win before displacing
+        # another job. Tuned on the chaos soak by
+        # scripts/sweeps/sweep_chaos_stickiness.py.
+        self.stickiness_hysteresis = float(
+            config.get("stickiness_hysteresis", 1.0)
+        )
         # Per-round planning deadline (seconds) for the degradation
         # ladder: primary backend -> restarted PDHG -> relaxed PGD ->
         # native greedy, each rung budgeted against what remains. None (default) keeps the
@@ -99,6 +111,16 @@ class ShockwavePlanner:
         # Ladder outcome of the most recent solve (consumed by
         # _record_solve to tag degraded rounds in solve_records).
         self._last_ladder: Optional[dict] = None
+
+        # Plan-ahead pipelining (shockwave_tpu/policies/speculation.py):
+        # the shared scaffolding lives on SpeculativePlannerMixin.
+        # ``speculate`` in the config is read by the SCHEDULER (which
+        # owns the execution model and supplies predicted outcomes);
+        # the planner only reconciles.
+        self._init_speculation(config)
+        # Set by a repair reconcile: the next solve goes through the
+        # delta-patched warm-started PDHG backend before anything else.
+        self._repair_with_spec = False
 
         self.round_index = 0
         self.recompute_flag = False
@@ -244,6 +266,85 @@ class ShockwavePlanner:
         ]
         return planner
 
+    # -- plan-ahead pipelining ------------------------------------------
+    # speculate_next_round / _reconcile_speculation / _observe_boundary
+    # come from SpeculativePlannerMixin; the hooks below are this
+    # planner kind's reconcile semantics.
+    def _spec_solve_base(self) -> int:
+        """Solve-bookkeeping length at snapshot time (install appends
+        only the clone's records past this point)."""
+        return len(self.solve_records)
+
+    def _augment_mismatch(self, mismatch: dict) -> dict:
+        """External staleness (batch-size switch, capacity event) the
+        fingerprint math cannot see is still churn."""
+        if self.recompute_flag:
+            mismatch = dict(mismatch)
+            mismatch.setdefault("", []).append("recompute_flagged")
+        return mismatch
+
+    def _install_speculation(self, spec) -> None:
+        """No-churn boundary: adopt the clone's post-replan outputs —
+        the plan window, the finish-time history its problem build
+        appended, and the solve bookkeeping. The live predictor inputs
+        (measured throughput schedules) are NOT touched: in simulation
+        they equal the clone's by exact prediction; in physical mode
+        the measured values stay authoritative for the next build."""
+        clone = spec.clone
+        if not spec.solved:
+            return  # the boundary serves from cache either way
+        self.schedules = OrderedDict(
+            (r, list(s)) for r, s in clone.schedules.items()
+        )
+        self.finish_time_estimates = {
+            j: list(h) for j, h in clone.finish_time_estimates.items()
+        }
+        self.solve_times.extend(
+            clone.solve_times[spec.base_solve_records:]
+        )
+        self.solve_records.extend(
+            dict(r)
+            for r in clone.solve_records[spec.base_solve_records:]
+        )
+        self.recompute_flag = False
+
+    def _boundary_stale(self) -> bool:
+        """Whether the boundary's cache-serve check would replan:
+        recompute flagged, no cached round at the cursor, or a cached
+        round whose jobs all completed while incomplete jobs remain
+        (mirrors :meth:`current_round_schedule`)."""
+        if self.recompute_flag or self.round_index not in self.schedules:
+            return True
+        schedule = self.schedules[self.round_index]
+        live = [
+            j
+            for j in schedule
+            if j in self.job_metadata
+            and self.job_metadata[j].completed_epochs
+            < self.job_metadata[j].total_epochs
+        ]
+        return not live and self._has_incomplete_jobs()
+
+    def _prepare_repair(self, spec, mismatch: dict) -> bool:
+        """Churned boundary. Only when the boundary was going to replan
+        anyway (so pipelining never re-plans more eagerly than serial):
+        the speculative window (when one was solved) becomes the
+        plan-cache warm basis, and the boundary replan is forced
+        through the delta-patched PDHG path —
+        :func:`shockwave_tpu.solver.warm_start.delta_patch_counts`
+        aligns the speculative solution across exactly the
+        arrival/departure/progress delta that invalidated it. Returns
+        whether a repair solve was armed."""
+        if not self._boundary_stale():
+            return False
+        if spec.solved:
+            self.schedules = OrderedDict(
+                (r, list(s)) for r, s in spec.clone.schedules.items()
+            )
+        self.recompute_flag = True
+        self._repair_with_spec = True
+        return True
+
     def current_round_schedule(self) -> list:
         """This round's job list, from the plan cache or a fresh solve
         (reference: shockwave.py:77-91).
@@ -253,7 +354,14 @@ class ShockwavePlanner:
         incomplete jobs remain — the reference returns the stale empty
         round, which the scheduler interprets as end-of-trace and wedges
         the remaining jobs (scheduler.py:1731-1732).
+
+        With plan-ahead pipelining armed, a pending speculative solve
+        for this boundary is reconciled first; the wall time this call
+        spends on reconcile + any solve is the run's EXPOSED planning
+        time (hidden speculative solve time rides its own histogram).
         """
+        start = time.perf_counter()
+        reconciled = self._reconcile_speculation()
         if not self.recompute_flag and self.round_index in self.schedules:
             schedule = self.schedules[self.round_index]
             live = [
@@ -264,9 +372,12 @@ class ShockwavePlanner:
                 < self.job_metadata[j].total_epochs
             ]
             if live or not self._has_incomplete_jobs():
+                if reconciled is not None:
+                    self._observe_boundary(time.perf_counter() - start)
                 return schedule
         self._replan()
         self.recompute_flag = False
+        self._observe_boundary(time.perf_counter() - start)
         return self.schedules[self.round_index]
 
     def _has_incomplete_jobs(self) -> bool:
@@ -377,20 +488,34 @@ class ShockwavePlanner:
         straight dispatch to the configured backend."""
         from shockwave_tpu.runtime import faults
 
-        injector = faults.active()
+        # A speculative clone never consumes injected solver faults:
+        # they are the LIVE ladder's events, and a hidden solve burning
+        # one would de-synchronize a chaos run from its serial baseline.
+        injector = (
+            None if getattr(self, "_speculative", False) else faults.active()
+        )
         self._last_ladder = None
+        # Repair reconcile (plan-ahead pipelining): this solve follows
+        # churn against a speculative plan — go through the
+        # delta-patched warm-started PDHG path first, falling back to
+        # the configured backend / degradation ladder only when the
+        # delta path cannot apply.
+        repair = self._repair_with_spec
+        self._repair_with_spec = False
+        self._last_repair = repair
         self._attempted_backend = self.backend
         # Computed once per solve, BEFORE the plan cache is overwritten:
-        # consumed by the pdhg branch (primary or ladder rung) and
-        # stamped into the flight-recorder snapshot — the recorder slims
-        # the plan cache out of the log, so replay must carry the
+        # consumed by the pdhg branch (primary, repair, or ladder rung)
+        # and stamped into the flight-recorder snapshot — the recorder
+        # slims the plan cache out of the log, so replay must carry the
         # derived warm-start vector itself to re-enter the same solve.
         # Skipped entirely when no pdhg solve can happen this round
-        # (non-pdhg backend, ladder unarmed): the counts walk over the
-        # cached window is pure-Python and the planner hot path should
-        # not pay it to produce a value nothing reads.
+        # (non-pdhg backend, ladder unarmed, no repair): the counts walk
+        # over the cached window is pure-Python and the planner hot path
+        # should not pay it to produce a value nothing reads.
         pdhg_possible = (
             self.backend == "pdhg"
+            or repair
             or self.plan_deadline_s is not None
             or injector is not None
         )
@@ -398,8 +523,21 @@ class ShockwavePlanner:
             self._solution_warm_start() if pdhg_possible else None
         )
         if self.plan_deadline_s is None and injector is None:
+            if repair and self.backend != "pdhg":
+                try:
+                    return self._solve_backend("pdhg", problem)
+                except Exception:
+                    # The delta path could not apply (solver raised on
+                    # the patched problem): the configured backend is
+                    # the fallback, exactly as if no speculation ran.
+                    obs.counter(
+                        "speculation_repair_fallbacks_total",
+                        "repair solves that fell back to the "
+                        "configured backend",
+                    ).inc()
+                    self._attempted_backend = self.backend
             return self._solve_backend(self.backend, problem)
-        return self._solve_with_ladder(problem, injector)
+        return self._solve_with_ladder(problem, injector, repair=repair)
 
     def _ladder_rungs(self) -> List[str]:
         """Degradation ladder: configured backend, then the restarted
@@ -418,7 +556,7 @@ class ShockwavePlanner:
         return rungs
 
     def _solve_with_ladder(
-        self, problem: EGProblem, injector
+        self, problem: EGProblem, injector, repair: bool = False
     ) -> "Tuple[np.ndarray, str]":
         """Run the solve down the degradation ladder under the round's
         planning budget. Every rung but the last is bounded by the
@@ -435,6 +573,11 @@ class ShockwavePlanner:
         start = time.monotonic()
         deadline = self.plan_deadline_s
         rungs = self._ladder_rungs()
+        if repair and "pdhg" in rungs:
+            # Repair reconcile under an armed ladder: the delta-patched
+            # PDHG solve is the designated repair path, so it leads the
+            # ladder; the configured primary becomes the next rung.
+            rungs = ["pdhg"] + [r for r in rungs if r != "pdhg"]
         attempts: List[dict] = []
         faults_hit: list = []
         last_error: Optional[BaseException] = None
@@ -788,6 +931,10 @@ class ShockwavePlanner:
             record["degraded"] = True
             record["fallback_from"] = ladder["fallback_from"]
             record["ladder"] = [dict(a) for a in ladder["attempts"]]
+        if getattr(self, "_last_repair", False):
+            # Pipelining repair: this solve re-planned churn against a
+            # speculative window through the delta-patched PDHG path.
+            record["repair"] = True
         self.solve_records.append(record)
         obs.histogram(
             "shockwave_solve_seconds",
@@ -806,6 +953,7 @@ class ShockwavePlanner:
         # reproduce the priorities (and hence the plan) bit-for-bit.
         recorder = obs.get_recorder()
         pre_state = self.state_dict() if recorder.enabled else None
+        self._replan_epoch += 1
         # Past rounds are never read again; keep the cache bounded.
         for r in [r for r in self.schedules if r < self.round_index]:
             del self.schedules[r]
@@ -889,6 +1037,7 @@ class ShockwavePlanner:
                         "future_rounds": problem.future_rounds,
                     },
                     pool=self.pool_label,
+                    tags=self._plan_record_tags,
                 )
 
     def _apply_stickiness(self, Y: np.ndarray, problem: EGProblem) -> np.ndarray:
@@ -952,7 +1101,7 @@ class ShockwavePlanner:
                 ):
                     continue
                 delta = (rate[k] - rate[j]) * r_star  # reorder regression
-                if rate[j] * delay_rounds <= delta:
+                if rate[j] * delay_rounds <= self.stickiness_hysteresis * delta:
                     continue
                 if best_delta is None or delta < best_delta:
                     best_k, best_delta = k, delta

@@ -61,11 +61,15 @@ DEFAULT_CAPACITY = 1024
 def job_to_spec_dict(job: Job) -> dict:
     """Wire-facing dict for one job (the SubmitterClient turns these
     into admission_pb2.JobSpec messages)."""
+    # Optional string fields ride proto3 string slots, which reject
+    # None: a trace job with no working directory must submit as ""
+    # (job_from_spec_dict already normalizes "" back to a falsy value
+    # on the receiving side).
     return {
         "job_type": job.job_type,
-        "command": job.command,
-        "working_directory": job.working_directory,
-        "num_steps_arg": job.num_steps_arg,
+        "command": job.command or "",
+        "working_directory": job.working_directory or "",
+        "num_steps_arg": job.num_steps_arg or "-n",
         "total_steps": int(job.total_steps),
         "scale_factor": int(job.scale_factor),
         "mode": job.mode,
