@@ -3,9 +3,11 @@
 
 Runs ``shockwave_tpu.analysis`` over the default enforcement scope
 (``shockwave_tpu/``, ``scripts/``, ``bench.py``) against the committed
-baseline (``lint_baseline.json``) and exits non-zero when either
-direction of the ratchet is violated — or when the gate itself is
-broken:
+baseline (``lint_baseline.json``) — six per-file rules plus the five
+interprocedural ones (lock-order-cycle, transitive-host-sync,
+swallowed-exception, shared-state-race, snapshot-escape) sharing one
+project build — and exits non-zero when either direction of the
+ratchet is violated, or when the gate itself is broken:
 
   exit 1  NEW findings — code introduced a violation the baseline does
           not accept. Fix it, or suppress the line with a justified

@@ -1,0 +1,109 @@
+#!/usr/bin/env python3
+"""Standing thread-race gate: static race rules + dynamic sanitizer.
+
+Two halves, mirroring the race-triage workflow in docs/USAGE.md:
+
+1. **Static**: run the `shared-state-race` and `snapshot-escape`
+   project rules over the package and fail on any unsuppressed
+   finding (the committed repo must stay race-clean — same contract
+   tier-1 enforces via tests/test_races.py, exposed here for CI
+   pipelines that want the witness chains on stdout).
+
+2. **Dynamic**: run the pipelining and runtime concurrency tests
+   under ``SHOCKWAVE_SANITIZE=threads`` — tests/conftest.py
+   instruments the lock-owning production classes the static pass
+   identifies, and any observed unsynchronized cross-thread write
+   pair raises at the offending line.
+
+Artifact: ``results/lint/races_smoke.json`` (thread-root census, race
+table, and the dynamic run's verdict). Exit 1 on any static finding
+or dynamic failure.
+
+Usage:
+  JAX_PLATFORMS=cpu python scripts/ci/races_smoke.py [--skip-dynamic]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+sys.path.insert(0, REPO_ROOT)
+
+from shockwave_tpu.analysis.core import repo_root  # noqa: E402
+from shockwave_tpu.analysis.project import Project  # noqa: E402
+from shockwave_tpu.analysis.rules.races import (  # noqa: E402
+    SharedStateRace,
+    SnapshotEscape,
+    thread_roots_dict,
+)
+from shockwave_tpu.utils.fileio import atomic_write_json  # noqa: E402
+
+DYNAMIC_TESTS = ["tests/test_pipelining.py", "tests/test_runtime.py"]
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description="thread-race CI gate")
+    parser.add_argument(
+        "--skip-dynamic",
+        action="store_true",
+        help="static rules only (the dynamic half re-runs the "
+        "pipelining + runtime test files, ~4 min)",
+    )
+    parser.add_argument(
+        "--out",
+        default=os.path.join(REPO_ROOT, "results", "lint",
+                             "races_smoke.json"),
+    )
+    args = parser.parse_args()
+
+    project = Project.build(repo_root())
+    static_findings = [
+        f
+        for rule in (SharedStateRace(), SnapshotEscape())
+        for f in rule.check_project(project)
+        if not f.suppressed
+    ]
+    for f in static_findings:
+        print(f.render(), file=sys.stderr)
+
+    dynamic = {"ran": False, "returncode": None}
+    if not args.skip_dynamic:
+        env = dict(os.environ)
+        env["SHOCKWAVE_SANITIZE"] = "threads"
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", *DYNAMIC_TESTS, "-q",
+             "-p", "no:cacheprovider"],
+            cwd=REPO_ROOT,
+            env=env,
+        )
+        dynamic = {"ran": True, "returncode": proc.returncode}
+
+    dump = thread_roots_dict(project)
+    verdict = {
+        "static_findings": [f.to_dict() for f in static_findings],
+        "thread_roots": dump["roots"],
+        "race_table": dump["races"],
+        "dynamic": {**dynamic, "tests": DYNAMIC_TESTS,
+                    "sanitize": "threads"},
+        "ok": not static_findings
+        and (not dynamic["ran"] or dynamic["returncode"] == 0),
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    atomic_write_json(args.out, verdict)
+    print(
+        f"races_smoke: {len(static_findings)} static finding(s), "
+        f"{len(dump['roots'])} thread roots, dynamic "
+        f"{'rc=' + str(dynamic['returncode']) if dynamic['ran'] else 'skipped'}"
+        f" -> {'PASS' if verdict['ok'] else 'FAIL'} ({args.out})"
+    )
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

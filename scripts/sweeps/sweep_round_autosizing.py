@@ -1,0 +1,213 @@
+#!/usr/bin/env python3
+"""Round-autosizing sweep with plan-ahead pipelining folded in.
+
+PR 1 established the round-autosizing grid on the 12-job dynamic trace
+(results/preemption_aware/): overhead-blind vs overhead-charged
+planner vs overhead-charged + auto-sized rounds
+(``--round_overhead_fraction 0.25`` stretches 60 s rounds to 396 s so
+the worst 99.1 s measured relaunch costs at most a quarter of a
+round). PR 11's follow-on (ROADMAP item 1) asked for ``--speculate``
+folded into that sweep: each cell now runs BOTH arms — serial and
+pipelined — and reports the hidden-vs-exposed solve ledger next to
+the scheduling-quality metrics, so the auto-sizing trade is read with
+the planning bill it would actually pay:
+
+* ``exposed_plan_s`` — planning wall time spent on the round loop's
+  thread (``planner.exposed_plan_times``; the quantity both A/B arms
+  count identically);
+* ``hidden_plan_s`` — speculative solve wall time hidden behind round
+  execution (the ``shockwave_plan_hidden_seconds`` histogram);
+* ``spec_stats`` — boundary reconcile outcomes (hit/repair/miss).
+
+Pipelining never re-plans more eagerly than serial, so each pipelined
+arm's makespan/preemptions/FTF must equal its serial arm bit-for-bit
+(``decision_identical`` is checked per cell); what changes is WHERE
+the solve bill lands. The headline: with the bill hidden, the round
+can be sized toward the preemption-overhead floor without the
+boundary planning stall scaling per round (docs/USAGE.md "Plan-ahead
+pipelining", Interactions).
+
+Usage:
+  python scripts/sweeps/sweep_round_autosizing.py \
+      [-o results/sweeps/round_autosizing.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+from shockwave_tpu import obs  # noqa: E402
+from shockwave_tpu.core.scheduler import Scheduler  # noqa: E402
+from shockwave_tpu.data import parse_trace  # noqa: E402
+from shockwave_tpu.data.default_oracle import generate_oracle  # noqa: E402
+from shockwave_tpu.data.profiles import load_or_synthesize_profiles  # noqa: E402
+from shockwave_tpu.policies import get_policy  # noqa: E402
+from shockwave_tpu.utils.fileio import atomic_write_json  # noqa: E402
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+TRACE = os.path.join(REPO, "traces", "small_12_dynamic.trace")
+
+# The measured per-family relaunch bill of the committed physical TPU
+# run (results/physical_tpu/shockwave_tpu/summary.json via
+# overheads_from_phase_report; pinned in tests/test_preemption_aware).
+MEASURED_OVERHEADS = {
+    "LM": 32.4,
+    "Recommendation": 32.6,
+    "ResNet-18": 92.8,
+    "ResNet-50": 99.1,
+    "Transformer": 31.8,
+}
+
+CELLS = (
+    # (name, preemption_overheads, round_overhead_fraction)
+    ("blind", None, None),
+    ("aware", MEASURED_OVERHEADS, None),
+    ("aware_autosize", MEASURED_OVERHEADS, 0.25),
+)
+
+
+def _hidden_solve_totals() -> dict:
+    metrics = obs.get_registry().snapshot()["metrics"]
+    metric = metrics.get("shockwave_plan_hidden_seconds")
+    if not metric or not metric["series"]:
+        return {"count": 0, "sum_s": 0.0}
+    return {
+        "count": int(sum(s["count"] for s in metric["series"])),
+        "sum_s": round(sum(s["sum"] for s in metric["series"]), 6),
+    }
+
+
+def run_cell(name, overheads, fraction, speculate, num_gpus=2, round_s=60):
+    jobs, arrivals = parse_trace(TRACE)
+    oracle = generate_oracle()
+    profiles = load_or_synthesize_profiles(
+        TRACE, jobs, oracle, worker_type="v100"
+    )
+    for i, job in enumerate(jobs):
+        job.duration = sum(profiles[i]["duration_every_epoch"])
+    config = {
+        "num_gpus": num_gpus,
+        "time_per_iteration": round_s,
+        "future_rounds": 20,
+        "lambda": 5.0,
+        "k": 10.0,
+        "solver_rel_gap": 1e-3,
+        "solver_timeout": 15,
+    }
+    if speculate:
+        config["speculate"] = True
+    obs.reset()
+    obs.configure(metrics=True)
+    sched = Scheduler(
+        get_policy("shockwave_tpu", seed=0),
+        throughputs=oracle,
+        seed=0,
+        time_per_iteration=round_s,
+        profiles=profiles,
+        shockwave_config=config,
+        preemption_overheads=overheads,
+        round_overhead_fraction=fraction,
+    )
+    t0 = time.time()
+    makespan = sched.simulate(
+        {"v100": num_gpus}, list(arrivals), list(jobs)
+    )
+    wall_s = time.time() - t0
+    planner = sched._shockwave
+    exposed = list(getattr(planner, "exposed_plan_times", []))
+    ftf_list, _unfair = sched.get_finish_time_fairness()
+    cell = {
+        "cell": name,
+        "speculate": bool(speculate),
+        "effective_round_s": sched._time_per_iteration,
+        "makespan_s": round(makespan, 1),
+        "avg_jct_s": round(sched.get_average_jct() or 0.0, 1),
+        "utilization": round(sched.get_cluster_utilization() or 0.0, 3),
+        "worst_ftf": round(max(ftf_list or [0.0]), 3),
+        "num_preemptions": sched._num_preemptions,
+        "rounds": sched._num_completed_rounds,
+        "sim_wall_s": round(wall_s, 1),
+        "ledger": {
+            "exposed_plan_s": round(sum(exposed), 6),
+            "exposed_solves": len(exposed),
+            "hidden": _hidden_solve_totals(),
+            "spec_stats": dict(
+                getattr(planner, "spec_stats", {}) or {}
+            ),
+        },
+    }
+    obs.reset()
+    return cell
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="round-autosizing x pipelining sweep (12-job trace)"
+    )
+    parser.add_argument(
+        "-o",
+        "--out",
+        default=os.path.join(
+            REPO, "results", "sweeps", "round_autosizing.json"
+        ),
+    )
+    args = parser.parse_args()
+
+    cells = []
+    for name, overheads, fraction in CELLS:
+        pair = {}
+        for speculate in (False, True):
+            arm = run_cell(name, overheads, fraction, speculate)
+            pair["pipelined" if speculate else "serial"] = arm
+            print(
+                f"{name} {'pipelined' if speculate else 'serial':9s}: "
+                f"round {arm['effective_round_s']:.0f}s makespan "
+                f"{arm['makespan_s']:.0f}s preemptions "
+                f"{arm['num_preemptions']} exposed "
+                f"{arm['ledger']['exposed_plan_s']:.3f}s hidden "
+                f"{arm['ledger']['hidden']['sum_s']:.3f}s "
+                f"spec {arm['ledger']['spec_stats']}",
+                file=sys.stderr,
+            )
+        # Pipelining must not change a single scheduling decision.
+        pair["decision_identical"] = (
+            pair["serial"]["makespan_s"] == pair["pipelined"]["makespan_s"]
+            and pair["serial"]["num_preemptions"]
+            == pair["pipelined"]["num_preemptions"]
+            and pair["serial"]["worst_ftf"]
+            == pair["pipelined"]["worst_ftf"]
+        )
+        cells.append(pair)
+
+    out = {
+        "trace": os.path.relpath(TRACE, REPO),
+        "cluster": "2 chips, 60 s base rounds, seed 0, synthetic oracle",
+        "overheads": MEASURED_OVERHEADS,
+        "comment": (
+            "PR 11 follow-on (ROADMAP item 1): --speculate folded into "
+            "the PR 1 round-autosizing sweep. Each cell runs serial and "
+            "pipelined arms; decision_identical pins that pipelining "
+            "changes WHERE the solve bill lands (exposed vs hidden), "
+            "never WHAT is decided."
+        ),
+        "cells": cells,
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    atomic_write_json(args.out, out)
+    print(f"wrote {args.out}", file=sys.stderr)
+    return 0 if all(c["decision_identical"] for c in cells) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,7 +4,9 @@ The rule catalog targets the hazard classes this codebase actually
 has (donated-buffer reuse, host syncs in hot loops, PRNG key reuse,
 unlocked shared-state mutation, non-atomic artifact writes, solver
 backend interface drift — and, interprocedurally, lock-order cycles,
-transitive host syncs, swallowed exceptions); a committed baseline
+transitive host syncs, swallowed exceptions, shared-state races
+across the discovered thread topology, and snapshot escapes from the
+speculation clone's deep-copy contract); a committed baseline
 ratchets the repo-wide finding count monotonically toward zero. CLI:
 ``python -m shockwave_tpu.analysis`` (see ``docs/USAGE.md``).
 

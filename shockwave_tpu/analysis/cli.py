@@ -83,6 +83,14 @@ def _parser() -> argparse.ArgumentParser:
         "SHOCKWAVE_SANITIZE=locks observed order) and exit",
     )
     p.add_argument(
+        "--thread-roots",
+        action="store_true",
+        help="print the discovered thread topology (Thread targets, "
+        "RPC handler roots, control-plane roots) and the shared-state "
+        "race table as JSON (the static prediction to diff against "
+        "SHOCKWAVE_SANITIZE=threads) and exit",
+    )
+    p.add_argument(
         "--baseline",
         default=None,
         help="baseline file (default: <repo>/lint_baseline.json)",
@@ -191,6 +199,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         from shockwave_tpu.analysis.rules.interproc import lock_graph_dict
 
         print(json.dumps(lock_graph_dict(), indent=2))
+        return 0
+
+    if args.thread_roots:
+        from shockwave_tpu.analysis.rules.races import thread_roots_dict
+
+        print(json.dumps(thread_roots_dict(), indent=2))
         return 0
 
     if args.fix:

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from shockwave_tpu.analysis.core import (
@@ -43,6 +44,43 @@ from shockwave_tpu.analysis.core import (
 # the threading names are the raw primitives they wrap.
 LOCK_FACTORIES = {"Lock", "RLock", "make_lock", "make_rlock"}
 CONDITION_FACTORIES = {"Condition", "make_condition"}
+
+# Factories whose product is internally synchronized (or thread-local):
+# fields holding one are exempt from shared-state analysis.
+THREADSAFE_FACTORIES = {
+    "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+    "Event", "Semaphore", "BoundedSemaphore", "Barrier", "local",
+}
+
+# In-place mutators on builtin containers (list/dict/set/deque/
+# OrderedDict). A call ``self.field.append(...)`` that does NOT resolve
+# to a project method is assumed to mutate the field.
+MUTATOR_METHODS = {
+    "append", "extend", "insert", "remove", "discard", "pop", "popitem",
+    "clear", "update", "setdefault", "sort", "reverse", "add",
+    "appendleft", "popleft", "extendleft", "rotate", "move_to_end",
+}
+
+# The repo's lock-discipline convention: a helper that runs under its
+# caller's critical section declares so in its docstring ("Caller
+# holds the lock (_cv)."). Thread-root seeding honors the declaration
+# the same way the host-sync rule honors "host-boundary" docstrings.
+_CALLER_HOLDS_RE = re.compile(r"[Cc]aller holds the lock \((\w+)\)")
+
+# Access kinds, ordered by severity for the race rule's GIL model:
+# READ    plain attribute load — atomic under the GIL;
+# REBIND  plain ``self.f = <expr not reading f>`` — atomic publication
+#         of a fresh value;
+# RMW     ``self.f += 1`` / ``self.f = f(self.f)`` — a read-modify-write
+#         on the FIELD BINDING (non-atomic across threads, but no
+#         structural aliasing: the new value is a fresh object);
+# MUTATE  in-place container mutation — subscript store/del, a mutator
+#         method call — which both races other accesses AND follows
+#         aliases (the snapshot-escape hazard).
+READ, REBIND, RMW, MUTATE = "read", "rebind", "rmw", "mutate"
+
+# The kinds that count as a WRITE for the shared-state-race rule.
+WRITE_KINDS = frozenset({RMW, MUTATE})
 
 
 class FunctionInfo:
@@ -77,7 +115,7 @@ class FunctionInfo:
 class ClassInfo:
     __slots__ = (
         "qname", "name", "module", "node", "methods", "bases",
-        "lock_attrs", "lock_aliases", "attr_types",
+        "lock_attrs", "lock_aliases", "attr_types", "safe_attrs",
     )
 
     def __init__(self, qname, name, module, node):
@@ -94,6 +132,9 @@ class ClassInfo:
         self.lock_aliases: Dict[str, str] = {}
         # self._attr = SomeProjectClass(...) -> class qname (field types).
         self.attr_types: Dict[str, str] = {}
+        # self attributes holding internally-synchronized objects
+        # (queue.Queue, threading.Event, ...): exempt from race checks.
+        self.safe_attrs: Set[str] = set()
 
     def __repr__(self):  # pragma: no cover - debugging aid
         return f"<class {self.qname}>"
@@ -103,7 +144,7 @@ class ModuleInfo:
     __slots__ = (
         "modname", "relpath", "tree", "source", "lines", "suppressions",
         "functions", "classes", "imports", "instances", "module_locks",
-        "aliased_defs", "traced_defs",
+        "aliased_defs", "traced_defs", "shared_globals",
     )
 
     def __init__(self, modname, relpath, source, tree):
@@ -128,6 +169,10 @@ class ModuleInfo:
         # module level — only these make the body device code; a plain
         # `public = _impl` alias or lru_cache wrapper does not.
         self.traced_defs: Set[str] = set()
+        # Module-level mutable-container globals (`_violations = []`):
+        # the module-global shared state the race analysis tracks when
+        # the module also owns a module-level lock.
+        self.shared_globals: Set[str] = set()
 
     def __repr__(self):  # pragma: no cover - debugging aid
         return f"<module {self.modname}>"
@@ -188,6 +233,19 @@ class Project:
         self.by_path: Dict[str, ModuleInfo] = {}  # by relpath
         self.functions: Dict[str, FunctionInfo] = {}  # by qname
         self.classes: Dict[str, ClassInfo] = {}  # by qname
+        # Fixpoint memo: lock/effect closures are O(project) to build
+        # and several ProjectRules need the same ones, so one analysis
+        # run computes each exactly once (the CLI builds ONE Project and
+        # every rule shares it; see core.run_paths). Keys are fixpoint
+        # names ("transitive_acquires", "effects", "held:<root>", ...).
+        self._cache: Dict[str, object] = {}
+
+    def cached(self, key: str, thunk):
+        """Memoize ``thunk()`` under ``key`` for this Project's lifetime
+        (the symbol table is immutable after :meth:`link`)."""
+        if key not in self._cache:
+            self._cache[key] = thunk()
+        return self._cache[key]
 
     # -- construction ----------------------------------------------------
     @classmethod
@@ -249,10 +307,23 @@ class Project:
                 if not isinstance(target, ast.Name):
                     continue
                 value = stmt.value
+                if isinstance(
+                    value,
+                    (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                     ast.ListComp, ast.SetComp),
+                ):
+                    mod.shared_globals.add(target.id)
+                    continue
                 if isinstance(value, ast.Call):
                     leaf = dotted_name(value.func).split(".")[-1]
                     if leaf in LOCK_FACTORIES | CONDITION_FACTORIES:
                         mod.module_locks.add(target.id)
+                        continue
+                    if leaf in (
+                        "dict", "list", "set", "OrderedDict",
+                        "defaultdict", "deque",
+                    ):
+                        mod.shared_globals.add(target.id)
                         continue
                     inner = unwrap_call(value)
                     if isinstance(inner, ast.Name):
@@ -309,7 +380,9 @@ class Project:
                 ):
                     continue
                 leaf = dotted_name(sub.value.func).split(".")[-1]
-                if leaf in LOCK_FACTORIES:
+                if leaf in THREADSAFE_FACTORIES:
+                    cls.safe_attrs.add(target.attr)
+                elif leaf in LOCK_FACTORIES:
                     cls.lock_attrs.add(target.attr)
                 elif leaf in CONDITION_FACTORIES:
                     # Condition(self._lock) aliases the underlying lock;
@@ -633,7 +706,11 @@ class Project:
     # -- fixpoints -------------------------------------------------------
     def transitive_acquires(self) -> Dict[str, Set[str]]:
         """qname -> set of lock nodes the function may acquire, directly
-        or through any resolvable call chain."""
+        or through any resolvable call chain. Memoized: every rule that
+        asks gets the same closure from one computation."""
+        return self.cached("transitive_acquires", self._transitive_acquires)
+
+    def _transitive_acquires(self) -> Dict[str, Set[str]]:
         direct: Dict[str, Set[str]] = {
             qn: {lock for _, lock in self.direct_acquisitions(fn)}
             for qn, fn in self.functions.items()
@@ -658,14 +735,15 @@ class Project:
         self,
         start: str,
         predicate,
-        reach: Dict[str, Set[str]],
-        want,
+        reach: Optional[Dict[str, Set[str]]] = None,
+        want=None,
         limit: int = 8,
     ) -> List[str]:
         """A shortest call chain from ``start`` to a function where
-        ``predicate(qname)`` holds, following only edges that keep
-        ``want`` reachable per ``reach``. Returns qnames including both
-        endpoints."""
+        ``predicate(qname)`` holds — with ``reach``, following only
+        edges that keep ``want`` reachable (the pruned form the
+        lock/host-sync rules use); with ``reach=None``, plain BFS over
+        every call edge. Returns qnames including both endpoints."""
         from collections import deque
 
         queue = deque([[start]])
@@ -683,8 +761,10 @@ class Project:
             for _, callee in fn.calls:
                 if callee in seen:
                     continue
-                if want not in reach.get(callee, set()) and not predicate(
-                    callee
+                if (
+                    reach is not None
+                    and want not in reach.get(callee, set())
+                    and not predicate(callee)
                 ):
                     continue
                 seen.add(callee)
@@ -697,3 +777,506 @@ class Project:
             return False
         rules = mod.suppressions.get(line, set())
         return rule in rules or "all" in rules
+
+    # -- thread topology -------------------------------------------------
+    def short(self, qn: str) -> str:
+        return (
+            qn[len(self.package) + 1:]
+            if qn.startswith(self.package + ".")
+            else qn
+        )
+
+    def class_family(self, cls_qname: str) -> str:
+        """The topmost project-local base of ``cls_qname`` — the
+        identity shared state is attributed to, so a field defined on a
+        base and touched from subclass methods pairs up correctly."""
+        families: Dict[str, str] = self.cached("families", dict)
+        if cls_qname in families:
+            return families[cls_qname]
+        seen = set()
+        cur = cls_qname
+        while cur not in seen and cur in self.classes:
+            seen.add(cur)
+            cls = self.classes[cur]
+            parent = None
+            for base in cls.bases:
+                resolved = self._resolve_class_name(cls.module, base)
+                if resolved and resolved not in seen:
+                    parent = resolved
+                    break
+            if parent is None:
+                break
+            cur = parent
+        families[cls_qname] = cur
+        return cur
+
+    def family_lock_attrs(self, family: str) -> Tuple[Set[str], Set[str]]:
+        """(lock-or-alias attrs, threadsafe attrs) unioned over every
+        class whose family root is ``family``."""
+        memo: Dict[str, tuple] = self.cached("family_attrs", dict)
+        if family not in memo:
+            locks: Set[str] = set()
+            safe: Set[str] = set()
+            for qn, cls in self.classes.items():
+                if self.class_family(qn) != family:
+                    continue
+                locks |= cls.lock_attrs
+                locks |= set(cls.lock_aliases)
+                safe |= cls.safe_attrs
+            memo[family] = (locks, safe)
+        return memo[family]
+
+    def family_owns_lock(self, family: str) -> bool:
+        locks, _ = self.family_lock_attrs(family)
+        return bool(locks)
+
+    def caller_holds_locks(self, fn: FunctionInfo) -> frozenset:
+        """Lock nodes a function's docstring contract declares held on
+        entry ("Caller holds the lock (_cv)." — the repo's convention
+        for helpers that run inside their caller's critical section)."""
+        doc = ast.get_docstring(fn.node) or ""
+        out: Set[str] = set()
+        for attr in _CALLER_HOLDS_RE.findall(doc):
+            if fn.cls is not None:
+                real = fn.cls.lock_aliases.get(attr, attr)
+                if real in fn.cls.lock_attrs:
+                    out.add(f"{self.short(fn.cls.qname)}.{real}")
+                    continue
+            if attr in fn.module.module_locks:
+                out.add(f"{self.short(fn.module.modname)}.{attr}")
+        return frozenset(out)
+
+    def thread_roots(self) -> List["ThreadRoot"]:
+        """Every entry point the process can run CONCURRENTLY with the
+        others: ``threading.Thread`` targets, the RPC handler methods
+        wired into a servicer's ``serve(port, {...})`` callback dict,
+        and the explicit control-plane roots (the physical round loop,
+        heartbeat reaper, watchdog tick, admission drain). ``multi``
+        marks roots that can race THEMSELVES (a thread spawned per
+        event, a gRPC handler running on a thread pool)."""
+        return self.cached("thread_roots", self._thread_roots)
+
+    def _thread_roots(self) -> List["ThreadRoot"]:
+        roots: Dict[str, ThreadRoot] = {}
+
+        def add(fn: FunctionInfo, kind: str, multi: bool, site) -> None:
+            existing = roots.get(fn.qname)
+            if existing is not None:
+                existing.multi = existing.multi or multi
+                return
+            roots[fn.qname] = ThreadRoot(
+                qname=fn.qname,
+                kind=kind,
+                multi=multi,
+                relpath=fn.module.relpath,
+                line=getattr(site, "lineno", fn.node.lineno),
+                seed_locks=self.caller_holds_locks(fn),
+            )
+
+        for fn in self.functions.values():
+            local_types = self._local_types(fn)
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                leaf = dotted_name(node.func).split(".")[-1]
+                if leaf == "Thread":
+                    target = next(
+                        (
+                            kw.value
+                            for kw in node.keywords
+                            if kw.arg == "target"
+                        ),
+                        None,
+                    )
+                    resolved = self._resolve_callable_ref(
+                        fn, target, local_types
+                    )
+                    if resolved is not None:
+                        add(resolved, "thread", True, node)
+                elif leaf == "serve":
+                    # scheduler_server.serve(port, {"done": self._done_rpc,
+                    # ...}): every dict value is an RPC handler root run
+                    # on the server's thread pool.
+                    for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        if not isinstance(arg, ast.Dict):
+                            continue
+                        for value in arg.values:
+                            resolved = self._resolve_callable_ref(
+                                fn, value, local_types
+                            )
+                            if resolved is not None:
+                                add(resolved, "rpc", True, value)
+
+        for suffix, kind, multi in EXPLICIT_THREAD_ROOTS:
+            fn = self.functions.get(f"{self.package}.{suffix}")
+            if fn is not None:
+                add(fn, kind, multi, fn.node)
+        return sorted(roots.values(), key=lambda r: r.qname)
+
+    def _resolve_callable_ref(
+        self, fn: FunctionInfo, node, local_types: Dict[str, str]
+    ) -> Optional[FunctionInfo]:
+        """Resolve a callable REFERENCE (not a call): a Thread target or
+        a servicer callback-dict value."""
+        if node is None:
+            return None
+        node = unwrap_call(node)  # functools.partial(f, ...) -> f
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.value, ast.Name
+        ):
+            base = node.value.id
+            if base == "self" and fn.cls is not None:
+                return self._method_on(fn.cls.qname, node.attr)
+            if base in local_types:
+                return self._method_on(local_types[base], node.attr)
+            if base in fn.module.instances:
+                return self._method_on(
+                    fn.module.instances[base], node.attr
+                )
+            return self.resolve_function(
+                fn.module, dotted_name(node), fn.local_imports
+            )
+        if isinstance(node, ast.Name):
+            if node.id in fn.module.functions:
+                return fn.module.functions[node.id]
+            return self.resolve_function(
+                fn.module, node.id, fn.local_imports
+            )
+        return None
+
+    # -- effect summaries ------------------------------------------------
+    def function_effects(self) -> Dict[str, "FunctionEffects"]:
+        """qname -> the function's shared-state accesses (with the lock
+        set lexically held at each site) and its call sites (with the
+        lock set held around each call). One walk per function, shared
+        by every rule that needs effects."""
+        return self.cached("effects", self._function_effects)
+
+    def _function_effects(self) -> Dict[str, "FunctionEffects"]:
+        out: Dict[str, FunctionEffects] = {}
+        for qn, fn in self.functions.items():
+            eff = FunctionEffects()
+            eff.local_names = self._locally_bound_names(fn)
+            resolved = {id(c): callee for c, callee in fn.calls}
+            self._effects_walk(fn, fn.node, (), eff, resolved)
+            out[qn] = eff
+        return out
+
+    @staticmethod
+    def _locally_bound_names(fn: FunctionInfo) -> Set[str]:
+        """Names bound in ``fn``'s own scope (params, assignment/for/
+        with/comprehension targets, local imports) MINUS names declared
+        ``global`` — a local shadowing a module global must not be
+        recorded as an access to the global."""
+        bound: Set[str] = set()
+        globals_declared: Set[str] = set()
+        args = fn.node.args
+        for a in (
+            list(args.posonlyargs) + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            bound.add(a.arg)
+        for node in Project._walk_own(fn.node):
+            if isinstance(node, ast.Global):
+                globals_declared.update(node.names)
+            elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            ):
+                bound.add(node.id)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    bound.add(
+                        alias.asname or alias.name.split(".")[0]
+                    )
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                       ast.GeneratorExp)
+            ):
+                for comp in node.generators:
+                    for sub in ast.walk(comp.target):
+                        if isinstance(sub, ast.Name):
+                            bound.add(sub.id)
+        return bound - globals_declared
+
+    def _self_attr(self, fn: FunctionInfo, node) -> Optional[str]:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and fn.cls is not None
+        ):
+            return node.attr
+        return None
+
+    def _record_self_access(
+        self, fn, eff, attr: str, kind: str, held, node
+    ) -> None:
+        family = self.class_family(fn.cls.qname)
+        lockish, safe = self.family_lock_attrs(family)
+        if attr in lockish or attr in safe:
+            return
+        eff.accesses.append(
+            FieldAccess(
+                owner=self.short(family),
+                attr=attr,
+                kind=kind,
+                locks=frozenset(held),
+                fn=fn.qname,
+                node=node,
+                in_ctor=fn.name == "__init__",
+            )
+        )
+
+    def _record_global_access(
+        self, fn, eff, name: str, kind: str, held, node
+    ) -> None:
+        if name not in fn.module.shared_globals:
+            return
+        if name in eff.local_names:
+            return  # a local shadows the module global in this scope
+        eff.accesses.append(
+            FieldAccess(
+                owner=self.short(fn.module.modname),
+                attr=name,
+                kind=kind,
+                locks=frozenset(held),
+                fn=fn.qname,
+                node=node,
+                in_ctor=False,
+            )
+        )
+
+    def _reads_same_field(self, fn, value, attr: str) -> bool:
+        """Whether ``value`` (a rebind RHS) reads ``self.<attr>`` — the
+        read-modify-write pattern that makes a rebind non-atomic."""
+        for sub in ast.walk(value):
+            if self._self_attr(fn, sub) == attr and isinstance(
+                sub.ctx, ast.Load
+            ):
+                return True
+        return False
+
+    def _effects_walk(self, fn, node, held, eff, resolved) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                # An item's context expression evaluates BEFORE the
+                # lock IT acquires is held — but with every EARLIER
+                # item's lock already held (left-to-right acquisition).
+                self._effects_walk(
+                    fn, item.context_expr, inner, eff, resolved
+                )
+                lock = self.lock_node(fn, item.context_expr)
+                if lock:
+                    inner = inner + (lock,)
+            for child in node.body:
+                self._effects_walk(fn, child, inner, eff, resolved)
+            return
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            if node is not fn.node:
+                return  # nested defs run on their caller's schedule
+        elif isinstance(node, ast.Call):
+            callee = resolved.get(id(node))
+            if callee is not None:
+                eff.calls.append((callee, frozenset(held), node))
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in MUTATOR_METHODS
+            ):
+                # An unresolved mutator call on a field: an in-place
+                # container mutation (self._outstanding.add(...)).
+                base = node.func.value
+                attr = self._self_attr(fn, base)
+                if attr is not None:
+                    self._record_self_access(
+                        fn, eff, attr, MUTATE, held, node
+                    )
+                elif isinstance(base, ast.Name):
+                    self._record_global_access(
+                        fn, eff, base.id, MUTATE, held, node
+                    )
+        elif isinstance(node, ast.Assign):
+            for target in node.targets:
+                self._effects_record_store(
+                    fn, eff, target, node.value, held, node
+                )
+        elif isinstance(node, ast.AugAssign):
+            self._effects_record_store(
+                fn, eff, node.target, None, held, node, aug=True
+            )
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                self._effects_record_store(
+                    fn, eff, target, None, held, node, aug=True
+                )
+        elif isinstance(node, ast.Attribute) and isinstance(
+            node.ctx, ast.Load
+        ):
+            attr = self._self_attr(fn, node)
+            if attr is not None:
+                self._record_self_access(fn, eff, attr, READ, held, node)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            self._record_global_access(
+                fn, eff, node.id, READ, held, node
+            )
+        for child in ast.iter_child_nodes(node):
+            self._effects_walk(fn, child, held, eff, resolved)
+
+    def _effects_record_store(
+        self, fn, eff, target, value, held, node, aug: bool = False
+    ) -> None:
+        attr = self._self_attr(fn, target)
+        if attr is not None:
+            kind = RMW if aug or (
+                value is not None and self._reads_same_field(fn, value, attr)
+            ) else REBIND
+            self._record_self_access(fn, eff, attr, kind, held, node)
+            return
+        if isinstance(target, ast.Subscript):
+            attr = self._self_attr(fn, target.value)
+            if attr is not None:
+                self._record_self_access(
+                    fn, eff, attr, MUTATE, held, node
+                )
+            elif isinstance(target.value, ast.Name):
+                self._record_global_access(
+                    fn, eff, target.value.id, MUTATE, held, node
+                )
+            return
+        if isinstance(target, ast.Name):
+            kind = RMW if aug else REBIND
+            self._record_global_access(
+                fn, eff, target.id, kind, held, node
+            )
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._effects_record_store(
+                    fn, eff, elt, None, held, node, aug=aug
+                )
+
+    # -- per-root guaranteed-held dataflow -------------------------------
+    def guaranteed_held(self, root: "ThreadRoot") -> Dict[str, frozenset]:
+        """qname -> the lock set guaranteed held on entry whenever the
+        function runs on ``root``'s thread — the MEET (intersection)
+        over every call path from the root, so it is a sound lower
+        bound: a lock in the set is held on every path."""
+        return self.cached(
+            f"held:{root.qname}", lambda: self._guaranteed_held(root)
+        )
+
+    def _guaranteed_held(self, root: "ThreadRoot") -> Dict[str, frozenset]:
+        effects = self.function_effects()
+        entry: Dict[str, frozenset] = {root.qname: root.seed_locks}
+        work = [root.qname]
+        while work:
+            qn = work.pop()
+            eff = effects.get(qn)
+            if eff is None:
+                continue
+            base = entry[qn]
+            for callee, held_at_site, _ in eff.calls:
+                at_entry = base | held_at_site
+                prev = entry.get(callee)
+                if prev is None:
+                    entry[callee] = at_entry
+                    work.append(callee)
+                else:
+                    met = prev & at_entry
+                    if met != prev:
+                        entry[callee] = met
+                        work.append(callee)
+        return entry
+
+    def call_chain(self, root_qname: str, target: str) -> List[str]:
+        """Shortest call chain root -> ... -> target (qnames, both ends
+        included), or [] when unreachable — the witness the race
+        findings print. The unpruned form of :meth:`witness_chain`."""
+        chain = self.witness_chain(
+            root_qname, lambda q: q == target, limit=12
+        )
+        return chain if chain[-1] == target else []
+
+
+class ThreadRoot:
+    """One concurrent entry point (see :meth:`Project.thread_roots`)."""
+
+    __slots__ = ("qname", "kind", "multi", "relpath", "line", "seed_locks")
+
+    def __init__(self, qname, kind, multi, relpath, line, seed_locks):
+        self.qname: str = qname
+        self.kind: str = kind
+        self.multi: bool = multi
+        self.relpath: str = relpath
+        self.line: int = line
+        self.seed_locks: frozenset = seed_locks
+
+    def to_dict(self) -> dict:
+        return {
+            "qname": self.qname,
+            "kind": self.kind,
+            "multi": self.multi,
+            "site": f"{self.relpath}:{self.line}",
+            "seed_locks": sorted(self.seed_locks),
+        }
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<root {self.kind} {self.qname}>"
+
+
+class FieldAccess:
+    """One shared-state access inside one function."""
+
+    __slots__ = ("owner", "attr", "kind", "locks", "fn", "node", "in_ctor")
+
+    def __init__(self, owner, attr, kind, locks, fn, node, in_ctor):
+        self.owner: str = owner
+        self.attr: str = attr
+        self.kind: str = kind  # READ / REBIND / MUTATE
+        self.locks: frozenset = locks
+        self.fn: str = fn
+        self.node: ast.AST = node
+        self.in_ctor: bool = in_ctor
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (
+            f"<{self.kind} {self.owner}.{self.attr} in {self.fn} "
+            f"locks={sorted(self.locks)}>"
+        )
+
+
+class FunctionEffects:
+    __slots__ = ("accesses", "calls", "local_names")
+
+    def __init__(self):
+        self.accesses: List[FieldAccess] = []
+        # (callee qname, locks held around the call, call node)
+        self.calls: List[Tuple[str, frozenset, ast.Call]] = []
+        # Locally-bound names (shadow module globals; see
+        # _locally_bound_names).
+        self.local_names: Set[str] = set()
+
+
+# Control-plane entry points that are thread roots by construction
+# rather than by a discoverable ``Thread(...)``/``serve(...)`` site:
+# the physical round loop is the implicit main root; the heartbeat
+# reaper and admission drain are distinct phases of it (rooted
+# separately so their docstring-declared lock contracts are checked
+# even if call-graph resolution to them ever regresses); the watchdog
+# tick runs on whichever scheduler thread calls check_round. Entries
+# missing from a (fixture) project are skipped.
+EXPLICIT_THREAD_ROOTS: Tuple[Tuple[str, str, bool], ...] = (
+    ("core.physical.PhysicalScheduler.run", "main", False),
+    ("core.physical.PhysicalScheduler._reap_dead_workers", "reaper", False),
+    (
+        "core.physical.PhysicalScheduler._drain_admission_queue",
+        "admission",
+        False,
+    ),
+    ("obs.watchdog.Watchdog.check_round", "watchdog", False),
+)

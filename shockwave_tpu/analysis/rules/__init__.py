@@ -20,6 +20,7 @@ from shockwave_tpu.analysis.rules.interproc import (
     TransitiveHostSync,
 )
 from shockwave_tpu.analysis.rules.locks import LockDiscipline
+from shockwave_tpu.analysis.rules.races import SharedStateRace, SnapshotEscape
 from shockwave_tpu.analysis.rules.rng import RngKeyReuse
 
 RULE_CLASSES = (
@@ -32,6 +33,8 @@ RULE_CLASSES = (
     LockOrderCycle,
     TransitiveHostSync,
     SwallowedException,
+    SharedStateRace,
+    SnapshotEscape,
 )
 
 
@@ -59,4 +62,6 @@ __all__ = [
     "LockOrderCycle",
     "TransitiveHostSync",
     "SwallowedException",
+    "SharedStateRace",
+    "SnapshotEscape",
 ]

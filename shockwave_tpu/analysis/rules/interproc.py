@@ -92,7 +92,13 @@ class LockOrderCycle(ProjectRule):
         """The full held-before graph: ``(edges, self_deadlocks)`` where
         ``edges`` maps ``(held, acquired)`` lock pairs to the first
         witness ``(fn, site, chain)``. The CLI's ``--lock-graph`` dump
-        and the committed sweep evidence both come from here."""
+        and the committed sweep evidence both come from here. Memoized
+        on the project so the rule run and the dump share one build."""
+        return project.cached(
+            "lock_order_graph", lambda: self._graph(project)
+        )
+
+    def _graph(self, project: Project):
         reach = project.transitive_acquires()
         reentrant = self._reentrant_locks(project)
         edges: Dict[Tuple[str, str], tuple] = {}
@@ -332,9 +338,11 @@ class TransitiveHostSync(ProjectRule):
         "same, every iteration, invisibly"
     )
 
-    def check_project(self, project: Project) -> Iterator[Finding]:
-        # Which functions contain direct sync sites (and are not
-        # declared host boundaries).
+    @staticmethod
+    def _sync_reach(project: Project):
+        """(syncs, reach): direct host-sync sites per function, and the
+        transitive closure of which sync-containing functions each
+        function reaches. Memoized on the project."""
         syncs: Dict[str, List[Tuple[ast.AST, str]]] = {}
         for qn, fn in project.functions.items():
             doc = ast.get_docstring(fn.node) or ""
@@ -344,8 +352,6 @@ class TransitiveHostSync(ProjectRule):
             if sites:
                 syncs[qn] = sites
 
-        # Transitive closure: which sync-containing functions does each
-        # function reach (through resolvable calls)?
         reach: Dict[str, Set[str]] = {
             qn: ({qn} if qn in syncs else set())
             for qn in project.functions
@@ -360,6 +366,12 @@ class TransitiveHostSync(ProjectRule):
                     acc |= reach.get(callee, set())
                 if len(acc) != before:
                     changed = True
+        return syncs, reach
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        syncs, reach = project.cached(
+            "host_sync_reach", lambda: self._sync_reach(project)
+        )
 
         seen: Set[Tuple[str, int, str]] = set()
         for fn in project.functions.values():

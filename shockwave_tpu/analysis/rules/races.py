@@ -1,0 +1,452 @@
+"""Thread-topology race rules: shared-state races and snapshot escapes.
+
+PR 11 made the control plane genuinely concurrent: a daemon speculation
+thread clones and solves against planner state while the round loop,
+the heartbeat reaper, the gRPC handlers (scheduler- and worker-side
+servicers), the watchdog tick, and the admission drain all mutate
+overlapping structures. These two rules turn the thread-safety story
+from convention into proof, on top of the thread-root discovery and
+per-function effect summaries in :mod:`shockwave_tpu.analysis.project`.
+
+* **shared-state-race** — for every (object family, field) pair
+  reachable from two thread roots (or from one root that can race
+  itself — a per-event daemon thread, a gRPC handler on a thread
+  pool), with at least one WRITE, where the lock sets *guaranteed*
+  held (the meet over all call paths from each root) are disjoint:
+  flag it, printing the two witness call chains. The write model is
+  GIL-aware: a plain attribute load and a plain rebind of a fresh
+  value are atomic in CPython and pair benignly; what races is an
+  in-place container mutation (``self._m[k] = v``, ``.append``,
+  ``del``) against any access, and a read-modify-write
+  (``self.n += 1``, ``self.f = g(self.f)``) against anything.
+  Scope: classes that own a lock (declaring, by construction, that
+  they are touched from multiple threads) and module globals in
+  modules that own a module-level lock. A class with no lock is
+  single-thread-confined by convention — its cross-thread story is
+  the snapshot-escape contract below, not lock discipline.
+
+* **snapshot-escape** — verifies ``clone_planner``'s deep-copy
+  contract. The speculation clone shares the process with the live
+  planner; ``state_dict()`` is deliberately shallow where it can
+  afford to be, and ``_MUTABLE_MD_FIELDS`` names exactly the per-job
+  metadata structures both sides mutate in place. The rule computes,
+  from the effect summaries, every field of the metadata classes (and
+  every planner field passed by bare reference through
+  ``state_dict``/``from_state``) that is mutated IN PLACE anywhere in
+  the project, and flags any such field the copy contract does not
+  cover — aliased mutable state that the live planner and the
+  speculative clone would both write. Guarded until this PR only by a
+  code comment.
+
+Dynamic counterpart: ``SHOCKWAVE_SANITIZE=threads``
+(:mod:`shockwave_tpu.analysis.sanitize`) instruments the same
+lock-owning classes at runtime and raises on an observed
+unsynchronized cross-thread write pair.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from shockwave_tpu.analysis.core import Finding, ProjectRule, dotted_name
+from shockwave_tpu.analysis.project import (
+    MUTATE,
+    Project,
+    READ,
+    WRITE_KINDS,
+)
+from shockwave_tpu.analysis.rules.interproc import _project_finding
+
+
+def _site(project: Project, access) -> str:
+    fn = project.functions[access.fn]
+    return f"{fn.module.relpath}:{getattr(access.node, 'lineno', 0)}"
+
+
+def _chain_str(project: Project, root, access, guaranteed) -> str:
+    chain = project.call_chain(root.qname, access.fn)
+    if not chain:
+        chain = [root.qname, "...", access.fn]
+    held = sorted(guaranteed) or ["no locks"]
+    return (
+        f"[{root.kind}] "
+        + " -> ".join(project.short(q) for q in chain)
+        + f" {access.kind}s at {_site(project, access)}"
+        + f" holding {{{', '.join(held)}}}"
+    )
+
+
+class SharedStateRace(ProjectRule):
+    name = "shared-state-race"
+    description = (
+        "a field reachable from two thread roots with at least one "
+        "write where the guaranteed-held lock sets are disjoint"
+    )
+    rationale = (
+        "the speculation thread, round loop, heartbeat reaper, RPC "
+        "handlers, watchdog, and admission drain overlap on the same "
+        "objects; an unlocked write pair only corrupts state under "
+        "production interleavings, never in single-threaded tests"
+    )
+
+    def analyze(self, project: Project) -> List[dict]:
+        """The raw race table (also behind the CLI's ``--thread-roots``
+        evidence dump): one entry per racy (owner, field) pair with
+        both witnesses. Memoized on the project so the rule run and the
+        evidence dump share one analysis."""
+        return project.cached(
+            "race_table", lambda: self._analyze(project)
+        )
+
+    def _analyze(self, project: Project) -> List[dict]:
+        roots = project.thread_roots()
+        if not roots:
+            return []
+        effects = project.function_effects()
+
+        # Owners in scope: lock-owning class families + module globals
+        # of modules owning a module-level lock.
+        allowed: Set[str] = set()
+        for qn in project.classes:
+            family = project.class_family(qn)
+            if project.family_owns_lock(family):
+                allowed.add(project.short(family))
+        for mod in project.modules.values():
+            if mod.module_locks:
+                allowed.add(project.short(mod.modname))
+
+        # (owner, attr) -> [(root, access, guaranteed-held)]
+        table: Dict[Tuple[str, str], list] = {}
+        for root in roots:
+            held = project.guaranteed_held(root)
+            for qn, entry_locks in held.items():
+                eff = effects.get(qn)
+                if eff is None:
+                    continue
+                for access in eff.accesses:
+                    if access.in_ctor or access.owner not in allowed:
+                        continue
+                    guaranteed = entry_locks | access.locks
+                    table.setdefault(
+                        (access.owner, access.attr), []
+                    ).append((root, access, guaranteed))
+
+        races: List[dict] = []
+        for (owner, attr), entries in sorted(table.items()):
+            pair = self._find_race_pair(entries)
+            if pair is None:
+                continue
+            (root_w, acc_w, held_w), (root_o, acc_o, held_o) = pair
+            write_fn = project.functions[acc_w.fn]
+            races.append(
+                {
+                    "owner": owner,
+                    "field": attr,
+                    # An inline-justified suppression at the write site
+                    # keeps the pair in this evidence table but out of
+                    # the findings (the comment is the review trail).
+                    "suppressed": project.is_suppressed(
+                        write_fn.module.relpath,
+                        getattr(acc_w.node, "lineno", 0),
+                        SharedStateRace.name,
+                    ),
+                    "write": {
+                        "root": root_w.qname,
+                        "kind": acc_w.kind,
+                        "site": _site(project, acc_w),
+                        "locks": sorted(held_w),
+                        "witness": _chain_str(
+                            project, root_w, acc_w, held_w
+                        ),
+                    },
+                    "other": {
+                        "root": root_o.qname,
+                        "kind": acc_o.kind,
+                        "site": _site(project, acc_o),
+                        "locks": sorted(held_o),
+                        "witness": _chain_str(
+                            project, root_o, acc_o, held_o
+                        ),
+                    },
+                    "_access": acc_w,
+                }
+            )
+        return races
+
+    @staticmethod
+    def _find_race_pair(entries) -> Optional[tuple]:
+        """The most severe racing pair among one field's accesses, or
+        None. Severity order: write/write beats write/read; distinct
+        roots beat a multi root racing itself."""
+        best = None
+        best_rank = -1
+        for i, (r1, a1, g1) in enumerate(entries):
+            if a1.kind not in WRITE_KINDS:
+                continue
+            for j, (r2, a2, g2) in enumerate(entries):
+                if i == j and not r1.multi:
+                    continue
+                if r1.qname == r2.qname and not r1.multi:
+                    continue
+                if a2.kind == READ and a2.fn == a1.fn:
+                    # A read in the same function as the write is the
+                    # write's own operand scan, not a second thread's
+                    # view — require the read elsewhere (the write
+                    # itself still pairs with writes anywhere).
+                    continue
+                if g1 & g2:
+                    continue
+                rank = (2 if a2.kind in WRITE_KINDS else 1) * 2 + (
+                    1 if r1.qname != r2.qname else 0
+                )
+                if rank > best_rank:
+                    best_rank = rank
+                    best = ((r1, a1, g1), (r2, a2, g2))
+        return best
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        for race in self.analyze(project):
+            access = race["_access"]
+            fn = project.functions[access.fn]
+            yield _project_finding(
+                self, project, fn, access.node,
+                f"unsynchronized cross-thread access to "
+                f"{race['owner']}.{race['field']}: "
+                f"{race['write']['witness']}; but "
+                f"{race['other']['witness']} — guaranteed-held lock "
+                "sets are disjoint, so these interleave",
+            )
+
+
+# -- snapshot-escape ----------------------------------------------------
+
+
+class SnapshotEscape(ProjectRule):
+    name = "snapshot-escape"
+    description = (
+        "a structure mutated in place by the live planner or the "
+        "speculative clone that clone_planner's deep-copy contract "
+        "does not cover (aliased mutable state)"
+    )
+    rationale = (
+        "the speculation clone shares the process with the live "
+        "planner; state_dict() is shallow by design and "
+        "_MUTABLE_MD_FIELDS names exactly what both sides mutate — a "
+        "field that joins the mutating set without joining the copied "
+        "set corrupts the live planner from the clone's thread"
+    )
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        clone_fn = next(
+            (
+                fn
+                for qn, fn in sorted(project.functions.items())
+                if fn.name == "clone_planner" and fn.cls is None
+            ),
+            None,
+        )
+        if clone_fn is None:
+            return
+        copied = self._copied_fields(clone_fn.module)
+        effects = project.function_effects()
+
+        # In-place mutated fields per class FAMILY (effect owners are
+        # family-rooted): family short name -> attr -> first witness.
+        mutated: Dict[str, Dict[str, object]] = {}
+        for qn, eff in effects.items():
+            for access in eff.accesses:
+                if access.kind != MUTATE or access.in_ctor:
+                    continue
+                mutated.setdefault(access.owner, {}).setdefault(
+                    access.attr, access
+                )
+
+        spec_entry = next(
+            (
+                qn
+                for qn, fn in sorted(project.functions.items())
+                if fn.name == "run_speculation" and fn.cls is None
+            ),
+            clone_fn.qname,
+        )
+
+        # (a) Metadata classes: everything stored into a planner's
+        # job_metadata mapping is snapshotted via the shallow
+        # dict(self.__dict__) path; every in-place-mutated field must
+        # be in the copied set.
+        for cls_qname in self._metadata_classes(project):
+            family = project.short(project.class_family(cls_qname))
+            for attr, access in sorted(
+                mutated.get(family, {}).items()
+            ):
+                if attr in copied:
+                    continue
+                yield self._escape_finding(
+                    project, access, spec_entry,
+                    f"{family}.{attr} is mutated in place here but "
+                    f"clone_planner's copied set (_MUTABLE_MD_FIELDS = "
+                    f"{sorted(copied)}) does not deep-copy it — the "
+                    "live planner and the speculative clone alias it, "
+                    "so a post-snapshot mutation on either side "
+                    "corrupts the other",
+                )
+
+        # (b) Planner classes: a state_dict entry that passes a field
+        # by bare reference (no copying wrapper) aliases it into the
+        # clone; if that field is mutated in place and from_state does
+        # not re-copy it, it escapes.
+        for cls_qname in self._planner_classes(project):
+            cls = project.classes[cls_qname]
+            state_fn = cls.methods.get("state_dict")
+            if state_fn is None:
+                continue
+            family = project.short(project.class_family(cls_qname))
+            bare = self._bare_state_fields(state_fn)
+            if "*" in bare:
+                # dict(self.__dict__): every in-place-mutated field of
+                # the family passes through the snapshot by reference.
+                bare = (bare - {"*"}) | set(mutated.get(family, {}))
+            recopied = self._from_state_copies(cls)
+            for attr in sorted(bare):
+                access = mutated.get(family, {}).get(attr)
+                if access is None or attr in recopied or attr in copied:
+                    continue
+                yield self._escape_finding(
+                    project, access, spec_entry,
+                    f"{family}.{attr} passes through state_dict by "
+                    "bare reference and is mutated in place here — "
+                    "the snapshot aliases it between the live planner "
+                    "and the speculative clone",
+                )
+
+    def _escape_finding(self, project, access, spec_entry, message):
+        fn = project.functions[access.fn]
+        chain = project.call_chain(spec_entry, access.fn)
+        if chain:
+            message += (
+                "; clone-side witness: "
+                + " -> ".join(project.short(q) for q in chain)
+            )
+        return _project_finding(self, project, fn, access.node, message)
+
+    @staticmethod
+    def _copied_fields(mod) -> Set[str]:
+        for stmt in mod.tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "_MUTABLE_MD_FIELDS"
+            ):
+                try:
+                    value = ast.literal_eval(stmt.value)
+                except ValueError:
+                    return set()
+                return {str(v) for v in value}
+        return set()
+
+    def _metadata_classes(self, project: Project) -> List[str]:
+        """Classes whose instances are stored into a ``job_metadata``
+        mapping (``self.job_metadata[job_id] = md``) — the values the
+        snapshot copies via their shallow ``state_dict``."""
+        out: Set[str] = set()
+        for fn in project.functions.values():
+            if fn.cls is None:
+                continue
+            local_types = project._local_types(fn)
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for target in node.targets:
+                    if not (
+                        isinstance(target, ast.Subscript)
+                        and project._self_attr(fn, target.value)
+                        == "job_metadata"
+                    ):
+                        continue
+                    value = node.value
+                    if isinstance(value, ast.Name):
+                        if value.id in local_types:
+                            out.add(local_types[value.id])
+                    elif isinstance(value, ast.Call):
+                        resolved = project._resolve_class_name(
+                            fn.module, dotted_name(value.func)
+                        )
+                        if resolved:
+                            out.add(resolved)
+        return sorted(out)
+
+    @staticmethod
+    def _planner_classes(project: Project) -> List[str]:
+        """The speculation-wired planner kinds: classes defining the
+        ``_spec_solve_base`` reconcile hook."""
+        return sorted(
+            qn
+            for qn, cls in project.classes.items()
+            if "_spec_solve_base" in cls.methods
+        )
+
+    @staticmethod
+    def _bare_state_fields(state_fn) -> Set[str]:
+        """Fields returned from state_dict as bare ``self.attr`` values
+        (no copying wrapper). ``return dict(self.__dict__)`` — the
+        JobMetadata idiom, a shallow copy of EVERY field — yields the
+        ``"*"`` sentinel, which the caller expands to all in-place-
+        mutated fields of the class family."""
+        bare: Set[str] = set()
+        for node in ast.walk(state_fn.node):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            if isinstance(node.value, ast.Dict):
+                for value in node.value.values:
+                    if (
+                        isinstance(value, ast.Attribute)
+                        and isinstance(value.value, ast.Name)
+                        and value.value.id == "self"
+                    ):
+                        bare.add(value.attr)
+            elif isinstance(node.value, ast.Call):
+                call = node.value
+                if (
+                    dotted_name(call.func) == "dict"
+                    and call.args
+                    and dotted_name(call.args[0]) == "self.__dict__"
+                ):
+                    bare.add("*")
+        return bare
+
+    @staticmethod
+    def _from_state_copies(cls) -> Set[str]:
+        """Attrs that ``from_state`` re-wraps in a fresh container
+        (``planner.x = dict(state[...])``) — copied at restore time, so
+        a bare state_dict reference does not alias."""
+        from_fn = cls.methods.get("from_state")
+        if from_fn is None:
+            return set()
+        out: Set[str] = set()
+        for node in ast.walk(from_fn.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Attribute) and isinstance(
+                    node.value, ast.Call
+                ):
+                    out.add(target.attr)
+        return out
+
+
+def thread_roots_dict(project: Optional[Project] = None) -> dict:
+    """JSON-ready dump of the discovered thread topology and the race
+    table — ``python -m shockwave_tpu.analysis --thread-roots`` and the
+    committed sweep evidence."""
+    project = project or Project.build()
+    return {
+        "roots": [r.to_dict() for r in project.thread_roots()],
+        # Copies, minus the witness AST handle: the table is memoized
+        # on the Project and check_project still needs "_access".
+        "races": [
+            {k: v for k, v in race.items() if k != "_access"}
+            for race in SharedStateRace().analyze(project)
+        ],
+    }

@@ -29,6 +29,29 @@
   signatures/budget seen — the silent 20-40 s stall the watchdog's
   solver-time rule can only flag after the fact).
 
+* **threads** — the dynamic counterpart of the static
+  ``shared-state-race`` rule. :func:`instrument_for_threads` patches
+  ``__setattr__`` on the classes the static pass identifies (the
+  lock-owning families of the race scope) to record, per (instance,
+  field), which thread wrote it and the intersection of sanitized
+  locks that thread held across its writes. A write from a second
+  thread whose lock set is disjoint from another writer's RAISES
+  :class:`ThreadRaceViolation` at the offending line. Two deliberate
+  deltas from the static model: tracking is at ATTRIBUTE-WRITE
+  granularity (an in-place container mutation never passes through
+  ``__setattr__`` — that hazard is the static rule's domain; the
+  dynamic half observes the rebind/RMW side), and it is STRICTER
+  about cross-thread lock-free rebinds (the static GIL model blesses
+  fresh-value publication; an observed lock-free cross-thread write
+  pair raises here, because at runtime the tracker cannot tell a
+  blessed publication from a torn read-modify-write). The first
+  writer per (instance, field) owns an exclusive construction/setup
+  phase that never pairs. Enabling ``threads`` also instruments the
+  lock factories — the held stack is what the recorder reads, and
+  the full lock sanitizer (order-inversion, self-deadlock,
+  hold-ceiling checks, recorded under kind ``"locks"``) is active
+  with it.
+
 Disabled (the default), every factory returns the raw
 ``threading`` primitive and every wrapper returns its argument —
 zero overhead, bit-identical behavior.
@@ -55,6 +78,7 @@ __all__ = [
     "LockOrderViolation",
     "LockHoldViolation",
     "RecompileViolation",
+    "ThreadRaceViolation",
     "configure",
     "enabled",
     "make_lock",
@@ -63,6 +87,8 @@ __all__ = [
     "watch_jit",
     "jax_entry",
     "check_recompiles",
+    "instrument_class",
+    "instrument_for_threads",
     "report",
     "reset",
     "violations_as_findings",
@@ -82,6 +108,10 @@ class LockHoldViolation(SanitizerError):
 
 
 class RecompileViolation(SanitizerError):
+    pass
+
+
+class ThreadRaceViolation(SanitizerError):
     pass
 
 
@@ -411,18 +441,24 @@ class SanitizedLock:
         return f"<SanitizedLock {self.name} {self._inner!r}>"
 
 
+def _locks_instrumented() -> bool:
+    """The thread sanitizer reads the held-lock stack, so enabling
+    ``threads`` instruments the lock factories too."""
+    return enabled("locks") or enabled("threads")
+
+
 def make_lock(name: str):
-    """A ``threading.Lock`` — instrumented when the lock sanitizer is
-    active. ``name`` is the project-wide lock identity, conventionally
-    matching the static analyzer's node names
+    """A ``threading.Lock`` — instrumented when the lock (or thread)
+    sanitizer is active. ``name`` is the project-wide lock identity,
+    conventionally matching the static analyzer's node names
     (``"obs.metrics.MetricsRegistry._lock"``)."""
-    if enabled("locks"):
+    if _locks_instrumented():
         return SanitizedLock(name, threading.Lock(), reentrant=False)
     return threading.Lock()
 
 
 def make_rlock(name: str):
-    if enabled("locks"):
+    if _locks_instrumented():
         return SanitizedLock(name, threading.RLock(), reentrant=True)
     return threading.RLock()
 
@@ -445,6 +481,162 @@ def observed_lock_graph() -> dict:
                 for (a, b), w in sorted(_lock_edges.items())
             ]
         }
+
+
+# -- thread-race sanitizer ----------------------------------------------
+
+# Classes patched by instrument_class (qname -> class), for report()
+# and idempotency across repeated instrument_for_threads() calls.
+_instrumented: Dict[str, type] = {}
+_tracked_write_count = 0
+
+# Attribute names never tracked: the sanitizer's own bookkeeping slot
+# plus lock objects (their wrappers maintain the held stack already).
+_TRACK_SKIP_PREFIX = "_san_"
+
+
+def _held_lock_names() -> frozenset:
+    return frozenset(h.lock.name for h in _held_stack())
+
+
+_OWNER_KEY = "\x00owner"
+
+
+def _note_field_write(owner: str, obj, attr: str) -> None:
+    """Record one field write on an instrumented instance and raise on
+    an observed unsynchronized cross-thread write pair.
+
+    Eraser-style lockset states per (instance, field): writes stay in
+    an EXCLUSIVE phase while a single thread owns the field
+    (construction and pre-publication setup — a driver configuring the
+    scheduler before starting its round-loop thread — are lock-free by
+    design and never pair). The first write from a SECOND thread moves
+    the field to the shared phase: from then on each writer thread's
+    entry is the INTERSECTION of the sanitized locks it held across
+    its writes, and two threads whose entries are disjoint raced."""
+    global _tracked_write_count
+    inst = getattr(obj, "__dict__", None)
+    if inst is None:  # __slots__ instance: nowhere to hang the table
+        return
+    thread = threading.current_thread().name
+    held = _held_lock_names()
+    track = inst.setdefault("_san_writes", {})
+    with _state_lock:
+        _tracked_write_count += 1
+        seen = track.get(attr)
+        if seen is None:
+            track[attr] = {_OWNER_KEY: thread}  # exclusive phase
+            return
+        if _OWNER_KEY in seen:
+            if seen[_OWNER_KEY] == thread:
+                return  # still exclusive: setup writes are free
+            # Second thread arrived: the field is shared from HERE.
+            # The exclusive owner's setup history is forgiven (it
+            # happened-before this thread could exist).
+            del seen[_OWNER_KEY]
+        prev = seen.get(thread)
+        seen[thread] = held if prev is None else (prev & held)
+        mine = seen[thread]
+        conflict = next(
+            (
+                (other, locks)
+                for other, locks in seen.items()
+                if other != thread and not (locks & mine)
+            ),
+            None,
+        )
+    if conflict is not None:
+        other, locks = conflict
+        entry = _record_violation(
+            "threads",
+            "sanitize-thread-race",
+            f"unsynchronized cross-thread write to {owner}.{attr}: "
+            f"{thread} wrote holding "
+            f"{{{', '.join(sorted(mine)) or 'no locks'}}} but {other} "
+            f"wrote holding "
+            f"{{{', '.join(sorted(locks)) or 'no locks'}}} — the "
+            "guaranteed lock sets are disjoint, so these writes "
+            "interleave",
+        )
+        raise ThreadRaceViolation(entry["message"])
+
+
+def instrument_class(cls: type, owner: Optional[str] = None) -> type:
+    """Patch ``cls.__setattr__`` to track per-(instance, field) writes
+    while the thread sanitizer is active. Idempotent per CLASS (the
+    marker lives in ``cls.__dict__``, not inherited, so a subclass can
+    still be instrumented independently while the same class is never
+    double-wrapped under two owner labels); returns ``cls``. The
+    underlying write always happens BEFORE the race check raises, so
+    state is not corrupted by the diagnostic."""
+    owner = owner or f"{cls.__module__}.{cls.__qualname__}"
+    if cls.__dict__.get("_san_instrumented"):
+        return cls
+    orig = cls.__setattr__
+
+    def __setattr__(self, name, value, _orig=orig, _owner=owner):
+        _orig(self, name, value)
+        # Gate per write, not just at patch time: the patch is
+        # irreversible, so a process that instrumented under
+        # ``threads`` and later turned it off (test suites) must stop
+        # tracking — locks made AFTER the switch-off are raw and
+        # invisible to the held stack, and pairing their correctly
+        # guarded writes as "lock-free" would raise spuriously.
+        if not name.startswith(_TRACK_SKIP_PREFIX) and enabled(
+            "threads"
+        ):
+            _note_field_write(_owner, self, name)
+
+    cls.__setattr__ = __setattr__
+    cls._san_instrumented = True
+    _instrumented[owner] = cls
+    return cls
+
+
+def instrument_for_threads() -> List[str]:
+    """Instrument the classes the STATIC pass identifies as shared
+    (the lock-owning class families in the shared-state-race scope of
+    :mod:`shockwave_tpu.analysis.rules.races`): every member class gets
+    write tracking. No-op unless ``threads`` is active. Returns the
+    instrumented class qnames."""
+    if not enabled("threads"):
+        return []
+    import importlib
+
+    from shockwave_tpu.analysis.project import Project
+
+    project = Project.build()
+    targets: List[str] = []
+    for qn in sorted(project.classes):
+        family = project.class_family(qn)
+        if not project.family_owns_lock(family):
+            continue
+        if qn.startswith(f"{project.package}.analysis."):
+            continue  # never instrument the sanitizer's own machinery
+        if qn != family:
+            # Patch only the family ROOT: subclasses inherit the
+            # instrumented __setattr__, and patching both would track
+            # every write twice (mis-counting construction writes).
+            continue
+        targets.append(qn)
+    done: List[str] = []
+    for qn in targets:
+        modname, _, clsname = qn.rpartition(".")
+        try:
+            cls = getattr(importlib.import_module(modname), clsname)
+        except (ImportError, AttributeError):  # pragma: no cover
+            # A class gated behind an optional dep loses write
+            # tracking: say so, or the coverage gap is invisible.
+            import logging
+
+            logging.getLogger("analysis.sanitize").warning(
+                "thread sanitizer could not import %s; its fields "
+                "are NOT write-tracked this run", qn, exc_info=True,
+            )
+            continue
+        instrument_class(cls, owner=project.short(qn))
+        done.append(qn)
+    return done
 
 
 # -- jax sanitizer ------------------------------------------------------
@@ -597,6 +789,10 @@ def report() -> dict:
                     for (a, b), w in sorted(_lock_edges.items())
                 ],
             },
+            "threads": {
+                "instrumented": sorted(_instrumented),
+                "tracked_writes": _tracked_write_count,
+            },
             "jax": {
                 "entries": {
                     name: dict(st) for name, st in sorted(_jax_entries.items())
@@ -619,12 +815,15 @@ def report() -> dict:
 
 
 def reset() -> None:
-    """Tests only: drop all recorded sanitizer state."""
-    global _violations
+    """Tests only: drop all recorded sanitizer state. Instrumented
+    classes stay patched (their tracking is per-instance, and dead
+    instances take their write tables with them)."""
+    global _violations, _tracked_write_count
     with _state_lock:
         _violations = []
         _lock_edges.clear()
         _jax_entries.clear()
         _jit_watches.clear()
         _recompile_checks.clear()
+        _tracked_write_count = 0
     _tls.held = []

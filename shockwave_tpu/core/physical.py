@@ -173,6 +173,17 @@ class PhysicalScheduler(Scheduler):
     def get_current_timestamp(self, in_seconds: bool = False) -> float:
         return time.time() - self._start_time
 
+    def add_job(self, job, timestamp=None):
+        """In-process admission entry. The gRPC server is live from
+        construction, so a worker registration or a Done report can
+        interleave with a driver thread's add_job even before the
+        round loop starts — the base (simulator) implementation
+        mutates allocation state and must run under the lock here."""
+        with self._cv:
+            job_id = super().add_job(job, timestamp=timestamp)
+            self._cv.notify_all()
+            return job_id
+
     # -- RPC callbacks --------------------------------------------------
     def _register_worker_rpc(self, worker_type, num_accelerators, ip_addr, port):
         """(reference: scheduler.py:2854-2940)"""
@@ -1025,8 +1036,13 @@ class PhysicalScheduler(Scheduler):
             obs.get_tracer().end(
                 f"round {self._round_id}", cat="sched", tid="rounds"
             )
-            self._round_id += 1
-            self._num_completed_rounds += 1
+            # Advance the round cursor under the lock: RPC handlers and
+            # the admission drain stamp records with the current round,
+            # and an unlocked increment here lets a Done/Submit racing
+            # the boundary attribute work to a half-advanced round.
+            with self._cv:
+                self._round_id += 1
+                self._num_completed_rounds += 1
 
         self.shutdown()
 
@@ -1048,10 +1064,18 @@ class PhysicalScheduler(Scheduler):
                 self._dispatched_worker_ids.get(key)
                 or self._current_worker_assignments.get(key, ())
             )
+            # Snapshot the connections under the lock too: the reaper
+            # pops dead workers from the map concurrently, and the kill
+            # RPCs below must run unlocked (a black-holed host would
+            # stall every lease handler otherwise).
+            clients = {
+                worker_id: self._worker_connections.get(worker_id)
+                for worker_id in worker_ids
+            }
         for worker_id in worker_ids:
             for job_int in key.as_tuple():
                 try:
-                    client = self._worker_connections.get(worker_id)
+                    client = clients.get(worker_id)
                     if client is None:
                         continue  # worker already retired
                     # Retried with backoff inside the client
@@ -1153,8 +1177,14 @@ class PhysicalScheduler(Scheduler):
         if self._shutdown_requested.is_set():
             return
         self._shutdown_requested.set()
+        # Snapshot under the lock: a straggling RegisterWorker or a
+        # concurrent reap mutates the connection map while this
+        # iterates (the shutdown RPCs themselves stay outside the lock
+        # — a black-holed worker must not wedge the lease handlers).
+        with self._cv:
+            clients = list(self._worker_connections.values())
         seen = set()
-        for worker_id, client in self._worker_connections.items():
+        for client in clients:
             if id(client) in seen:
                 continue
             seen.add(id(client))

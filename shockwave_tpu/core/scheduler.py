@@ -358,8 +358,20 @@ class Scheduler:
                     "set was fixed; its chips will not be planned",
                     worker_type,
                 )
-            self._worker_types.append(worker_type)
-            self._worker_types.sort()
+            # Atomic publication: the streaming-admission validator
+            # (core/physical.py _validate_job_runnable) reads this list
+            # from the SubmitJobs RPC thread without the round loop's
+            # condition lock (admission must stay cheap under a
+            # submission storm). Rebinding a fresh sorted list is an
+            # atomic pointer swap under the GIL; an in-place
+            # append+sort would expose a half-sorted list mid-read.
+            # The read-modify-write here is safe because every writer
+            # holds _cv — only the lockless READER side is unguarded,
+            # and it sees the old or the new list, never a torn one.
+            # shockwave-lint: disable=shared-state-race
+            self._worker_types = sorted(
+                [*self._worker_types, worker_type]
+            )
             self._cluster_spec[worker_type] = 0
             self._worker_type_to_worker_ids[worker_type] = []
             self._worker_time_so_far[worker_type] = 0.0

@@ -25,6 +25,15 @@ assert len(jax.devices()) == 8, (
     f"expected 8 virtual CPU devices, got {jax.devices()}"
 )
 
+# SHOCKWAVE_SANITIZE=threads (the races_smoke CI step): patch write
+# tracking onto the lock-owning production classes the static
+# shared-state-race pass identifies, BEFORE any test constructs them.
+# No-op (and costs nothing) unless the env var names "threads".
+from shockwave_tpu.analysis import sanitize as _sanitize  # noqa: E402
+
+if _sanitize.enabled("threads"):
+    _sanitize.instrument_for_threads()
+
 
 def pytest_configure(config):
     config.addinivalue_line(
