@@ -124,6 +124,72 @@ def pipelining_phase():
     }
 
 
+def whatif_phase():
+    """What-if fleet throughput: one lane-banded batched counterfactual
+    dispatch (256 scenarios over a 250-job market — fleet sizes x
+    weight x switch-cost x round-length knobs) vs the standalone
+    single-scenario solve. Reports ``whatif_scenarios_per_s`` (gated,
+    higher is better) and the amortization factor; a 3-lane bit-parity
+    audit backs the number with the batched-equals-standalone proof the
+    whatif contract promises."""
+    from shockwave_tpu.whatif import (
+        Scenario,
+        ScenarioBatch,
+        audit_lanes,
+        solve_scenario,
+        solve_scenarios,
+    )
+
+    problem = make_problem(
+        num_jobs=250, future_rounds=50, num_gpus=64, seed=11
+    )
+    scenarios = [Scenario(name="baseline")] + [
+        Scenario(
+            name=f"s{i}",
+            num_gpus=float(16 + 8 * (i % 32)),
+            priority_scale=0.5 + (i % 8) * 0.25,
+            switch_cost_scale=0.5 + (i % 4) * 0.5,
+            round_duration=60.0 + (i % 5) * 30.0,
+        )
+        for i in range(255)
+    ]
+    batch = ScenarioBatch(problem, scenarios)
+    solve_scenarios(batch)  # compile (one per lane/slot band)
+    # Min-of-5: the chunked dispatch is a train of small kernel calls,
+    # so host scheduling noise is one-sided (interference only ever
+    # slows a rep) — the min is the stable capability estimate the
+    # regression gate can ratchet on where a median still flaps +-30%
+    # on this shared-core host.
+    batch_times = []
+    for _ in range(5):
+        t0 = time.time()
+        s_list, _, _ = solve_scenarios(batch)
+        batch_times.append(time.time() - t0)
+    batch_s = min(batch_times)
+    solve_scenario(batch, 0)  # compile the standalone reference
+    singles = []
+    for _ in range(3):
+        t0 = time.time()
+        solve_scenario(batch, 0)
+        singles.append(time.time() - t0)
+    single_s = statistics.median(singles)
+    audit = audit_lanes(batch, s_list, indices=(0, 17, 255))
+    assert audit["bit_identical"], (
+        f"whatif batched lanes diverged from standalone solves: "
+        f"{audit['mismatched']}"
+    )
+    return {
+        "whatif_scenarios_per_s": round(len(scenarios) / batch_s, 1),
+        "whatif_batch_solve_s": round(batch_s, 4),
+        "whatif_single_solve_s": round(single_s, 4),
+        "whatif_amortization_x": round(
+            single_s * len(scenarios) / max(batch_s, 1e-9), 1
+        ),
+        "whatif_audit": "ok",
+        "whatif_config": "250 jobs x 256 scenarios",
+    }
+
+
 def main():
     from shockwave_tpu.solver.eg_jax import (
         counts_to_schedule,
@@ -467,6 +533,9 @@ def main():
         # behind round r, and the reconcile hit rate on a no-churn
         # trace (both gated by check_bench_regression.py).
         **pipelining_phase(),
+        # What-if fleet: batched counterfactual solve throughput
+        # (whatif_scenarios_per_s gated by check_bench_regression.py).
+        **whatif_phase(),
         "config": "1000 jobs x 256 gpus x 50 rounds",
     }
 

@@ -1,0 +1,350 @@
+#!/usr/bin/env python3
+"""What-if fleet CLI: capacity planning and admission pricing against
+real recorded planner state.
+
+``sweep`` loads a flight-recorder decision log (or an ``export-state``
+artifact), builds a scenario grid — fleet sizes x weight knobs x
+switch-cost knobs x round lengths — and solves the WHOLE grid in one
+lane-banded vmapped dispatch, emitting a capacity-planning report
+(Nash welfare / makespan / worst-FTF-proxy deltas per scenario) plus
+the timing and bit-parity audit the acceptance artifact commits:
+
+  python scripts/analysis/whatif.py sweep \
+      --log results/flight_recorder/decisions.jsonl \
+      --capacity 1,2,4,8 --priority-scale 0.5,1,2 \
+      --out results/whatif/sweep.json
+
+``price`` prices a hypothetical tenant burst against the same recorded
+state — the offline twin of the ``--price-admission`` online path
+(scripts/streaming_soak.py) — and reports the marginal-price decision
+next to what quota-only admission would have done:
+
+  python scripts/analysis/whatif.py price \
+      --log results/flight_recorder/decisions.jsonl \
+      --burst-jobs 4 --burst-scale 2 --out results/whatif/price.json
+
+See docs/USAGE.md "What-if fleet & admission pricing".
+"""
+
+import argparse
+import itertools
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+def _parse_floats(raw):
+    return [float(x) for x in str(raw).split(",") if x.strip()]
+
+
+def _load_base(args):
+    """(problem, job_keys, s0, round, source) from --log or --state."""
+    from shockwave_tpu.obs.recorder import load_exported_state
+    from shockwave_tpu.whatif import (
+        base_problem_from_log,
+        base_problem_from_state,
+    )
+
+    if args.state:
+        envelope = load_exported_state(args.state)
+        problem, keys, s0 = base_problem_from_state(
+            envelope["planner_state"]
+        )
+        return problem, keys, s0, envelope.get("round"), args.state
+    problem, keys, s0, rnd = base_problem_from_log(
+        args.log, round_index=args.round
+    )
+    return problem, keys, s0, rnd, args.log
+
+
+def _build_grid(problem, args):
+    """Identity baseline + the cartesian scenario grid."""
+    from shockwave_tpu.whatif import Scenario
+
+    capacities = (
+        _parse_floats(args.capacity)
+        if args.capacity
+        else [float(problem.num_gpus)]
+    )
+    pscales = (
+        _parse_floats(args.priority_scale) if args.priority_scale else [1.0]
+    )
+    sscales = (
+        _parse_floats(args.switch_scale) if args.switch_scale else [1.0]
+    )
+    durs = (
+        _parse_floats(args.round_s)
+        if args.round_s
+        else [float(problem.round_duration)]
+    )
+    scenarios = [Scenario(name="baseline")]
+    for cap, ps, ss, dur in itertools.product(
+        capacities, pscales, sscales, durs
+    ):
+        scenarios.append(
+            Scenario(
+                name=f"g{cap:g}_p{ps:g}_s{ss:g}_d{dur:g}",
+                num_gpus=cap,
+                priority_scale=ps,
+                switch_cost_scale=ss,
+                round_duration=dur,
+                tags={
+                    "capacity": cap, "priority_scale": ps,
+                    "switch_cost_scale": ss, "round_s": dur,
+                },
+            )
+        )
+    return scenarios
+
+
+def cmd_sweep(args) -> int:
+    from shockwave_tpu.whatif import (
+        ScenarioBatch,
+        audit_lanes,
+        scenario_report,
+        solve_scenario,
+        solve_scenarios,
+    )
+
+    problem, keys, s0, rnd, source = _load_base(args)
+    scenarios = _build_grid(problem, args)
+    batch = ScenarioBatch(problem, scenarios, s0=s0)
+    print(
+        f"{source} round {rnd}: {problem.num_jobs} jobs x "
+        f"{len(scenarios)} scenarios ({batch.lanes} lanes, "
+        f"{batch.slots} slots)"
+    )
+    # Warm both kernels outside the timed region (one compile per
+    # band is the contract; the timing must show dispatch, not XLA).
+    solve_scenarios(batch)
+    solve_scenario(batch, 0)
+    t0 = time.monotonic()
+    s_list, objs, diags = solve_scenarios(batch)
+    batch_s = time.monotonic() - t0
+    singles = []
+    for _ in range(5):
+        t0 = time.monotonic()
+        solve_scenario(batch, 0)
+        singles.append(time.monotonic() - t0)
+    single_s = statistics.median(singles)
+    audit_n = (
+        len(scenarios)
+        if args.audit_lanes < 0
+        else min(args.audit_lanes, len(scenarios))
+    )
+    audit = audit_lanes(batch, s_list, indices=range(audit_n))
+    rows = scenario_report(problem, scenarios, s_list, objs, diags)
+    report = {
+        "source": source,
+        "round": rnd,
+        "base": {
+            "jobs": problem.num_jobs,
+            "num_gpus": float(problem.num_gpus),
+            "round_duration_s": float(problem.round_duration),
+            "future_rounds": int(problem.future_rounds),
+        },
+        "timing": {
+            "scenarios": len(scenarios),
+            "lanes": batch.lanes,
+            "slots": batch.slots,
+            "batch_solve_s": round(batch_s, 4),
+            "single_solve_s": round(single_s, 4),
+            "x_vs_single_solve": round(batch_s / max(single_s, 1e-9), 2),
+            "scenarios_per_s": round(
+                len(scenarios) / max(batch_s, 1e-9), 1
+            ),
+        },
+        "audit": audit,
+        "scenarios": rows,
+    }
+    if args.out:
+        from shockwave_tpu.utils.fileio import atomic_write_json
+
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        atomic_write_json(args.out, report)
+        print(f"wrote {args.out}")
+    t = report["timing"]
+    print(
+        f"batch {t['batch_solve_s']}s for {t['scenarios']} scenarios "
+        f"({t['scenarios_per_s']}/s) = {t['x_vs_single_solve']}x one "
+        f"standalone solve ({t['single_solve_s']}s); audit "
+        f"{audit['audited']} lanes, bit_identical={audit['bit_identical']}"
+    )
+    best = max(rows[1:], key=lambda r: r["nash_welfare_delta"], default=None)
+    if best is not None:
+        print(
+            f"best scenario {best['name']}: welfare "
+            f"{best['nash_welfare_delta']:+.4f}, makespan "
+            f"{best['makespan_delta_s']:+.0f}s"
+        )
+    return 0 if audit["bit_identical"] else 1
+
+
+def cmd_price(args) -> int:
+    from shockwave_tpu.core.job import Job
+    from shockwave_tpu.whatif import AdmissionPricer
+
+    problem, keys, s0, rnd, source = _load_base(args)
+    burst = [
+        Job(
+            job_type=args.burst_job_type,
+            command="whatif-burst",
+            total_steps=1000,
+            scale_factor=int(args.burst_scale),
+            mode="static",
+            priority_weight=float(args.burst_priority),
+            duration=float(args.burst_duration)
+            if args.burst_duration
+            else None,
+            tenant=args.tenant,
+        )
+        for _ in range(args.burst_jobs)
+    ]
+    # Offline pricing against a recorded state: the provider hands the
+    # pricer the already-built market (no per-query planner restore).
+    state_holder = {"problem": problem, "keys": keys, "s0": s0}
+    pricer = AdmissionPricer(
+        state_provider=lambda: state_holder,
+        threshold=args.threshold,
+        budget_s=args.budget_s,
+    )
+    # Warm the 2-lane kernel outside the reported decision: the
+    # operator's offline query prices the admission, not this
+    # process's XLA compile.
+    pricer.price(burst)
+    decision = pricer.price(burst)
+    # Quota-only comparison: the existing path admits any batch whose
+    # tenant is under quota — for a fresh tenant, always.
+    quota_only = (
+        "reject"
+        if args.tenant_quota is not None
+        and len(burst) > args.tenant_quota
+        else "accept"
+    )
+    report = {
+        "source": source,
+        "round": rnd,
+        "base": {
+            "jobs": problem.num_jobs,
+            "num_gpus": float(problem.num_gpus),
+        },
+        "burst": {
+            "jobs": args.burst_jobs,
+            "scale_factor": args.burst_scale,
+            "duration_s": args.burst_duration,
+            "priority_weight": args.burst_priority,
+            "tenant": args.tenant,
+        },
+        "threshold": args.threshold,
+        "quota_only_decision": quota_only,
+        "priced_decision": decision.as_record(),
+        "improved": (
+            decision.action in ("accept", "reject")
+            and decision.action != quota_only
+        ),
+    }
+    if args.out:
+        from shockwave_tpu.utils.fileio import atomic_write_json
+
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        atomic_write_json(args.out, report)
+        print(f"wrote {args.out}")
+    print(json.dumps(report["priced_decision"]))
+    print(
+        f"quota-only would {quota_only}; marginal price says "
+        f"{decision.action} ({decision.reason})"
+    )
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    def add_source(p):
+        p.add_argument(
+            "--log", default=None,
+            help="flight-recorder decision log to seed from",
+        )
+        p.add_argument(
+            "--state", default=None,
+            help="export-state artifact to seed from (instead of --log)",
+        )
+        p.add_argument(
+            "--round", type=int, default=None,
+            help="planning round (default: last recorded plan)",
+        )
+        p.add_argument("--out", default=None, help="JSON report path")
+
+    p_sweep = sub.add_parser(
+        "sweep", help="batched capacity-planning scenario sweep"
+    )
+    add_source(p_sweep)
+    p_sweep.add_argument(
+        "--capacity", default=None,
+        help="comma list of fleet sizes (chips) to sweep",
+    )
+    p_sweep.add_argument(
+        "--priority-scale", default=None,
+        help="comma list of demand-weight scales",
+    )
+    p_sweep.add_argument(
+        "--switch-scale", default=None,
+        help="comma list of switch-cost scales",
+    )
+    p_sweep.add_argument(
+        "--round-s", default=None,
+        help="comma list of round lengths (seconds)",
+    )
+    p_sweep.add_argument(
+        "--audit-lanes", type=int, default=-1,
+        help="lanes to bit-audit against standalone solves "
+        "(-1 = every scenario)",
+    )
+
+    p_price = sub.add_parser(
+        "price", help="marginal-price one hypothetical admission burst"
+    )
+    add_source(p_price)
+    p_price.add_argument("--burst-jobs", type=int, default=4)
+    p_price.add_argument("--burst-scale", type=int, default=1)
+    p_price.add_argument(
+        "--burst-duration", type=float, default=None,
+        help="per-job demand seconds (default: the full planning window)",
+    )
+    p_price.add_argument("--burst-priority", type=float, default=1.0)
+    p_price.add_argument(
+        "--burst-job-type", default="ResNet-18 (batch size 32)"
+    )
+    p_price.add_argument("--tenant", default="whatif")
+    p_price.add_argument(
+        "--tenant-quota", type=int, default=None,
+        help="pending-job quota the quota-only comparison applies "
+        "(default: none, i.e. quota-only accepts)",
+    )
+    p_price.add_argument(
+        "--threshold", type=float, default=1e-3,
+        help="max incumbent welfare loss before rejection (default: "
+        "the solver-noise floor)",
+    )
+    p_price.add_argument("--budget-s", dest="budget_s", type=float,
+                         default=60.0)
+
+    args = parser.parse_args(argv)
+    if not args.log and not args.state:
+        parser.error("one of --log / --state is required")
+    if args.cmd == "sweep":
+        return cmd_sweep(args)
+    return cmd_price(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

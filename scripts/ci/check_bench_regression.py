@@ -14,6 +14,7 @@ Tracked series (direction-aware):
   delta_replan_warm_s    delta-patched incremental replan lower is better
   effective_overhead_pct pipelined/serial exposed plan %  lower is better
   speculation_hit_rate   no-churn reconcile hit rate      higher is better
+  whatif_scenarios_per_s batched counterfactual solves/s  higher is better
 
 The pipelining pair comes from bench.py's pipelining_phase() (a small
 serial-vs-pipelined sim A/B); records predating PR 11 lack them and
@@ -53,6 +54,7 @@ TRACKED = {
     "delta_replan_warm_s": True,
     "effective_overhead_pct": True,
     "speculation_hit_rate": False,
+    "whatif_scenarios_per_s": False,
 }
 
 # Absolute values below which a series is "as good as zero": a

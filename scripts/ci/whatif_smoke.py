@@ -1,0 +1,295 @@
+#!/usr/bin/env python3
+"""CI gate: what-if fleet smoke (reduced-scale acceptance).
+
+Asserts the scenario-batched counterfactual solver's contract on the
+committed flight-recorder fixture, small enough for every CI run:
+
+  * **Lane parity** — every lane of a mixed 16-scenario grid
+    (capacity / weight / switch-cost / round-length overlays) is
+    bit-identical to the standalone solve of that scenario;
+  * **Throughput floor** — a 64-scenario chunked batch completes in
+    under HALF the wall clock of solving the same 64 scenarios
+    standalone one by one (the full-scale acceptance artifact,
+    results/whatif/, measures the 1024-scenario fleet end to end);
+  * **Pricing decisions** — the marginal-price admission pricer
+    accepts under an infinite threshold, rejects the committed
+    fixture's oversized burst at threshold 0, and a zero budget forces
+    the quota-only fallback;
+  * **Fallback keeps streaming green** — a small streaming-admission
+    sim with pricing enabled and a zero budget (every batch falls
+    back) still admits every submission exactly once.
+
+Regenerates ``results/whatif/whatif_smoke.json``; exits 1 on any
+violated invariant. Wired into the verify skill next to
+``cells_smoke.py`` / ``churn_smoke.py``.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+LOG = os.path.join(REPO, "results", "flight_recorder", "decisions.jsonl")
+# Batched must beat sequential-standalone by at least 2x on the same
+# 64 scenarios (measured ~6x on the 2-core reference host; the margin
+# absorbs CI scheduler noise without letting the amortization rot).
+AMORTIZATION_BAR_X = 2.0
+
+
+def parity_and_throughput(failures):
+    import numpy as np
+
+    from shockwave_tpu.whatif import (
+        Scenario,
+        ScenarioBatch,
+        audit_lanes,
+        base_problem_from_log,
+        solve_scenario,
+        solve_scenarios,
+    )
+
+    problem, _keys, s0, rnd = base_problem_from_log(LOG)
+    rng = np.random.default_rng(0)
+    grid = [Scenario(name="baseline")]
+    for i in range(15):
+        mask = None
+        if i % 5 == 4 and problem.num_jobs > 1:
+            mask = (rng.random(problem.num_jobs) < 0.7).astype(float)
+            mask[0] = 1.0
+        grid.append(
+            Scenario(
+                name=f"s{i}",
+                num_gpus=float(1 + (i % 8)),
+                priority_scale=0.5 + (i % 4) * 0.5,
+                switch_cost_scale=float(i % 3),
+                round_duration=30.0 * (1 + i % 4),
+                job_mask=mask,
+            )
+        )
+    batch = ScenarioBatch(problem, grid, s0=s0)
+    s_list, objs, diags = solve_scenarios(batch)
+    audit = audit_lanes(batch, s_list)
+    if not audit["bit_identical"]:
+        failures.append(
+            f"lane parity: lanes {audit['mismatched']} diverged from "
+            "their standalone solves"
+        )
+    if not all(d["converged"] for d in diags):
+        failures.append("a smoke-grid scenario solve did not converge")
+
+    wide = ScenarioBatch(
+        problem,
+        [Scenario(name="baseline")]
+        + [
+            Scenario(name=f"w{i}", num_gpus=float(1 + i % 16))
+            for i in range(63)
+        ],
+        s0=s0,
+    )
+    solve_scenarios(wide)  # compile
+    t0 = time.monotonic()
+    solve_scenarios(wide)
+    batch_s = time.monotonic() - t0
+    solve_scenario(wide, 0)  # compile the standalone reference
+    t0 = time.monotonic()
+    for i in range(64):
+        solve_scenario(wide, i)
+    sequential_s = time.monotonic() - t0
+    amortization = sequential_s / max(batch_s, 1e-9)
+    if amortization < AMORTIZATION_BAR_X:
+        failures.append(
+            f"throughput floor: batched 64 scenarios only "
+            f"{amortization:.2f}x faster than sequential standalone "
+            f"solves (bar {AMORTIZATION_BAR_X}x)"
+        )
+    return {
+        "round": rnd,
+        "jobs": problem.num_jobs,
+        "grid_scenarios": len(grid),
+        "audit": audit,
+        "throughput": {
+            "scenarios": 64,
+            "batch_solve_s": round(batch_s, 4),
+            "sequential_standalone_s": round(sequential_s, 4),
+            "amortization_x": round(amortization, 2),
+            "bar_x": AMORTIZATION_BAR_X,
+        },
+    }
+
+
+def pricing_decisions(failures):
+    from shockwave_tpu.core.job import Job
+    from shockwave_tpu.obs.recorder import extract_state
+    from shockwave_tpu.whatif import AdmissionPricer
+
+    state = extract_state(LOG)["planner_state"]
+    burst = [
+        Job(
+            job_type="ResNet-18 (batch size 32)",
+            command="smoke",
+            total_steps=100,
+            scale_factor=2,
+            mode="static",
+            duration=4000.0,
+            tenant="smoke",
+        )
+        for _ in range(4)
+    ]
+    lenient = AdmissionPricer(
+        lambda: state, threshold=float("inf"), budget_s=60.0
+    ).price(burst)
+    strict = AdmissionPricer(
+        lambda: state, threshold=0.0, budget_s=60.0
+    ).price(burst)
+    broke = AdmissionPricer(
+        lambda: state, threshold=0.0, budget_s=0.0
+    ).price(burst)
+    if lenient.action != "accept":
+        failures.append(
+            f"pricing: infinite threshold must accept, got "
+            f"{lenient.action} ({lenient.reason})"
+        )
+    if strict.action != "reject":
+        failures.append(
+            f"pricing: the fixture burst must reject at threshold 0, "
+            f"got {strict.action} ({strict.reason})"
+        )
+    if broke.action != "fallback" or broke.reason != "budget_exceeded":
+        failures.append(
+            f"pricing: zero budget must fall back, got {broke.action} "
+            f"({broke.reason})"
+        )
+    return {
+        "lenient": lenient.as_record(),
+        "strict": strict.as_record(),
+        "budget_zero": broke.as_record(),
+    }
+
+
+def fallback_keeps_streaming_green(failures):
+    """Pricing with a zero budget (every batch abstains) must leave the
+    streaming front door's exactly-once contract untouched."""
+    from shockwave_tpu import obs
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.data.profiles import synthesize_profiles
+    from shockwave_tpu.data.workload_info import steps_per_epoch
+    from shockwave_tpu.core.job import Job
+    from shockwave_tpu.policies import get_policy
+    from shockwave_tpu.runtime.admission import StreamingSubmitter
+    from shockwave_tpu.whatif import AdmissionPricer
+
+    obs.reset()
+    num_jobs = 12
+    oracle = generate_oracle()
+    jobs = [
+        Job(
+            job_type="ResNet-18 (batch size 32)",
+            command="python3 main.py",
+            total_steps=steps_per_epoch("ResNet-18", 32),
+            scale_factor=1,
+            mode="static",
+            tenant=f"t{i % 2}",
+        )
+        for i in range(num_jobs)
+    ]
+    arrivals = [120.0 * i for i in range(num_jobs)]
+    profiles = synthesize_profiles(jobs, oracle)
+    sched = Scheduler(
+        get_policy("shockwave_tpu_pdhg"),
+        throughputs=oracle,
+        seed=0,
+        time_per_iteration=120,
+        profiles=profiles,
+        shockwave_config={
+            "num_gpus": 4,
+            "time_per_iteration": 120,
+            "future_rounds": 6,
+            "lambda": 2.0,
+            "k": 1e-3,
+        },
+    )
+    pricer = AdmissionPricer(
+        state_provider=lambda: (
+            sched._shockwave.state_dict()
+            if sched._shockwave is not None and sched._shockwave.num_jobs
+            else None
+        ),
+        threshold=0.0,
+        budget_s=0.0,  # every priced batch overruns -> fallback
+    )
+    submitter = StreamingSubmitter(arrivals, jobs, batch_size=3)
+    sched.simulate(
+        {"v100": 4}, submitter=submitter, admission_pricer=pricer
+    )
+    summary = sched._admission.summary()
+    completed = sum(
+        1 for t in sched._job_completion_times.values() if t is not None
+    )
+    if summary["accepted_jobs"] != num_jobs:
+        failures.append(
+            f"fallback stream: {summary['accepted_jobs']} of "
+            f"{num_jobs} jobs accepted"
+        )
+    if summary["admitted_jobs"] != num_jobs or completed != num_jobs:
+        failures.append(
+            f"fallback stream: admitted {summary['admitted_jobs']}, "
+            f"completed {completed}, expected {num_jobs} exactly once"
+        )
+    if summary["priced_rejects"] != 0:
+        failures.append(
+            "fallback stream: a zero-budget pricer rejected a batch"
+        )
+    if summary["priced_fallbacks"] == 0:
+        failures.append(
+            "fallback stream: pricing never engaged (no fallbacks "
+            "counted) — the gate is vacuous"
+        )
+    return {
+        "jobs": num_jobs,
+        "completed": completed,
+        "admission": {
+            k: summary[k]
+            for k in (
+                "accepted_jobs", "admitted_jobs", "priced_rejects",
+                "priced_fallbacks", "deduped_batches",
+            )
+        },
+    }
+
+
+def run() -> int:
+    from shockwave_tpu.utils.fileio import atomic_write_json
+
+    failures = []
+    t0 = time.time()
+    report = {
+        "parity": parity_and_throughput(failures),
+        "pricing": pricing_decisions(failures),
+        "streaming_fallback": fallback_keeps_streaming_green(failures),
+    }
+    report["elapsed_s"] = round(time.time() - t0, 1)
+    report["failures"] = failures
+    report["ok"] = not failures
+    out = os.path.join(REPO, "results", "whatif", "whatif_smoke.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    atomic_write_json(out, report)
+    print(f"wrote {out} ({report['elapsed_s']}s)")
+    for failure in failures:
+        print(f"FAIL: {failure}")
+    if not failures:
+        print("whatif smoke: all invariants hold")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(run())

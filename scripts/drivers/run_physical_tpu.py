@@ -188,10 +188,14 @@ def main(argv=None):
     )
     parser.add_argument(
         "--speculate",
-        action="store_true",
+        action=argparse.BooleanOptionalAction,
+        default=True,
         help="plan-ahead pipelining: solve round r+1 speculatively on a "
         "background thread while round r executes, reconciling at the "
-        "boundary (shockwave policies only; see docs/USAGE.md)",
+        "boundary (shockwave policies only; see docs/USAGE.md). ON by "
+        "default since the 30 s-round soak "
+        "(results/pipelining/soak30/); --no-speculate is the serial "
+        "escape hatch",
     )
     parser.add_argument(
         "--speculate_epoch_tolerance",

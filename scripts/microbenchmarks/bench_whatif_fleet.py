@@ -1,0 +1,208 @@
+#!/usr/bin/env python3
+"""The what-if fleet acceptance artifact: >=1000 counterfactual solves
+from a real flight-recorder state, every lane audited bit-identical,
+with every honest comparator measured on this host.
+
+Four regimes of the same 1024-scenario capacity-planning grid (fleet
+sizes x demand weights x switch-cost knobs) over one recorded round of
+``results/flight_recorder/decisions.jsonl``:
+
+  * ``batch`` — the production path: auto-chunked lane-banded vmapped
+    dispatch (cache-resident chunks, per-chunk early stop);
+  * ``monolithic`` — the same 1024 lanes in ONE dispatch (what the
+    chunking optimization buys on a bandwidth-bound CPU host);
+  * ``sequential`` — 1024 standalone single-scenario solves (what the
+    batch must amortize);
+  * ``end_to_end`` — fresh-process wall clock of the whatif CLI
+    answering ONE what-if vs answering the full 1024-scenario fleet:
+    the operator-facing bar (<10x), because a cold analysis process
+    pays one kernel compile either way and the fleet rides it
+    (amortize-the-compile, the Large-Scale Regularized Matching shape
+    PAPERS.md names).
+
+Writes ``results/whatif/fleet_1024.json``; exits 1 if any audited
+lane diverges from its standalone solve or the end-to-end fleet costs
+>= 10x the end-to-end single what-if.
+
+Usage:
+  JAX_PLATFORMS=cpu python scripts/microbenchmarks/bench_whatif_fleet.py \
+      [--round 91] [--out results/whatif/fleet_1024.json]
+"""
+
+import argparse
+import itertools
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+sys.path.insert(0, REPO)
+
+LOG = os.path.join(REPO, "results", "flight_recorder", "decisions.jsonl")
+
+CAPACITIES = (
+    "1,2,3,4,5,6,7,8,10,12,14,16,20,24,28,32,40,48,56,64,80,96,112,"
+    "128,160,192,224,256,320,384,448"
+)
+PRIORITY_SCALES = "0.25,0.5,0.75,1,1.25,1.5,2,2.5,3,4,5"
+SWITCH_SCALES = "0,1,2"
+
+
+def build_grid(problem):
+    from shockwave_tpu.whatif import Scenario
+
+    caps = [float(x) for x in CAPACITIES.split(",")]
+    pscales = [float(x) for x in PRIORITY_SCALES.split(",")]
+    sscales = [float(x) for x in SWITCH_SCALES.split(",")]
+    return [Scenario(name="baseline")] + [
+        Scenario(
+            name=f"g{c:g}_p{p:g}_s{s:g}",
+            num_gpus=c,
+            priority_scale=p,
+            switch_cost_scale=s,
+            tags={"capacity": c, "priority_scale": p, "switch_scale": s},
+        )
+        for c, p, s in itertools.product(caps, pscales, sscales)
+    ]
+
+
+def timed_process(extra_args):
+    """Fresh-process CLI wall clock (cold kernels by construction)."""
+    cli = os.path.join(REPO, "scripts", "analysis", "whatif.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.monotonic()
+    subprocess.run(
+        [sys.executable, cli, "sweep", "--log", LOG, "--audit-lanes", "0"]
+        + extra_args,
+        check=True,
+        cwd=REPO,
+        env=env,
+        stdout=subprocess.DEVNULL,
+    )
+    return time.monotonic() - t0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--round", type=int, default=91)
+    parser.add_argument(
+        "--out",
+        default=os.path.join(REPO, "results", "whatif", "fleet_1024.json"),
+    )
+    args = parser.parse_args(argv)
+
+    from shockwave_tpu.utils.fileio import atomic_write_json
+    from shockwave_tpu.whatif import (
+        ScenarioBatch,
+        audit_lanes,
+        base_problem_from_log,
+        scenario_report,
+        solve_scenario,
+        solve_scenarios,
+    )
+
+    problem, _keys, s0, rnd = base_problem_from_log(
+        LOG, round_index=args.round
+    )
+    grid = build_grid(problem)
+    batch = ScenarioBatch(problem, grid, s0=s0)
+    print(
+        f"round {rnd}: {problem.num_jobs} jobs x {len(grid)} scenarios "
+        f"({batch.lanes} lanes, {batch.slots} slots)"
+    )
+
+    solve_scenarios(batch)  # compile
+    t0 = time.monotonic()
+    s_list, objs, diags = solve_scenarios(batch)
+    batch_s = time.monotonic() - t0
+
+    t0 = time.monotonic()
+    solve_scenarios(batch, chunk_lanes=0)
+    monolithic_s = time.monotonic() - t0
+
+    solve_scenario(batch, 0)  # compile the standalone reference
+    singles = []
+    for _ in range(5):
+        t0 = time.monotonic()
+        solve_scenario(batch, 0)
+        singles.append(time.monotonic() - t0)
+    single_s = statistics.median(singles)
+
+    print("auditing every lane against its standalone solve ...")
+    t0 = time.monotonic()
+    audit = audit_lanes(batch, s_list)
+    sequential_s = time.monotonic() - t0  # the audit IS the sequential run
+    print(
+        f"batch {batch_s:.3f}s | monolithic {monolithic_s:.3f}s | "
+        f"sequential {sequential_s:.3f}s | single {single_s * 1e3:.1f}ms "
+        f"| audit {audit['audited']} lanes "
+        f"bit_identical={audit['bit_identical']}"
+    )
+
+    print("end-to-end fresh-process CLI runs (cold kernels) ...")
+    e2e_single_s = timed_process(["--capacity", "2"])
+    e2e_fleet_s = timed_process(
+        [
+            "--capacity", CAPACITIES,
+            "--priority-scale", PRIORITY_SCALES,
+            "--switch-scale", SWITCH_SCALES,
+        ]
+    )
+    e2e_ratio = e2e_fleet_s / max(e2e_single_s, 1e-9)
+    print(
+        f"end-to-end: 1 what-if {e2e_single_s:.2f}s, "
+        f"{len(grid)} what-ifs {e2e_fleet_s:.2f}s -> {e2e_ratio:.2f}x"
+    )
+
+    rows = scenario_report(problem, grid, s_list, objs, diags)
+    report = {
+        "source": LOG,
+        "round": rnd,
+        "base": {
+            "jobs": problem.num_jobs,
+            "num_gpus": float(problem.num_gpus),
+            "round_duration_s": float(problem.round_duration),
+            "future_rounds": int(problem.future_rounds),
+        },
+        "scenarios": len(grid),
+        "lanes": batch.lanes,
+        "slots": batch.slots,
+        "timing": {
+            "batch_chunked_s": round(batch_s, 4),
+            "batch_monolithic_s": round(monolithic_s, 4),
+            "sequential_standalone_s": round(sequential_s, 4),
+            "single_solve_warm_s": round(single_s, 5),
+            "scenarios_per_s": round(len(grid) / batch_s, 1),
+            "chunked_vs_monolithic_x": round(monolithic_s / batch_s, 2),
+            "batch_vs_sequential_x": round(sequential_s / batch_s, 2),
+            "batch_vs_warm_single_x": round(batch_s / single_s, 1),
+        },
+        "end_to_end": {
+            "what": "fresh-process whatif CLI wall clock (cold "
+            "kernels): one what-if vs the full fleet",
+            "single_whatif_s": round(e2e_single_s, 2),
+            "fleet_s": round(e2e_fleet_s, 2),
+            "fleet_vs_single_x": round(e2e_ratio, 2),
+            "bar_x": 10.0,
+        },
+        "audit": audit,
+        "max_cycles_observed": max(d["cycles"] for d in diags),
+        "report_rows": rows,
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    atomic_write_json(args.out, report)
+    print(f"wrote {args.out}")
+    ok = audit["bit_identical"] and e2e_ratio < 10.0
+    if not audit["bit_identical"]:
+        print(f"FAIL: lanes {audit['mismatched']} diverged")
+    if e2e_ratio >= 10.0:
+        print(f"FAIL: end-to-end fleet {e2e_ratio:.2f}x >= 10x")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

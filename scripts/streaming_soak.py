@@ -98,11 +98,32 @@ def run_stream(args, arrivals, jobs, profiles, oracle, decision_log=None):
         if args.policy.startswith("shockwave")
         else None,
     )
+    pricer = None
+    if getattr(args, "price_admission", False) and args.policy.startswith(
+        "shockwave"
+    ):
+        from shockwave_tpu.whatif import AdmissionPricer
+
+        # Snapshot the live planner at decision time; in sim the
+        # submitter pumps on the round-loop thread, so state_dict()
+        # never races a replan. Before the first plan there is no
+        # planner — the pricer abstains (quota-only fallback).
+        pricer = AdmissionPricer(
+            state_provider=lambda: (
+                sched._shockwave.state_dict()
+                if sched._shockwave is not None
+                and sched._shockwave.num_jobs
+                else None
+            ),
+            threshold=args.price_threshold,
+            budget_s=args.price_budget_s,
+        )
     makespan = sched.simulate(
         {"v100": args.num_gpus},
         submitter=submitter,
         admission_capacity=args.admission_capacity,
         admission_retry_s=args.round_s / 2.0,
+        admission_pricer=pricer,
     )
     ftf_list, unfair = sched.get_finish_time_fairness()
     completed = sum(
@@ -339,6 +360,35 @@ def build_parser():
     )
     parser.add_argument("--batch_size", type=int, default=4)
     parser.add_argument("--admission_capacity", type=int, default=16)
+    parser.add_argument(
+        "--price-admission",
+        "--price_admission",
+        dest="price_admission",
+        action="store_true",
+        help="marginal-price admission: price each fresh batch's "
+        "Nash-welfare externality with a 2-scenario what-if solve "
+        "(shockwave policies only); any pricing failure or blown "
+        "budget falls back to the quota-only path",
+    )
+    parser.add_argument(
+        "--price_threshold",
+        "--price-threshold",
+        dest="price_threshold",
+        type=float,
+        default=1e-3,
+        help="max incumbent Nash-welfare loss a burst may impose "
+        "before it is rejected (default: the solver-noise floor; "
+        "see docs/USAGE.md)",
+    )
+    parser.add_argument(
+        "--price_budget_s",
+        "--price-budget-s",
+        dest="price_budget_s",
+        type=float,
+        default=0.25,
+        help="wall-clock budget for one pricing solve; overruns "
+        "abstain to the quota-only path",
+    )
     parser.add_argument("--round_s", type=float, default=120.0)
     parser.add_argument("--future_rounds", type=int, default=8)
     parser.add_argument("--plan_deadline_s", type=float, default=30.0)

@@ -19,6 +19,8 @@ Layout (bottom-up):
   solver/     the JAX Eisenberg-Gale solver + integer rounding/packing
   policies/   allocation-policy library (name -> policy registry)
   runtime/    physical-cluster control plane (RPC, workers, leases)
+  whatif/     scenario-batched counterfactual solves (capacity planning,
+              marginal-price admission)
   models/     JAX/Flax example workload models (the payloads)
   ops/        low-level JAX/Pallas kernels used by the solver
   parallel/   device-mesh / sharding helpers for multi-chip solves

@@ -27,6 +27,11 @@ from shockwave_tpu.analysis.core import FileContext, Finding, Rule, dotted_name
 _BACKEND_GLOBS = (
     "shockwave_tpu/solver/eg_*.py",
     "shockwave_tpu/native/__init__.py",
+    # The what-if fleet solves the same EG objective in batch: a
+    # scenario kernel that silently dropped the switching-cost term
+    # would price counterfactuals against a different market than the
+    # planner runs.
+    "shockwave_tpu/whatif/*.py",
 )
 _NON_BACKEND_FILES = {"shockwave_tpu/solver/eg_problem.py"}
 _PLANNER_FILE = "shockwave_tpu/policies/shockwave.py"

@@ -5,8 +5,9 @@ A single ``.item()`` / ``float()`` / ``np.asarray`` /
 ``lax.scan`` body forces a device->host transfer every iteration,
 serializing the dispatch pipeline that makes JAX fast (and inside a
 traced scan body it is an outright tracer leak). Scoped to the code
-that owns hot loops: ``models/``, ``parallel/``, and the solver's JAX
-hot path ``solver/eg_jax.py``.
+that owns hot loops: ``models/``, ``parallel/``, the what-if fleet's batched solve path
+``whatif/``, and the solver's JAX hot paths ``solver/eg_jax.py`` /
+``solver/eg_pdhg.py``.
 """
 
 from __future__ import annotations
@@ -28,6 +29,10 @@ from shockwave_tpu.analysis.rules.donation import collect_donated_callables
 _SCOPE_PREFIXES = (
     "shockwave_tpu/models/",
     "shockwave_tpu/parallel/",
+    # The what-if fleet's batched counterfactual path: a host sync
+    # inside its vmapped solve would serialize a thousand lanes at
+    # once.
+    "shockwave_tpu/whatif/",
 )
 _SCOPE_FILES = (
     "shockwave_tpu/solver/eg_jax.py",

@@ -1921,6 +1921,7 @@ class Scheduler:
         submitter=None,
         admission_capacity: Optional[int] = None,
         admission_retry_s: Optional[float] = None,
+        admission_pricer=None,
     ) -> float:
         """Trace-driven simulation; returns the makespan
         (reference: scheduler.py:1365-1796, from_trace path).
@@ -1983,6 +1984,11 @@ class Scheduler:
                 ),
                 clock=lambda: self._current_timestamp,
                 shards=getattr(self._shockwave, "num_cells", 1) or 1,
+                # Marginal-price admission (whatif 2-scenario solve):
+                # optional, and safe here by construction — in sim the
+                # submitter pumps on the round-loop thread, so the
+                # pricer's planner-state snapshot never races a replan.
+                pricer=admission_pricer,
             )
         else:
             assert arrival_times is not None and jobs is not None

@@ -28,6 +28,12 @@ CLI::
     python -m shockwave_tpu.obs.recorder summary results/run/decisions.jsonl
     python -m shockwave_tpu.obs.recorder replay  results/run/decisions.jsonl
     python -m shockwave_tpu.obs.recorder replay  results/run/decisions.jsonl --round 12
+    python -m shockwave_tpu.obs.recorder export-state results/run/decisions.jsonl --round 12
+
+``export-state`` writes one round's restorable planner state (the same
+reconstruction replay runs, as a standalone artifact) — the input the
+what-if fleet (:mod:`shockwave_tpu.whatif`) perturbs into
+counterfactual scenarios.
 
 Disabled by default (``FlightRecorder.enabled`` is False) behind the
 same null-object contract as the rest of :mod:`shockwave_tpu.obs`:
@@ -660,6 +666,28 @@ def _resolve_recorded_state(
     return state
 
 
+def resolve_plan_state(
+    record: dict,
+    profiles: Optional[dict] = None,
+    schedules: Optional[dict] = None,
+) -> dict:
+    """The restorable planner state inside one (decoded) plan record:
+    every flat state resolved against the ``job_profile`` records and
+    accumulated throughput schedules. The result round-trips through
+    :func:`shockwave_tpu.policies.shockwave.planner_from_state` — the
+    shared head of :func:`replay_plan_record` and the ``export-state``
+    artifact the what-if fleet consumes."""
+    state = dict(record["planner_state"])
+    if "children" in state:
+        state["children"] = OrderedDict(
+            (name, _resolve_recorded_state(child_state, profiles, schedules))
+            for name, child_state in state["children"].items()
+        )
+    else:
+        state = _resolve_recorded_state(state, profiles, schedules)
+    return state
+
+
 def replay_plan_record(
     record: dict,
     profiles: Optional[dict] = None,
@@ -678,14 +706,7 @@ def replay_plan_record(
     """
     from shockwave_tpu.policies.shockwave import planner_from_state
 
-    state = dict(record["planner_state"])
-    if "children" in state:
-        state["children"] = OrderedDict(
-            (name, _resolve_recorded_state(child_state, profiles, schedules))
-            for name, child_state in state["children"].items()
-        )
-    else:
-        state = _resolve_recorded_state(state, profiles, schedules)
+    state = resolve_plan_state(record, profiles, schedules)
     # Replay is offline math, not a timing re-enactment: disable the
     # degradation ladder's deadline so a slow replay host cannot fall
     # down a different rung than the recorded solve. The snapshot's
@@ -731,6 +752,26 @@ def replay_log(path: str, round_index: Optional[int] = None) -> List[dict]:
     record's replay alone and the shared accumulation continues from
     the measured history."""
     results = []
+    for record, profiles, record_schedules in _scan_plan_records(path):
+        if round_index is not None and record.get("round") != round_index:
+            continue
+        results.append(
+            replay_plan_record(
+                record, profiles=profiles, schedules=record_schedules
+            )
+        )
+    return results
+
+
+def _scan_plan_records(path: str):
+    """The ONE scan discipline replay and state extraction share:
+    yield ``(decoded plan record, profiles-so-far,
+    schedules-for-this-record)`` in file order, with ``job_profile``
+    records and the delta-encoded throughput tails accumulated exactly
+    as replay requires — speculative records rebuild against a
+    throwaway base and never advance the shared accumulation. Any
+    change to the log protocol lands here once, keeping export-state
+    artifacts provably in lockstep with what replay reconstructs."""
     profiles: dict = {}
     schedules: dict = {}
     for record in iter_records(path):
@@ -742,19 +783,103 @@ def replay_log(path: str, round_index: Optional[int] = None) -> List[dict]:
             continue
         record = dict(record)
         record["planner_state"] = decode(record["planner_state"])
-        if record.get("speculative"):
-            record_schedules: dict = {}
-        else:
-            record_schedules = schedules
-        accumulate_schedules(record, record_schedules)
-        if round_index is not None and record.get("round") != round_index:
-            continue
-        results.append(
-            replay_plan_record(
-                record, profiles=profiles, schedules=record_schedules
-            )
+        record_schedules: dict = (
+            {} if record.get("speculative") else schedules
         )
-    return results
+        accumulate_schedules(record, record_schedules)
+        yield record, profiles, record_schedules
+
+
+def extract_state(path: str, round_index: Optional[int] = None) -> dict:
+    """The restorable planner state of one recorded planning round
+    (the LAST committed plan when ``round_index`` is None).
+    Speculative plan records are skipped: they snapshot a predicted
+    clone, not a committed planning round. Returns ``{"round",
+    "backend", "objective", "planner_state"}`` where ``planner_state``
+    restores through
+    :func:`shockwave_tpu.policies.shockwave.planner_from_state`.
+    """
+    if round_index is None:
+        # Cheap pre-pass for the default: resolving EVERY record just
+        # to keep the final one would be O(rounds^2 x jobs) on long
+        # logs.
+        for record in iter_records(path):
+            if record.get("event") == "plan" and not record.get(
+                "speculative"
+            ):
+                round_index = record.get("round")
+        if round_index is None:
+            raise ValueError(f"{path}: no committed plan records")
+    found: Optional[dict] = None
+    rounds_seen: List[int] = []
+    for record, profiles, record_schedules in _scan_plan_records(path):
+        if record.get("speculative"):
+            continue
+        r = record.get("round")
+        rounds_seen.append(r)
+        if r != round_index:
+            continue
+        # Resolve at match time: the state must see exactly the
+        # schedules accumulated up to its own record, and
+        # _resolve_recorded_state deep-copies what it takes.
+        found = {
+            "round": r,
+            "backend": record.get("backend"),
+            "objective": record.get("objective"),
+            "planner_state": resolve_plan_state(
+                record, profiles, record_schedules
+            ),
+        }
+    if found is None:
+        raise ValueError(
+            f"{path}: no plan record for round {round_index!r} "
+            f"(recorded rounds: {rounds_seen})"
+        )
+    return found
+
+
+def export_state(
+    path: str, out: Optional[str] = None,
+    round_index: Optional[int] = None,
+) -> dict:
+    """Write one round's restorable planner state as a standalone JSON
+    artifact (the ``export-state`` CLI subcommand): the envelope the
+    what-if CLI consumes without re-scanning the whole decision log.
+    ``out`` defaults to ``<log>.state-r<round>.json``. Returns the
+    extraction (state still decoded) with the written path under
+    ``"out"`` — one log scan total."""
+    from shockwave_tpu.utils.fileio import atomic_write_json
+
+    extracted = extract_state(path, round_index=round_index)
+    if out is None:
+        out = f"{path}.state-r{extracted['round']}.json"
+    envelope = {
+        "event": "planner_state",
+        "schema": SCHEMA,
+        "source_log": str(path),
+        "round": extracted["round"],
+        "backend": extracted["backend"],
+        "objective": extracted["objective"],
+        "planner_state": encode(extracted["planner_state"]),
+    }
+    atomic_write_json(out, envelope, indent=None)
+    extracted["out"] = out
+    return extracted
+
+
+def load_exported_state(path: str) -> dict:
+    """Read an :func:`export_state` artifact back into a decoded
+    envelope (``planner_state`` restorable via planner_from_state)."""
+    with open(path) as f:
+        envelope = json.load(f)
+    if envelope.get("event") != "planner_state":
+        raise ValueError(
+            f"{path} is not an export-state artifact (event="
+            f"{envelope.get('event')!r}); run `python -m "
+            "shockwave_tpu.obs.recorder export-state <log>` to make one"
+        )
+    envelope["planner_state"] = decode(envelope["planner_state"])
+    return envelope
 
 
 def summarize_log(path: str) -> dict:
@@ -828,10 +953,40 @@ def main(argv=None):
         "--round", type=int, default=None,
         help="replay only this planning round",
     )
+    p_exp = sub.add_parser(
+        "export-state",
+        help="write one round's restorable planner state as a "
+        "standalone artifact (what-if fleet input)",
+    )
+    p_exp.add_argument("log")
+    p_exp.add_argument(
+        "--round", type=int, default=None,
+        help="planning round to extract (default: the last recorded "
+        "plan)",
+    )
+    p_exp.add_argument(
+        "--out", default=None,
+        help="output path (default: <log>.state-r<round>.json)",
+    )
     args = parser.parse_args(argv)
 
     if args.cmd == "summary":
         print(json.dumps(summarize_log(args.log), indent=1))
+        return 0
+
+    if args.cmd == "export-state":
+        extracted = export_state(
+            args.log, out=args.out, round_index=args.round
+        )
+        print(
+            json.dumps(
+                {
+                    "round": extracted["round"],
+                    "backend": extracted["backend"],
+                    "out": extracted["out"],
+                }
+            )
+        )
         return 0
 
     results = replay_log(args.log, round_index=args.round)

@@ -52,6 +52,12 @@ STATUS_CLOSED = "CLOSED"
 # keep batches single-tenant — both in-repo submitters do — and one
 # tenant's quota never sheds another tenant's jobs.
 STATUS_QUOTA = "QUOTA"
+# Hard rejection by the marginal-price admission pricer (whatif
+# 2-scenario solve): admitting the batch would cost the incumbents
+# more Nash welfare than the configured threshold. Same retry
+# semantics as QUOTA — resubmitting the identical batch re-prices the
+# identical externality, so submitters shed instead of retrying.
+STATUS_PRICED = "PRICED"
 
 # Default bound on pending (accepted-but-not-admitted) jobs; the env
 # knob SHOCKWAVE_ADMISSION_QUEUE_CAP overrides it in physical mode.
@@ -200,6 +206,7 @@ class AdmissionQueue:
         tenant_quotas: Optional[dict] = None,
         shard_label: Optional[str] = None,
         tenant_ledger: Optional[_TenantLedger] = None,
+        pricer=None,
     ):
         self.capacity = max(1, int(capacity))
         # Base unit of the queue-depth-derived backpressure delay: a
@@ -218,6 +225,24 @@ class AdmissionQueue:
         self.tenant_quotas = {
             str(t): max(0, int(q)) for t, q in (tenant_quotas or {}).items()
         }
+        # Optional marginal-price admission
+        # (:class:`shockwave_tpu.whatif.AdmissionPricer`): prices a
+        # fresh batch's Nash-welfare externality BEFORE the queue lock
+        # is taken (the 2-scenario solve must never serialize other
+        # submitters), and only ever adds a rejection reason — every
+        # pricer failure/budget-overrun falls back to this queue's
+        # quota-only path unchanged. A priced solve still costs its
+        # own wall clock once (the budget is consulted after the
+        # solve); repeated overruns open the pricer's circuit breaker
+        # so a chronically slow market stops being solved at all.
+        self._pricer = pricer
+        # token -> verdict (STATUS_PRICED or None) for batches already
+        # priced: a backpressure-bounced batch retries the SAME token,
+        # and re-pricing the identical batch would pay the 2-scenario
+        # solve once per retry for the identical answer.
+        self._priced_tokens: "OrderedDict[str, Optional[str]]" = (
+            OrderedDict()
+        )
         self._clock = clock or time.monotonic
         self._lock = sanitize.make_lock(
             "runtime.admission.AdmissionQueue._lock"
@@ -248,6 +273,9 @@ class AdmissionQueue:
             "deduped_batches": 0,
             "closed_rejects": 0,
             "quota_rejects": 0,
+            "priced_rejects": 0,
+            "priced_accepts": 0,
+            "priced_fallbacks": 0,
             "admitted_jobs": 0,
         }
         # Published once so the admission_backlog watchdog rule can
@@ -288,6 +316,10 @@ class AdmissionQueue:
         an empty one) and is idempotent."""
         token = str(token)
         now = self._clock() if now is None else now
+        if self._pricer is not None and jobs:
+            status = self._maybe_price(token, jobs)
+            if status is not None:
+                return status, 0.0, 0
         with self._lock:
             self._opened = True
             if token and token in self._token_jobs:
@@ -377,6 +409,55 @@ class AdmissionQueue:
             if close:
                 self._close_locked()
             return STATUS_ACCEPTED, 0.0, len(jobs)
+
+    def _maybe_price(self, token: str, jobs: Sequence[Job]):
+        """Marginal-price pass for one fresh batch, OUTSIDE the queue
+        lock (a pricing solve must not serialize sibling submitters).
+        Returns :data:`STATUS_PRICED` when the batch is shed, else
+        None — the normal submit path (dedup, quota, backpressure)
+        then decides. A retried token is never re-priced (the ledger
+        already resolved it); two handler threads racing the same
+        FRESH token may both pay the pricing solve, but the ledger
+        still admits exactly one."""
+        with self._lock:
+            self._opened = True
+            if (token and token in self._token_jobs) or self._closed:
+                return None  # dedup / closed-stream semantics own this
+            if token and token in self._priced_tokens:
+                # A backpressure-bounced retry of an already-priced
+                # batch: same token, same batch, same externality —
+                # reuse the verdict instead of re-solving.
+                return self._priced_tokens[token]
+        decision = self._pricer.price(jobs)
+        stat = {
+            "accept": "priced_accepts",
+            "reject": "priced_rejects",
+            "fallback": "priced_fallbacks",
+        }.get(decision.action, "priced_fallbacks")
+        verdict = STATUS_PRICED if decision.action == "reject" else None
+        with self._lock:
+            if token and token in self._priced_tokens:
+                # Two handler threads raced the same fresh token; the
+                # first verdict written wins so both callers see ONE
+                # consistent answer (a split accept/shed response
+                # would desynchronize the client from the ledger).
+                return self._priced_tokens[token]
+            self.stats[stat] += 1
+            if token:
+                self._priced_tokens[token] = verdict
+                while len(self._priced_tokens) > 1024:
+                    self._priced_tokens.popitem(last=False)
+            self._record_event_locked(
+                "priced", token, len(jobs), len(self._pending),
+                **decision.as_record(),
+            )
+        if verdict is not None:
+            obs.counter(
+                "admission_rejected_total",
+                "submissions rejected (backpressure, quota, pricing, "
+                "or closed stream)",
+            ).inc(reason="priced")
+        return verdict
 
     def close(self, token: str = "") -> None:
         """End of stream: no further submissions will be accepted.
@@ -576,6 +657,7 @@ class ShardedAdmissionQueue:
         clock: Optional[Callable[[], float]] = None,
         priority_aware: bool = False,
         tenant_quotas: Optional[dict] = None,
+        pricer=None,
     ):
         self.num_shards = max(1, int(num_shards))
         self.capacity = max(self.num_shards, int(capacity))
@@ -597,6 +679,10 @@ class ShardedAdmissionQueue:
                 tenant_quotas=tenant_quotas,
                 shard_label=f"s{i:02d}",
                 tenant_ledger=ledger,
+                # One pricer for the fleet: the externality is a
+                # fleet-wide quantity, whichever shard a token hashes
+                # to.
+                pricer=pricer,
             )
             for i in range(self.num_shards)
         ]
@@ -807,6 +893,7 @@ def build_queue(
     shards: int = 1,
     priority_aware: Optional[bool] = None,
     tenant_quotas: Optional[dict] = None,
+    pricer=None,
 ):
     """Front-door factory: one queue, or a sharded one when the planner
     is cell-decomposed. Env knobs fill unset policy arguments:
@@ -835,6 +922,7 @@ def build_queue(
             clock=clock,
             priority_aware=priority_aware,
             tenant_quotas=tenant_quotas,
+            pricer=pricer,
         )
     return AdmissionQueue(
         capacity=capacity,
@@ -842,6 +930,7 @@ def build_queue(
         clock=clock,
         priority_aware=priority_aware,
         tenant_quotas=tenant_quotas,
+        pricer=pricer,
     )
 
 
@@ -885,6 +974,7 @@ class StreamingSubmitter:
             "rpc_faults": 0,
             "backpressure_retries": 0,
             "quota_rejects": 0,
+            "priced_rejects": 0,
         }
 
     def exhausted(self) -> bool:
@@ -973,6 +1063,13 @@ class StreamingSubmitter:
                 # pending quota. Retrying the same batch would spin —
                 # the jobs are shed (counted, never silently).
                 self.stats["quota_rejects"] += 1
+                self._inflight = None
+                continue
+            if status == STATUS_PRICED:
+                # Marginal-price rejection: same shed-don't-spin
+                # semantics as QUOTA (re-pricing the identical batch
+                # yields the identical externality).
+                self.stats["priced_rejects"] += 1
                 self._inflight = None
                 continue
             # ACCEPTED (fresh or deduplicated): stamp each job's true

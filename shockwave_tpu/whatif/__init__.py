@@ -1,0 +1,41 @@
+"""The on-chip what-if fleet: batched counterfactual EG solves.
+
+ROADMAP item 4. ``vmap`` the restarted-PDHG market kernel over
+*scenarios* — demand mixes, fleet sizes, policy knobs — in one
+lane-banded dispatch, seeded from live planner state or a committed
+flight-recorder log, with an online 2-scenario variant pricing
+admission bursts by their marginal Nash-welfare impact.
+
+Entry points:
+
+* :class:`Scenario` / :class:`ScenarioBatch` /
+  :func:`solve_scenarios` — the batched counterfactual solver
+  (``scripts/analysis/whatif.py`` is the operator CLI).
+* :func:`solve_scenario` / :func:`audit_lanes` — the standalone
+  reference each lane is bit-identical to, and the audit that proves
+  it.
+* :func:`base_problem_from_state` / :func:`base_problem_from_log` —
+  seeding from ``ShockwavePlanner.state_dict()`` or a decision log.
+* :class:`AdmissionPricer` — the marginal-price admission hook
+  (``runtime/admission.py``; ``--price-admission`` on the streaming
+  drivers).
+"""
+
+from shockwave_tpu.whatif.pricing import (  # noqa: F401
+    AdmissionPricer,
+    PricingDecision,
+    burst_problem,
+)
+from shockwave_tpu.whatif.scenario import (  # noqa: F401
+    Scenario,
+    ScenarioBatch,
+    audit_lanes,
+    scenario_metrics,
+    scenario_report,
+    solve_scenario,
+    solve_scenarios,
+)
+from shockwave_tpu.whatif.seed import (  # noqa: F401
+    base_problem_from_log,
+    base_problem_from_state,
+)

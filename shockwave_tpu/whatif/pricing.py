@@ -1,0 +1,351 @@
+"""Marginal-price admission: a 2-scenario what-if solve per burst.
+
+Tenant quotas answer "how many pending jobs may you hold"; they cannot
+answer "what does admitting this burst *cost the fleet*". The market
+formulation can: solve the live planning problem twice — with and
+without the burst — and the difference in the incumbents' Nash welfare
+IS the burst's externality, the DuaLip-style per-entity price
+(PAPERS.md) of letting it in. Both solves are lanes of one
+:class:`~shockwave_tpu.whatif.scenario.ScenarioBatch` warm-started
+from the live plan, so a pricing decision costs one small batched
+dispatch, not two planner rounds.
+
+The pricer is strictly OPTIONAL and strictly BOUNDED: any failure —
+no planner state yet, a solve error, or the wall-clock budget blown —
+returns a ``fallback`` decision and admission proceeds through the
+existing quota-only path unchanged. Pricing can only ever *add* a
+rejection reason; it can never block, slow past its budget, or change
+the exactly-once token contract (the queue prices a token at most
+once, before it enters the ledger).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from shockwave_tpu import obs
+from shockwave_tpu.solver.eg_jax import _EPS
+from shockwave_tpu.solver.eg_problem import EGProblem
+
+logger = logging.getLogger(__name__)
+
+# Bounded pricing solve: the decision needs the minimax geometry (who
+# must run) and the welfare fill, not a polished residual — 24 cycles
+# matches the polish budget polish_relaxed uses.
+DEFAULT_PRICING_MAX_CYCLES = 24
+DEFAULT_PRICING_BUDGET_S = 0.25
+# Noise floor on the rejection test: the with/without lanes are two
+# genuinely different truncated f32 solves, so a zero-externality
+# burst can price at a small negative delta (observed ~3e-4 on the
+# committed fixture vs ~-12 for a real crowding burst). A strict
+# `delta < 0` would shed harmless bursts on solver noise.
+DEFAULT_PRICING_THRESHOLD = 1e-3
+# Circuit breaker: a budget overrun still PAID its wall clock (the
+# budget is consulted after the solve — a kernel compile or an
+# oversized market cannot be interrupted mid-dispatch), so after this
+# many consecutive overruns the pricer stops solving outright and
+# abstains for free, re-probing with one real solve every
+# _CIRCUIT_PROBE_EVERY batches in case the kernel warmed up or the
+# market shrank.
+_CIRCUIT_OPEN_AFTER = 3
+_CIRCUIT_PROBE_EVERY = 8
+
+
+@dataclasses.dataclass
+class PricingDecision:
+    """Outcome of pricing one submission batch. ``action`` is
+    ``accept`` / ``reject`` / ``fallback`` (fallback = the quota-only
+    path decides, pricing abstains). ``welfare_delta`` is the
+    incumbents' Nash-welfare change caused by admitting the burst
+    (negative = the burst crowds incumbents out); ``burst_welfare`` the
+    burst's own welfare under admission."""
+
+    action: str
+    reason: str
+    welfare_delta: Optional[float] = None
+    burst_welfare: Optional[float] = None
+    solve_s: float = 0.0
+
+    def as_record(self) -> dict:
+        return {
+            "action": self.action,
+            "reason": self.reason,
+            "welfare_delta": (
+                round(self.welfare_delta, 9)
+                if self.welfare_delta is not None
+                else None
+            ),
+            "burst_welfare": (
+                round(self.burst_welfare, 9)
+                if self.burst_welfare is not None
+                else None
+            ),
+            "solve_s": round(self.solve_s, 4),
+        }
+
+
+def burst_problem(problem: EGProblem, jobs: Sequence) -> EGProblem:
+    """Append hypothetical (not-yet-admitted) burst rows to the live
+    problem. A burst job's demand is its declared ``duration`` (epochs
+    synthesized at one-per-round granularity); a job with no declared
+    duration conservatively asks for the full planning window — the
+    worst case the price must cover. Burst rows are never incumbents
+    and carry no relaunch overhead."""
+    B = len(jobs)
+    dur = max(float(problem.round_duration), 1e-9)
+    window = dur * float(problem.future_rounds)
+    demand = np.array(
+        [
+            float(getattr(job, "duration", None) or window)
+            for job in jobs
+        ]
+    )
+    epochs = np.maximum(np.round(demand / dur), 1.0)
+    zeros = np.zeros(B)
+    return dataclasses.replace(
+        problem,
+        priorities=np.concatenate(
+            [
+                problem.priorities,
+                [
+                    float(getattr(job, "priority_weight", 1.0) or 1.0)
+                    for job in jobs
+                ],
+            ]
+        ),
+        completed_epochs=np.concatenate(
+            [problem.completed_epochs, zeros]
+        ),
+        total_epochs=np.concatenate([problem.total_epochs, epochs]),
+        epoch_duration=np.concatenate(
+            [problem.epoch_duration, demand / epochs]
+        ),
+        remaining_runtime=np.concatenate(
+            [problem.remaining_runtime, demand]
+        ),
+        nworkers=np.concatenate(
+            [
+                problem.nworkers,
+                [
+                    float(getattr(job, "scale_factor", 1) or 1)
+                    for job in jobs
+                ],
+            ]
+        ),
+        switch_cost=np.concatenate(
+            [
+                np.zeros(problem.num_jobs)
+                if problem.switch_cost is None
+                else np.asarray(problem.switch_cost, np.float64),
+                zeros,
+            ]
+        ),
+        incumbent=np.concatenate(
+            [
+                np.zeros(problem.num_jobs)
+                if problem.incumbent is None
+                else np.asarray(problem.incumbent, np.float64),
+                zeros,
+            ]
+        ),
+    )
+
+
+def _welfare(
+    problem: EGProblem, s: np.ndarray, rows: np.ndarray, norm: float
+) -> float:
+    """Priority-weighted true-log Nash welfare of ``rows`` under grant
+    ``s``, with a FIXED normalization so the with/without comparison
+    isolates grant changes (the kernel's own normalization divides by
+    each scenario's active-job count, which differs by construction
+    here)."""
+    s = np.asarray(s, np.float64)
+    total = np.maximum(np.asarray(problem.total_epochs, np.float64), _EPS)
+    epoch_dur = np.maximum(
+        np.asarray(problem.epoch_duration, np.float64), _EPS
+    )
+    completed = np.asarray(problem.completed_epochs, np.float64)
+    dur = max(float(problem.round_duration), 1e-9)
+    need_sec = (
+        np.maximum(np.asarray(problem.total_epochs) - completed, 0.0)
+        * epoch_dur
+    )
+    xcap = need_sec / dur
+    progress = completed / total + (dur / (epoch_dur * total)) * np.minimum(
+        s, xcap
+    )
+    q = np.asarray(problem.priorities, np.float64) / max(norm, 1.0)
+    return float(np.sum(rows * q * np.log(progress + _EPS)))
+
+
+class AdmissionPricer:
+    """Prices a submission batch by its marginal Nash-welfare impact.
+
+    ``state_provider`` returns a planner state dict
+    (:meth:`ShockwavePlanner.state_dict` — the caller owns snapshot
+    safety) or None when no planner exists yet. A burst is ACCEPTED
+    when the incumbents' welfare delta is no worse than
+    ``-threshold``; REJECTED when the burst's externality exceeds it;
+    and every failure mode — including a pricing solve that overran
+    ``budget_s`` — abstains with ``fallback`` so the quota-only path
+    keeps sole authority."""
+
+    def __init__(
+        self,
+        state_provider: Callable[[], Optional[dict]],
+        threshold: float = DEFAULT_PRICING_THRESHOLD,
+        budget_s: float = DEFAULT_PRICING_BUDGET_S,
+        max_cycles: int = DEFAULT_PRICING_MAX_CYCLES,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._provider = state_provider
+        self.threshold = float(threshold)
+        self.budget_s = float(budget_s)
+        self.max_cycles = int(max_cycles)
+        self._clock = clock
+        # Circuit-breaker state (GIL-atomic counters; approximate
+        # under concurrent handlers, which only shifts WHEN a probe
+        # happens, never correctness — every path still abstains).
+        self._consecutive_overruns = 0
+        self._open_skips = 0
+
+    def price(self, jobs: Sequence) -> PricingDecision:
+        t0 = self._clock()
+        if self._consecutive_overruns >= _CIRCUIT_OPEN_AFTER:
+            self._open_skips += 1
+            if self._open_skips % _CIRCUIT_PROBE_EVERY != 0:
+                # Open circuit: abstain for free instead of paying
+                # another over-budget solve on the admission path.
+                decision = PricingDecision(
+                    action="fallback", reason="circuit_open"
+                )
+                obs.counter(
+                    "admission_priced_total",
+                    "submission batches priced by the marginal-welfare "
+                    "admission pricer",
+                ).inc(decision=decision.action)
+                return decision
+        try:
+            decision = self._price_inner(list(jobs), t0)
+        except Exception as e:
+            # Pricing is advisory: any failure must degrade to the
+            # quota-only path, loudly (logged + counted), never block
+            # admission.
+            logger.warning(
+                "admission pricing failed (%s: %s); falling back to "
+                "quota-only admission",
+                type(e).__name__,
+                e,
+            )
+            decision = PricingDecision(
+                action="fallback",
+                reason=f"error:{type(e).__name__}",
+                solve_s=self._clock() - t0,
+            )
+        if decision.reason == "budget_exceeded":
+            self._consecutive_overruns += 1
+        elif decision.action in ("accept", "reject"):
+            self._consecutive_overruns = 0
+            self._open_skips = 0
+        obs.counter(
+            "admission_priced_total",
+            "submission batches priced by the marginal-welfare "
+            "admission pricer",
+        ).inc(decision=decision.action)
+        obs.histogram(
+            "admission_pricing_solve_seconds",
+            "wall-clock of one 2-scenario marginal-price solve",
+        ).observe(decision.solve_s)
+        return decision
+
+    def _price_inner(self, jobs: List, t0: float) -> PricingDecision:
+        from shockwave_tpu.whatif.scenario import (
+            Scenario,
+            ScenarioBatch,
+            solve_scenarios,
+        )
+        from shockwave_tpu.whatif.seed import base_problem_from_state
+
+        if not jobs:
+            return PricingDecision(
+                action="fallback", reason="empty_batch",
+                solve_s=self._clock() - t0,
+            )
+        state = self._provider()
+        if state is None:
+            return PricingDecision(
+                action="fallback", reason="no_planner_state",
+                solve_s=self._clock() - t0,
+            )
+        if isinstance(state, dict) and isinstance(
+            state.get("problem"), EGProblem
+        ):
+            # Pre-built market (the offline whatif CLI prices recorded
+            # rounds without a planner restore per query).
+            problem = state["problem"]
+            s0 = state.get("s0")
+        else:
+            try:
+                problem, _keys, s0 = base_problem_from_state(state)
+            except ValueError:
+                # No incomplete jobs in the live market: the burst has
+                # no incumbents to crowd out — nothing to price.
+                return PricingDecision(
+                    action="fallback", reason="empty_market",
+                    solve_s=self._clock() - t0,
+                )
+        J, B = problem.num_jobs, len(jobs)
+        augmented = burst_problem(problem, jobs)
+        if s0 is not None and len(s0) == J:
+            from shockwave_tpu.solver.eg_pdhg import _default_s0
+
+            s0_aug = np.concatenate(
+                [np.asarray(s0, np.float64), _default_s0(augmented)[J:]]
+            )
+        else:
+            s0_aug = None
+        incumbent_rows = np.concatenate([np.ones(J), np.zeros(B)])
+        burst_rows = 1.0 - incumbent_rows
+        batch = ScenarioBatch(
+            augmented,
+            [
+                Scenario(name="with_burst"),
+                Scenario(name="without_burst", job_mask=incumbent_rows),
+            ],
+            s0=s0_aug,
+        )
+        s_list, _, _ = solve_scenarios(batch, max_cycles=self.max_cycles)
+        # Fixed normalization (the with-burst market's size x window):
+        # the delta then measures grant movement, not the denominator.
+        norm = float(J + B) * float(problem.future_rounds)
+        w_with = _welfare(augmented, s_list[0], incumbent_rows, norm)
+        w_without = _welfare(augmented, s_list[1], incumbent_rows, norm)
+        burst_welfare = _welfare(augmented, s_list[0], burst_rows, norm)
+        solve_s = self._clock() - t0
+        delta = w_with - w_without
+        if solve_s > self.budget_s:
+            # The answer arrived too late to be load-bearing: a pricer
+            # this slow on this fleet must not sit on the admission
+            # path — abstain (and keep abstaining until the operator
+            # raises the budget or shrinks the market).
+            return PricingDecision(
+                action="fallback", reason="budget_exceeded",
+                welfare_delta=delta, burst_welfare=burst_welfare,
+                solve_s=solve_s,
+            )
+        if delta < -self.threshold:
+            return PricingDecision(
+                action="reject", reason="negative_externality",
+                welfare_delta=delta, burst_welfare=burst_welfare,
+                solve_s=solve_s,
+            )
+        return PricingDecision(
+            action="accept", reason="priced",
+            welfare_delta=delta, burst_welfare=burst_welfare,
+            solve_s=solve_s,
+        )

@@ -1,0 +1,618 @@
+"""Scenario-batched counterfactual EG solves: the on-chip what-if fleet.
+
+Capacity planning and admission pricing are both *solves* in the market
+formulation — "what if we add 64 chips / double the MoE mix / tighten
+the round length" is the same J-slot restarted-PDHG saddle point
+(:func:`shockwave_tpu.solver.eg_pdhg._pdhg_core`) on perturbed inputs.
+This module batches those perturbations the way Large-Scale Regularized
+Matching batches matching instances (PAPERS.md): ``vmap`` over a
+leading *scenario* axis, one compile per (slot-band, lane-band), so a
+thousand counterfactuals cost one vectorized dispatch instead of a
+thousand planner rounds.
+
+The key structural choice is **on-device overlays**: the base problem's
+job rows are packed ONCE (shared across lanes, replicated under
+``shard_map``), and each scenario is a small overlay — a 0/1 job mask
+(demand mixes, with/without an admission burst), a per-job priority
+scale (weight knobs), a per-lane capacity (fleet sizes), a switch-cost
+scale, and per-lane ``round_duration`` / ``future_rounds`` /
+``regularizer`` scalars (policy knobs) — applied inside the jitted
+kernel. A 1024-scenario batch therefore moves ~2 overlay arrays, not
+1024 copies of the fleet.
+
+Bit-identity contract (pinned by tests/test_whatif.py): every overlay
+is multiplicative with an exact identity (``x * 1.0`` and ``x * mask``
+with a 0/1 mask are exact in f32) or a direct per-lane value, so
+
+  * an identity-overlay lane is bit-identical to
+    :func:`shockwave_tpu.solver.eg_pdhg.solve_pdhg_relaxed` on the base
+    problem, and
+  * every perturbed lane is bit-identical to :func:`solve_scenario` —
+    the standalone (unbatched) solve of that scenario through the same
+    overlay arithmetic.
+
+A scenario's market therefore does not change meaning by being solved
+next to 1023 neighbors — the same guarantee the cells batched lanes
+give (:mod:`shockwave_tpu.cells.batched`, whose lane banding this
+module reuses).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from shockwave_tpu import obs
+from shockwave_tpu.analysis import sanitize
+from shockwave_tpu.cells.batched import lane_band
+from shockwave_tpu.solver.eg_jax import _EPS, num_slots_for
+from shockwave_tpu.solver.eg_pdhg import (
+    DEFAULT_INNER_ITERS,
+    DEFAULT_MAX_CYCLES,
+    DEFAULT_TOL,
+    _STALL_REL,
+    _default_s0,
+    _packed_args,
+    _pdhg_core,
+)
+from shockwave_tpu.solver.eg_problem import EGProblem
+
+
+@dataclasses.dataclass
+class Scenario:
+    """One counterfactual: the knobs that differ from the live fleet.
+
+    Every field defaults to the exact identity, so ``Scenario()`` is
+    the baseline lane (bit-identical to the live solve). ``job_mask``
+    is 0/1 over the base problem's job order — 0 removes the job from
+    this scenario's market entirely (it stops counting toward the
+    welfare normalization, capacity, and the makespan, exactly as if
+    it had never been admitted)."""
+
+    name: str = "baseline"
+    # Fleet size: absolute chips, or a scale on the base capacity
+    # (absolute wins when both are set).
+    num_gpus: Optional[float] = None
+    capacity_scale: Optional[float] = None
+    # Demand mix: 0/1 over base job order (None = all jobs).
+    job_mask: Optional[np.ndarray] = None
+    # Weight knob: scalar, or per-job over base job order.
+    priority_scale: Union[float, np.ndarray] = 1.0
+    # Preemption-pricing knob: scales the measured relaunch overheads.
+    switch_cost_scale: float = 1.0
+    # Policy knobs (None = the base problem's value).
+    round_duration: Optional[float] = None
+    future_rounds: Optional[float] = None
+    regularizer: Optional[float] = None
+    # Free-form labels carried into reports (grid coordinates etc.).
+    tags: Dict = dataclasses.field(default_factory=dict)
+
+
+@functools.partial(jax.jit, static_argnames=("max_cycles", "inner_iters"))
+def _solve_scenarios_kernel(
+    active,  # [slots] shared base rows -----------------------------
+    priorities,
+    completed,
+    total,
+    epoch_dur,
+    remaining,
+    nworkers,
+    switch_bonus,
+    s0,
+    job_mask,  # [L, slots] overlays --------------------------------
+    priority_scale,  # [L, slots]
+    num_gpus,  # [L] per-lane scalars -------------------------------
+    switch_scale,
+    round_duration,
+    future_rounds,
+    regularizer,
+    tol,  # shared scalars ------------------------------------------
+    stall_rel,
+    max_cycles: int = DEFAULT_MAX_CYCLES,
+    inner_iters: int = DEFAULT_INNER_ITERS,
+):
+    core = functools.partial(
+        _pdhg_core,
+        max_cycles=max_cycles,
+        inner_iters=inner_iters,
+        axis_name=None,
+    )
+
+    def one(mask, pscale, gpus, sscale, dur, R, k):
+        return core(
+            active * mask, priorities * pscale, completed, total,
+            epoch_dur, remaining, nworkers, switch_bonus * sscale, s0,
+            gpus, dur, R, k, tol, stall_rel,
+        )
+
+    return jax.vmap(one)(
+        job_mask, priority_scale, num_gpus, switch_scale,
+        round_duration, future_rounds, regularizer,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("max_cycles", "inner_iters"))
+def _solve_scenario_kernel(
+    active,
+    priorities,
+    completed,
+    total,
+    epoch_dur,
+    remaining,
+    nworkers,
+    switch_bonus,
+    s0,
+    job_mask,  # [slots]
+    priority_scale,  # [slots]
+    num_gpus,
+    switch_scale,
+    round_duration,
+    future_rounds,
+    regularizer,
+    tol,
+    stall_rel,
+    max_cycles: int = DEFAULT_MAX_CYCLES,
+    inner_iters: int = DEFAULT_INNER_ITERS,
+):
+    """The unbatched reference: identical overlay arithmetic, no vmap —
+    what a lane of :func:`_solve_scenarios_kernel` must reproduce
+    bit-for-bit (the audit the whatif CLI and CI gate run)."""
+    return _pdhg_core(
+        active * job_mask, priorities * priority_scale, completed,
+        total, epoch_dur, remaining, nworkers,
+        switch_bonus * switch_scale, s0, num_gpus,
+        round_duration, future_rounds, regularizer, tol, stall_rel,
+        max_cycles=max_cycles, inner_iters=inner_iters, axis_name=None,
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _build_scenarios_sharded(mesh: Mesh, axis: str, max_cycles, inner_iters):
+    """shard_map the batched kernel over the scenario axis: the base
+    rows and warm start replicate, the overlay lanes split across
+    devices, and there are no collectives (scenarios are independent by
+    construction)."""
+    from shockwave_tpu.utils.compat import shard_map
+
+    def kernel(*args):
+        return _solve_scenarios_kernel(
+            *args, max_cycles=max_cycles, inner_iters=inner_iters
+        )
+
+    spec_l = P(axis)
+    spec_rep = P()
+    diag_spec = {
+        k: spec_l
+        for k in (
+            "cycles", "iterations", "restarts", "residual", "residual0",
+            "converged", "welfare_filled",
+        )
+    }
+    fn = shard_map(
+        kernel,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(spec_rep,) * 9 + (spec_l,) * 7 + (spec_rep,) * 2,
+        out_specs=(spec_l, spec_l, diag_spec),
+    )
+    return jax.jit(fn)
+
+
+class ScenarioBatch:
+    """S heterogeneous scenarios packed into power-of-two lane bands
+    over one shared base problem.
+
+    Lanes past ``len(scenarios)`` are inert (all-zero job mask, 1-chip
+    capacity), so sweeping 5 scenarios this round and 1000 the next
+    reuses at most log2(S)+1 compiled programs — the same banding
+    discipline as :func:`shockwave_tpu.cells.batched.lane_band`.
+    """
+
+    def __init__(
+        self,
+        problem: EGProblem,
+        scenarios: Sequence[Scenario],
+        s0: Optional[np.ndarray] = None,
+        slots: Optional[int] = None,
+    ):
+        if not scenarios:
+            raise ValueError("a ScenarioBatch needs at least one scenario")
+        self.problem = problem
+        self.scenarios = list(scenarios)
+        self.slots = (
+            int(slots) if slots else num_slots_for(problem.num_jobs)
+        )
+        self.lanes = lane_band(len(self.scenarios))
+        if s0 is None:
+            s0 = _default_s0(problem)
+        self.base_args = _packed_args(problem, self.slots, s0)[:9]
+        self.overlays = self._pack_overlays(problem)
+
+    def _pack_overlays(self, problem: EGProblem):
+        J, slots, L = problem.num_jobs, self.slots, self.lanes
+        mask = np.ones((L, slots), np.float32)
+        pscale = np.ones((L, slots), np.float32)
+        gpus = np.ones(L, np.float32)
+        sscale = np.ones(L, np.float32)
+        dur = np.full(L, np.float32(problem.round_duration), np.float32)
+        rounds = np.full(L, np.float32(problem.future_rounds), np.float32)
+        reg = np.full(L, np.float32(problem.regularizer), np.float32)
+        base_reg = float(problem.regularizer)
+        for i, sc in enumerate(self.scenarios):
+            if sc.job_mask is not None:
+                jm = np.asarray(sc.job_mask, np.float32)
+                if jm.shape != (J,):
+                    raise ValueError(
+                        f"scenario {sc.name!r}: job_mask shape {jm.shape}"
+                        f" != ({J},)"
+                    )
+                mask[i, :J] = jm
+            if sc.num_gpus is not None:
+                gpus[i] = np.float32(sc.num_gpus)
+            elif sc.capacity_scale is not None:
+                gpus[i] = np.float32(
+                    float(problem.num_gpus) * float(sc.capacity_scale)
+                )
+            else:
+                gpus[i] = np.float32(problem.num_gpus)
+            ps = sc.priority_scale
+            if np.ndim(ps) == 0:
+                pscale[i, :] = np.float32(ps)
+            else:
+                ps = np.asarray(ps, np.float32)
+                if ps.shape != (J,):
+                    raise ValueError(
+                        f"scenario {sc.name!r}: priority_scale shape "
+                        f"{ps.shape} != ({J},)"
+                    )
+                pscale[i, :J] = ps
+            # The packed switch_bonus is base_regularizer * cost *
+            # incumbent; a regularizer knob must re-price it too, so
+            # the ratio folds into the lane's switch scale (exactly 1.0
+            # when neither knob is set).
+            scale = float(sc.switch_cost_scale)
+            if sc.regularizer is not None and base_reg > 0.0:
+                scale *= float(sc.regularizer) / base_reg
+            sscale[i] = np.float32(scale)
+            if sc.round_duration is not None:
+                dur[i] = np.float32(sc.round_duration)
+            if sc.future_rounds is not None:
+                rounds[i] = np.float32(sc.future_rounds)
+            if sc.regularizer is not None:
+                reg[i] = np.float32(sc.regularizer)
+        # Inert padding lanes: no jobs, one chip — converge in one
+        # cycle and never gate the batch.
+        mask[len(self.scenarios):, :] = 0.0
+        return tuple(
+            jnp.asarray(a)
+            for a in (mask, pscale, gpus, sscale, dur, rounds, reg)
+        )
+
+    def lane_args(self, index: int):
+        """The standalone-reference arguments for one scenario lane:
+        (9 base arrays, 7 per-lane overlay values) exactly as the
+        batched kernel sees them — what :func:`solve_scenario` and the
+        bit-parity audit consume."""
+        mask, pscale, gpus, sscale, dur, rounds, reg = self.overlays
+        return self.base_args, (
+            mask[index], pscale[index], gpus[index], sscale[index],
+            dur[index], rounds[index], reg[index],
+        )
+
+
+def _diag_row(diag, i: int) -> dict:
+    return {
+        "cycles": int(np.asarray(diag["cycles"])[i]),
+        "iterations": int(np.asarray(diag["iterations"])[i]),
+        "restarts": int(np.asarray(diag["restarts"])[i]),
+        "residual": float(np.asarray(diag["residual"])[i]),
+        "converged": bool(np.asarray(diag["converged"])[i]),
+        "welfare_filled": bool(np.asarray(diag["welfare_filled"])[i]),
+    }
+
+
+# Cache-resident chunk target (elements per overlay row-block). One
+# dispatch's per-cycle cost is flat while lanes x slots stays around
+# this size (op-overhead bound) and turns memory-bandwidth bound past
+# it: on the 2-core reference host a 12-job (64-slot) state solves
+# 64-lane chunks at ~0.5 ms/scenario but a monolithic 1024-lane
+# dispatch at ~2.6 ms/scenario (results/whatif/). Chunking also lets
+# each chunk's while_loop stop at its OWN slowest lane instead of the
+# global slowest. Lane arithmetic is chunking-invariant (vmap is
+# lanewise), so bit parity is unaffected; all full chunks share one
+# compiled program (same lane band).
+_CHUNK_TARGET_ELEMENTS = 4096
+
+
+def _auto_chunk_lanes(lanes: int, slots: int) -> int:
+    chunk = 8
+    while (
+        chunk * 2 <= lanes and (chunk * 2) * slots <= _CHUNK_TARGET_ELEMENTS
+    ):
+        chunk *= 2
+    return chunk
+
+
+def solve_scenarios(
+    batch: ScenarioBatch,
+    tol: float = DEFAULT_TOL,
+    stall_rel: float = _STALL_REL,
+    max_cycles: int = DEFAULT_MAX_CYCLES,
+    inner_iters: int = DEFAULT_INNER_ITERS,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "scenarios",
+    chunk_lanes: Optional[int] = None,
+) -> Tuple[List[np.ndarray], List[float], List[dict]]:
+    """Solve every scenario's counterfactual market in one batched
+    dispatch; returns per-scenario ``(s [num_jobs] float64, objective,
+    diagnostics)`` lists (inert padding lanes dropped).
+
+    Large lane bands are auto-split into cache-resident chunks
+    (``chunk_lanes``: None = auto, 0 = monolithic) — all full chunks
+    reuse one compiled program, chunks past the real scenario count
+    are skipped outright, and each chunk early-stops on its own
+    slowest lane. With ``mesh`` set (and the lane band divisible by
+    the device count) the monolithic kernel runs under ``shard_map``
+    with the scenario axis split over devices — per-device work is a
+    fixed slice of lanes regardless of how many what-ifs the operator
+    asks."""
+    scalars = (jnp.float32(tol), jnp.float32(stall_rel))
+    t0 = time.monotonic()
+    if mesh is not None and batch.lanes % int(
+        np.prod(mesh.devices.shape)
+    ) == 0:
+        fn = _build_scenarios_sharded(
+            mesh, axis_name, int(max_cycles), int(inner_iters)
+        )
+        shard_l = NamedSharding(mesh, P(axis_name))
+        rep = NamedSharding(mesh, P())
+        placed = [jax.device_put(a, rep) for a in batch.base_args]
+        placed += [jax.device_put(a, shard_l) for a in batch.overlays]
+        placed += [jax.device_put(v, rep) for v in scalars]
+        with sanitize.jax_entry("whatif.solve_scenarios_sharded"):
+            s, obj, diag = fn(*placed)
+    else:
+        if chunk_lanes is None:
+            chunk = _auto_chunk_lanes(batch.lanes, batch.slots)
+        else:
+            chunk = int(chunk_lanes) or batch.lanes
+        chunk = min(chunk, batch.lanes)
+        # Floor to a power of two: the lane band is one, so only
+        # power-of-two chunks tile it exactly — an uneven tail chunk
+        # would both break the diag concat and compile a second
+        # program, defeating one-compile-per-band.
+        p = 1
+        while p * 2 <= chunk:
+            p *= 2
+        chunk = p
+        parts = []
+        with sanitize.jax_entry("whatif.solve_scenarios"):
+            for lo in range(0, batch.lanes, chunk):
+                if lo >= len(batch.scenarios):
+                    break  # all-inert tail chunks of the lane band
+                overlays = tuple(
+                    a[lo:lo + chunk] for a in batch.overlays
+                )
+                parts.append(
+                    _solve_scenarios_kernel(
+                        *batch.base_args, *overlays, *scalars,
+                        max_cycles=int(max_cycles),
+                        inner_iters=int(inner_iters),
+                    )
+                )
+        sanitize.check_recompiles(
+            "whatif.solve_scenarios",
+            _solve_scenarios_kernel,
+            (chunk, batch.slots, int(max_cycles), int(inner_iters)),
+        )
+        s = jnp.concatenate([part[0] for part in parts])
+        obj = jnp.concatenate([part[1] for part in parts])
+        diag = {
+            k: jnp.stack([part[2][k] for part in parts]).reshape(-1)
+            for k in parts[0][2]
+        }
+    s = np.asarray(s)
+    obj = np.asarray(obj)
+    dt = time.monotonic() - t0
+    n = len(batch.scenarios)
+    obs.counter(
+        "whatif_scenarios_solved_total",
+        "counterfactual scenario solves completed by the what-if fleet",
+    ).inc(n)
+    obs.gauge(
+        "whatif_lane_band",
+        "power-of-two lane band of the last scenario batch",
+    ).set(float(batch.lanes))
+    obs.histogram(
+        "whatif_batch_solve_seconds",
+        "wall-clock of one batched scenario-fleet solve",
+    ).observe(dt)
+    J = batch.problem.num_jobs
+    return (
+        [s[i, :J].astype(np.float64) for i in range(n)],
+        [float(o) for o in obj[:n]],
+        [_diag_row(diag, i) for i in range(n)],
+    )
+
+
+def solve_scenario(
+    batch: ScenarioBatch,
+    index: int,
+    tol: float = DEFAULT_TOL,
+    stall_rel: float = _STALL_REL,
+    max_cycles: int = DEFAULT_MAX_CYCLES,
+    inner_iters: int = DEFAULT_INNER_ITERS,
+) -> Tuple[np.ndarray, float, dict]:
+    """Standalone (unbatched) solve of one scenario through the same
+    overlay arithmetic — the bit-parity reference each batched lane is
+    audited against."""
+    base, lane = batch.lane_args(index)
+    with sanitize.jax_entry("whatif.solve_scenario"):
+        s, obj, diag = _solve_scenario_kernel(
+            *base, *lane, jnp.float32(tol), jnp.float32(stall_rel),
+            max_cycles=int(max_cycles), inner_iters=int(inner_iters),
+        )
+    J = batch.problem.num_jobs
+    return (
+        np.asarray(s)[:J].astype(np.float64),
+        float(obj),
+        {
+            "cycles": int(diag["cycles"]),
+            "iterations": int(diag["iterations"]),
+            "restarts": int(diag["restarts"]),
+            "residual": float(diag["residual"]),
+            "converged": bool(diag["converged"]),
+            "welfare_filled": bool(diag["welfare_filled"]),
+        },
+    )
+
+
+def audit_lanes(
+    batch: ScenarioBatch,
+    s_list: Sequence[np.ndarray],
+    indices: Optional[Sequence[int]] = None,
+    **solve_kwargs,
+) -> dict:
+    """Re-solve scenarios standalone and compare bit-for-bit against
+    the batched lanes. Returns ``{"audited", "mismatched", "indices"}``
+    — a non-empty ``mismatched`` list means the batched dispatch
+    changed a market's answer, which the contract forbids."""
+    if indices is None:
+        indices = range(len(batch.scenarios))
+    mismatched = []
+    for i in indices:
+        s_ref, _, _ = solve_scenario(batch, i, **solve_kwargs)
+        if not np.array_equal(
+            np.asarray(s_list[i], np.float32),
+            np.asarray(s_ref, np.float32),
+        ):
+            mismatched.append(int(i))
+    return {
+        "audited": len(list(indices)),
+        "mismatched": mismatched,
+        "bit_identical": not mismatched,
+    }
+
+
+# ----------------------------------------------------------------------
+# Report-side metrics (host, float64 — planning semantics, not the f32
+# kernel arithmetic).
+# ----------------------------------------------------------------------
+def scenario_metrics(
+    problem: EGProblem, scenario: Scenario, s: np.ndarray
+) -> dict:
+    """Planning metrics of one scenario's relaxed solution ``s``:
+    priority-weighted Nash welfare (the core's normalized true-log
+    term), regularized makespan, worst remaining lateness, and a
+    finish-time-fairness proxy (window + contention-inflated lateness
+    over predicted remaining runtime — the ratio the planner's FTF
+    priorities are built from, re-evaluated under the scenario's
+    grant)."""
+    s = np.asarray(s, np.float64)
+    mask = (
+        np.asarray(scenario.job_mask, np.float64)
+        if scenario.job_mask is not None
+        else np.ones(problem.num_jobs)
+    )
+    dur = float(
+        scenario.round_duration
+        if scenario.round_duration is not None
+        else problem.round_duration
+    )
+    R = float(
+        scenario.future_rounds
+        if scenario.future_rounds is not None
+        else problem.future_rounds
+    )
+    gpus = (
+        float(scenario.num_gpus)
+        if scenario.num_gpus is not None
+        else float(problem.num_gpus)
+        * float(
+            scenario.capacity_scale
+            if scenario.capacity_scale is not None
+            else 1.0
+        )
+    )
+    pscale = np.broadcast_to(
+        np.asarray(scenario.priority_scale, np.float64), (problem.num_jobs,)
+    )
+    active = mask * (np.asarray(problem.nworkers, np.float64) <= gpus)
+    n_active = max(float(active.sum()), 1.0)
+    total = np.maximum(np.asarray(problem.total_epochs, np.float64), _EPS)
+    epoch_dur = np.maximum(
+        np.asarray(problem.epoch_duration, np.float64), _EPS
+    )
+    completed = np.asarray(problem.completed_epochs, np.float64)
+    remaining = np.asarray(problem.remaining_runtime, np.float64)
+    q = active * np.asarray(problem.priorities, np.float64) * pscale / (
+        n_active * R
+    )
+    need_sec = np.maximum(
+        np.asarray(problem.total_epochs, np.float64) - completed, 0.0
+    ) * epoch_dur
+    xcap = need_sec / max(dur, _EPS)
+    progress = completed / total + (dur / (epoch_dur * total)) * np.minimum(
+        s, xcap
+    )
+    welfare = float(np.sum(q * np.log(progress + _EPS)))
+    lateness = np.where(active > 0, remaining - dur * s, 0.0)
+    floor = float(np.max(np.where(active > 0, remaining - need_sec, 0.0)))
+    makespan = max(max(floor, 0.0), float(np.max(lateness, initial=0.0)))
+    contention = n_active / max(gpus, 1.0)
+    ftf_proxy = np.where(
+        active > 0,
+        (dur * R + np.maximum(lateness, 0.0) * contention)
+        / np.maximum(remaining, 1.0),
+        0.0,
+    )
+    return {
+        "name": scenario.name,
+        "tags": dict(scenario.tags),
+        "active_jobs": int(round(active.sum())),
+        "scheduled_jobs": int(np.sum((s >= 0.5) & (active > 0))),
+        "granted_rounds": float(np.sum(s * active)),
+        "nash_welfare": welfare,
+        "makespan_s": makespan,
+        "worst_lateness_s": float(
+            np.max(np.maximum(lateness, 0.0), initial=0.0)
+        ),
+        "worst_ftf_proxy": float(np.max(ftf_proxy, initial=0.0)),
+        "capacity": gpus,
+    }
+
+
+def scenario_report(
+    problem: EGProblem,
+    scenarios: Sequence[Scenario],
+    s_list: Sequence[np.ndarray],
+    objectives: Sequence[float],
+    diags: Sequence[dict],
+    baseline_index: int = 0,
+) -> List[dict]:
+    """Per-scenario capacity-planning rows with deltas against the
+    baseline scenario (by default the first — conventionally the
+    identity lane)."""
+    rows = []
+    base = scenario_metrics(
+        problem, scenarios[baseline_index], s_list[baseline_index]
+    )
+    for sc, s, o, d in zip(scenarios, s_list, objectives, diags):
+        m = scenario_metrics(problem, sc, s)
+        m["objective"] = float(o)
+        m["converged"] = bool(d["converged"])
+        m["cycles"] = int(d["cycles"])
+        m["nash_welfare_delta"] = m["nash_welfare"] - base["nash_welfare"]
+        m["makespan_delta_s"] = m["makespan_s"] - base["makespan_s"]
+        m["worst_ftf_proxy_delta"] = (
+            m["worst_ftf_proxy"] - base["worst_ftf_proxy"]
+        )
+        rows.append(m)
+    return rows

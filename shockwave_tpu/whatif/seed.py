@@ -1,0 +1,115 @@
+"""Seeding the what-if fleet from real planner state.
+
+Counterfactuals are only as good as the world they perturb, so the
+scenario batch is seeded from the planner's own problem-building path:
+a recorded flight-recorder snapshot (every plan record carries the full
+pre-replan planner state; ``python -m shockwave_tpu.obs.recorder
+export-state`` extracts one round's restorable copy) or the live
+planner's ``state_dict()`` — in both cases the state is restored
+through :func:`shockwave_tpu.policies.shockwave.planner_from_state`
+and the base :class:`~shockwave_tpu.solver.eg_problem.EGProblem` is
+built by the SAME ``_build_problem`` the production replan runs, so a
+what-if's baseline lane prices exactly the market the planner saw.
+
+Restoration always happens on a throwaway clone (the state dict, not
+the planner object), because ``_build_problem`` appends to the
+finish-time-fairness history — a what-if must never perturb the live
+planner's priorities.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from shockwave_tpu.solver.eg_problem import EGProblem
+
+
+def base_problem_from_state(
+    state: dict,
+) -> Tuple[EGProblem, List[str], Optional[np.ndarray]]:
+    """Restore a planner state dict and build its EG problem.
+
+    Returns ``(problem, job_keys, s0)`` where ``job_keys`` are the
+    stringified job ids in problem row order and ``s0`` is the
+    plan-cache warm start (the live plan's round counts, None when the
+    state carries no usable cache). A ``cell_set`` (federated) state is
+    merged into one global market: per-cell rows concatenated,
+    capacities summed — the fleet-wide counterfactual a capacity
+    planner wants, priced with the same shared planning config every
+    cell already agrees on.
+    """
+    from shockwave_tpu.policies.shockwave import (
+        ShockwavePlanner,
+        planner_from_state,
+    )
+
+    if state.get("kind") == "cell_set":
+        import dataclasses
+
+        problems, keys, warms = [], [], []
+        for name, child_state in state["children"].items():
+            child = ShockwavePlanner.from_state(child_state)
+            problem, job_ids = child._build_problem()
+            if problem is None:
+                continue
+            problems.append(problem)
+            keys.extend(str(j) for j in job_ids)
+            w = child._solution_warm_start()
+            warms.append(
+                w if w is not None else np.zeros(problem.num_jobs)
+            )
+        if not problems:
+            raise ValueError(
+                "cell_set state has no incomplete jobs to build a "
+                "what-if problem from"
+            )
+        ref = problems[0]
+        merged = dataclasses.replace(
+            ref,
+            **{
+                f: np.concatenate(
+                    [np.asarray(getattr(p, f)) for p in problems]
+                )
+                for f in (
+                    "priorities", "completed_epochs", "total_epochs",
+                    "epoch_duration", "remaining_runtime", "nworkers",
+                    "switch_cost", "incumbent",
+                )
+            },
+            num_gpus=int(sum(p.num_gpus for p in problems)),
+        )
+        return merged, keys, np.concatenate(warms)
+
+    planner = planner_from_state(state)
+    if not hasattr(planner, "_build_problem"):
+        raise ValueError(
+            f"planner kind {state.get('kind')!r} does not expose "
+            "_build_problem; seed the what-if fleet from a flat or "
+            "cell_set snapshot"
+        )
+    problem, job_ids = planner._build_problem()
+    if problem is None:
+        raise ValueError(
+            "planner state has no incomplete jobs to build a what-if "
+            "problem from"
+        )
+    s0 = planner._solution_warm_start()
+    return problem, [str(j) for j in job_ids], s0
+
+
+def base_problem_from_log(
+    path: str, round_index: Optional[int] = None
+) -> Tuple[EGProblem, List[str], Optional[np.ndarray], int]:
+    """Seed directly from a flight-recorder decision log: extract the
+    (resolved) planner state of ``round_index`` (default: the last
+    recorded plan) and build its problem. Returns ``(problem,
+    job_keys, s0, round)``."""
+    from shockwave_tpu.obs.recorder import extract_state
+
+    extracted = extract_state(path, round_index=round_index)
+    problem, keys, s0 = base_problem_from_state(
+        extracted["planner_state"]
+    )
+    return problem, keys, s0, int(extracted["round"])

@@ -1,0 +1,585 @@
+"""What-if fleet: scenario-lane bit parity, overlay correctness,
+marginal-price admission, and the CLI/export-state forensics chain."""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shockwave_tpu import obs
+from shockwave_tpu.core.job import Job
+from shockwave_tpu.solver.eg_pdhg import solve_pdhg_relaxed
+from shockwave_tpu.solver.eg_problem import EGProblem
+from shockwave_tpu.whatif import (
+    AdmissionPricer,
+    Scenario,
+    ScenarioBatch,
+    audit_lanes,
+    base_problem_from_log,
+    base_problem_from_state,
+    burst_problem,
+    scenario_report,
+    solve_scenario,
+    solve_scenarios,
+)
+from shockwave_tpu.whatif.pricing import PricingDecision
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT_LOG = os.path.join(
+    REPO_ROOT, "results", "flight_recorder", "decisions.jsonl"
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_obs():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def make_problem(num_jobs=10, num_gpus=4, seed=0, future_rounds=8):
+    rng = np.random.default_rng(seed)
+    total = rng.integers(5, 40, num_jobs).astype(float)
+    completed = np.floor(total * rng.uniform(0, 0.8, num_jobs))
+    epoch_dur = rng.uniform(60, 900, num_jobs)
+    incumbent = (rng.random(num_jobs) < 0.3).astype(np.float64)
+    return EGProblem(
+        priorities=rng.uniform(0.5, 10.0, num_jobs),
+        completed_epochs=completed,
+        total_epochs=total,
+        epoch_duration=epoch_dur,
+        remaining_runtime=(total - completed) * epoch_dur,
+        nworkers=rng.choice([1, 1, 2], num_jobs).astype(float),
+        num_gpus=num_gpus,
+        round_duration=120.0,
+        future_rounds=future_rounds,
+        regularizer=10.0,
+        log_bases=np.array([0.0, 0.2, 0.4, 0.6, 0.8, 1.0]),
+        switch_cost=rng.uniform(20.0, 60.0, num_jobs) * incumbent,
+        incumbent=incumbent,
+    )
+
+
+def mixed_grid(problem, n=7):
+    rng = np.random.default_rng(1)
+    grid = [Scenario(name="baseline")]
+    for i in range(n - 1):
+        mask = None
+        if i % 3 == 2:
+            mask = (rng.random(problem.num_jobs) < 0.7).astype(float)
+        grid.append(
+            Scenario(
+                name=f"s{i}",
+                num_gpus=float(2 + (i % 6)),
+                priority_scale=0.5 + (i % 4) * 0.5,
+                switch_cost_scale=float(i % 3),
+                round_duration=60.0 * (1 + i % 3),
+                job_mask=mask,
+            )
+        )
+    return grid
+
+
+# ----------------------------------------------------------------------
+# Lane bit-parity: the acceptance contract.
+# ----------------------------------------------------------------------
+class TestLaneParity:
+    def test_identity_lane_bit_identical_to_solve_pdhg_relaxed(self):
+        problem = make_problem()
+        batch = ScenarioBatch(problem, [Scenario(name="baseline")])
+        s_list, objs, diags = solve_scenarios(batch)
+        s0 = np.asarray(batch.base_args[8])[: problem.num_jobs]
+        s_ref, obj_ref, _ = solve_pdhg_relaxed(problem, s0=s0)
+        assert np.array_equal(
+            np.float32(s_list[0]), np.float32(s_ref)
+        ), "identity lane diverged from the standalone pdhg solve"
+        assert objs[0] == pytest.approx(obj_ref, abs=0.0)
+
+    def test_every_mixed_grid_lane_bit_identical_to_standalone(self):
+        problem = make_problem()
+        batch = ScenarioBatch(problem, mixed_grid(problem))
+        s_list, _, diags = solve_scenarios(batch)
+        audit = audit_lanes(batch, s_list)
+        assert audit["bit_identical"], audit
+        assert all(d["converged"] for d in diags)
+
+    def test_capacity_overlay_matches_standalone_problem(self):
+        """A fleet-size lane is bit-identical to solving a problem
+        BUILT with that capacity (the overlay is a pass-through
+        value, not an approximation)."""
+        import dataclasses
+
+        problem = make_problem()
+        batch = ScenarioBatch(
+            problem,
+            [Scenario(name="baseline"), Scenario(name="cap9", num_gpus=9)],
+        )
+        s_list, objs, _ = solve_scenarios(batch)
+        s0 = np.asarray(batch.base_args[8])[: problem.num_jobs]
+        bigger = dataclasses.replace(problem, num_gpus=9)
+        s_ref, obj_ref, _ = solve_pdhg_relaxed(bigger, s0=s0)
+        assert np.array_equal(np.float32(s_list[1]), np.float32(s_ref))
+        assert objs[1] == pytest.approx(obj_ref, abs=0.0)
+
+    def test_sharded_scenario_axis_matches_single_device(self):
+        """shard_map over the scenario axis (8 virtual devices) returns
+        the same lanes as the single-device vmap — scenarios are
+        independent, so sharding is a pure split."""
+        import jax
+        from jax.sharding import Mesh
+
+        problem = make_problem()
+        grid = mixed_grid(problem, n=8)
+        batch = ScenarioBatch(problem, grid)
+        s_single, obj_single, _ = solve_scenarios(batch)
+        mesh = Mesh(np.array(jax.devices()), ("scenarios",))
+        s_mesh, obj_mesh, _ = solve_scenarios(batch, mesh=mesh)
+        for a, b in zip(s_single, s_mesh):
+            np.testing.assert_allclose(a, b, rtol=0, atol=0)
+        assert obj_single == obj_mesh
+
+
+# ----------------------------------------------------------------------
+# Overlay correctness: perturbations land in the right lane.
+# ----------------------------------------------------------------------
+class TestOverlays:
+    def test_perturbing_one_lane_leaves_others_untouched(self):
+        problem = make_problem()
+        a = ScenarioBatch(
+            problem,
+            [
+                Scenario(name="baseline"),
+                Scenario(name="p2", priority_scale=2.0),
+            ],
+        )
+        b = ScenarioBatch(
+            problem,
+            [
+                Scenario(name="baseline"),
+                Scenario(name="p4", priority_scale=4.0),
+            ],
+        )
+        s_a, obj_a, _ = solve_scenarios(a)
+        s_b, obj_b, _ = solve_scenarios(b)
+        assert np.array_equal(s_a[0], s_b[0]), (
+            "editing lane 1's overlay changed lane 0"
+        )
+        assert obj_a[0] == obj_b[0]
+
+    def test_scenario_order_permutes_lanes(self):
+        problem = make_problem()
+        scs = [
+            Scenario(name="baseline"),
+            Scenario(name="cap2", num_gpus=2.0),
+            Scenario(name="half_switch", switch_cost_scale=0.5),
+        ]
+        fwd = solve_scenarios(ScenarioBatch(problem, scs))[0]
+        rev = solve_scenarios(ScenarioBatch(problem, scs[::-1]))[0]
+        for i in range(3):
+            assert np.array_equal(fwd[i], rev[2 - i])
+
+    def test_job_mask_prices_the_market_without_the_job(self):
+        """A masked-out job gets no grant, counts for nothing, and the
+        remaining jobs' market matches solving the sub-problem with
+        the job truly absent (same decisions, same objective to f32
+        accumulation noise)."""
+        import dataclasses
+
+        problem = make_problem(num_jobs=8)
+        mask = np.ones(8)
+        mask[[2, 5]] = 0.0
+        batch = ScenarioBatch(
+            problem, [Scenario(name="without", job_mask=mask)]
+        )
+        s_list, objs, _ = solve_scenarios(batch)
+        assert np.all(s_list[0][[2, 5]] == 0.0)
+        keep = mask > 0
+        sub = dataclasses.replace(
+            problem,
+            **{
+                f: np.asarray(getattr(problem, f))[keep]
+                for f in (
+                    "priorities", "completed_epochs", "total_epochs",
+                    "epoch_duration", "remaining_runtime", "nworkers",
+                    "switch_cost", "incumbent",
+                )
+            },
+        )
+        s_sub, obj_sub, _ = solve_pdhg_relaxed(sub)
+        assert objs[0] == pytest.approx(obj_sub, rel=1e-3)
+        assert np.array_equal(
+            s_list[0][keep] >= 0.5, np.asarray(s_sub) >= 0.5
+        )
+
+    def test_chunk_lanes_normalized_to_power_of_two(self):
+        """A non-divisor chunk size is floored to a power of two so
+        chunks tile the lane band exactly; results match the default
+        chunking bit-for-bit."""
+        problem = make_problem()
+        batch = ScenarioBatch(problem, mixed_grid(problem, n=8))
+        s_auto, obj_auto, _ = solve_scenarios(batch)
+        s_odd, obj_odd, _ = solve_scenarios(batch, chunk_lanes=3)
+        for a, b in zip(s_auto, s_odd):
+            assert np.array_equal(a, b)
+        assert obj_auto == obj_odd
+
+    def test_lane_banding_pads_to_power_of_two(self):
+        problem = make_problem()
+        assert ScenarioBatch(problem, [Scenario()] * 3).lanes == 4
+        assert ScenarioBatch(problem, [Scenario()] * 5).lanes == 8
+        assert ScenarioBatch(problem, [Scenario()] * 8).lanes == 8
+
+    def test_report_rows_carry_deltas(self):
+        problem = make_problem()
+        scs = [Scenario(name="baseline"), Scenario(name="cap12", num_gpus=12)]
+        s_list, objs, diags = solve_scenarios(ScenarioBatch(problem, scs))
+        rows = scenario_report(problem, scs, s_list, objs, diags)
+        assert rows[0]["nash_welfare_delta"] == 0.0
+        assert rows[1]["capacity"] == 12
+        # More chips can only help welfare at fixed demand.
+        assert rows[1]["nash_welfare_delta"] >= -1e-9
+
+
+# ----------------------------------------------------------------------
+# Seeding from recorded state.
+# ----------------------------------------------------------------------
+class TestSeeding:
+    def test_seed_from_committed_log(self):
+        problem, keys, _s0, rnd = base_problem_from_log(ARTIFACT_LOG)
+        assert problem.num_jobs == len(keys) > 0
+        assert rnd >= 0
+        s_list, _, diags = solve_scenarios(
+            ScenarioBatch(problem, [Scenario(name="baseline")])
+        )
+        assert diags[0]["converged"]
+
+    def test_export_state_roundtrip_matches_direct_seed(self, tmp_path):
+        from shockwave_tpu.obs import recorder as rec
+
+        out = str(tmp_path / "state.json")
+        rec.export_state(ARTIFACT_LOG, out)
+        envelope = rec.load_exported_state(out)
+        p_direct, k_direct, _, rnd = base_problem_from_log(ARTIFACT_LOG)
+        p_loaded, k_loaded, _ = base_problem_from_state(
+            envelope["planner_state"]
+        )
+        assert envelope["round"] == rnd
+        assert k_loaded == k_direct
+        for field in (
+            "priorities", "completed_epochs", "remaining_runtime",
+            "nworkers",
+        ):
+            np.testing.assert_allclose(
+                getattr(p_loaded, field), getattr(p_direct, field)
+            )
+
+    def test_export_state_cli_subcommand(self, tmp_path):
+        from shockwave_tpu.obs import recorder as rec
+
+        out = str(tmp_path / "state.json")
+        assert (
+            rec.main(["export-state", ARTIFACT_LOG, "--out", out]) == 0
+        )
+        assert rec.load_exported_state(out)["event"] == "planner_state"
+
+    def test_extract_state_unknown_round_lists_rounds(self):
+        from shockwave_tpu.obs import recorder as rec
+
+        with pytest.raises(ValueError, match="recorded rounds"):
+            rec.extract_state(ARTIFACT_LOG, round_index=10**9)
+
+
+# ----------------------------------------------------------------------
+# Marginal-price admission.
+# ----------------------------------------------------------------------
+def _burst(n=4, scale=2, duration=4000.0, tenant="t"):
+    return [
+        Job(
+            job_type="ResNet-18 (batch size 32)",
+            command="x",
+            total_steps=100,
+            scale_factor=scale,
+            mode="static",
+            duration=duration,
+            tenant=tenant,
+        )
+        for _ in range(n)
+    ]
+
+
+def _prebuilt_provider(problem):
+    holder = {
+        "problem": problem,
+        "keys": [str(i) for i in range(problem.num_jobs)],
+        "s0": None,
+    }
+    return lambda: holder
+
+
+def contended_problem(num_jobs=6, num_gpus=2):
+    """Every incumbent wants the whole planning window on a saturated
+    fleet — any admitted burst must take grants (and welfare) from
+    them."""
+    total = np.full(num_jobs, 20.0)
+    return EGProblem(
+        priorities=np.ones(num_jobs),
+        completed_epochs=np.full(num_jobs, 2.0),
+        total_epochs=total,
+        epoch_duration=np.full(num_jobs, 60.0),
+        remaining_runtime=np.full(num_jobs, 18 * 60.0),
+        nworkers=np.ones(num_jobs),
+        num_gpus=num_gpus,
+        round_duration=120.0,
+        future_rounds=8,
+        regularizer=1e-3,
+        log_bases=np.array([0.0, 0.2, 0.4, 0.6, 0.8, 1.0]),
+        switch_cost=np.zeros(num_jobs),
+        incumbent=np.ones(num_jobs),
+    )
+
+
+class TestPricing:
+    def test_threshold_flips_accept_reject(self):
+        problem = contended_problem(num_jobs=6, num_gpus=2)
+        provider = _prebuilt_provider(problem)
+        heavy = _burst(n=6, scale=2)
+        strict = AdmissionPricer(provider, threshold=0.0, budget_s=60.0)
+        lenient = AdmissionPricer(
+            provider, threshold=float("inf"), budget_s=60.0
+        )
+        d_strict = strict.price(heavy)
+        d_lenient = lenient.price(heavy)
+        assert d_strict.action == "reject"
+        assert d_strict.reason == "negative_externality"
+        assert d_strict.welfare_delta < 0
+        assert d_lenient.action == "accept"
+        # Same 2-scenario solve, same externality, different verdicts.
+        assert d_lenient.welfare_delta == pytest.approx(
+            d_strict.welfare_delta
+        )
+
+    def test_budget_overrun_falls_back(self):
+        problem = contended_problem(num_jobs=6, num_gpus=2)
+        pricer = AdmissionPricer(
+            _prebuilt_provider(problem), threshold=0.0, budget_s=0.0
+        )
+        decision = pricer.price(_burst())
+        assert decision.action == "fallback"
+        assert decision.reason == "budget_exceeded"
+
+    def test_no_planner_state_falls_back(self):
+        pricer = AdmissionPricer(lambda: None)
+        decision = pricer.price(_burst())
+        assert decision.action == "fallback"
+        assert decision.reason == "no_planner_state"
+
+    def test_circuit_breaker_stops_solving_after_overruns(self):
+        """Consecutive budget overruns open the circuit: the pricer
+        abstains WITHOUT consulting the provider (no solve paid),
+        re-probing periodically."""
+        from shockwave_tpu.whatif.pricing import (
+            _CIRCUIT_OPEN_AFTER,
+            _CIRCUIT_PROBE_EVERY,
+        )
+
+        problem = contended_problem(num_jobs=6, num_gpus=2)
+        holder = {"problem": problem, "s0": None}
+        calls = {"n": 0}
+
+        def provider():
+            calls["n"] += 1
+            return holder
+
+        pricer = AdmissionPricer(provider, threshold=0.0, budget_s=0.0)
+        for _ in range(_CIRCUIT_OPEN_AFTER):
+            assert pricer.price(_burst()).reason == "budget_exceeded"
+        solves_before_open = calls["n"]
+        decisions = [
+            pricer.price(_burst()) for _ in range(_CIRCUIT_PROBE_EVERY)
+        ]
+        assert all(d.action == "fallback" for d in decisions)
+        assert any(d.reason == "circuit_open" for d in decisions)
+        # Only the periodic probe paid a real solve while open.
+        assert calls["n"] - solves_before_open <= 1
+
+    def test_provider_error_falls_back(self):
+        def boom():
+            raise RuntimeError("planner exploded")
+
+        decision = AdmissionPricer(boom).price(_burst())
+        assert decision.action == "fallback"
+        assert decision.reason == "error:RuntimeError"
+
+    def test_burst_problem_rows(self):
+        problem = make_problem(num_jobs=5)
+        jobs = _burst(n=3, scale=2, duration=problem.round_duration * 4)
+        augmented = burst_problem(problem, jobs)
+        assert augmented.num_jobs == 8
+        np.testing.assert_allclose(
+            augmented.remaining_runtime[5:], problem.round_duration * 4
+        )
+        assert np.all(augmented.incumbent[5:] == 0)
+        assert np.all(augmented.nworkers[5:] == 2)
+        # Base rows untouched.
+        np.testing.assert_allclose(
+            augmented.priorities[:5], problem.priorities
+        )
+
+
+class _StubPricer:
+    def __init__(self, action):
+        self.action = action
+        self.calls = 0
+
+    def price(self, jobs):
+        self.calls += 1
+        return PricingDecision(
+            action=self.action, reason="stub", welfare_delta=-1.0
+        )
+
+
+class TestQueueIntegration:
+    def _queue(self, pricer):
+        from shockwave_tpu.runtime.admission import AdmissionQueue
+
+        return AdmissionQueue(capacity=64, pricer=pricer)
+
+    def test_priced_reject_sheds_batch(self):
+        from shockwave_tpu.runtime.admission import STATUS_PRICED
+
+        pricer = _StubPricer("reject")
+        queue = self._queue(pricer)
+        status, retry, admitted = queue.submit("tok-1", _burst(2))
+        assert status == STATUS_PRICED
+        assert admitted == 0
+        assert queue.depth() == 0
+        assert queue.stats["priced_rejects"] == 1
+        assert pricer.calls == 1
+
+    def test_priced_accept_and_fallback_take_normal_path(self):
+        from shockwave_tpu.runtime.admission import STATUS_ACCEPTED
+
+        for action, stat in (
+            ("accept", "priced_accepts"),
+            ("fallback", "priced_fallbacks"),
+        ):
+            queue = self._queue(_StubPricer(action))
+            status, _, admitted = queue.submit("tok-1", _burst(2))
+            assert status == STATUS_ACCEPTED
+            assert admitted == 2
+            assert queue.stats[stat] == 1
+
+    def test_backpressure_retry_is_not_repriced(self):
+        """A batch bounced by backpressure retries the SAME token; the
+        queue reuses the pricing verdict instead of paying another
+        2-scenario solve per retry."""
+        from shockwave_tpu.runtime.admission import (
+            STATUS_ACCEPTED,
+            STATUS_RETRY_AFTER,
+            AdmissionQueue,
+        )
+
+        pricer = _StubPricer("accept")
+        queue = AdmissionQueue(capacity=4, pricer=pricer)
+        assert queue.submit("tok-a", _burst(4))[0] == STATUS_ACCEPTED
+        status, _, _ = queue.submit("tok-b", _burst(3))
+        assert status == STATUS_RETRY_AFTER
+        queue.drain()
+        status, _, admitted = queue.submit("tok-b", _burst(3))
+        assert status == STATUS_ACCEPTED and admitted == 3
+        assert pricer.calls == 2, (
+            "the bounced token must be priced once, not per retry"
+        )
+
+    def test_retried_token_is_not_repriced(self):
+        from shockwave_tpu.runtime.admission import STATUS_ACCEPTED
+
+        pricer = _StubPricer("accept")
+        queue = self._queue(pricer)
+        queue.submit("tok-1", _burst(2))
+        status, _, admitted = queue.submit("tok-1", _burst(2))
+        assert status == STATUS_ACCEPTED
+        assert admitted == 2
+        assert queue.stats["deduped_batches"] == 1
+        assert pricer.calls == 1, "a resolved token must not re-price"
+
+    def test_streaming_submitter_sheds_priced_batches(self):
+        from shockwave_tpu.runtime.admission import StreamingSubmitter
+
+        pricer = _StubPricer("reject")
+        queue = self._queue(pricer)
+        jobs = _burst(4, tenant="t0")
+        submitter = StreamingSubmitter(
+            [0.0, 0.0, 10.0, 10.0], jobs, batch_size=2
+        )
+        out = submitter.pump(queue, now=100.0)
+        assert out == []
+        assert submitter.exhausted()
+        assert submitter.stats["priced_rejects"] == 2
+        assert queue.closed
+
+    def test_sharded_queue_threads_pricer(self):
+        from shockwave_tpu.runtime.admission import (
+            STATUS_PRICED,
+            build_queue,
+        )
+
+        queue = build_queue(
+            capacity=64,
+            retry_delay_s=1.0,
+            shards=2,
+            pricer=_StubPricer("reject"),
+        )
+        status, _, _ = queue.submit("tok-1", _burst(2))
+        assert status == STATUS_PRICED
+        assert queue.summary()["priced_rejects"] == 1
+
+
+# ----------------------------------------------------------------------
+# CLI.
+# ----------------------------------------------------------------------
+def _load_cli():
+    spec = importlib.util.spec_from_file_location(
+        "whatif_cli",
+        os.path.join(REPO_ROOT, "scripts", "analysis", "whatif.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestCLI:
+    def test_sweep_on_committed_log(self, tmp_path):
+        cli = _load_cli()
+        out = str(tmp_path / "sweep.json")
+        rc = cli.main(
+            [
+                "sweep", "--log", ARTIFACT_LOG,
+                "--capacity", "1,2,4", "--out", out,
+            ]
+        )
+        assert rc == 0
+        report = json.load(open(out))
+        assert report["audit"]["bit_identical"]
+        assert report["timing"]["scenarios"] == 4
+        assert len(report["scenarios"]) == 4
+        assert report["scenarios"][0]["name"] == "baseline"
+
+    def test_price_on_committed_log(self, tmp_path):
+        cli = _load_cli()
+        out = str(tmp_path / "price.json")
+        rc = cli.main(
+            [
+                "price", "--log", ARTIFACT_LOG,
+                "--burst-jobs", "4", "--burst-scale", "2",
+                "--burst-duration", "4000", "--out", out,
+            ]
+        )
+        assert rc == 0
+        report = json.load(open(out))
+        assert report["priced_decision"]["action"] in (
+            "accept", "reject"
+        )
+        assert report["quota_only_decision"] == "accept"
