@@ -1,0 +1,133 @@
+#!/usr/bin/env python3
+"""Fuse per-process Chrome trace dumps into one Perfetto-loadable
+fleet trace aligned to scheduler time.
+
+Each process of a physical run exports its own timeline on its own
+clock (scheduler: ``--trace-out``; worker agents: the
+``SHOCKWAVE_TRACE_OUT`` env contract). This tool shifts every file
+onto the scheduler's clock using the ``otherData.clock`` anchor each
+export carries (wall time at trace zero + the NTP-style offset the
+register/heartbeat exchange estimated), remaps pid/tid ranges so
+tracks never collide, synthesizes Chrome flow arrows for every
+cross-process causal edge (:mod:`shockwave_tpu.obs.propagate`
+contexts), and reports per-job chain connectivity plus the
+critical-path latency budget.
+
+Usage:
+  python scripts/analysis/merge_traces.py sched_trace.json \
+      worker_trace_0.json worker_trace_1.json -o merged.json \
+      [--breakdown breakdown.json] [--require-connected]
+
+Exit codes: 0 ok; 1 --require-connected failed (no sampled job chain
+spans 2+ processes as one connected tree); 2 unreadable input.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+from shockwave_tpu.obs import spantree  # noqa: E402
+
+
+def _fail(message: str) -> None:
+    print(f"error: {message}", file=sys.stderr)
+    raise SystemExit(2)
+
+
+def load_trace(path: str) -> dict:
+    if not os.path.exists(path):
+        _fail(f"trace file not found: {path}")
+    try:
+        with open(path) as f:
+            trace = json.load(f)
+    except json.JSONDecodeError as e:
+        _fail(f"trace file {path} is not valid JSON (truncated?): {e}")
+    except OSError as e:
+        _fail(f"cannot read trace file {path}: {e}")
+    if not isinstance(trace.get("traceEvents"), list):
+        _fail(f"trace file {path}: no traceEvents list")
+    return trace
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "traces", nargs="+",
+        help="per-process trace dumps (the scheduler's file is "
+        "auto-detected by its otherData.role and becomes the clock "
+        "reference)",
+    )
+    parser.add_argument(
+        "-o", "--output", required=True,
+        help="write the merged Perfetto-loadable trace here",
+    )
+    parser.add_argument(
+        "--breakdown", default=None,
+        help="also write per-job chain connectivity + latency-budget "
+        "JSON here",
+    )
+    parser.add_argument(
+        "--require-connected", action="store_true",
+        help="exit 1 unless at least one job chain spans 2+ processes "
+        "as a single connected causal tree (the obs CI gate's bar)",
+    )
+    args = parser.parse_args(argv)
+
+    traces = [load_trace(path) for path in args.traces]
+    merged = spantree.merge_traces(traces)
+    from shockwave_tpu.utils.fileio import atomic_write_text
+
+    atomic_write_text(args.output, json.dumps(merged))
+
+    events = merged["traceEvents"]
+    chains = spantree.collect_chains(events)
+    summaries = {
+        trace_id: spantree.chain_summary(chain)
+        for trace_id, chain in chains.items()
+    }
+    budgets = spantree.latency_budget(events)
+    connected_multi = [
+        t for t, s in summaries.items()
+        if s["connected"] and s["processes"] >= 2
+    ]
+    report = {
+        "output": args.output,
+        "sources": merged["otherData"]["sources"],
+        "events": len(events),
+        "flow_edges": merged["otherData"]["flow_edges"],
+        "chains": len(summaries),
+        "connected_chains": sum(
+            1 for s in summaries.values() if s["connected"]
+        ),
+        "cross_process_connected_chains": len(connected_multi),
+        "latency_budget": budgets,
+        "latency_budget_fleet": spantree.budget_fleet_summary(budgets),
+        "chain_summaries": summaries,
+    }
+    if args.breakdown:
+        atomic_write_text(args.breakdown, json.dumps(report, indent=1))
+        print(f"Wrote {args.breakdown}")
+    print(
+        f"Wrote {args.output}: {len(events)} events from "
+        f"{len(traces)} processes, {len(summaries)} causal chains "
+        f"({len(connected_multi)} connected across 2+ processes, "
+        f"{report['flow_edges']} flow arrows) — load in "
+        "https://ui.perfetto.dev"
+    )
+    if args.require_connected and not connected_multi:
+        print(
+            "error: no sampled job chain spans 2+ processes as a "
+            "connected tree", file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -27,7 +27,10 @@ sys.path.insert(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
 )
 
-from shockwave_tpu.obs.metrics import SCHEMA  # noqa: E402
+from shockwave_tpu.obs.metrics import (  # noqa: E402
+    SCHEMA,
+    quantile_from_buckets,
+)
 
 
 def _fail(message: str) -> None:
@@ -179,8 +182,19 @@ def calibration_rows(m: Metrics):
     ]
 
 
+def _series_p99(series):
+    """p99 from a snapshot series' cumulative buckets (the shared
+    obs.metrics.quantile_from_buckets math; None pre-PR-4 dumps had no
+    buckets)."""
+    value, _ = quantile_from_buckets(
+        series.get("buckets") or {}, 0.99, series.get("max")
+    )
+    return value
+
+
 def histogram_rows(m: Metrics, name, label_keys):
-    """One row per label series: labels..., count, total, mean, min, max."""
+    """One row per label series: labels..., count, total, mean, p99,
+    min, max."""
     rows = []
     for series in sorted(
         m.series(name), key=lambda s: tuple(sorted(s["labels"].items()))
@@ -189,7 +203,8 @@ def histogram_rows(m: Metrics, name, label_keys):
         mean = series["sum"] / count if count else None
         rows.append(
             tuple(series["labels"].get(k, "—") for k in label_keys)
-            + (count, series["sum"], mean, series["min"], series["max"])
+            + (count, series["sum"], mean, _series_p99(series),
+               series["min"], series["max"])
         )
     return rows
 
@@ -208,6 +223,7 @@ def histogram_summary_rows(m: Metrics, names):
                     count,
                     series["sum"],
                     series["sum"] / count if count else None,
+                    _series_p99(series),
                     series["min"],
                     series["max"],
                 )
@@ -325,8 +341,8 @@ def build_report(metrics_path, trace_path=None):
         out += ["", "## Plan solves (per backend)", ""]
         out.append(
             _table(
-                ["backend", "ok", "solves", "total s", "mean s", "min s",
-                 "max s"],
+                ["backend", "ok", "solves", "total s", "mean s",
+                 "p99 s", "min s", "max s"],
                 solver,
             )
         )
@@ -335,7 +351,8 @@ def build_report(metrics_path, trace_path=None):
         out += ["", "## Planning phases", ""]
         out.append(
             _table(
-                ["phase", "calls", "total s", "mean s", "min s", "max s"],
+                ["phase", "calls", "total s", "mean s", "p99 s",
+                 "min s", "max s"],
                 phases,
             )
         )
@@ -346,8 +363,8 @@ def build_report(metrics_path, trace_path=None):
         out += ["", "## Solver backend phases (device vs host)", ""]
         out.append(
             _table(
-                ["backend", "phase", "calls", "total s", "mean s", "min s",
-                 "max s"],
+                ["backend", "phase", "calls", "total s", "mean s",
+                 "p99 s", "min s", "max s"],
                 backend_phases,
             )
         )
@@ -359,7 +376,8 @@ def build_report(metrics_path, trace_path=None):
         out += ["", "## RPC latency", ""]
         out.append(
             _table(
-                ["method", "calls", "total s", "mean s", "min s", "max s"],
+                ["method", "calls", "total s", "mean s", "p99 s",
+                 "min s", "max s"],
                 rpc,
             )
         )
@@ -378,7 +396,8 @@ def build_report(metrics_path, trace_path=None):
         out += ["", "## Distributions", ""]
         out.append(
             _table(
-                ["series", "count", "total", "mean", "min", "max"],
+                ["series", "count", "total", "mean", "p99", "min",
+                 "max"],
                 runtime,
             )
         )
@@ -408,7 +427,61 @@ def build_report(metrics_path, trace_path=None):
             out += ["", trace_sections(trace)]
         except ValueError as e:
             _fail(f"trace file {trace_path}: {e}")
+        budgets = trace_latency_budgets(trace)
+        if budgets:
+            from shockwave_tpu.obs.spantree import budget_fleet_summary
+
+            fleet = budget_fleet_summary(budgets)
+            out += ["", "## Per-job latency budget (from the causal "
+                    "span tree)", ""]
+            out.append(
+                "Critical-path breakdown per sampled job "
+                "(obs/propagate.py contexts; merged fleet traces get "
+                "true worker run spans, a scheduler-only trace "
+                "approximates run as dispatch-to-completion). Fleet "
+                f"means over {fleet['jobs']} jobs: "
+                f"queue-wait {_fmt(fleet['mean_queue_wait_s'])} s, "
+                f"plan-exposed {_fmt(fleet['mean_plan_exposed_s'])} s, "
+                f"dispatch {_fmt(fleet['mean_dispatch_s'])} s, "
+                f"run {_fmt(fleet['mean_run_s'])} s, "
+                f"sync {_fmt(fleet['mean_sync_s'])} s."
+            )
+            out.append("")
+
+            def job_sort_key(j):
+                return (0, int(j)) if j.isdigit() else (1, j)
+
+            out.append(
+                _table(
+                    ["job", "queue-wait s", "plan-exposed s",
+                     "dispatch s", "run s", "sync s", "total s"],
+                    [
+                        (
+                            job,
+                            budgets[job]["queue_wait_s"],
+                            budgets[job]["plan_exposed_s"],
+                            budgets[job]["dispatch_s"],
+                            budgets[job]["run_s"],
+                            budgets[job]["sync_s"],
+                            budgets[job]["total_s"],
+                        )
+                        for job in sorted(budgets, key=job_sort_key)
+                    ],
+                )
+            )
     return "\n".join(out) + "\n"
+
+
+def trace_latency_budgets(trace: dict):
+    """Per-job latency budgets from a trace dump's causally-stamped
+    events ({} when the trace carries no contexts — tracing was on but
+    sampling off, or a pre-fleet dump)."""
+    from shockwave_tpu.obs.spantree import latency_budget
+
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        return {}
+    return latency_budget(events)
 
 
 def build_json(metrics_path, trace_path=None) -> dict:
@@ -426,7 +499,7 @@ def build_json(metrics_path, trace_path=None) -> dict:
             dict(
                 zip(
                     ("backend", "ok", "count", "total_s", "mean_s",
-                     "min_s", "max_s"),
+                     "p99_s", "min_s", "max_s"),
                     row,
                 )
             )
@@ -437,8 +510,8 @@ def build_json(metrics_path, trace_path=None) -> dict:
         "plan_phases": [
             dict(
                 zip(
-                    ("phase", "count", "total_s", "mean_s", "min_s",
-                     "max_s"),
+                    ("phase", "count", "total_s", "mean_s", "p99_s",
+                     "min_s", "max_s"),
                     row,
                 )
             )
@@ -468,6 +541,9 @@ def build_json(metrics_path, trace_path=None) -> dict:
         events = trace.get("traceEvents")
         if not isinstance(events, list):
             _fail(f"trace file {trace_path}: no traceEvents list")
+        from shockwave_tpu.obs.spantree import budget_fleet_summary
+
+        budgets = trace_latency_budgets(trace)
         data["trace"] = {
             "events": len(events),
             "health_events": [
@@ -475,6 +551,8 @@ def build_json(metrics_path, trace_path=None) -> dict:
                 for e in events
                 if e.get("name") == "health" and e.get("ph") == "i"
             ],
+            "latency_budget": budgets,
+            "latency_budget_fleet": budget_fleet_summary(budgets),
         }
     return data
 

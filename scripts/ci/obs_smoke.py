@@ -1,0 +1,341 @@
+#!/usr/bin/env python3
+"""CI smoke gate for fleet-wide observability: causal trace
+propagation, clock-aligned trace merging, and the live scrape plane.
+
+Two phases, ~1 min total on CPU:
+
+1. **Disabled parity** — the same sim run with every obs plane off and
+   with metrics+tracing on must produce IDENTICAL makespans and
+   per-job completion times (observability changes no decision).
+2. **Live 2-agent cluster** — a real PhysicalScheduler with two worker
+   AGENT SUBPROCESSES (separate processes, separate trace clocks),
+   jobs submitted through the SubmitJobs front door, scrape endpoint
+   on an ephemeral port. Asserts:
+
+   * ``/metrics`` serves fleet-merged series: scheduler series plus
+     worker-registry series carrying ``worker="<id>"`` labels, and the
+     per-worker ``worker_clock_offset_seconds`` gauges;
+   * ``/healthz`` answers 200 with a JSON body;
+   * every worker agent's trace/metrics exports landed (the SIGTERM
+     flush path shares this export code);
+   * ``merge_traces`` fuses the three per-process traces into a valid
+     Perfetto trace in which at least one sampled job's
+     submit -> admit -> dispatch -> run -> done chain is ONE connected
+     causal tree spanning 2+ processes, with clock-aligned timestamps;
+   * the per-job latency budget (queue-wait / plan-exposed / dispatch /
+     run / sync) is derivable for every completed job.
+
+Writes ``results/fleet_trace/``: the merged Perfetto trace, the
+captured scrape output, the healthz body, the chain/budget breakdown,
+and ``obs_smoke.json`` (the gate verdict). Exits non-zero on any
+violated invariant. Wired into the verify skill next to the other
+smokes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+OUT = os.path.join(REPO, "results", "fleet_trace")
+
+
+def parity_phase(failures):
+    """Sim twice — obs fully off vs metrics+trace on — and compare."""
+    from shockwave_tpu import obs
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.data.generate import smoke_trace_jobs
+    from shockwave_tpu.data.profiles import synthesize_profiles
+    from shockwave_tpu.policies import get_policy
+
+    def run(enable_obs):
+        obs.reset()
+        if enable_obs:
+            obs.configure(metrics=True, trace=True)
+        oracle = generate_oracle()
+        jobs, arrivals = smoke_trace_jobs(6, epochs=1, arrival_gap_s=60.0)
+        profiles = synthesize_profiles(jobs, oracle)
+        sched = Scheduler(
+            get_policy("shockwave_tpu"),
+            throughputs=oracle,
+            seed=0,
+            time_per_iteration=120,
+            profiles=profiles,
+            shockwave_config={
+                "num_gpus": 4,
+                "time_per_iteration": 120,
+                "future_rounds": 6,
+                "lambda": 2.0,
+                "k": 1e-3,
+                "solver_rel_gap": 1e-3,
+                "solver_timeout": 15,
+            },
+        )
+        makespan = sched.simulate({"v100": 4}, arrivals, jobs)
+        completions = {
+            str(j): t for j, t in sched._job_completion_times.items()
+        }
+        obs.reset()
+        return makespan, completions
+
+    makespan_off, completions_off = run(False)
+    makespan_on, completions_on = run(True)
+    if makespan_off != makespan_on or completions_off != completions_on:
+        failures.append(
+            "disabled parity broken: obs-on sim diverged from obs-off "
+            f"(makespan {makespan_on} vs {makespan_off})"
+        )
+    return {
+        "makespan_s": makespan_off,
+        "jobs": len(completions_off),
+        "bit_identical": (
+            makespan_off == makespan_on and completions_off == completions_on
+        ),
+    }
+
+
+def _http_get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+def live_phase(failures):
+    from shockwave_tpu import obs
+    from shockwave_tpu.core.physical import PhysicalScheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.obs import spantree
+    from shockwave_tpu.policies import get_policy
+    from shockwave_tpu.runtime.rpc.submitter_client import SubmitterClient
+    from shockwave_tpu.runtime.testing import make_synthetic_job
+    from shockwave_tpu.utils.fileio import atomic_write_text
+    from shockwave_tpu.utils.hostenv import free_port
+
+    obs.reset()
+    obs.configure(metrics=True, trace=True)
+    os.environ["SHOCKWAVE_FLEET_SCRAPE_S"] = "0.5"
+
+    import tempfile
+
+    # Job logs/checkpoints are scratch, not artifacts: keep them out of
+    # the committed results/fleet_trace/ directory.
+    run_dir = tempfile.mkdtemp(prefix="obs_smoke_")
+    sched_port = free_port()
+    sched = PhysicalScheduler(
+        get_policy("fifo"),
+        port=sched_port,
+        throughputs=generate_oracle(),
+        time_per_iteration=3.0,
+        completion_buffer_seconds=8.0,
+        minimum_time_between_allocation_resets=0.0,
+        metrics_port=0,
+    )
+    workers = []
+    worker_exports = []
+    try:
+        for i in range(2):
+            env = dict(os.environ)
+            metrics_path = os.path.join(OUT, f"worker{i}_metrics.json")
+            trace_path = os.path.join(OUT, f"worker{i}_trace.json")
+            for stale in (metrics_path, trace_path):
+                if os.path.exists(stale):
+                    os.remove(stale)
+            worker_exports.append((metrics_path, trace_path))
+            env.update(
+                {
+                    "SHOCKWAVE_METRICS_OUT": metrics_path,
+                    "SHOCKWAVE_TRACE_OUT": trace_path,
+                    "SHOCKWAVE_HEARTBEAT_S": "0.3",
+                    "JAX_PLATFORMS": "cpu",
+                }
+            )
+            workers.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m",
+                        "shockwave_tpu.runtime.worker",
+                        "-t", "v100", "-n", "1",
+                        "-a", "127.0.0.1", "-s", str(sched_port),
+                        "-p", str(free_port()),
+                        "--run_dir", os.path.join(run_dir, f"w{i}"),
+                        "--checkpoint_dir",
+                        os.path.join(run_dir, f"ckpt{i}"),
+                    ],
+                    env=env,
+                    cwd=REPO,
+                )
+            )
+        sched.wait_for_workers(2, timeout=60)
+
+        jobs = [
+            make_synthetic_job(total_steps=400, steps_per_sec=200)
+            for _ in range(3)
+        ]
+        sched.expect_stream()
+
+        def submit():
+            client = SubmitterClient(
+                "127.0.0.1", sched_port, client_id="obs-smoke"
+            )
+            client.submit_stream(jobs, batch_size=2)
+
+        submitter = threading.Thread(target=submit, daemon=True)
+        submitter.start()
+        runner = threading.Thread(
+            target=lambda: sched.run(max_rounds=20), daemon=True
+        )
+        runner.start()
+
+        # Let a round land + the fleet poller scrape, then hit the
+        # LIVE endpoints mid-run (that is the point of a scrape plane).
+        base = f"http://127.0.0.1:{sched._fleet.port}"
+        deadline = time.time() + 30
+        metrics_text = ""
+        while time.time() < deadline:
+            time.sleep(1.0)
+            try:
+                _, metrics_text = _http_get(base + "/metrics")
+            except Exception:
+                continue
+            if 'worker="' in metrics_text and (
+                "worker_launches_total" in metrics_text
+            ):
+                break
+        health_code, health_text = _http_get(base + "/healthz")
+        scrape_path = os.path.join(OUT, "scrape_metrics.prom")
+        atomic_write_text(scrape_path, metrics_text)
+        atomic_write_text(
+            os.path.join(OUT, "healthz.json"), health_text
+        )
+
+        if 'worker="' not in metrics_text:
+            failures.append(
+                "/metrics never served a worker-labeled series"
+            )
+        if "worker_launches_total" not in metrics_text:
+            failures.append(
+                "/metrics is missing the fleet-merged worker series "
+                "(worker_launches_total)"
+            )
+        if "worker_clock_offset_seconds" not in metrics_text:
+            failures.append(
+                "/metrics is missing the per-worker clock-offset gauges"
+            )
+        if health_code != 200:
+            failures.append(f"/healthz answered {health_code}, not 200")
+        else:
+            health = json.loads(health_text)
+            if health.get("status") not in ("ok", "degraded"):
+                failures.append(f"/healthz body malformed: {health}")
+
+        runner.join(timeout=120)
+        if runner.is_alive():
+            failures.append("round loop did not finish in 120 s")
+        completed = sum(
+            1 for t in sched._job_completion_times.values()
+            if t is not None
+        )
+        if completed != len(jobs):
+            failures.append(
+                f"only {completed}/{len(jobs)} jobs completed"
+            )
+    finally:
+        sched.shutdown()
+        for proc in workers:
+            try:
+                proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    # Scheduler-side trace export + the workers' shutdown exports.
+    sched_trace = os.path.join(OUT, "scheduler_trace.json")
+    obs.export_trace(sched_trace)
+    obs.export_metrics(os.path.join(OUT, "scheduler_metrics.json"))
+    trace_files = [sched_trace]
+    for metrics_path, trace_path in worker_exports:
+        if not os.path.exists(trace_path):
+            failures.append(
+                f"worker trace export missing: {trace_path}"
+            )
+            continue
+        trace_files.append(trace_path)
+        if not os.path.exists(metrics_path):
+            failures.append(
+                f"worker metrics export missing: {metrics_path}"
+            )
+
+    # Merge + causal-tree validation (the committed fleet artifact).
+    merged_path = os.path.join(OUT, "merged_trace.json")
+    merged = spantree.merge_traces(
+        [json.load(open(p)) for p in trace_files]
+    )
+    atomic_write_text(merged_path, json.dumps(merged))
+    events = merged["traceEvents"]
+    chains = spantree.collect_chains(events)
+    summaries = [spantree.chain_summary(c) for c in chains.values()]
+    cross = [
+        s for s in summaries if s["connected"] and s["processes"] >= 2
+    ]
+    if not cross:
+        failures.append(
+            "no sampled job chain reconstructs as one connected causal "
+            "tree across 2+ processes"
+        )
+    budgets = spantree.latency_budget(events)
+    if len(budgets) < 3:
+        failures.append(
+            f"latency budget derivable for only {len(budgets)}/3 jobs"
+        )
+    breakdown = {
+        "sources": merged["otherData"]["sources"],
+        "chains": len(chains),
+        "cross_process_connected_chains": len(cross),
+        "flow_edges": merged["otherData"]["flow_edges"],
+        "latency_budget": budgets,
+        "latency_budget_fleet": spantree.budget_fleet_summary(budgets),
+    }
+    atomic_write_text(
+        os.path.join(OUT, "breakdown.json"),
+        json.dumps(breakdown, indent=1),
+    )
+    obs.reset()
+    return {
+        "completed_jobs": completed,
+        "scrape_port": sched._fleet.port if sched._fleet else None,
+        "chains": len(chains),
+        "cross_process_connected_chains": len(cross),
+        "flow_edges": merged["otherData"]["flow_edges"],
+        "latency_budget_fleet": breakdown["latency_budget_fleet"],
+    }
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    from shockwave_tpu.utils.fileio import atomic_write_json
+
+    failures = []
+    result = {"parity": parity_phase(failures)}
+    result["live"] = live_phase(failures)
+    result["failures"] = failures
+    result["ok"] = not failures
+    atomic_write_json(os.path.join(OUT, "obs_smoke.json"), result)
+    print(json.dumps(result, indent=1))
+    if failures:
+        print("\nOBS SMOKE: FAIL", file=sys.stderr)
+        for failure in failures:
+            print(f"  - {failure}", file=sys.stderr)
+        return 1
+    print("\nOBS SMOKE: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -68,6 +68,7 @@ def run_physical_cluster(
     trace_out=None,
     decision_log=None,
     watchdog_rules=None,
+    metrics_port=None,
 ):
     """Drive the full trace against a live localhost cluster; writes
     <out_dir>/{summary.json,round_log.json,timelines.json} and returns
@@ -134,7 +135,13 @@ def run_physical_cluster(
         shockwave_config=shockwave_config,
         preemption_overheads=preemption_overheads,
         round_overhead_fraction=round_overhead_fraction,
+        metrics_port=metrics_port,
     )
+    if sched._fleet is not None and sched._fleet.port is not None:
+        print(
+            f"Fleet scrape endpoint: http://127.0.0.1:"
+            f"{sched._fleet.port}/metrics (and /healthz)"
+        )
     worker_proc = subprocess.Popen(
         [
             sys.executable, "-m", "shockwave_tpu.runtime.worker",
@@ -267,6 +274,22 @@ def run_physical_cluster(
                     4,
                 ),
             }
+        # Per-job critical-path/latency-budget breakdown from the live
+        # tracer's causal span tree (queue-wait / plan-exposed /
+        # dispatch / run / sync) — the same math report_run.py and
+        # merge_traces.py apply offline. Only present when tracing ran
+        # (the events exist); disabled runs skip it entirely.
+        if trace_out and obs.trace_enabled():
+            from shockwave_tpu.obs import spantree
+
+            budgets = spantree.latency_budget(
+                obs.get_tracer().export_dict()["traceEvents"]
+            )
+            if budgets:
+                summary["latency_budget"] = {
+                    "fleet": spantree.budget_fleet_summary(budgets),
+                    "jobs": budgets,
+                }
         # Admission front-door health rides every physical summary:
         # queue depth must be back to zero at the end of a clean run,
         # and the reject/dedup counts are the backpressure/idempotency

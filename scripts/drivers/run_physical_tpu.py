@@ -265,6 +265,7 @@ def main(argv=None):
         trace_out=args.trace_out,
         decision_log=args.decision_log,
         watchdog_rules=obs.watchdog_rules_from_args(args),
+        metrics_port=args.metrics_port,
         extra_summary=lambda sched, run_dir: {
             "trace": args.trace,
             "preemption_overhead_phases": collect_phase_report(run_dir),

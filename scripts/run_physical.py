@@ -55,6 +55,7 @@ def main(args):
         time_per_iteration=args.time_per_iteration,
         profiles=profiles,
         shockwave_config=shockwave_config,
+        metrics_port=args.metrics_port,
     )
     print(f"Scheduler listening on :{args.port}; waiting for "
           f"{args.expected_workers} workers...")

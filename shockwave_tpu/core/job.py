@@ -35,6 +35,11 @@ class Job:
     # the anonymous tenant (no quota applies). Not part of the trace
     # format — single-tenant traces stay byte-identical.
     tenant: str = ""
+    # Causal root context of this job's life, carried on the SubmitJobs
+    # wire (admission_pb2.JobSpec.trace_context; obs/propagate.py
+    # encoding). Empty = untraced — the scheduler starts a fresh root
+    # at admission if tracing is on. Not part of the trace format.
+    trace_context: str = ""
 
     def __post_init__(self):
         if self.SLO is not None and self.SLO < 0:

@@ -36,6 +36,24 @@ from shockwave_tpu.runtime.lease import INFINITY
 
 LOG = logging.getLogger("core.physical")
 
+
+def _clock_gauges():
+    """The per-worker clock-sync gauge pair — one definition so the
+    heartbeat setter and the retirement remover can never drift onto
+    different series."""
+    return (
+        obs.gauge(
+            "worker_clock_offset_seconds",
+            "per-worker NTP-style clock offset vs the scheduler "
+            "(worker's min-RTT estimate, heartbeat-reported)",
+        ),
+        obs.gauge(
+            "worker_clock_rtt_seconds",
+            "round-trip time of the offset estimate's best sample",
+        ),
+    )
+
+
 SCHEDULE_RECOMPUTE_FRACTION = 0.5
 LEASE_UPDATE_FRACTION = 0.75
 JOB_COMPLETION_BUFFER_SECONDS = 60.0
@@ -49,6 +67,7 @@ class PhysicalScheduler(Scheduler):
         port: int = 50060,
         completion_buffer_seconds: float = JOB_COMPLETION_BUFFER_SECONDS,
         heartbeat_timeout_s: Optional[float] = None,
+        metrics_port: Optional[int] = None,
         **kwargs,
     ):
         # The reference's fixed 1920s reset throttle assumes 360s rounds
@@ -67,6 +86,20 @@ class PhysicalScheduler(Scheduler):
         self._port = port
         self._completion_buffer = completion_buffer_seconds
         self._start_time = time.time()
+        if obs.trace_enabled():
+            # merge_traces.py alignment anchor: this process's trace
+            # clock (wall-since-start, installed by the base __init__)
+            # is zero at _start_time on the wall clock; the scheduler
+            # IS the fleet's reference clock (offset 0).
+            obs.get_tracer().set_meta(
+                {
+                    "role": "scheduler",
+                    "clock": {
+                        "wall_at_zero_s": self._start_time,
+                        "offset_to_scheduler_s": 0.0,
+                    },
+                }
+            )
 
         self._lock = sanitize.make_rlock(
             "core.physical.PhysicalScheduler._lock"
@@ -151,6 +184,30 @@ class PhysicalScheduler(Scheduler):
             heartbeat_timeout_s = max(15.0, 2.5 * self._time_per_iteration)
         self._heartbeat_timeout_s = float(heartbeat_timeout_s)
 
+        # Fleet telemetry plane: periodic DumpMetrics pulls over every
+        # worker agent merged under a worker label, served (with the
+        # scheduler's own series) on a stdlib-HTTP Prometheus scrape
+        # endpoint plus /healthz. Enabled by the metrics_port arg or
+        # SHOCKWAVE_METRICS_PORT (0 = ephemeral; read the bound port
+        # back from self._fleet.port). Off = None = zero overhead.
+        self._fleet = None
+        # worker_id -> (agent label, agent addr): fleet scrape targets
+        # are per AGENT (one RPC client per address), labeled by the
+        # agent's lowest worker id.
+        self._fleet_agents: Dict[int, Tuple[str, Tuple[str, int]]] = {}
+        if metrics_port is None:
+            env_port = os.environ.get("SHOCKWAVE_METRICS_PORT")
+            metrics_port = int(env_port) if env_port not in (None, "") else None
+        if metrics_port is not None:
+            from shockwave_tpu.obs.fleet import FleetTelemetry
+
+            self._fleet = FleetTelemetry(
+                scrape_interval_s=float(
+                    os.environ.get("SHOCKWAVE_FLEET_SCRAPE_S", "5.0")
+                )
+            )
+            self._fleet.start(http_port=int(metrics_port))
+
         from shockwave_tpu.runtime.rpc import scheduler_server
 
         self._server = scheduler_server.serve(
@@ -208,6 +265,18 @@ class PhysicalScheduler(Scheduler):
             for worker_id in worker_ids:
                 self._worker_connections[worker_id] = client
                 self._worker_addrs[worker_id] = (ip_addr, port)
+            if self._fleet is not None:
+                # One scrape target per agent process, labeled by its
+                # lowest worker id (the label the merged fleet series
+                # carry as worker="<id>").
+                label = str(min(worker_ids))
+                for worker_id in worker_ids:
+                    self._fleet_agents[worker_id] = (
+                        label, (ip_addr, port)
+                    )
+                self._fleet.add_target(
+                    label, client.dump_worker_metrics
+                )
             # Registration starts the liveness lease; see
             # _heartbeat_rpc / _dead_workers. Lock order _cv -> _hb_lock.
             now = time.monotonic()
@@ -217,13 +286,28 @@ class PhysicalScheduler(Scheduler):
             self._cv.notify_all()
         return worker_ids, self._time_per_iteration
 
-    def _heartbeat_rpc(self, worker_id) -> None:
+    def _heartbeat_rpc(
+        self, worker_id, est_offset_s: float = 0.0, est_rtt_s: float = 0.0
+    ) -> None:
         """Liveness ping from a worker agent; deliberately does NOT take
-        the round loop's condition lock (see _hb_lock)."""
+        the round loop's condition lock (see _hb_lock). Heartbeats also
+        carry the worker's best NTP-style clock-offset estimate
+        (scheduler_clock - worker_clock; est_rtt_s > 0 marks it valid),
+        exported as per-worker gauges for the clock_skew watchdog rule
+        and merge_traces.py."""
         with self._hb_lock:
             worker_id = int(worker_id)
-            if worker_id not in self._retired_workers:
-                self._last_heartbeat[worker_id] = time.monotonic()
+            if worker_id in self._retired_workers:
+                return
+            self._last_heartbeat[worker_id] = time.monotonic()
+            if est_rtt_s > 0:
+                # Inside _hb_lock so a concurrent retirement (which
+                # marks retired THEN removes these series, also under
+                # _hb_lock) cannot interleave with the set and leave a
+                # frozen gauge behind for a dead worker.
+                offset_gauge, rtt_gauge = _clock_gauges()
+                offset_gauge.set(est_offset_s, worker=str(worker_id))
+                rtt_gauge.set(est_rtt_s, worker=str(worker_id))
 
     def _submit_jobs_rpc(self, token, specs, close):
         """Streaming-admission handler: validate the batch, offer it to
@@ -505,9 +589,25 @@ class PhysicalScheduler(Scheduler):
         super().remove_worker(worker_id)
         self._worker_connections.pop(worker_id, None)
         self._worker_addrs.pop(worker_id, None)
+        agent = self._fleet_agents.pop(worker_id, None)
+        if agent is not None and self._fleet is not None:
+            label = agent[0]
+            if not any(
+                lbl == label for lbl, _ in self._fleet_agents.values()
+            ):
+                # Last worker of the agent gone: stop scraping it.
+                self._fleet.remove_target(label)
         with self._hb_lock:
             self._last_heartbeat.pop(worker_id, None)
             self._retired_workers.add(worker_id)
+            # Its clock gauges go with it, removed under the SAME lock
+            # the heartbeat setter holds: a retired worker must not
+            # serve a frozen offset to /metrics and the clock_skew
+            # rule forever, and a racing stale heartbeat must not
+            # re-create the series after this removal.
+            offset_gauge, rtt_gauge = _clock_gauges()
+            offset_gauge.remove(worker=str(worker_id))
+            rtt_gauge.remove(worker=str(worker_id))
         self._next_assignments = OrderedDict(
             (key, ids)
             for key, ids in self._next_assignments.items()
@@ -520,9 +620,29 @@ class PhysicalScheduler(Scheduler):
             "scheduler-side RPC handler latency (lock wait included)",
         ).observe(time.perf_counter() - start, method=method)
 
-    def _done_rpc(self, worker_id, job_ids, num_steps, execution_times, logs):
-        """(reference: scheduler_server.py:62-95 -> _done_callback)"""
+    def _done_rpc(
+        self, worker_id, job_ids, num_steps, execution_times, logs,
+        trace_contexts=None,
+    ):
+        """(reference: scheduler_server.py:62-95 -> _done_callback).
+        ``trace_contexts`` (parallel to ``job_ids``) carries each
+        micro-task's worker-side run-span context; the completion
+        handling joins the job's causal chain as its child."""
         rpc_start = time.perf_counter()
+        if obs.trace_enabled() and trace_contexts:
+            from shockwave_tpu.obs import propagate
+
+            for job_int, wire in zip(job_ids, trace_contexts):
+                run_ctx = propagate.from_wire(wire)
+                if run_ctx is None:
+                    continue
+                obs.instant(
+                    "done_report", cat="rpc", tid="jobs",
+                    args={"job_id": int(job_int),
+                          "worker_id": int(worker_id),
+                          "trace_id": run_ctx.trace_id,
+                          "parent_span_id": run_ctx.span_id},
+                )
         with self._cv:
             if len(job_ids) == 1:
                 key = JobId(job_ids[0])
@@ -666,12 +786,37 @@ class PhysicalScheduler(Scheduler):
             # jobs (reference marks them at dispatch, scheduler.py:1935).
             self._running_jobs.add(single)
             self._per_job_latest_timestamps[single] = self.get_current_timestamp()
+        # Causal chain: one dispatch span per (possibly packed) key as a
+        # child of each member job's root; the RunJob descriptions carry
+        # the dispatch context so the worker's run spans hang under it.
+        dispatch_ctx = {}
+        for single in key.singletons():
+            root = self._job_trace_ctx.get(single)
+            if root is not None:
+                dispatch_ctx[single] = root.child()
+        span_args = {"job_id": str(key), "workers": scale_factor,
+                     "round": self._round_id}
+        first_ctx = (
+            next(iter(dispatch_ctx.values())) if dispatch_ctx else None
+        )
+        if first_ctx is not None:
+            span_args.update(first_ctx.args())
         dispatch_start = time.perf_counter()
         with obs.span(
-            "dispatch", cat="rpc", tid="dispatch",
-            args={"job_id": str(key), "workers": scale_factor,
-                  "round": self._round_id},
+            "dispatch", cat="rpc", tid="dispatch", args=span_args,
         ):
+            # A packed pair has one dispatch span but one context per
+            # member: the span is stamped with the first member's, so
+            # the other members' contexts (whose span ids the workers
+            # will parent their run spans to) must be emitted as their
+            # own causal nodes or those chains dangle in the merge.
+            for single, ctx in dispatch_ctx.items():
+                if ctx is first_ctx:
+                    continue
+                obs.instant(
+                    "dispatch_member", cat="rpc", tid="dispatch",
+                    args={"job_id": str(single), **ctx.args()},
+                )
             for rank, worker_id in enumerate(worker_ids):
                 descriptions = []
                 for single in key.singletons():
@@ -683,6 +828,9 @@ class PhysicalScheduler(Scheduler):
                             lead_addr
                         )
                     )
+                    ctx = dispatch_ctx.get(single)
+                    if ctx is not None:
+                        descriptions[-1]["trace_context"] = ctx.to_wire()
                 self._outstanding.add((key, worker_id))
                 rpc_start = time.perf_counter()
                 client = self._worker_connections.get(worker_id)
@@ -1049,16 +1197,31 @@ class PhysicalScheduler(Scheduler):
     def _kill_job(self, key: JobId) -> None:
         """Kill an unresponsive micro-task and synthesize zero-progress
         completions so bookkeeping converges
-        (reference: scheduler.py:3098-3170)."""
+        (reference: scheduler.py:3098-3170). The kill span joins the
+        job's causal chain and its context rides the KillJob RPC so
+        the worker's kill handling hangs under it."""
         obs.counter(
             "scheduler_kills_total", "straggler/unresponsive job kills"
         ).inc()
+        kill_ctx = None
+        with self._cv:
+            # _remove_job pops root contexts under the condition lock;
+            # this lookup must not interleave with it.
+            for single in key.singletons():
+                root = self._job_trace_ctx.get(single)
+                if root is not None:
+                    kill_ctx = root.child()
+                    break
         with obs.span(
-            "kill", cat="sched", tid="dispatch", args={"job_id": str(key)}
+            "kill", cat="sched", tid="dispatch",
+            args={"job_id": str(key),
+                  **(kill_ctx.args() if kill_ctx else {})},
         ):
-            self._kill_job_inner(key)
+            self._kill_job_inner(
+                key, kill_wire=kill_ctx.to_wire() if kill_ctx else ""
+            )
 
-    def _kill_job_inner(self, key: JobId) -> None:
+    def _kill_job_inner(self, key: JobId, kill_wire: str = "") -> None:
         with self._cv:
             worker_ids = list(
                 self._dispatched_worker_ids.get(key)
@@ -1081,7 +1244,7 @@ class PhysicalScheduler(Scheduler):
                     # Retried with backoff inside the client
                     # (runtime/retry.py); reaching here means every
                     # attempt failed.
-                    client.kill_job(job_int)
+                    client.kill_job(job_int, trace_context=kill_wire)
                 except Exception:
                     # The synthesized zero-progress Done below still
                     # converges bookkeeping, but a kill RPC that cannot
@@ -1177,6 +1340,8 @@ class PhysicalScheduler(Scheduler):
         if self._shutdown_requested.is_set():
             return
         self._shutdown_requested.set()
+        if self._fleet is not None:
+            self._fleet.stop()
         # Snapshot under the lock: a straggling RegisterWorker or a
         # concurrent reap mutates the connection map while this
         # iterates (the shutdown RPCs themselves stay outside the lock

@@ -215,6 +215,14 @@ class Scheduler:
         # their in-flight merge (see _done_callback's fault flag).
         self._fault_tainted: set = set()
         self._job_timelines: Dict[JobId, list] = {}
+        # Per-job causal root contexts (obs/propagate.py): jobs arriving
+        # through the front door carry the submitter's root, everything
+        # else gets a fresh one at admission; every span/instant of the
+        # job's life stamps ids from this chain so merge_traces.py can
+        # reconstruct one cross-process tree. Populated only while
+        # tracing is on AND the chain is sampled — disabled runs never
+        # touch it.
+        self._job_trace_ctx: Dict[JobId, object] = {}
         # Structured event log (job admissions, per-round assignments,
         # completions) consumed by scripts/analysis/postprocess_log.py —
         # the machine-readable equivalent of the reference's text-log
@@ -673,13 +681,40 @@ class Scheduler:
         obs.gauge(
             "scheduler_queue_depth", "active (incomplete) jobs"
         ).set(len(self._jobs))
+        trace_args = {}
+        if obs.trace_enabled():
+            from shockwave_tpu.obs import propagate
+
+            # Adopt the submitter's root (front-door jobs carry it on
+            # the wire) or mint a fresh one; an unsampled chain traces
+            # locally but is never stored/propagated.
+            root = propagate.from_wire(getattr(job, "trace_context", ""))
+            if root is None:
+                root = propagate.new_root()
+            if root is not None and root.sampled:
+                self._job_trace_ctx[job_id] = root
+                trace_args = {
+                    "trace_id": root.trace_id,
+                    "parent_span_id": root.span_id,
+                }
+                now = self.get_current_timestamp()
+                if now > timestamp:
+                    # The admission-queue wait, as its own span under
+                    # the job's root (arrival stamp -> admission).
+                    wait_ctx = root.child()
+                    obs.complete(
+                        "queue_wait", ts_s=timestamp, dur_s=now - timestamp,
+                        cat="job", tid="jobs",
+                        args={"job_id": job_id.integer, **wait_ctx.args()},
+                    )
         # ts is the (monotone) scheduler clock, not the arrival stamp: a
         # backlogged admission would otherwise time-travel the track.
         obs.instant(
             "job_admitted", cat="job", tid="jobs",
             ts_s=self.get_current_timestamp(),
             args={"job_id": job_id.integer, "job_type": job.job_type,
-                  "scale_factor": job.scale_factor, "arrival_s": timestamp},
+                  "scale_factor": job.scale_factor, "arrival_s": timestamp,
+                  **trace_args},
         )
         self._logger.info("[Job dispatched]\tJob ID: %s", job_id)
         return job_id
@@ -746,6 +781,7 @@ class Scheduler:
                 del self._job_type_to_job_ids[job_type_key]
         self._remove_from_priorities(job_id)
         self._need_to_update_allocation = True
+        self._job_trace_ctx.pop(job_id, None)
         self._logger.info("Remaining active jobs: %d", len(self._jobs))
 
     def _record_completion_telemetry(self, job_id: JobId, duration) -> None:
@@ -760,6 +796,10 @@ class Scheduler:
             "scheduler_queue_depth", "active (incomplete) jobs"
         ).set(len(self._jobs))
         args = {"job_id": job_id.integer}
+        root = self._job_trace_ctx.get(job_id)
+        if root is not None:
+            args["trace_id"] = root.trace_id
+            args["parent_span_id"] = root.span_id
         if duration is not None:
             obs.histogram(
                 "scheduler_job_jct_seconds", "per-job completion time"
@@ -2291,6 +2331,19 @@ class Scheduler:
                         all_num_steps[i],
                         max_finish_time - self._current_timestamp,
                     )
+                run_args = {
+                    "round": self._num_completed_rounds,
+                    "workers": len(worker_ids),
+                    "worker_type": worker_type,
+                }
+                run_root = self._job_trace_ctx.get(
+                    job_id.singletons()[0]
+                )
+                if run_root is not None:
+                    # Sim runs are single-process, but the same causal
+                    # chain args make spantree/merge_traces analyses
+                    # work on sim traces unchanged.
+                    run_args.update(run_root.child().args())
                 obs.complete(
                     f"run job {job_id}",
                     ts_s=self._current_timestamp,
@@ -2298,11 +2351,7 @@ class Scheduler:
                     cat="job",
                     pid="cluster",
                     tid=f"job {job_id}",
-                    args={
-                        "round": self._num_completed_rounds,
-                        "workers": len(worker_ids),
-                        "worker_type": worker_type,
-                    },
+                    args=run_args,
                 )
                 heapq.heappush(
                     running_jobs,

@@ -95,6 +95,9 @@ class _NullInstrument:
     def observe(self, value, **labels):
         pass
 
+    def remove(self, **labels):
+        pass
+
 
 _NULL = _NullInstrument()
 
@@ -354,6 +357,17 @@ def add_telemetry_args(parser) -> None:
         help="watchdog rule overrides: a JSON literal or a path to a "
         "JSON file, e.g. '{\"worst_ftf\": {\"threshold\": 1.5}}' "
         "(implies --watchdog)",
+    )
+    parser.add_argument(
+        "--metrics-port",
+        "--metrics_port",
+        dest="metrics_port",
+        type=int,
+        default=None,
+        help="serve a live Prometheus scrape endpoint on this port "
+        "(/metrics = scheduler + fleet-merged worker series, /healthz "
+        "= watchdog-backed health JSON); 0 binds an ephemeral port. "
+        "Physical mode only; also settable via SHOCKWAVE_METRICS_PORT",
     )
 
 

@@ -53,6 +53,33 @@ def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def quantile_from_buckets(buckets, q, observed_max=None):
+    """Upper-bound quantile estimate from a histogram's CUMULATIVE
+    ``{le_str: count}`` buckets (snapshot schema, ``"+Inf"`` included):
+    the smallest finite bucket bound whose cumulative count covers the
+    quantile; observations past the last finite bound resolve to
+    ``observed_max`` (the snapshot's ``max``), or to ``None`` when no
+    max is known. Returns ``(value, count)`` — ``(None, 0)`` for an
+    empty histogram. One implementation for every consumer (the
+    watchdog's ``replan_p99`` rule, report_run's p99 columns, the CI
+    gates) so the bucket math cannot drift."""
+    if not buckets:
+        return None, 0
+    count = max(buckets.values())
+    if count <= 0:
+        return None, 0
+    need = q * count
+    finite = sorted(
+        (float(le), cum)
+        for le, cum in buckets.items()
+        if le not in ("+Inf", "inf")
+    )
+    for bound, cum in finite:
+        if cum >= need:
+            return bound, count
+    return observed_max, count
+
+
 class _Instrument:
     """Shared handle plumbing: one named metric, many label series."""
 
@@ -120,6 +147,15 @@ class Gauge(_Instrument):
             return
         with registry._lock:
             self._get_series(labels)["value"] += amount
+
+    def remove(self, **labels) -> None:
+        """Drop one label series (a retired worker's gauge must not
+        serve a frozen value forever)."""
+        registry = self._registry
+        if not registry.enabled:
+            return
+        with registry._lock:
+            self._series.pop(_label_key(labels), None)
 
     def snapshot_series(self) -> list:
         return [

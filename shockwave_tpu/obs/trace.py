@@ -93,7 +93,16 @@ class EventTracer:
         self._lock = sanitize.make_lock("obs.trace.EventTracer._lock")
         self._events: list = []
         self._epoch = time.perf_counter()
+        # Wall clock at the tracer's zero point: the anchor
+        # merge_traces.py uses to place this process's timeline on the
+        # fleet-wide (scheduler) clock. A process that installs its own
+        # clock (the physical scheduler's wall-since-start) overrides
+        # it via set_meta({"clock": {...}}).
+        self._epoch_wall = time.time()
         self._clock: Optional[Callable[[], float]] = None
+        # Export metadata (role, worker identity, clock anchor/offset)
+        # merged into the dump's otherData.
+        self._meta: dict = {}
         # track name -> integer id maps (pids and per-pid tids)
         self._pids: Dict[str, int] = {}
         self._tids: Dict[Tuple[str, str], int] = {}
@@ -105,6 +114,23 @@ class EventTracer:
         creation."""
         with self._lock:
             self._clock = clock
+
+    def set_meta(self, meta: dict) -> None:
+        """Merge export metadata into the dump's ``otherData`` (one
+        level deep: dict values update the existing dict). Processes
+        record their role and clock anchor here —
+        ``{"clock": {"wall_at_zero_s": ..., "offset_to_scheduler_s":
+        ...}}`` is what ``merge_traces.py`` aligns timelines with."""
+        with self._lock:
+            for key, value in meta.items():
+                if isinstance(value, dict) and isinstance(
+                    self._meta.get(key), dict
+                ):
+                    self._meta[key].update(value)
+                else:
+                    self._meta[key] = dict(value) if isinstance(
+                        value, dict
+                    ) else value
 
     def _now_s(self) -> float:
         if self._clock is not None:
@@ -247,6 +273,15 @@ class EventTracer:
     def export_dict(self) -> dict:
         with self._lock:
             events = list(self._events)
+            other = {"producer": "shockwave_tpu.obs"}
+            other["clock"] = {"wall_at_zero_s": self._epoch_wall}
+            for key, value in self._meta.items():
+                if isinstance(value, dict) and isinstance(
+                    other.get(key), dict
+                ):
+                    other[key] = {**other[key], **value}
+                else:
+                    other[key] = value
         # Stable sort per track: X spans from concurrent threads (whose
         # ts is their enter time but whose append happens at exit) can
         # land out of order; sorting restores the per-tid monotonic-ts
@@ -263,7 +298,7 @@ class EventTracer:
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",
-            "otherData": {"producer": "shockwave_tpu.obs"},
+            "otherData": other,
         }
 
     def export(self, path: str) -> None:
@@ -276,3 +311,6 @@ class EventTracer:
             self._events.clear()
             self._pids.clear()
             self._tids.clear()
+            self._meta.clear()
+            self._epoch = time.perf_counter()
+            self._epoch_wall = time.time()

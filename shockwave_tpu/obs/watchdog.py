@@ -51,6 +51,13 @@ Rules (all thresholds overridable via a config dict, e.g. the
                      ``min_events`` — the cell keeps its cached plan
                      while the rest of the fleet proceeds, but an
                      operator must know).
+``clock_skew``       a worker's NTP-estimated clock offset
+                     (``worker_clock_offset_seconds{worker}``, the
+                     heartbeat-reported min-RTT estimate) drifted past
+                     ``max_offset_s``, or JUMPED by more than
+                     ``max_jump_s`` between checks — either way the
+                     merged fleet trace's alignment (and any
+                     cross-host latency attribution) is suspect.
 
 A rule re-fires only when its value worsens past the last fired value
 (no per-round alert spam while a breach persists). Disabled by default
@@ -84,6 +91,7 @@ DEFAULT_RULES: Dict[str, dict] = {
     "admission_backlog": {"fraction": 0.9, "min_depth": 8},
     "replan_p99": {"budget_s": None, "min_solves": 5, "quantile": 0.99},
     "cell_failure": {"min_events": 1},
+    "clock_skew": {"max_offset_s": 1.0, "max_jump_s": 0.5},
 }
 
 
@@ -117,6 +125,9 @@ class Watchdog:
         self._preemption_deltas: deque = deque()
         # job -> [last_steps, consecutive scheduled rounds w/o progress]
         self._progress: Dict[object, list] = {}
+        # worker -> [last offset seen, currently-breached flag] for the
+        # clock_skew rule's per-worker hysteresis.
+        self._clock_offsets: Dict[str, list] = {}
         # Jobs granted workers at the PREVIOUS check: the steps delta a
         # check observes covers the previous round's execution.
         self._prev_scheduled: set = set()
@@ -140,6 +151,7 @@ class Watchdog:
             self._solve_means.clear()
             self._preemption_deltas.clear()
             self._progress.clear()
+            self._clock_offsets.clear()
             self._prev_scheduled.clear()
             self._last_fired.clear()
 
@@ -233,6 +245,8 @@ class Watchdog:
                     self.rules["cell_failure"]["min_events"],
                     round_index, fired,
                 )
+            if "clock_skew" in self.rules:
+                self._check_clock_skew(metrics, round_index, fired)
 
             for alert in fired:
                 alert["time_s"] = float(now_s)
@@ -314,23 +328,17 @@ class Watchdog:
 
     @classmethod
     def _histogram_quantile(cls, metrics, name, q):
-        """Upper-bound quantile estimate from cumulative buckets: the
-        smallest bucket bound whose cumulative count covers the
-        quantile (the +Inf bucket resolves to the observed max).
-        Returns (value, count) or (None, count)."""
+        """Upper-bound quantile estimate over every label series'
+        cumulative buckets (the shared
+        :func:`shockwave_tpu.obs.metrics.quantile_from_buckets` math;
+        the +Inf bucket resolves to the observed max). Returns
+        (value, count) or (None, count)."""
+        from shockwave_tpu.obs.metrics import quantile_from_buckets
+
         count, merged, observed_max = cls._merged_buckets(metrics, name)
         if count <= 0 or not merged:
             return None, count
-        need = q * count
-        finite = sorted(
-            ((float(le), cum) for le, cum in merged.items()
-             if le != "+Inf"),
-            key=lambda item: item[0],
-        )
-        for bound, cum in finite:
-            if cum >= need:
-                return bound, count
-        return observed_max, count
+        return quantile_from_buckets(merged, q, observed_max)
 
     def _check_admission_backlog(self, metrics, round_index, fired) -> None:
         """Caller holds the lock (check_round)."""
@@ -366,6 +374,47 @@ class Watchdog:
             )
         else:
             self._rearm("replan_p99")
+
+    def _check_clock_skew(self, metrics, round_index, fired) -> None:
+        """Caller holds the lock (check_round). Per-worker (like
+        straggler: the shared hysteresis slot would let one skewed
+        worker mask another): fire when |offset| crosses
+        ``max_offset_s``, or when the offset jumps by more than
+        ``max_jump_s`` between consecutive checks (a step change means
+        one of the clocks was yanked — NTP sync, VM migration — and
+        historical alignment is suspect); one alert per breach episode,
+        re-armed when the offset is back under threshold."""
+        cfg = self.rules["clock_skew"]
+        metric = metrics.get("worker_clock_offset_seconds")
+        seen = set()
+        for series in (metric or {}).get("series", ()):
+            worker = series["labels"].get("worker")
+            if worker is None:
+                continue
+            seen.add(worker)
+            offset = float(series["value"])
+            state = self._clock_offsets.get(worker)
+            jump = abs(offset - state[0]) if state is not None else 0.0
+            breach = abs(offset) > cfg["max_offset_s"]
+            jumped = jump > cfg["max_jump_s"]
+            was_breached = state is not None and state[1]
+            if (breach or jumped) and not was_breached:
+                fired.append(
+                    {
+                        "rule": "clock_skew",
+                        "round": int(round_index),
+                        "value": round(offset, 6),
+                        "threshold": float(cfg["max_offset_s"]),
+                        "worker": str(worker),
+                        "jump_s": round(jump, 6),
+                    }
+                )
+            # Only a SUSTAINED offset breach latches the episode: a
+            # jump is a one-shot event (and the jump back to a sane
+            # offset at recovery must clear the latch, not re-arm it).
+            self._clock_offsets[worker] = [offset, breach]
+        for gone in [w for w in self._clock_offsets if w not in seen]:
+            del self._clock_offsets[gone]
 
     def _check_worst_ftf(self, metrics, round_index, fired) -> None:
         """Caller holds the lock (check_round)."""

@@ -84,6 +84,7 @@ def job_to_spec_dict(job: Job) -> dict:
         "duration": float(job.duration) if job.duration else 0.0,
         "needs_data_dir": bool(job.needs_data_dir),
         "tenant": str(getattr(job, "tenant", "") or ""),
+        "trace_context": str(getattr(job, "trace_context", "") or ""),
     }
 
 
@@ -124,6 +125,7 @@ def job_from_spec_dict(spec: dict) -> Job:
         duration=duration if duration > 0 else None,
         needs_data_dir=bool(spec.get("needs_data_dir", False)),
         tenant=str(spec.get("tenant", "") or ""),
+        trace_context=str(spec.get("trace_context", "") or ""),
     )
 
 

@@ -24,6 +24,7 @@ from typing import Dict, List
 
 from shockwave_tpu import obs
 from shockwave_tpu.analysis import sanitize
+from shockwave_tpu.obs import propagate
 
 LOG = logging.getLogger("runtime.dispatcher")
 
@@ -125,7 +126,7 @@ class Dispatcher:
 
     def _dispatch_jobs_helper(self, job_descriptions, worker_id, round_id):
         accel_id = self._accelerator_queue.get()
-        job_ids, steps, durations, logs = [], [], [], []
+        job_ids, steps, durations, logs, contexts = [], [], [], [], []
         try:
             # A packed pair space-shares the accelerator: both processes
             # run CONCURRENTLY (reference: dispatcher.py:447-525, where
@@ -148,7 +149,7 @@ class Dispatcher:
                         "launch of job %s failed", job.get("job_id"),
                         exc_info=True,
                     )
-                    results[i] = (0, 0.0, "")
+                    results[i] = (0, 0.0, "", "")
 
             launchers = [
                 threading.Thread(target=launch, args=(i, job), daemon=True)
@@ -158,11 +159,14 @@ class Dispatcher:
                 t.start()
             for t in launchers:
                 t.join()
-            for job, (n, d, log_text) in zip(job_descriptions, results):
+            for job, (n, d, log_text, ctx_wire) in zip(
+                job_descriptions, results
+            ):
                 job_ids.append(job["job_id"])
                 steps.append(n)
                 durations.append(d)
                 logs.append(log_text)
+                contexts.append(ctx_wire)
         finally:
             self._accelerator_queue.put(accel_id)
         try:
@@ -171,7 +175,8 @@ class Dispatcher:
             # stall or dropped packet costs a retry here, not the
             # round's training progress.
             self._worker_rpc_client.notify_scheduler(
-                worker_id, job_ids, steps, durations, logs
+                worker_id, job_ids, steps, durations, logs,
+                trace_contexts=contexts,
             )
         except Exception:
             # Every retry exhausted: either the scheduler is gone for
@@ -189,9 +194,15 @@ class Dispatcher:
 
     def _launch_job(self, job, accel_id, worker_id, round_id):
         """Run one training subprocess to completion; returns
-        (steps, duration, iterator_log_text)
-        (reference: dispatcher.py:309-445)."""
+        (steps, duration, iterator_log_text, run_span_wire_context)
+        (reference: dispatcher.py:309-445). The run span joins the
+        job's cross-process causal chain as a child of the scheduler's
+        dispatch span (job["trace_context"]); its own context rides the
+        Done report so the scheduler's completion handling hangs under
+        it."""
         job_id = int(job["job_id"])
+        parent_ctx = propagate.from_wire(job.get("trace_context", ""))
+        run_ctx = parent_ctx.child() if parent_ctx is not None else None
         ckpt_dir, log_file = self._job_dirs(job_id, worker_id, round_id)
         command = self._construct_command(job, ckpt_dir)
         env = dict(os.environ)
@@ -215,7 +226,8 @@ class Dispatcher:
         start = time.time()
         with obs.span(
             "run_job", cat="worker", pid="worker", tid=f"accel {accel_id}",
-            args={"job_id": job_id, "round": round_id},
+            args={"job_id": job_id, "round": round_id,
+                  **propagate.ctx_args(run_ctx)},
         ):
             # Not an artifact: a live fd handed to Popen for the
             # subprocess to stream into — temp+rename atomicity is
@@ -267,7 +279,7 @@ class Dispatcher:
                 "worker_relaunch_overhead_seconds",
                 "subprocess lifetime minus reported training time",
             ).observe(max(elapsed - d, 0.0))
-        return n, d, log_text
+        return n, d, log_text, propagate.ctx_wire(run_ctx)
 
     def _get_steps_and_execution_time(self, log_file: str):
         """Parse the iterator's structured log

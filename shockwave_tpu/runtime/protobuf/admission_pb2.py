@@ -5,98 +5,32 @@ Implements the three messages of the streaming-admission front door —
 exactly the two entry points the hand-rolled gRPC wiring
 (:mod:`shockwave_tpu.runtime.rpc.wiring`) uses, ``SerializeToString``
 and ``FromString``, emitting/consuming canonical proto3 wire format
-(defaults omitted, repeated submessages length-delimited, doubles as
-64-bit little-endian) so a protoc-generated counterpart interoperates
+(see :mod:`.wire`) so a protoc-generated counterpart interoperates
 byte-for-byte. Unknown fields are skipped per proto3 rules, keeping
 the parser forward-compatible with a widened schema. Field numbers are
 documented in admission.proto.
+
+Causal tracing extensions (:mod:`shockwave_tpu.obs.propagate`):
+``JobSpec.trace_context`` (13, string) carries the submitter's per-job
+ROOT context — the span every scheduler/worker span of that job's life
+hangs under — and ``SubmitJobsRequest.trace_context`` (4, string) the
+batch RPC's own context. Both optional and default-empty, so untraced
+submissions stay byte-identical to the legacy wire.
 """
 
 from __future__ import annotations
 
-import struct
 from typing import List
 
-
-def _encode_varint(value: int) -> bytes:
-    out = bytearray()
-    value = int(value)
-    while True:
-        bits = value & 0x7F
-        value >>= 7
-        if value:
-            out.append(bits | 0x80)
-        else:
-            out.append(bits)
-            return bytes(out)
-
-
-def _decode_varint(data: bytes, pos: int):
-    result = 0
-    shift = 0
-    while True:
-        if pos >= len(data):
-            raise ValueError("truncated varint")
-        byte = data[pos]
-        pos += 1
-        result |= (byte & 0x7F) << shift
-        if not byte & 0x80:
-            return result, pos
-        shift += 7
-        if shift > 63:
-            raise ValueError("varint too long")
-
-
-def _tag(field: int, wire_type: int) -> bytes:
-    return _encode_varint((field << 3) | wire_type)
-
-
-def _put_str(out: bytearray, field: int, value: str) -> None:
-    payload = value.encode("utf-8")
-    if payload:
-        out += _tag(field, 2) + _encode_varint(len(payload)) + payload
-
-
-def _put_varint(out: bytearray, field: int, value: int) -> None:
-    if value:
-        out += _tag(field, 0) + _encode_varint(int(value))
-
-
-def _put_double(out: bytearray, field: int, value: float) -> None:
-    if value:
-        out += _tag(field, 1) + struct.pack("<d", float(value))
-
-
-def _put_msg(out: bytearray, field: int, payload: bytes) -> None:
-    out += _tag(field, 2) + _encode_varint(len(payload)) + payload
-
-
-def _scan_fields(data: bytes):
-    """Yield (field, wire_type, value) over a message's wire bytes;
-    length-delimited values come back as raw ``bytes``."""
-    pos = 0
-    while pos < len(data):
-        tag, pos = _decode_varint(data, pos)
-        field, wire_type = tag >> 3, tag & 0x07
-        if wire_type == 0:
-            value, pos = _decode_varint(data, pos)
-        elif wire_type == 1:
-            if pos + 8 > len(data):
-                raise ValueError("truncated 64-bit field")
-            value = struct.unpack("<d", data[pos : pos + 8])[0]
-            pos += 8
-        elif wire_type == 2:
-            length, pos = _decode_varint(data, pos)
-            if pos + length > len(data):
-                raise ValueError("truncated length-delimited field")
-            value = data[pos : pos + length]
-            pos += length
-        elif wire_type == 5:
-            pos += 4
-            continue  # 32-bit (unknown field: skip)
-        else:
-            raise ValueError(f"unsupported wire type {wire_type}")
-        yield field, wire_type, value
+from shockwave_tpu.runtime.protobuf.wire import (
+    encode_varint as _encode_varint,  # noqa: F401 (test fixtures build
+    tag as _tag,  # noqa: F401         raw unknown-field bytes with these)
+    put_double,
+    put_msg,
+    put_str,
+    put_varint,
+    scan_fields,
+)
 
 
 class JobSpec:
@@ -116,6 +50,7 @@ class JobSpec:
         duration: float = 0.0,
         needs_data_dir: bool = False,
         tenant: str = "",
+        trace_context: str = "",
     ):
         self.job_type = job_type
         self.command = command
@@ -129,27 +64,29 @@ class JobSpec:
         self.duration = float(duration)
         self.needs_data_dir = bool(needs_data_dir)
         self.tenant = tenant
+        self.trace_context = trace_context
 
     def SerializeToString(self) -> bytes:  # noqa: N802 (protobuf API)
         out = bytearray()
-        _put_str(out, 1, self.job_type)
-        _put_str(out, 2, self.command)
-        _put_str(out, 3, self.working_directory)
-        _put_str(out, 4, self.num_steps_arg)
-        _put_varint(out, 5, self.total_steps)
-        _put_varint(out, 6, self.scale_factor)
-        _put_str(out, 7, self.mode)
-        _put_double(out, 8, self.priority_weight)
-        _put_double(out, 9, self.slo)
-        _put_double(out, 10, self.duration)
-        _put_varint(out, 11, int(self.needs_data_dir))
-        _put_str(out, 12, self.tenant)
+        put_str(out, 1, self.job_type)
+        put_str(out, 2, self.command)
+        put_str(out, 3, self.working_directory)
+        put_str(out, 4, self.num_steps_arg)
+        put_varint(out, 5, self.total_steps)
+        put_varint(out, 6, self.scale_factor)
+        put_str(out, 7, self.mode)
+        put_double(out, 8, self.priority_weight)
+        put_double(out, 9, self.slo)
+        put_double(out, 10, self.duration)
+        put_varint(out, 11, int(self.needs_data_dir))
+        put_str(out, 12, self.tenant)
+        put_str(out, 13, self.trace_context)
         return bytes(out)
 
     @classmethod
     def FromString(cls, data: bytes) -> "JobSpec":  # noqa: N802
         spec = cls()
-        for field, wire_type, value in _scan_fields(data):
+        for field, wire_type, value in scan_fields(data):
             if field == 1 and wire_type == 2:
                 spec.job_type = value.decode("utf-8")
             elif field == 2 and wire_type == 2:
@@ -174,40 +111,48 @@ class JobSpec:
                 spec.needs_data_dir = bool(value)
             elif field == 12 and wire_type == 2:
                 spec.tenant = value.decode("utf-8")
+            elif field == 13 and wire_type == 2:
+                spec.trace_context = value.decode("utf-8")
         return spec
 
 
 class SubmitJobsRequest:
-    """message SubmitJobsRequest { token, repeated JobSpec jobs, close }"""
+    """message SubmitJobsRequest { token, repeated JobSpec jobs, close,
+    trace_context }"""
 
     def __init__(
         self,
         token: str = "",
         jobs: List[JobSpec] = None,
         close: bool = False,
+        trace_context: str = "",
     ):
         self.token = token
         self.jobs = list(jobs) if jobs else []
         self.close = bool(close)
+        self.trace_context = trace_context
 
     def SerializeToString(self) -> bytes:  # noqa: N802
         out = bytearray()
-        _put_str(out, 1, self.token)
+        put_str(out, 1, self.token)
         for spec in self.jobs:
-            _put_msg(out, 2, spec.SerializeToString())
-        _put_varint(out, 3, int(self.close))
+            put_msg(out, 2, spec.SerializeToString())
+        put_varint(out, 3, int(self.close))
+        put_str(out, 4, self.trace_context)
         return bytes(out)
 
     @classmethod
     def FromString(cls, data: bytes) -> "SubmitJobsRequest":  # noqa: N802
         request = cls()
-        for field, wire_type, value in _scan_fields(data):
+        for field, wire_type, value in scan_fields(data):
             if field == 1 and wire_type == 2:
                 request.token = value.decode("utf-8")
             elif field == 2 and wire_type == 2:
                 request.jobs.append(JobSpec.FromString(value))
             elif field == 3 and wire_type == 0:
                 request.close = bool(value)
+            elif field == 4 and wire_type == 2:
+                request.trace_context = value.decode("utf-8")
         return request
 
 
@@ -231,17 +176,17 @@ class SubmitJobsResponse:
 
     def SerializeToString(self) -> bytes:  # noqa: N802
         out = bytearray()
-        _put_str(out, 1, self.status)
-        _put_double(out, 2, self.retry_after_s)
-        _put_varint(out, 3, self.admitted)
-        _put_str(out, 4, self.error)
-        _put_varint(out, 5, self.queue_depth)
+        put_str(out, 1, self.status)
+        put_double(out, 2, self.retry_after_s)
+        put_varint(out, 3, self.admitted)
+        put_str(out, 4, self.error)
+        put_varint(out, 5, self.queue_depth)
         return bytes(out)
 
     @classmethod
     def FromString(cls, data: bytes) -> "SubmitJobsResponse":  # noqa: N802
         response = cls()
-        for field, wire_type, value in _scan_fields(data):
+        for field, wire_type, value in scan_fields(data):
             if field == 1 and wire_type == 2:
                 response.status = value.decode("utf-8")
             elif field == 2 and wire_type == 1:

@@ -1,0 +1,11 @@
+"""Frozen protoc-generated modules for the PRE-trace-context RPC schema.
+
+These are the original ``protoc --python_out`` artifacts for
+worker_to_scheduler.proto and scheduler_to_worker.proto, kept verbatim
+(registered under ``legacy_*.proto`` names so they coexist with the
+live modules in the default descriptor pool) as the OLD side of the
+wire-compatibility regression tests: an old-schema reader must parse
+new messages (unknown trace-context/clock fields skipped) and a
+new-schema reader must parse old messages (context absent -> fresh
+root span). Production code never imports these.
+"""

@@ -1,32 +1,157 @@
-# -*- coding: utf-8 -*-
-# Generated by the protocol buffer compiler.  DO NOT EDIT!
-# source: scheduler_to_worker.proto
-"""Generated protocol buffer code."""
-from google.protobuf.internal import builder as _builder
-from google.protobuf import descriptor as _descriptor
-from google.protobuf import descriptor_pool as _descriptor_pool
-from google.protobuf import symbol_database as _symbol_database
-# @@protoc_insertion_point(imports)
+"""Hand-rolled protobuf for scheduler_to_worker.proto (no protoc in
+this build; the frozen protoc originals live in ``legacy/`` as the
+wire-compat test fixtures).
 
-_sym_db = _symbol_database.Default()
+Canonical proto3 wire format with unknown fields skipped, exactly like
+the sibling hand-rolled modules (see :mod:`.wire`). Schema extensions
+over the legacy wire:
+
+  * ``JobDescription.trace_context`` (10, string) — the dispatching
+    scheduler span's causal context; the worker opens its launch/run
+    spans as children so the job's cross-process chain stays connected
+    (:mod:`shockwave_tpu.obs.propagate`).
+  * ``KillJobRequest.trace_context`` (2, string) — same, for kills.
+
+Both are optional: absent on the wire they parse to ``""`` (fresh root
+at the receiver), and empty they serialize to zero bytes (legacy byte
+identity).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from shockwave_tpu.runtime.protobuf.wire import (
+    put_msg,
+    put_str,
+    put_varint,
+    scan_fields,
+)
 
 
-from . import common_pb2 as common__pb2
+class JobDescription:
+    """message JobDescription — one micro-task of a RunJob dispatch."""
+
+    def __init__(
+        self,
+        job_id: int = 0,
+        job_type: str = "",
+        command: str = "",
+        working_directory: str = "",
+        needs_data_dir: bool = False,
+        num_steps_arg: str = "",
+        num_steps: int = 0,
+        has_duration: bool = False,
+        duration: int = 0,
+        trace_context: str = "",
+    ):
+        self.job_id = int(job_id)
+        self.job_type = job_type
+        self.command = command
+        self.working_directory = working_directory
+        self.needs_data_dir = bool(needs_data_dir)
+        self.num_steps_arg = num_steps_arg
+        self.num_steps = int(num_steps)
+        self.has_duration = bool(has_duration)
+        self.duration = int(duration)
+        self.trace_context = trace_context
+
+    def SerializeToString(self) -> bytes:  # noqa: N802 (protobuf API)
+        out = bytearray()
+        put_varint(out, 1, self.job_id)
+        put_str(out, 2, self.job_type)
+        put_str(out, 3, self.command)
+        put_str(out, 4, self.working_directory)
+        put_varint(out, 5, int(self.needs_data_dir))
+        put_str(out, 6, self.num_steps_arg)
+        put_varint(out, 7, self.num_steps)
+        put_varint(out, 8, int(self.has_duration))
+        put_varint(out, 9, self.duration)
+        put_str(out, 10, self.trace_context)
+        return bytes(out)
+
+    @classmethod
+    def FromString(cls, data: bytes) -> "JobDescription":  # noqa: N802
+        msg = cls()
+        for field, wire_type, value in scan_fields(data):
+            if field == 1 and wire_type == 0:
+                msg.job_id = int(value)
+            elif field == 2 and wire_type == 2:
+                msg.job_type = value.decode("utf-8")
+            elif field == 3 and wire_type == 2:
+                msg.command = value.decode("utf-8")
+            elif field == 4 and wire_type == 2:
+                msg.working_directory = value.decode("utf-8")
+            elif field == 5 and wire_type == 0:
+                msg.needs_data_dir = bool(value)
+            elif field == 6 and wire_type == 2:
+                msg.num_steps_arg = value.decode("utf-8")
+            elif field == 7 and wire_type == 0:
+                msg.num_steps = int(value)
+            elif field == 8 and wire_type == 0:
+                msg.has_duration = bool(value)
+            elif field == 9 and wire_type == 0:
+                msg.duration = int(value)
+            elif field == 10 and wire_type == 2:
+                msg.trace_context = value.decode("utf-8")
+        return msg
 
 
-DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile(b'\n\x19scheduler_to_worker.proto\x12\rshockwave_tpu\x1a\x0c\x63ommon.proto\"\xc8\x01\n\x0eJobDescription\x12\x0e\n\x06job_id\x18\x01 \x01(\x04\x12\x10\n\x08job_type\x18\x02 \x01(\t\x12\x0f\n\x07\x63ommand\x18\x03 \x01(\t\x12\x19\n\x11working_directory\x18\x04 \x01(\t\x12\x16\n\x0eneeds_data_dir\x18\x05 \x01(\x08\x12\x15\n\rnum_steps_arg\x18\x06 \x01(\t\x12\x11\n\tnum_steps\x18\x07 \x01(\x04\x12\x14\n\x0chas_duration\x18\x08 \x01(\x08\x12\x10\n\x08\x64uration\x18\t \x01(\x04\"m\n\rRunJobRequest\x12\x37\n\x10job_descriptions\x18\x01 \x03(\x0b\x32\x1d.shockwave_tpu.JobDescription\x12\x11\n\tworker_id\x18\x02 \x01(\x04\x12\x10\n\x08round_id\x18\x03 \x01(\x04\" \n\x0eKillJobRequest\x12\x0e\n\x06job_id\x18\x01 \x01(\x04\x32\x86\x02\n\x11SchedulerToWorker\x12>\n\x06RunJob\x12\x1c.shockwave_tpu.RunJobRequest\x1a\x14.shockwave_tpu.Empty\"\x00\x12@\n\x07KillJob\x12\x1d.shockwave_tpu.KillJobRequest\x1a\x14.shockwave_tpu.Empty\"\x00\x12\x35\n\x05Reset\x12\x14.shockwave_tpu.Empty\x1a\x14.shockwave_tpu.Empty\"\x00\x12\x38\n\x08Shutdown\x12\x14.shockwave_tpu.Empty\x1a\x14.shockwave_tpu.Empty\"\x00\x62\x06proto3')
+class RunJobRequest:
+    """message RunJobRequest { job_descriptions, worker_id, round_id }"""
 
-_builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())
-_builder.BuildTopDescriptorsAndMessages(DESCRIPTOR, 'scheduler_to_worker_pb2', globals())
-if _descriptor._USE_C_DESCRIPTORS == False:
+    def __init__(
+        self,
+        job_descriptions: Optional[List[JobDescription]] = None,
+        worker_id: int = 0,
+        round_id: int = 0,
+    ):
+        self.job_descriptions = (
+            list(job_descriptions) if job_descriptions else []
+        )
+        self.worker_id = int(worker_id)
+        self.round_id = int(round_id)
 
-  DESCRIPTOR._options = None
-  _JOBDESCRIPTION._serialized_start=59
-  _JOBDESCRIPTION._serialized_end=259
-  _RUNJOBREQUEST._serialized_start=261
-  _RUNJOBREQUEST._serialized_end=370
-  _KILLJOBREQUEST._serialized_start=372
-  _KILLJOBREQUEST._serialized_end=404
-  _SCHEDULERTOWORKER._serialized_start=407
-  _SCHEDULERTOWORKER._serialized_end=669
-# @@protoc_insertion_point(module_scope)
+    def SerializeToString(self) -> bytes:  # noqa: N802
+        out = bytearray()
+        for description in self.job_descriptions:
+            put_msg(out, 1, description.SerializeToString())
+        put_varint(out, 2, self.worker_id)
+        put_varint(out, 3, self.round_id)
+        return bytes(out)
+
+    @classmethod
+    def FromString(cls, data: bytes) -> "RunJobRequest":  # noqa: N802
+        msg = cls()
+        for field, wire_type, value in scan_fields(data):
+            if field == 1 and wire_type == 2:
+                msg.job_descriptions.append(JobDescription.FromString(value))
+            elif field == 2 and wire_type == 0:
+                msg.worker_id = int(value)
+            elif field == 3 and wire_type == 0:
+                msg.round_id = int(value)
+        return msg
+
+
+class KillJobRequest:
+    """message KillJobRequest { job_id, trace_context }"""
+
+    def __init__(self, job_id: int = 0, trace_context: str = ""):
+        self.job_id = int(job_id)
+        self.trace_context = trace_context
+
+    def SerializeToString(self) -> bytes:  # noqa: N802
+        out = bytearray()
+        put_varint(out, 1, self.job_id)
+        put_str(out, 2, self.trace_context)
+        return bytes(out)
+
+    @classmethod
+    def FromString(cls, data: bytes) -> "KillJobRequest":  # noqa: N802
+        msg = cls()
+        for field, wire_type, value in scan_fields(data):
+            if field == 1 and wire_type == 0:
+                msg.job_id = int(value)
+            elif field == 2 and wire_type == 2:
+                msg.trace_context = value.decode("utf-8")
+        return msg

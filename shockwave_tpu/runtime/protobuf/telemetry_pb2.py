@@ -1,44 +1,22 @@
 """Hand-rolled protobuf for telemetry.proto (no protoc in this build).
 
-``MetricsDump`` carries one proto3 ``string text = 1`` field and
-implements exactly the two entry points the hand-rolled gRPC wiring
+``MetricsDump`` carries one proto3 ``string text = 1`` field;
+``MetricsRequest`` is the scrape request — one optional
+``string trace_context = 1`` (:mod:`shockwave_tpu.obs.propagate`) so a
+fleet scrape shows up in the causal trace. Both implement exactly the
+two entry points the hand-rolled gRPC wiring
 (:mod:`shockwave_tpu.runtime.rpc.wiring`) uses — ``SerializeToString``
 and ``FromString`` — emitting/consuming canonical proto3 wire format
-(tag 0x0A = field 1, wire type 2, varint length, UTF-8 bytes; empty
-string omitted), so a protoc-generated counterpart interoperates
-byte-for-byte. Unknown fields are skipped per proto3 rules, keeping the
-parser forward-compatible with a widened schema.
+(see :mod:`.wire`), so a protoc-generated counterpart interoperates
+byte-for-byte. An empty ``MetricsRequest`` serializes to zero bytes,
+i.e. it is wire-identical to ``Empty`` — old scrapers keep working
+unchanged. Unknown fields are skipped per proto3 rules, keeping the
+parsers forward-compatible with a widened schema.
 """
 
 from __future__ import annotations
 
-
-def _encode_varint(value: int) -> bytes:
-    out = bytearray()
-    while True:
-        bits = value & 0x7F
-        value >>= 7
-        if value:
-            out.append(bits | 0x80)
-        else:
-            out.append(bits)
-            return bytes(out)
-
-
-def _decode_varint(data: bytes, pos: int):
-    result = 0
-    shift = 0
-    while True:
-        if pos >= len(data):
-            raise ValueError("truncated varint")
-        byte = data[pos]
-        pos += 1
-        result |= (byte & 0x7F) << shift
-        if not byte & 0x80:
-            return result, pos
-        shift += 7
-        if shift > 63:
-            raise ValueError("varint too long")
+from shockwave_tpu.runtime.protobuf.wire import put_str, scan_fields
 
 
 class MetricsDump:
@@ -48,31 +26,35 @@ class MetricsDump:
         self.text = text
 
     def SerializeToString(self) -> bytes:  # noqa: N802 (protobuf API)
-        payload = self.text.encode("utf-8")
-        if not payload:
-            return b""
-        return b"\x0a" + _encode_varint(len(payload)) + payload
+        out = bytearray()
+        put_str(out, 1, self.text)
+        return bytes(out)
 
     @classmethod
     def FromString(cls, data: bytes) -> "MetricsDump":  # noqa: N802
-        text = ""
-        pos = 0
-        while pos < len(data):
-            tag, pos = _decode_varint(data, pos)
-            field, wire_type = tag >> 3, tag & 0x07
-            if wire_type == 2:  # length-delimited
-                length, pos = _decode_varint(data, pos)
-                if pos + length > len(data):
-                    raise ValueError("truncated length-delimited field")
-                if field == 1:
-                    text = data[pos : pos + length].decode("utf-8")
-                pos += length
-            elif wire_type == 0:  # varint (unknown field: skip)
-                _, pos = _decode_varint(data, pos)
-            elif wire_type == 5:  # 32-bit
-                pos += 4
-            elif wire_type == 1:  # 64-bit
-                pos += 8
-            else:
-                raise ValueError(f"unsupported wire type {wire_type}")
-        return cls(text)
+        msg = cls()
+        for field, wire_type, value in scan_fields(data):
+            if field == 1 and wire_type == 2:
+                msg.text = value.decode("utf-8")
+        return msg
+
+
+class MetricsRequest:
+    """message MetricsRequest { string trace_context = 1; } — wire-
+    identical to Empty when the context is absent."""
+
+    def __init__(self, trace_context: str = ""):
+        self.trace_context = trace_context
+
+    def SerializeToString(self) -> bytes:  # noqa: N802
+        out = bytearray()
+        put_str(out, 1, self.trace_context)
+        return bytes(out)
+
+    @classmethod
+    def FromString(cls, data: bytes) -> "MetricsRequest":  # noqa: N802
+        msg = cls()
+        for field, wire_type, value in scan_fields(data):
+            if field == 1 and wire_type == 2:
+                msg.trace_context = value.decode("utf-8")
+        return msg

@@ -1,0 +1,134 @@
+"""Shared proto3 wire-format helpers for the hand-rolled message
+modules (no protoc in this build).
+
+One copy of the varint/tag/field encoders and the tolerant field
+scanner that :mod:`admission_pb2`, :mod:`telemetry_pb2`,
+:mod:`worker_to_scheduler_pb2`, and :mod:`scheduler_to_worker_pb2` all
+build on. Everything emits canonical proto3 encoding — defaults
+omitted, fields in number order, repeated scalars PACKED (what protoc
+emits for proto3) — so a protoc-generated counterpart interoperates
+byte-for-byte; every parser skips unknown fields per proto3 rules,
+which is what keeps the RPC schema extensible without a flag day.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, List, Tuple
+
+
+def encode_varint(value: int) -> bytes:
+    # Negatives encode as 64-bit two's complement (protoc's behavior
+    # for int32/int64 fields); without the mask Python's arithmetic
+    # shift would never reach zero and the loop would hang.
+    value = int(value) & 0xFFFFFFFFFFFFFFFF
+    out = bytearray()
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def decode_varint(data: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated varint")
+        byte = data[pos]
+        pos += 1
+        result |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def tag(field: int, wire_type: int) -> bytes:
+    return encode_varint((field << 3) | wire_type)
+
+
+def put_str(out: bytearray, field: int, value: str) -> None:
+    payload = value.encode("utf-8")
+    if payload:
+        out += tag(field, 2) + encode_varint(len(payload)) + payload
+
+
+def put_varint(out: bytearray, field: int, value: int) -> None:
+    if value:
+        out += tag(field, 0) + encode_varint(int(value))
+
+
+def put_double(out: bytearray, field: int, value: float) -> None:
+    if value:
+        out += tag(field, 1) + struct.pack("<d", float(value))
+
+
+def put_msg(out: bytearray, field: int, payload: bytes) -> None:
+    out += tag(field, 2) + encode_varint(len(payload)) + payload
+
+
+def put_packed_varints(out: bytearray, field: int, values) -> None:
+    """Packed repeated varint field (proto3's default for repeated
+    scalars; empty lists are omitted)."""
+    if not values:
+        return
+    payload = b"".join(encode_varint(int(v)) for v in values)
+    put_msg(out, field, payload)
+
+
+def put_packed_doubles(out: bytearray, field: int, values) -> None:
+    if not values:
+        return
+    payload = b"".join(struct.pack("<d", float(v)) for v in values)
+    put_msg(out, field, payload)
+
+
+def unpack_packed_varints(payload: bytes) -> List[int]:
+    values = []
+    pos = 0
+    while pos < len(payload):
+        value, pos = decode_varint(payload, pos)
+        values.append(value)
+    return values
+
+
+def unpack_packed_doubles(payload: bytes) -> List[float]:
+    if len(payload) % 8:
+        raise ValueError("truncated packed double field")
+    return [v[0] for v in struct.iter_unpack("<d", payload)]
+
+
+def scan_fields(data: bytes) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field, wire_type, value) over a message's wire bytes;
+    length-delimited values come back as raw ``bytes``, 64-bit fields
+    as doubles (this schema has no fixed64 ints), varints as ints.
+    32-bit and unrecognized fields are skipped per proto3 rules."""
+    pos = 0
+    while pos < len(data):
+        field_tag, pos = decode_varint(data, pos)
+        field, wire_type = field_tag >> 3, field_tag & 0x07
+        if wire_type == 0:
+            value, pos = decode_varint(data, pos)
+        elif wire_type == 1:
+            if pos + 8 > len(data):
+                raise ValueError("truncated 64-bit field")
+            value = struct.unpack("<d", data[pos : pos + 8])[0]
+            pos += 8
+        elif wire_type == 2:
+            length, pos = decode_varint(data, pos)
+            if pos + length > len(data):
+                raise ValueError("truncated length-delimited field")
+            value = data[pos : pos + length]
+            pos += length
+        elif wire_type == 5:
+            pos += 4
+            continue  # 32-bit (unknown field: skip)
+        else:
+            raise ValueError(f"unsupported wire type {wire_type}")
+        yield field, wire_type, value

@@ -1,34 +1,309 @@
-# -*- coding: utf-8 -*-
-# Generated by the protocol buffer compiler.  DO NOT EDIT!
-# source: worker_to_scheduler.proto
-"""Generated protocol buffer code."""
-from google.protobuf.internal import builder as _builder
-from google.protobuf import descriptor as _descriptor
-from google.protobuf import descriptor_pool as _descriptor_pool
-from google.protobuf import symbol_database as _symbol_database
-# @@protoc_insertion_point(imports)
+"""Hand-rolled protobuf for worker_to_scheduler.proto (no protoc in
+this build; the frozen protoc originals live in ``legacy/`` as the
+wire-compat test fixtures).
 
-_sym_db = _symbol_database.Default()
+Implements the worker -> scheduler messages with exactly the two entry
+points the hand-rolled gRPC wiring uses — ``SerializeToString`` and
+``FromString`` — emitting/consuming canonical proto3 wire format
+(defaults omitted, repeated scalars packed, doubles little-endian) so
+the protoc-generated counterpart interoperates byte-for-byte. Unknown
+fields are skipped per proto3 rules.
+
+Schema extensions over the legacy wire (all optional; absent fields
+parse to defaults, and a default field serializes to zero bytes, so
+old and new peers interoperate in both directions):
+
+  * ``RegisterWorkerRequest.client_send_s`` (5, double) and
+    ``RegisterWorkerResponse.sched_recv_s``/``sched_send_s`` (5/6,
+    double) — the registration leg of the NTP-style clock-offset
+    exchange (worker wall clock out, scheduler wall clock back).
+  * ``Heartbeat.client_send_s`` (3, double) — each heartbeat restarts
+    the exchange; ``est_offset_s``/``est_rtt_s`` (4/5, double) report
+    the worker's current best estimate back to the scheduler
+    (``est_rtt_s > 0`` marks the pair valid — a real round trip is
+    never zero); ``trace_context`` (6, string) carries the agent's
+    causal context (:mod:`shockwave_tpu.obs.propagate`).
+  * ``HeartbeatAck`` — NEW response message for SendHeartbeat
+    (``sched_recv_s``/``sched_send_s``); an old scheduler still
+    returns ``Empty``, which parses here as an ack with no timestamps
+    (no sample taken), and an old worker parses the ack as ``Empty``
+    with unknown fields skipped.
+  * ``DoneRequest.trace_context`` (6, repeated string) — one causal
+    context per reported job, parallel to ``job_id``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from shockwave_tpu.runtime.protobuf.wire import (
+    put_double,
+    put_msg,
+    put_packed_doubles,
+    put_packed_varints,
+    put_str,
+    put_varint,
+    scan_fields,
+    unpack_packed_doubles,
+    unpack_packed_varints,
+)
 
 
-from . import common_pb2 as common__pb2
+class RegisterWorkerRequest:
+    """message RegisterWorkerRequest { worker_type, num_accelerators,
+    ip_addr, port, client_send_s }"""
+
+    def __init__(
+        self,
+        worker_type: str = "",
+        num_accelerators: int = 0,
+        ip_addr: str = "",
+        port: int = 0,
+        client_send_s: float = 0.0,
+    ):
+        self.worker_type = worker_type
+        self.num_accelerators = int(num_accelerators)
+        self.ip_addr = ip_addr
+        self.port = int(port)
+        self.client_send_s = float(client_send_s)
+
+    def SerializeToString(self) -> bytes:  # noqa: N802 (protobuf API)
+        out = bytearray()
+        put_str(out, 1, self.worker_type)
+        put_varint(out, 2, self.num_accelerators)
+        put_str(out, 3, self.ip_addr)
+        put_varint(out, 4, self.port)
+        put_double(out, 5, self.client_send_s)
+        return bytes(out)
+
+    @classmethod
+    def FromString(cls, data: bytes) -> "RegisterWorkerRequest":  # noqa: N802
+        msg = cls()
+        for field, wire_type, value in scan_fields(data):
+            if field == 1 and wire_type == 2:
+                msg.worker_type = value.decode("utf-8")
+            elif field == 2 and wire_type == 0:
+                msg.num_accelerators = int(value)
+            elif field == 3 and wire_type == 2:
+                msg.ip_addr = value.decode("utf-8")
+            elif field == 4 and wire_type == 0:
+                msg.port = int(value)
+            elif field == 5 and wire_type == 1:
+                msg.client_send_s = value
+        return msg
 
 
-DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile(b'\n\x19worker_to_scheduler.proto\x12\rshockwave_tpu\x1a\x0c\x63ommon.proto\"e\n\x15RegisterWorkerRequest\x12\x13\n\x0bworker_type\x18\x01 \x01(\t\x12\x18\n\x10num_accelerators\x18\x02 \x01(\r\x12\x0f\n\x07ip_addr\x18\x03 \x01(\t\x12\x0c\n\x04port\x18\x04 \x01(\r\"l\n\x16RegisterWorkerResponse\x12\x0f\n\x07success\x18\x01 \x01(\x08\x12\x12\n\nworker_ids\x18\x02 \x03(\x04\x12\x16\n\x0eround_duration\x18\x03 \x01(\x04\x12\x15\n\rerror_message\x18\x04 \x01(\t\"J\n\tHeartbeat\x12\x11\n\tworker_id\x18\x01 \x01(\x04\x12*\n\tjob_state\x18\x02 \x03(\x0b\x32\x17.shockwave_tpu.JobState\"q\n\x0b\x44oneRequest\x12\x11\n\tworker_id\x18\x01 \x01(\x04\x12\x0e\n\x06job_id\x18\x02 \x03(\x04\x12\x11\n\tnum_steps\x18\x03 \x03(\x04\x12\x16\n\x0e\x65xecution_time\x18\x04 \x03(\x01\x12\x14\n\x0citerator_log\x18\x05 \x03(\t2\xed\x01\n\x11WorkerToScheduler\x12]\n\x0eRegisterWorker\x12$.shockwave_tpu.RegisterWorkerRequest\x1a%.shockwave_tpu.RegisterWorkerResponse\x12?\n\rSendHeartbeat\x12\x18.shockwave_tpu.Heartbeat\x1a\x14.shockwave_tpu.Empty\x12\x38\n\x04\x44one\x12\x1a.shockwave_tpu.DoneRequest\x1a\x14.shockwave_tpu.Emptyb\x06proto3')
+class RegisterWorkerResponse:
+    """message RegisterWorkerResponse { success, worker_ids,
+    round_duration, error_message, sched_recv_s, sched_send_s }"""
 
-_builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())
-_builder.BuildTopDescriptorsAndMessages(DESCRIPTOR, 'worker_to_scheduler_pb2', globals())
-if _descriptor._USE_C_DESCRIPTORS == False:
+    def __init__(
+        self,
+        success: bool = False,
+        worker_ids: Optional[List[int]] = None,
+        round_duration: int = 0,
+        error_message: str = "",
+        sched_recv_s: float = 0.0,
+        sched_send_s: float = 0.0,
+    ):
+        self.success = bool(success)
+        self.worker_ids = [int(w) for w in (worker_ids or [])]
+        self.round_duration = int(round_duration)
+        self.error_message = error_message
+        self.sched_recv_s = float(sched_recv_s)
+        self.sched_send_s = float(sched_send_s)
 
-  DESCRIPTOR._options = None
-  _REGISTERWORKERREQUEST._serialized_start=58
-  _REGISTERWORKERREQUEST._serialized_end=159
-  _REGISTERWORKERRESPONSE._serialized_start=161
-  _REGISTERWORKERRESPONSE._serialized_end=269
-  _HEARTBEAT._serialized_start=271
-  _HEARTBEAT._serialized_end=345
-  _DONEREQUEST._serialized_start=347
-  _DONEREQUEST._serialized_end=460
-  _WORKERTOSCHEDULER._serialized_start=463
-  _WORKERTOSCHEDULER._serialized_end=700
-# @@protoc_insertion_point(module_scope)
+    def SerializeToString(self) -> bytes:  # noqa: N802
+        out = bytearray()
+        put_varint(out, 1, int(self.success))
+        put_packed_varints(out, 2, self.worker_ids)
+        put_varint(out, 3, self.round_duration)
+        put_str(out, 4, self.error_message)
+        put_double(out, 5, self.sched_recv_s)
+        put_double(out, 6, self.sched_send_s)
+        return bytes(out)
+
+    @classmethod
+    def FromString(cls, data: bytes) -> "RegisterWorkerResponse":  # noqa: N802
+        msg = cls()
+        for field, wire_type, value in scan_fields(data):
+            if field == 1 and wire_type == 0:
+                msg.success = bool(value)
+            elif field == 2 and wire_type == 2:
+                msg.worker_ids.extend(unpack_packed_varints(value))
+            elif field == 2 and wire_type == 0:
+                msg.worker_ids.append(int(value))  # unpacked sender
+            elif field == 3 and wire_type == 0:
+                msg.round_duration = int(value)
+            elif field == 4 and wire_type == 2:
+                msg.error_message = value.decode("utf-8")
+            elif field == 5 and wire_type == 1:
+                msg.sched_recv_s = value
+            elif field == 6 and wire_type == 1:
+                msg.sched_send_s = value
+        return msg
+
+
+class JobState:
+    """message JobState (common.proto) { job_id, status } — carried in
+    heartbeats; ``status`` is the JobStatus enum's integer value."""
+
+    def __init__(self, job_id: int = 0, status: int = 0):
+        self.job_id = int(job_id)
+        self.status = int(status)
+
+    def SerializeToString(self) -> bytes:  # noqa: N802
+        out = bytearray()
+        put_varint(out, 1, self.job_id)
+        put_varint(out, 2, self.status)
+        return bytes(out)
+
+    @classmethod
+    def FromString(cls, data: bytes) -> "JobState":  # noqa: N802
+        msg = cls()
+        for field, wire_type, value in scan_fields(data):
+            if field == 1 and wire_type == 0:
+                msg.job_id = int(value)
+            elif field == 2 and wire_type == 0:
+                msg.status = int(value)
+        return msg
+
+
+class Heartbeat:
+    """message Heartbeat { worker_id, job_state, client_send_s,
+    est_offset_s, est_rtt_s, trace_context }"""
+
+    def __init__(
+        self,
+        worker_id: int = 0,
+        job_state: Optional[List[JobState]] = None,
+        client_send_s: float = 0.0,
+        est_offset_s: float = 0.0,
+        est_rtt_s: float = 0.0,
+        trace_context: str = "",
+    ):
+        self.worker_id = int(worker_id)
+        self.job_state = list(job_state) if job_state else []
+        self.client_send_s = float(client_send_s)
+        self.est_offset_s = float(est_offset_s)
+        self.est_rtt_s = float(est_rtt_s)
+        self.trace_context = trace_context
+
+    def SerializeToString(self) -> bytes:  # noqa: N802
+        out = bytearray()
+        put_varint(out, 1, self.worker_id)
+        for state in self.job_state:
+            put_msg(out, 2, state.SerializeToString())
+        put_double(out, 3, self.client_send_s)
+        put_double(out, 4, self.est_offset_s)
+        put_double(out, 5, self.est_rtt_s)
+        put_str(out, 6, self.trace_context)
+        return bytes(out)
+
+    @classmethod
+    def FromString(cls, data: bytes) -> "Heartbeat":  # noqa: N802
+        msg = cls()
+        for field, wire_type, value in scan_fields(data):
+            if field == 1 and wire_type == 0:
+                msg.worker_id = int(value)
+            elif field == 2 and wire_type == 2:
+                msg.job_state.append(JobState.FromString(value))
+            elif field == 3 and wire_type == 1:
+                msg.client_send_s = value
+            elif field == 4 and wire_type == 1:
+                msg.est_offset_s = value
+            elif field == 5 and wire_type == 1:
+                msg.est_rtt_s = value
+            elif field == 6 and wire_type == 2:
+                msg.trace_context = value.decode("utf-8")
+        return msg
+
+
+class HeartbeatAck:
+    """message HeartbeatAck { sched_recv_s, sched_send_s } — the
+    scheduler's side of the NTP exchange. Wire-compatible with Empty in
+    both directions (all fields optional)."""
+
+    def __init__(self, sched_recv_s: float = 0.0, sched_send_s: float = 0.0):
+        self.sched_recv_s = float(sched_recv_s)
+        self.sched_send_s = float(sched_send_s)
+
+    def SerializeToString(self) -> bytes:  # noqa: N802
+        out = bytearray()
+        put_double(out, 1, self.sched_recv_s)
+        put_double(out, 2, self.sched_send_s)
+        return bytes(out)
+
+    @classmethod
+    def FromString(cls, data: bytes) -> "HeartbeatAck":  # noqa: N802
+        msg = cls()
+        for field, wire_type, value in scan_fields(data):
+            if field == 1 and wire_type == 1:
+                msg.sched_recv_s = value
+            elif field == 2 and wire_type == 1:
+                msg.sched_send_s = value
+        return msg
+
+
+class DoneRequest:
+    """message DoneRequest { worker_id, job_id, num_steps,
+    execution_time, iterator_log, trace_context }"""
+
+    def __init__(
+        self,
+        worker_id: int = 0,
+        job_id: Optional[List[int]] = None,
+        num_steps: Optional[List[int]] = None,
+        execution_time: Optional[List[float]] = None,
+        iterator_log: Optional[List[str]] = None,
+        trace_context: Optional[List[str]] = None,
+    ):
+        self.worker_id = int(worker_id)
+        self.job_id = [int(j) for j in (job_id or [])]
+        self.num_steps = [int(s) for s in (num_steps or [])]
+        self.execution_time = [float(t) for t in (execution_time or [])]
+        self.iterator_log = [str(x) for x in (iterator_log or [])]
+        self.trace_context = [str(x) for x in (trace_context or [])]
+
+    def SerializeToString(self) -> bytes:  # noqa: N802
+        out = bytearray()
+        put_varint(out, 1, self.worker_id)
+        put_packed_varints(out, 2, self.job_id)
+        put_packed_varints(out, 3, self.num_steps)
+        put_packed_doubles(out, 4, self.execution_time)
+        for log in self.iterator_log:
+            # Repeated strings serialize every element, empty included
+            # (unlike singular strings, where empty means absent) —
+            # dropping one would shift the per-job parallel arrays.
+            put_msg(out, 5, log.encode("utf-8"))
+        if any(self.trace_context):
+            # Every entry serializes (even empty ones) to keep the
+            # per-job parallel-array alignment; an all-empty list is
+            # omitted entirely for legacy byte identity.
+            for ctx in self.trace_context:
+                put_msg(out, 6, ctx.encode("utf-8"))
+        return bytes(out)
+
+    @classmethod
+    def FromString(cls, data: bytes) -> "DoneRequest":  # noqa: N802
+        msg = cls()
+        for field, wire_type, value in scan_fields(data):
+            if field == 1 and wire_type == 0:
+                msg.worker_id = int(value)
+            elif field == 2 and wire_type == 2:
+                msg.job_id.extend(unpack_packed_varints(value))
+            elif field == 2 and wire_type == 0:
+                msg.job_id.append(int(value))
+            elif field == 3 and wire_type == 2:
+                msg.num_steps.extend(unpack_packed_varints(value))
+            elif field == 3 and wire_type == 0:
+                msg.num_steps.append(int(value))
+            elif field == 4 and wire_type == 2:
+                msg.execution_time.extend(unpack_packed_doubles(value))
+            elif field == 4 and wire_type == 1:
+                msg.execution_time.append(value)
+            elif field == 5 and wire_type == 2:
+                msg.iterator_log.append(value.decode("utf-8"))
+            elif field == 6 and wire_type == 2:
+                msg.trace_context.append(value.decode("utf-8"))
+        return msg

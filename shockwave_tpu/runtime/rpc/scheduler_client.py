@@ -32,6 +32,9 @@ class SchedulerRpcClient:
         self._addr = f"{server_ip_addr}:{port}"
         self._retry = retry or RetryPolicy.from_env()
         self._teardown_retry = self._retry.single_shot()
+        # Metrics scrapes are periodic: the next poll is the retry, and
+        # a backoff pile-up behind a dead worker helps nobody.
+        self._scrape_retry = self._teardown_retry
 
     def _stubs(self, channel):
         return make_stubs(channel, "SchedulerToWorker")
@@ -60,6 +63,7 @@ class SchedulerRpcClient:
                 num_steps=d["num_steps"],
                 has_duration=d.get("has_duration", False),
                 duration=int(d.get("duration", 0)),
+                trace_context=d.get("trace_context", ""),
             )
             for d in job_descriptions
         ]
@@ -73,12 +77,30 @@ class SchedulerRpcClient:
             lambda stubs, timeout: stubs.RunJob(request, timeout=timeout),
         )
 
-    def kill_job(self, job_id: int) -> None:
-        request = s2w_pb2.KillJobRequest(job_id=job_id)
+    def kill_job(self, job_id: int, trace_context: str = "") -> None:
+        request = s2w_pb2.KillJobRequest(
+            job_id=job_id, trace_context=trace_context
+        )
         self._call(
             "KillJob",
             lambda stubs, timeout: stubs.KillJob(request, timeout=timeout),
         )
+
+    def dump_worker_metrics(self, trace_context: str = "") -> str:
+        """Scrape the worker agent's metrics registry (Prometheus
+        exposition text) — the fleet telemetry plane's pull
+        (obs/fleet.py merges these under a worker label)."""
+        from shockwave_tpu.runtime.protobuf import telemetry_pb2
+
+        request = telemetry_pb2.MetricsRequest(trace_context=trace_context)
+        response = self._call(
+            "DumpMetrics",
+            lambda stubs, timeout: stubs.DumpMetrics(
+                request, timeout=timeout
+            ),
+            policy=self._scrape_retry,
+        )
+        return response.text
 
     def reset(self) -> None:
         self._call(

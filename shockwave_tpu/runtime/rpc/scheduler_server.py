@@ -32,6 +32,9 @@ LOG = logging.getLogger("runtime.scheduler_server")
 
 def _worker_to_scheduler_handlers(callbacks):
     def RegisterWorker(request, context):
+        import time
+
+        recv_s = time.time()
         try:
             worker_ids, round_duration = callbacks["register_worker"](
                 request.worker_type,
@@ -39,10 +42,16 @@ def _worker_to_scheduler_handlers(callbacks):
                 request.ip_addr,
                 request.port,
             )
+            # The scheduler's receive/send wall clock rides back so the
+            # agent can take its first NTP-style clock-offset sample
+            # (obs/propagate + merge_traces rely on these; a legacy
+            # agent just skips the unknown fields).
             return w2s_pb2.RegisterWorkerResponse(
                 success=True,
                 worker_ids=worker_ids,
                 round_duration=int(round_duration),
+                sched_recv_s=recv_s,
+                sched_send_s=time.time(),
             )
         except Exception as e:  # noqa: BLE001 - reported to the caller
             LOG.exception("RegisterWorker failed")
@@ -51,10 +60,19 @@ def _worker_to_scheduler_handlers(callbacks):
             )
 
     def SendHeartbeat(request, context):
+        import time
+
+        recv_s = time.time()
         cb = callbacks.get("heartbeat")
         if cb is not None:
-            cb(request.worker_id)
-        return common_pb2.Empty()
+            cb(
+                request.worker_id,
+                est_offset_s=request.est_offset_s,
+                est_rtt_s=request.est_rtt_s,
+            )
+        return w2s_pb2.HeartbeatAck(
+            sched_recv_s=recv_s, sched_send_s=time.time()
+        )
 
     def Done(request, context):
         callbacks["done"](
@@ -63,6 +81,7 @@ def _worker_to_scheduler_handlers(callbacks):
             list(request.num_steps),
             list(request.execution_time),
             list(request.iterator_log),
+            trace_contexts=list(request.trace_context),
         )
         return common_pb2.Empty()
 
@@ -129,6 +148,7 @@ def _admission_handlers(callbacks):
                     "duration": spec.duration,
                     "needs_data_dir": spec.needs_data_dir,
                     "tenant": spec.tenant,
+                    "trace_context": spec.trace_context,
                 }
                 for spec in request.jobs
             ]

@@ -32,6 +32,7 @@ import grpc
 LOG = logging.getLogger("runtime.submitter_client")
 
 from shockwave_tpu import obs
+from shockwave_tpu.obs import propagate
 from shockwave_tpu.runtime import faults
 from shockwave_tpu.runtime.admission import job_to_spec_dict
 from shockwave_tpu.runtime.protobuf import admission_pb2 as adm_pb2
@@ -103,12 +104,39 @@ class SubmitterClient:
         queue_depth); raises :class:`SubmissionRejected` on INVALID/
         ERROR statuses."""
         token = token if token is not None else self.next_token()
-        specs = [
-            adm_pb2.JobSpec(**(j if isinstance(j, dict) else job_to_spec_dict(j)))
+        spec_dicts = [
+            dict(j) if isinstance(j, dict) else job_to_spec_dict(j)
             for j in jobs
         ]
+        # Causal roots: each traced job's whole cross-process life hangs
+        # under the context minted HERE (submit is the chain's first
+        # event). Created once per call, BEFORE the retry loop — a
+        # transport retry re-sends the same context with the same token.
+        for spec in spec_dicts:
+            if spec.get("trace_context"):
+                continue
+            ctx = propagate.new_root()
+            if ctx is None or not ctx.sampled:
+                continue
+            spec["trace_context"] = ctx.to_wire()
+            obs.instant(
+                "job_submit", cat="job", pid="submitter", tid="jobs",
+                args={"job_type": spec.get("job_type", ""),
+                      "token": token, **ctx.args()},
+            )
+        # The batch RPC's own context: forced-sampled iff any member
+        # job sampled, so it never consumes the deterministic sampling
+        # counter (which would alias the per-job pattern — e.g. at
+        # fraction 0.5 with one-job batches, alternating draws would
+        # sample 100% of jobs and 0% of batches).
+        batch_ctx = None
+        if any(spec.get("trace_context") for spec in spec_dicts):
+            batch_ctx = propagate.new_root(force_sample=True)
         request = adm_pb2.SubmitJobsRequest(
-            token=token, jobs=specs, close=close
+            token=token,
+            jobs=[adm_pb2.JobSpec(**spec) for spec in spec_dicts],
+            close=close,
+            trace_context=propagate.ctx_wire(batch_ctx),
         )
 
         def attempt(timeout):
@@ -126,7 +154,14 @@ class SubmitterClient:
             faults.note_rpc_success("SubmitJobs")
             return response
 
-        response = call_with_retry(attempt, self._retry, method="SubmitJobs")
+        with obs.span(
+            "submit_jobs", cat="rpc", pid="submitter", tid="rpc",
+            args={"token": token, "jobs": len(spec_dicts),
+                  **propagate.ctx_args(batch_ctx)},
+        ):
+            response = call_with_retry(
+                attempt, self._retry, method="SubmitJobs"
+            )
         if response.status in ("INVALID", "ERROR"):
             raise SubmissionRejected(response.status, response.error)
         if response.status == "QUOTA":

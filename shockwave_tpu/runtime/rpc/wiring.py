@@ -31,17 +31,33 @@ SERVICES = {
             w2s_pb2.RegisterWorkerRequest,
             w2s_pb2.RegisterWorkerResponse,
         ),
-        "SendHeartbeat": (w2s_pb2.Heartbeat, common_pb2.Empty),
+        # The ack carries the scheduler's receive/send timestamps for
+        # the NTP-style clock-offset exchange; it is wire-compatible
+        # with the legacy Empty in both directions (all fields
+        # optional, proto3 unknown-field tolerance).
+        "SendHeartbeat": (w2s_pb2.Heartbeat, w2s_pb2.HeartbeatAck),
         "Done": (w2s_pb2.DoneRequest, common_pb2.Empty),
         # Observability: scrape the scheduler's metrics registry as
-        # Prometheus exposition text (see obs.render_prometheus).
-        "DumpMetrics": (common_pb2.Empty, telemetry_pb2.MetricsDump),
+        # Prometheus exposition text (see obs.render_prometheus). The
+        # request is wire-identical to the legacy Empty when it
+        # carries no trace context.
+        "DumpMetrics": (
+            telemetry_pb2.MetricsRequest,
+            telemetry_pb2.MetricsDump,
+        ),
     },
     "SchedulerToWorker": {
         "RunJob": (s2w_pb2.RunJobRequest, common_pb2.Empty),
         "KillJob": (s2w_pb2.KillJobRequest, common_pb2.Empty),
         "Reset": (common_pb2.Empty, common_pb2.Empty),
         "Shutdown": (common_pb2.Empty, common_pb2.Empty),
+        # Observability, the other direction: the scheduler's fleet
+        # telemetry plane polls each worker agent's registry and
+        # merges the series under a worker label (obs/fleet.py).
+        "DumpMetrics": (
+            telemetry_pb2.MetricsRequest,
+            telemetry_pb2.MetricsDump,
+        ),
     },
     "IteratorToScheduler": {
         "InitJob": (it_pb2.InitJobRequest, it_pb2.UpdateLeaseResponse),

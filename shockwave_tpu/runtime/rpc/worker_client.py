@@ -21,6 +21,19 @@ from shockwave_tpu.runtime.retry import RetryPolicy, call_with_retry
 from shockwave_tpu.runtime.rpc.wiring import make_stubs
 
 
+def _clock_sample(t0, t1, t2, t3):
+    """Classic NTP sample from one request/response exchange: the
+    worker sent at t0 (its clock), the scheduler received at t1 and
+    replied at t2 (its clock), the worker got the reply at t3. Returns
+    (offset_s, rtt_s) where offset = scheduler_clock - worker_clock,
+    or ``None`` when the peer echoed no timestamps (legacy schema)."""
+    if not t1 or not t2:
+        return None
+    offset = ((t1 - t0) + (t2 - t3)) / 2.0
+    rtt = max((t3 - t0) - (t2 - t1), 1e-9)
+    return offset, rtt
+
+
 class WorkerRpcClient:
     def __init__(
         self,
@@ -56,12 +69,20 @@ class WorkerRpcClient:
     def register_worker(
         self, worker_type: str, num_accelerators: int, ip_addr: str, port: int
     ):
-        """Returns (worker_ids, round_duration, error_message)."""
+        """Returns (worker_ids, round_duration, error_message,
+        clock_sample) — ``clock_sample`` is the registration leg's
+        NTP-style (offset_s, rtt_s) estimate of
+        ``scheduler_clock - worker_clock``, or ``None`` against a
+        legacy scheduler that echoes no timestamps."""
+        import time
+
+        t0 = time.time()
         request = w2s_pb2.RegisterWorkerRequest(
             worker_type=worker_type,
             num_accelerators=num_accelerators,
             ip_addr=ip_addr,
             port=port,
+            client_send_s=t0,
         )
         response = self._call(
             "RegisterWorker",
@@ -69,42 +90,79 @@ class WorkerRpcClient:
                 request, timeout=timeout
             ),
         )
+        t3 = time.time()
         if not response.success:
-            return None, None, response.error_message
-        return list(response.worker_ids), response.round_duration, None
+            return None, None, response.error_message, None
+        sample = _clock_sample(t0, response.sched_recv_s,
+                               response.sched_send_s, t3)
+        return (
+            list(response.worker_ids),
+            response.round_duration,
+            None,
+            sample,
+        )
 
-    def send_heartbeat(self, worker_id: int) -> None:
-        self._call(
+    def send_heartbeat(
+        self,
+        worker_id: int,
+        est_offset_s: float = 0.0,
+        est_rtt_s: float = 0.0,
+        trace_context: str = "",
+    ):
+        """One liveness ping; doubles as a clock-offset exchange.
+        Reports the worker's current best (offset, rtt) estimate to the
+        scheduler and returns this ping's fresh (offset_s, rtt_s)
+        sample — ``None`` against a legacy scheduler."""
+        import time
+
+        t0 = time.time()
+        response = self._call(
             "SendHeartbeat",
             lambda stubs, timeout: stubs.SendHeartbeat(
-                w2s_pb2.Heartbeat(worker_id=worker_id), timeout=timeout
+                w2s_pb2.Heartbeat(
+                    worker_id=worker_id,
+                    client_send_s=t0,
+                    est_offset_s=est_offset_s,
+                    est_rtt_s=est_rtt_s,
+                    trace_context=trace_context,
+                ),
+                timeout=timeout,
             ),
             policy=self._heartbeat_retry,
         )
+        return _clock_sample(
+            t0, response.sched_recv_s, response.sched_send_s, time.time()
+        )
 
-    def dump_metrics(self) -> str:
+    def dump_metrics(self, trace_context: str = "") -> str:
         """Scrape the scheduler's metrics registry (Prometheus
         exposition text; the /metrics-style dump RPC)."""
-        from shockwave_tpu.runtime.protobuf import common_pb2
+        from shockwave_tpu.runtime.protobuf import telemetry_pb2
 
+        request = telemetry_pb2.MetricsRequest(trace_context=trace_context)
         response = self._call(
             "DumpMetrics",
             lambda stubs, timeout: stubs.DumpMetrics(
-                common_pb2.Empty(), timeout=timeout
+                request, timeout=timeout
             ),
         )
         return response.text
 
     def notify_scheduler(
-        self, worker_id, job_ids, num_steps, execution_times, iterator_logs
+        self, worker_id, job_ids, num_steps, execution_times, iterator_logs,
+        trace_contexts=None,
     ) -> None:
-        """Report completed micro-tasks (reference: worker_client.py:62-86)."""
+        """Report completed micro-tasks (reference: worker_client.py:62-86).
+        ``trace_contexts`` (parallel to ``job_ids``) carries each
+        micro-task's run-span context back to the scheduler so its
+        completion handling joins the job's causal chain."""
         request = w2s_pb2.DoneRequest(
             worker_id=worker_id,
             job_id=[int(j) for j in job_ids],
             num_steps=[int(s) for s in num_steps],
             execution_time=[float(t) for t in execution_times],
             iterator_log=[str(x) for x in iterator_logs],
+            trace_context=[str(x) for x in (trace_contexts or [])],
         )
         self._call(
             "Done",

@@ -2,7 +2,11 @@
 scheduler/runtime/rpc/worker_server.py).
 
 Callbacks: run_job(job_descriptions, worker_id, round_id),
-kill_job(job_id), reset(), shutdown().
+kill_job(job_id), reset(), shutdown(). Job descriptions carry the
+dispatching scheduler span's ``trace_context`` so the dispatcher's
+launch/run spans join the job's cross-process causal chain
+(obs/propagate.py). DumpMetrics serves the agent's own metrics
+registry to the scheduler's fleet telemetry plane (obs/fleet.py).
 """
 
 from __future__ import annotations
@@ -27,6 +31,7 @@ def _handlers(callbacks):
                 "num_steps_arg": d.num_steps_arg,
                 "num_steps": d.num_steps,
                 "duration": d.duration if d.has_duration else None,
+                "trace_context": d.trace_context,
             }
             for d in request.job_descriptions
         ]
@@ -34,8 +39,27 @@ def _handlers(callbacks):
         return common_pb2.Empty()
 
     def KillJob(request, context):
+        from shockwave_tpu import obs
+        from shockwave_tpu.obs import propagate
+
+        kill_ctx = propagate.from_wire(request.trace_context)
+        if kill_ctx is not None:
+            # The kill lands in the job's causal chain as a child of
+            # the scheduler's kill span.
+            obs.instant(
+                "kill_job", cat="worker", pid="worker", tid="control",
+                args={"job_id": int(request.job_id),
+                      "trace_id": kill_ctx.trace_id,
+                      "parent_span_id": kill_ctx.span_id},
+            )
         callbacks["kill_job"](request.job_id)
         return common_pb2.Empty()
+
+    def DumpMetrics(request, context):
+        from shockwave_tpu import obs
+        from shockwave_tpu.runtime.protobuf import telemetry_pb2
+
+        return telemetry_pb2.MetricsDump(text=obs.render_prometheus())
 
     def Reset(request, context):
         callbacks["reset"]()
@@ -50,6 +74,7 @@ def _handlers(callbacks):
         "KillJob": KillJob,
         "Reset": Reset,
         "Shutdown": Shutdown,
+        "DumpMetrics": DumpMetrics,
     }
 
 

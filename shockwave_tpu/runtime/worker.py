@@ -1,5 +1,15 @@
 """The worker agent: registers with the scheduler, serves SchedulerToWorker,
 and owns the dispatcher. Reference: scheduler/worker.py.
+
+Fleet observability: every register/heartbeat exchange doubles as an
+NTP-style clock sample (offset of the scheduler's wall clock against
+this host's), the rolling best estimate is reported back on each
+heartbeat (the scheduler exports it per worker and the ``clock_skew``
+watchdog rule alerts on drift) and stamped into the trace export's
+clock metadata so ``scripts/analysis/merge_traces.py`` can align this
+process's timeline to scheduler time. Telemetry exports flush on
+SIGTERM too — a reclaimed worker must not take its whole telemetry
+file with it.
 """
 
 from __future__ import annotations
@@ -8,6 +18,7 @@ import argparse
 import logging
 import os
 import shutil
+import signal
 import socket
 import threading
 
@@ -27,6 +38,9 @@ class Worker:
         use_numactl: bool = False,
         heartbeat_interval_s: float = 1.0,
     ):
+        from shockwave_tpu import obs
+        from shockwave_tpu.obs import propagate
+        from shockwave_tpu.obs.fleet import ClockEstimator
         from shockwave_tpu.runtime.dispatcher import Dispatcher
         from shockwave_tpu.runtime.rpc import worker_server
         from shockwave_tpu.runtime.rpc.worker_client import WorkerRpcClient
@@ -34,6 +48,10 @@ class Worker:
         self._worker_type = worker_type
         self._port = port
         self._rpc_client = WorkerRpcClient(sched_addr, sched_port)
+        self._clock_sync = ClockEstimator()
+        # The agent's own causal context: heartbeats carry it so even
+        # control-plane pings are attributable to this agent's chain.
+        self._agent_ctx = propagate.new_root()
 
         # Clear stale checkpoints from a previous incarnation
         # (reference: worker.py:86-93).
@@ -55,13 +73,25 @@ class Worker:
         )
 
         ip_addr = socket.gethostbyname(socket.gethostname())
-        worker_ids, round_duration, error = self._rpc_client.register_worker(
-            worker_type, num_accelerators, ip_addr, port
+        worker_ids, round_duration, error, clock_sample = (
+            self._rpc_client.register_worker(
+                worker_type, num_accelerators, ip_addr, port
+            )
         )
         if error:
             raise RuntimeError(f"Worker registration failed: {error}")
         self._worker_ids = worker_ids
         self._round_duration = round_duration
+        self._clock_sync.add(clock_sample)
+        if obs.trace_enabled():
+            obs.get_tracer().set_meta(
+                {
+                    "role": "worker",
+                    "worker": str(min(worker_ids)),
+                    "worker_ids": list(worker_ids),
+                }
+            )
+            self._export_clock_meta()
         self._dispatcher = Dispatcher(
             round_duration,
             list(range(num_accelerators)),
@@ -89,16 +119,46 @@ class Worker:
             round_duration,
         )
 
+    def _export_clock_meta(self) -> None:
+        """Stamp the current best clock-offset estimate into the trace
+        export's clock metadata (merge_traces.py's alignment input)."""
+        from shockwave_tpu import obs
+
+        best = self._clock_sync.best()
+        if best is None:
+            return
+        obs.get_tracer().set_meta(
+            {
+                "clock": {
+                    "offset_to_scheduler_s": best[0],
+                    "offset_rtt_s": best[1],
+                }
+            }
+        )
+
     def _heartbeat_loop(self):
+        from shockwave_tpu import obs
+        from shockwave_tpu.obs import propagate
+
         while not self._shutdown_event.wait(self._heartbeat_interval):
+            best = self._clock_sync.best()
             for worker_id in self._worker_ids:
                 try:
-                    self._rpc_client.send_heartbeat(worker_id)
+                    sample = self._rpc_client.send_heartbeat(
+                        worker_id,
+                        est_offset_s=best[0] if best else 0.0,
+                        est_rtt_s=best[1] if best else 0.0,
+                        trace_context=propagate.ctx_wire(self._agent_ctx),
+                    )
                 except Exception:
                     # Single-shot by policy: the next tick is the retry,
                     # and the scheduler being briefly unreachable is not
                     # this worker's emergency.
                     LOG.debug("heartbeat failed", exc_info=True)
+                    continue
+                self._clock_sync.add(sample)
+            if obs.trace_enabled():
+                self._export_clock_meta()
 
     # -- RPC callbacks --------------------------------------------------
     def _run_job_callback(self, job_descriptions, worker_id, round_id):
@@ -119,6 +179,17 @@ class Worker:
         self._server.stop(grace=2)
 
 
+def _export_telemetry(telemetry_out: dict) -> None:
+    """Flush the env-contract telemetry exports (idempotent: atomic
+    temp+rename writes, so a double flush just rewrites the file)."""
+    from shockwave_tpu import obs
+
+    if telemetry_out.get("metrics"):
+        obs.export_metrics(telemetry_out["metrics"])
+    if telemetry_out.get("trace"):
+        obs.export_trace(telemetry_out["trace"])
+
+
 def main():
     from shockwave_tpu import obs
 
@@ -137,7 +208,8 @@ def main():
     # Worker agents are subprocesses, so telemetry rides the env contract
     # (SHOCKWAVE_METRICS_OUT / SHOCKWAVE_TRACE_OUT name export paths) —
     # the physical drivers set it when their --metrics-out/--trace-out
-    # flags are given; dumps land at shutdown.
+    # flags are given; dumps land at shutdown AND on SIGTERM (a
+    # reclaimed/killed agent must not lose its whole telemetry file).
     telemetry_out = obs.configure_from_env()
     worker = Worker(
         args.worker_type,
@@ -149,11 +221,21 @@ def main():
         args.checkpoint_dir,
         use_numactl=args.use_numactl,
     )
+
+    def _on_sigterm(signum, frame):
+        # Keep the handler minimal: flush telemetry, then take the
+        # normal shutdown path (kill training processes, unblock join).
+        # A second SIGTERM mid-flush falls through to the default
+        # handler via the flag below.
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        try:
+            _export_telemetry(telemetry_out)
+        finally:
+            worker._shutdown_callback()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     worker.join()
-    if telemetry_out["metrics"]:
-        obs.export_metrics(telemetry_out["metrics"])
-    if telemetry_out["trace"]:
-        obs.export_trace(telemetry_out["trace"])
+    _export_telemetry(telemetry_out)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,660 @@
+"""Fleet observability tests: causal trace-context propagation, the
+NTP-style clock estimator, the fleet metrics plane (worker-label merge
++ scrape endpoint), the span-tree merge/latency-budget math, the
+clock_skew watchdog rule, the shared bucket-quantile helper, and the
+worker agent's SIGTERM telemetry flush."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from shockwave_tpu import obs
+from shockwave_tpu.obs import propagate, spantree
+from shockwave_tpu.obs.fleet import (
+    ClockEstimator,
+    FleetTelemetry,
+    merge_prometheus_texts,
+    relabel_prometheus_text,
+)
+from shockwave_tpu.obs.metrics import quantile_from_buckets
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def clean_obs():
+    obs.reset()
+    propagate.configure_sampling(None)
+    yield
+    obs.reset()
+    propagate.configure_sampling(None)
+
+
+# ----------------------------------------------------------------------
+# Trace-context propagation.
+# ----------------------------------------------------------------------
+class TestPropagate:
+    def test_disabled_tracing_short_circuits(self):
+        assert propagate.new_root() is None
+        assert propagate.ctx_args(None) == {}
+        assert propagate.ctx_wire(None) == ""
+
+    def test_root_child_and_wire_roundtrip(self):
+        obs.configure(trace=True)
+        root = propagate.new_root()
+        assert root is not None and root.sampled
+        child = root.child()
+        assert child.trace_id == root.trace_id
+        assert child.parent_span_id == root.span_id
+        assert child.span_id != root.span_id
+        parsed = propagate.from_wire(child.to_wire())
+        assert parsed.trace_id == root.trace_id
+        assert parsed.span_id == child.span_id
+        assert parsed.sampled
+
+    def test_from_wire_tolerates_garbage(self):
+        assert propagate.from_wire("") is None
+        assert propagate.from_wire("not-a-context") is None
+        assert propagate.from_wire("zz-yy-1") is None
+        assert propagate.from_wire("abc") is None
+
+    def test_args_shape(self):
+        obs.configure(trace=True)
+        root = propagate.new_root()
+        args = root.args()
+        assert args == {
+            "trace_id": root.trace_id, "span_id": root.span_id
+        }
+        child_args = root.child().args()
+        assert child_args["parent_span_id"] == root.span_id
+
+    def test_unsampled_context_ships_nothing(self):
+        ctx = propagate.TraceContext("aa", "bb", sampled=False)
+        assert ctx.to_wire() == ""
+        assert ctx.child().sampled is False
+
+    def test_deterministic_sampling_fraction(self):
+        obs.configure(trace=True)
+        propagate.configure_sampling(0.5)
+        decisions = [propagate.new_root().sampled for _ in range(6)]
+        assert decisions == [True, False, True, False, True, False]
+        propagate.configure_sampling(0.0)
+        assert propagate.new_root().sampled is False
+        propagate.configure_sampling(1.0)
+        assert propagate.new_root().sampled is True
+
+    def test_force_sample_skips_the_counter(self):
+        obs.configure(trace=True)
+        propagate.configure_sampling(0.5)
+        first = propagate.new_root()          # counter 0 -> sampled
+        forced = propagate.new_root(force_sample=True)
+        second = propagate.new_root()         # counter 1 -> unsampled
+        third = propagate.new_root()          # counter 2 -> sampled
+        assert first.sampled and forced.sampled
+        assert not second.sampled and third.sampled
+
+    def test_adopt_or_root_prefers_wire(self):
+        obs.configure(trace=True)
+        root = propagate.new_root()
+        adopted = propagate.adopt_or_root(root.to_wire())
+        assert adopted.trace_id == root.trace_id
+        fresh = propagate.adopt_or_root("")
+        assert fresh is not None and fresh.trace_id != root.trace_id
+
+
+# ----------------------------------------------------------------------
+# quantile_from_buckets (the factored p99 math).
+# ----------------------------------------------------------------------
+class TestQuantileFromBuckets:
+    def test_empty(self):
+        assert quantile_from_buckets({}, 0.99) == (None, 0)
+        assert quantile_from_buckets({"+Inf": 0}, 0.99) == (None, 0)
+
+    def test_single_bucket(self):
+        value, count = quantile_from_buckets(
+            {"1.0": 5, "+Inf": 5}, 0.99
+        )
+        assert value == 1.0 and count == 5
+
+    def test_inf_only_resolves_to_observed_max(self):
+        value, count = quantile_from_buckets(
+            {"+Inf": 7}, 0.99, observed_max=41.5
+        )
+        assert value == 41.5 and count == 7
+        value, _ = quantile_from_buckets({"+Inf": 7}, 0.99)
+        assert value is None
+
+    def test_typical_distribution(self):
+        buckets = {"0.1": 90, "1.0": 98, "10.0": 100, "+Inf": 100}
+        assert quantile_from_buckets(buckets, 0.5)[0] == 0.1
+        assert quantile_from_buckets(buckets, 0.99)[0] == 10.0
+        assert quantile_from_buckets(buckets, 0.95)[0] == 1.0
+
+    def test_watchdog_and_helper_agree(self):
+        from shockwave_tpu.obs.watchdog import Watchdog
+
+        obs.configure(metrics=True)
+        h = obs.get_registry().histogram("q_test")
+        for v in (0.02, 0.02, 0.02, 0.02, 40.0):
+            h.observe(v)
+        metrics = obs.get_registry().snapshot()["metrics"]
+        value, count = Watchdog._histogram_quantile(
+            metrics, "q_test", 0.99
+        )
+        series = metrics["q_test"]["series"][0]
+        direct = quantile_from_buckets(
+            series["buckets"], 0.99, series["max"]
+        )
+        assert (value, count) == direct
+
+
+# ----------------------------------------------------------------------
+# Clock estimation.
+# ----------------------------------------------------------------------
+def test_gauge_series_removal():
+    obs.configure(metrics=True)
+    gauge = obs.gauge("worker_clock_offset_seconds", "offset")
+    gauge.set(0.5, worker="3")
+    gauge.set(0.7, worker="5")
+    gauge.remove(worker="3")
+    gauge.remove(worker="99")  # absent series: no-op
+    snap = obs.get_registry().snapshot()["metrics"]
+    workers = [
+        s["labels"]["worker"]
+        for s in snap["worker_clock_offset_seconds"]["series"]
+    ]
+    assert workers == ["5"]
+
+
+def test_negative_varint_encodes_like_protoc():
+    from shockwave_tpu.runtime.protobuf.wire import (
+        decode_varint,
+        encode_varint,
+    )
+
+    encoded = encode_varint(-1)
+    assert len(encoded) == 10  # two's-complement 64-bit, protoc-style
+    value, pos = decode_varint(encoded, 0)
+    assert value == 0xFFFFFFFFFFFFFFFF and pos == 10
+
+
+class TestClockEstimator:
+    def test_min_rtt_sample_wins(self):
+        clock = ClockEstimator()
+        clock.add((0.5, 0.10))
+        clock.add((0.1, 0.01))  # tightest round trip
+        clock.add((0.9, 0.50))
+        assert clock.best() == (0.1, 0.01)
+        assert clock.offset() == 0.1
+
+    def test_none_and_invalid_ignored(self):
+        clock = ClockEstimator()
+        clock.add(None)
+        clock.add((1.0, 0.0))
+        clock.add((1.0, -1.0))
+        assert clock.best() is None and clock.offset() is None
+
+    def test_window_forgets_stale_best(self):
+        clock = ClockEstimator(window=2)
+        clock.add((0.1, 0.01))
+        clock.add((0.2, 0.05))
+        clock.add((0.3, 0.07))  # evicts the 0.01-rtt sample
+        assert clock.best() == (0.2, 0.05)
+
+    def test_ntp_sample_math(self):
+        from shockwave_tpu.runtime.rpc.worker_client import _clock_sample
+
+        # Worker clock 10 s behind scheduler, symmetric 0.1 s legs.
+        t0, t1, t2, t3 = 100.0, 110.1, 110.2, 100.3
+        offset, rtt = _clock_sample(t0, t1, t2, t3)
+        assert offset == pytest.approx(10.0)
+        assert rtt == pytest.approx(0.2)
+        assert _clock_sample(t0, 0.0, 0.0, t3) is None
+
+
+# ----------------------------------------------------------------------
+# Prometheus text merging.
+# ----------------------------------------------------------------------
+class TestPrometheusMerge:
+    def test_relabel_injects_worker_label(self):
+        text = (
+            "# HELP c jobs\n# TYPE c counter\n"
+            'c{kind="x"} 3\nc 1\n'
+        )
+        out = relabel_prometheus_text(text, worker="2")
+        assert 'c{kind="x",worker="2"} 3' in out
+        assert 'c{worker="2"} 1' in out
+        assert "# TYPE c counter" in out
+
+    def test_merge_dedupes_headers_and_keeps_samples(self):
+        sched = "# HELP c jobs\n# TYPE c counter\nc 1\n"
+        worker = '# HELP c jobs\n# TYPE c counter\nc{worker="2"} 3\n'
+        merged = merge_prometheus_texts([sched, worker])
+        assert merged.count("# TYPE c counter") == 1
+        assert "c 1" in merged and 'c{worker="2"} 3' in merged
+
+    def test_histogram_children_stay_with_family(self):
+        text = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="1.0"} 1\nh_bucket{le="+Inf"} 2\n'
+            "h_sum 1.5\nh_count 2\n"
+            "# TYPE h_min gauge\nh_min 0.5\n"
+        )
+        merged = merge_prometheus_texts([text])
+        lines = merged.splitlines()
+        assert lines.index("# TYPE h histogram") < lines.index("h_sum 1.5")
+        assert "# TYPE h_min gauge" in merged
+
+
+# ----------------------------------------------------------------------
+# FleetTelemetry: pull, merge, endpoints.
+# ----------------------------------------------------------------------
+class TestFleetTelemetry:
+    def test_poll_merge_and_render(self):
+        obs.configure(metrics=True)
+        obs.counter("sched_only_total", "scheduler series").inc()
+        fleet = FleetTelemetry(scrape_interval_s=30)
+        fleet.add_target(
+            "3",
+            lambda: "# TYPE worker_launches_total counter\n"
+            "worker_launches_total 5\n",
+        )
+        fleet.add_target(
+            "7",
+            lambda: "# TYPE worker_launches_total counter\n"
+            "worker_launches_total 2\n",
+        )
+        assert fleet.poll_once() == 2
+        text = fleet.render()
+        assert "sched_only_total 1" in text
+        assert 'worker_launches_total{worker="3"} 5' in text
+        assert 'worker_launches_total{worker="7"} 2' in text
+        assert text.count("# TYPE worker_launches_total counter") == 1
+
+    def test_failed_target_counted_not_fatal(self):
+        obs.configure(metrics=True)
+
+        def boom():
+            raise ConnectionError("worker gone")
+
+        fleet = FleetTelemetry(scrape_interval_s=30)
+        fleet.add_target("3", boom)
+        assert fleet.poll_once() == 0
+        snap = obs.get_registry().snapshot()["metrics"]
+        assert "fleet_scrape_failures_total" in snap
+
+    def test_remove_target_drops_dump(self):
+        fleet = FleetTelemetry(scrape_interval_s=30)
+        fleet.add_target("3", lambda: "x_total 1\n")
+        fleet.poll_once()
+        fleet.remove_target("3")
+        assert 'worker="3"' not in fleet.render()
+
+    def test_http_endpoints(self):
+        obs.configure(metrics=True)
+        obs.counter("sched_only_total", "scheduler series").inc()
+        fleet = FleetTelemetry(scrape_interval_s=30)
+        fleet.add_target("0", lambda: "w_total 1\n")
+        fleet.poll_once()
+        fleet.start(http_port=0)
+        try:
+            base = f"http://127.0.0.1:{fleet.port}"
+            with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+                body = r.read().decode()
+                assert r.status == 200
+                assert 'w_total{worker="0"} 1' in body
+                assert "sched_only_total 1" in body
+            with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+                health = json.loads(r.read().decode())
+                assert r.status == 200
+                assert health["status"] == "ok"
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(base + "/nope", timeout=5)
+            assert err.value.code == 404
+        finally:
+            fleet.stop()
+
+    def test_healthz_degraded_when_watchdog_gauge_zero(self):
+        obs.configure_watchdog()
+        obs.gauge("scheduler_health", "health").set(0.0)
+        obs.get_watchdog().alerts.append(
+            {"rule": "worst_ftf", "round": 1, "value": 9.0,
+             "threshold": 2.0, "time_s": 0.0}
+        )
+        fleet = FleetTelemetry(scrape_interval_s=30)
+        code, body = fleet.healthz()
+        assert code == 503 and body["status"] == "degraded"
+        assert body["watchdog"]["alerts"] == 1
+
+
+# ----------------------------------------------------------------------
+# clock_skew watchdog rule.
+# ----------------------------------------------------------------------
+class TestClockSkewRule:
+    def _offset(self, worker, value):
+        obs.gauge(
+            "worker_clock_offset_seconds", "offset"
+        ).set(value, worker=worker)
+
+    def test_fires_on_offset_past_threshold_once_per_episode(self):
+        obs.configure_watchdog()
+        watchdog = obs.get_watchdog()
+        self._offset("3", 2.5)
+        fired = watchdog.check_round(1, 1.0)
+        assert [a["rule"] for a in fired] == ["clock_skew"]
+        assert fired[0]["worker"] == "3"
+        # Persisting breach: no per-round spam.
+        assert watchdog.check_round(2, 2.0) == []
+        # Recovery re-arms; a new breach fires again.
+        self._offset("3", 0.0)
+        assert watchdog.check_round(3, 3.0) == []
+        self._offset("3", 3.0)
+        refired = watchdog.check_round(4, 4.0)
+        assert [a["rule"] for a in refired] == ["clock_skew"]
+
+    def test_fires_on_jump_between_heartbeats(self):
+        obs.configure_watchdog(
+            {"clock_skew": {"max_offset_s": 10.0, "max_jump_s": 0.2}}
+        )
+        watchdog = obs.get_watchdog()
+        self._offset("3", 0.1)
+        assert watchdog.check_round(1, 1.0) == []
+        self._offset("3", 0.9)  # |jump| = 0.8 > 0.2, offset under max
+        fired = watchdog.check_round(2, 2.0)
+        assert [a["rule"] for a in fired] == ["clock_skew"]
+        assert fired[0]["jump_s"] == pytest.approx(0.8)
+
+    def test_per_worker_isolation(self):
+        obs.configure_watchdog()
+        watchdog = obs.get_watchdog()
+        self._offset("3", 2.5)
+        self._offset("5", 0.0)
+        fired = watchdog.check_round(1, 1.0)
+        assert len(fired) == 1
+        # A second worker breaching is NOT masked by the first.
+        self._offset("5", -4.0)
+        fired = watchdog.check_round(2, 2.0)
+        assert [a["worker"] for a in fired] == ["5"]
+
+
+# ----------------------------------------------------------------------
+# Span-tree math.
+# ----------------------------------------------------------------------
+def _span(name, ts_s, dur_s, pid, ctx=None, **args):
+    e = {
+        "name": name, "ph": "X", "pid": pid, "tid": 1,
+        "ts": ts_s * 1e6, "dur": dur_s * 1e6,
+        "args": dict(args),
+    }
+    if ctx is not None:
+        e["args"].update(ctx.args())
+    return e
+
+
+def _instant(name, ts_s, pid, **args):
+    return {
+        "name": name, "ph": "i", "pid": pid, "tid": 1,
+        "ts": ts_s * 1e6, "args": dict(args),
+    }
+
+
+class TestSpanTree:
+    def _chain_events(self):
+        root = propagate.TraceContext("t1", "r1")
+        dispatch = root.child()
+        run = dispatch.child()
+        events = [
+            _instant("job_submit", 0.0, 1, trace_id="t1", span_id="r1",
+                     job_type="x"),
+            _instant("job_admitted", 1.0, 1, job_id=4, arrival_s=0.0,
+                     trace_id="t1", parent_span_id="r1"),
+            _span("queue_wait", 0.0, 1.0, 1, ctx=root.child(), job_id=4),
+            _span("solve:pdhg", 1.2, 0.5, 1),
+            _span("dispatch", 2.0, 0.1, 1, ctx=dispatch, job_id="4"),
+            _span("run_job", 2.2, 3.0, 2, ctx=run, job_id=4),
+            _instant("job_complete", 5.5, 1, job_id=4,
+                     trace_id="t1", parent_span_id="r1"),
+        ]
+        return events
+
+    def test_collect_and_connectivity(self):
+        chains = spantree.collect_chains(self._chain_events())
+        assert set(chains) == {"t1"}
+        summary = spantree.chain_summary(chains["t1"])
+        assert summary["connected"]
+        assert summary["processes"] == 2
+
+    def test_broken_chain_detected(self):
+        events = self._chain_events()
+        # Orphan the run span: its parent is no known node.
+        events[-2]["args"]["parent_span_id"] = "doesnotexist"
+        chains = spantree.collect_chains(events)
+        assert not spantree.chain_summary(chains["t1"])["connected"]
+
+    def test_latency_budget_segments(self):
+        budgets = spantree.latency_budget(self._chain_events())
+        assert set(budgets) == {"4"}
+        b = budgets["4"]
+        assert b["queue_wait_s"] == pytest.approx(1.0)
+        # solve overlaps [admitted=1.0, first_dispatch=2.0] for 0.5 s.
+        assert b["plan_exposed_s"] == pytest.approx(0.5)
+        assert b["dispatch_s"] == pytest.approx(0.1)
+        assert b["run_s"] == pytest.approx(3.0)
+        assert b["sync_s"] == pytest.approx(0.3)
+        assert b["total_s"] == pytest.approx(5.5)
+        fleet = spantree.budget_fleet_summary(budgets)
+        assert fleet["jobs"] == 1
+        assert fleet["mean_run_s"] == pytest.approx(3.0)
+        assert spantree.budget_fleet_summary({}) is None
+
+    def test_merge_aligns_clocks_and_draws_flows(self):
+        root = propagate.TraceContext("t1", "r1")
+        child = root.child()
+        sched_trace = {
+            "traceEvents": [
+                {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+                 "args": {"name": "scheduler"}},
+                _span("dispatch", 10.0, 0.1, 1, ctx=root),
+            ],
+            "otherData": {
+                "role": "scheduler",
+                "clock": {"wall_at_zero_s": 1000.0,
+                          "offset_to_scheduler_s": 0.0},
+            },
+        }
+        worker_trace = {
+            "traceEvents": [
+                {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+                 "args": {"name": "worker"}},
+                # Worker clock zero = wall 1007, and its wall clock runs
+                # 3 s behind the scheduler's (offset +3): an event at
+                # worker-trace 5 s is scheduler time 5 + (1007+3-1000).
+                _span("run_job", 5.0, 1.0, 1, ctx=child),
+            ],
+            "otherData": {
+                "role": "worker", "worker": "2",
+                "clock": {"wall_at_zero_s": 1007.0,
+                          "offset_to_scheduler_s": 3.0},
+            },
+        }
+        merged = spantree.merge_traces([sched_trace, worker_trace])
+        events = merged["traceEvents"]
+        run = next(e for e in events if e["name"] == "run_job")
+        assert run["ts"] == pytest.approx(15.0 * 1e6)
+        # Worker pid remapped away from the scheduler's.
+        dispatch = next(e for e in events if e["name"] == "dispatch")
+        assert run["pid"] != dispatch["pid"]
+        # One cross-process causal edge -> one s/f flow pair.
+        assert merged["otherData"]["flow_edges"] == 1
+        flow_phases = sorted(
+            e["ph"] for e in events if e.get("cat") == "causal"
+        )
+        assert flow_phases == ["f", "s"]
+        # Worker process name carries its identity suffix.
+        names = [
+            e["args"]["name"] for e in events
+            if e.get("ph") == "M" and e["name"] == "process_name"
+        ]
+        assert any("worker 2" in n for n in names)
+
+    def test_packed_pair_spans_credit_both_members(self):
+        assert spantree._job_keys("(3, 7)") == ["3", "7"]
+        assert spantree._job_keys(4) == ["4"]
+        events = [
+            _instant("job_admitted", 1.0, 1, job_id=3, arrival_s=0.0),
+            _instant("job_admitted", 1.0, 1, job_id=7, arrival_s=0.0),
+            _span("dispatch", 2.0, 0.1, 1, job_id="(3, 7)"),
+            _span("run job (3, 7)", 2.1, 3.0, 1),
+            _instant("job_complete", 5.1, 1, job_id=3),
+            _instant("job_complete", 5.1, 1, job_id=7),
+        ]
+        budgets = spantree.latency_budget(events)
+        for job in ("3", "7"):
+            assert budgets[job]["dispatch_s"] == pytest.approx(0.1)
+            assert budgets[job]["run_s"] == pytest.approx(3.0)
+
+    def test_packed_sim_run_span_with_first_members_context(self):
+        # A sim pair run span only carries the FIRST member's chain in
+        # its trace args; the name is authoritative so BOTH members
+        # must still be credited.
+        root3 = propagate.TraceContext("t3", "r3")
+        events = [
+            _instant("job_admitted", 1.0, 1, job_id=3, arrival_s=0.0,
+                     trace_id="t3", parent_span_id="r3"),
+            _instant("job_admitted", 1.0, 1, job_id=7, arrival_s=0.0),
+            _span("run job (3, 7)", 2.0, 3.0, 1, ctx=root3.child()),
+            _instant("job_complete", 5.0, 1, job_id=3),
+            _instant("job_complete", 5.0, 1, job_id=7),
+        ]
+        budgets = spantree.latency_budget(events)
+        assert budgets["3"]["run_s"] == pytest.approx(3.0)
+        assert budgets["7"]["run_s"] == pytest.approx(3.0)
+
+    def test_merge_reference_detection_and_errors(self):
+        with pytest.raises(ValueError):
+            spantree.merge_traces([])
+        # Scheduler file not first: still chosen as reference.
+        a = {"traceEvents": [], "otherData": {"role": "worker",
+             "clock": {"wall_at_zero_s": 5.0}}}
+        b = {"traceEvents": [], "otherData": {"role": "scheduler",
+             "clock": {"wall_at_zero_s": 9.0}}}
+        merged = spantree.merge_traces([a, b])
+        sources = merged["otherData"]["sources"]
+        assert sources[1]["reference"] is True
+        assert sources[0]["shift_s"] == pytest.approx(-4.0)
+
+
+# ----------------------------------------------------------------------
+# End-to-end: a traced sim run produces connected chains (single
+# process), and the tracer's clock metadata survives export.
+# ----------------------------------------------------------------------
+def test_sim_trace_chains_connected():
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.data.generate import smoke_trace_jobs
+    from shockwave_tpu.data.profiles import synthesize_profiles
+    from shockwave_tpu.policies import get_policy
+
+    obs.configure(metrics=True, trace=True)
+    oracle = generate_oracle()
+    jobs, arrivals = smoke_trace_jobs(4, epochs=1, arrival_gap_s=60.0)
+    profiles = synthesize_profiles(jobs, oracle)
+    sched = Scheduler(
+        get_policy("fifo"),
+        throughputs=oracle,
+        seed=0,
+        time_per_iteration=120,
+        profiles=profiles,
+    )
+    sched.simulate({"v100": 2}, arrivals, jobs)
+    events = obs.get_tracer().export_dict()["traceEvents"]
+    chains = spantree.collect_chains(events)
+    assert len(chains) == 4
+    for chain in chains.values():
+        assert spantree.chain_summary(chain)["connected"]
+    budgets = spantree.latency_budget(events)
+    assert len(budgets) == 4
+    for budget in budgets.values():
+        assert budget["total_s"] > 0
+
+
+def test_tracer_export_carries_clock_meta():
+    obs.configure(trace=True)
+    tracer = obs.get_tracer()
+    tracer.set_meta({"role": "worker", "clock": {
+        "offset_to_scheduler_s": 1.5}})
+    dump = tracer.export_dict()
+    clock = dump["otherData"]["clock"]
+    assert clock["offset_to_scheduler_s"] == 1.5
+    assert clock["wall_at_zero_s"] > 0  # default anchor preserved
+    assert dump["otherData"]["role"] == "worker"
+
+
+# ----------------------------------------------------------------------
+# Worker agent SIGTERM flush: a reclaimed agent must not lose its
+# telemetry exports.
+# ----------------------------------------------------------------------
+def test_worker_agent_sigterm_flushes_telemetry(tmp_path):
+    from shockwave_tpu.core.physical import PhysicalScheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.policies import get_policy
+    from shockwave_tpu.utils.hostenv import free_port
+
+    sched_port = free_port()
+    sched = PhysicalScheduler(
+        get_policy("fifo"),
+        port=sched_port,
+        throughputs=generate_oracle(),
+        time_per_iteration=3.0,
+        minimum_time_between_allocation_resets=0.0,
+    )
+    metrics_path = tmp_path / "worker_metrics.json"
+    trace_path = tmp_path / "worker_trace.json"
+    env = dict(os.environ)
+    env.update(
+        {
+            "SHOCKWAVE_METRICS_OUT": str(metrics_path),
+            "SHOCKWAVE_TRACE_OUT": str(trace_path),
+            "SHOCKWAVE_HEARTBEAT_S": "0.2",
+            "JAX_PLATFORMS": "cpu",
+        }
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "shockwave_tpu.runtime.worker",
+            "-t", "v100", "-n", "1",
+            "-a", "127.0.0.1", "-s", str(sched_port),
+            "-p", str(free_port()),
+            "--run_dir", str(tmp_path / "run"),
+            "--checkpoint_dir", str(tmp_path / "ckpt"),
+        ],
+        env=env,
+        cwd=REPO_ROOT,
+    )
+    try:
+        sched.wait_for_workers(1, timeout=60)
+        # A couple of heartbeats so the agent has clock samples to
+        # stamp into the export.
+        time.sleep(1.0)
+        assert not metrics_path.exists()  # nothing flushed yet
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=20)
+        assert metrics_path.exists(), "SIGTERM lost the metrics export"
+        assert trace_path.exists(), "SIGTERM lost the trace export"
+        metrics = json.loads(metrics_path.read_text())
+        assert metrics["schema"] == "shockwave-metrics-v1"
+        trace = json.loads(trace_path.read_text())
+        assert isinstance(trace["traceEvents"], list)
+        assert trace["otherData"]["role"] == "worker"
+        clock = trace["otherData"]["clock"]
+        assert "offset_to_scheduler_s" in clock
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        sched.shutdown()

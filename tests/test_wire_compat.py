@@ -1,0 +1,326 @@
+"""Wire-compatibility regression tests for the trace-context /
+clock-offset schema extensions.
+
+The OLD side is the frozen protoc-generated modules in
+``shockwave_tpu/runtime/protobuf/legacy/`` (the exact pre-extension
+artifacts); the NEW side is the live hand-rolled modules. Both
+directions are asserted for every extended message type:
+
+  * old readers parse new messages — the unknown trace-context/clock
+    fields are skipped per proto3 rules, every legacy field intact;
+  * new readers parse old messages — extensions absent -> defaults
+    ("" context = fresh root at the receiver, 0.0 timestamps = no
+    clock sample);
+  * with no extension fields set, the new serializers are
+    BYTE-IDENTICAL to protoc's canonical proto3 output (packed
+    repeated scalars included) — an untraced run is indistinguishable
+    on the wire from the old build.
+"""
+
+import pytest
+
+protobuf = pytest.importorskip("google.protobuf")
+
+from shockwave_tpu.obs import propagate  # noqa: E402
+from shockwave_tpu.runtime.protobuf import (  # noqa: E402
+    admission_pb2 as adm_pb2,
+    common_pb2,
+    scheduler_to_worker_pb2 as s2w_new,
+    telemetry_pb2,
+    worker_to_scheduler_pb2 as w2s_new,
+)
+from shockwave_tpu.runtime.protobuf.legacy import (  # noqa: E402
+    scheduler_to_worker_pb2 as s2w_old,
+    worker_to_scheduler_pb2 as w2s_old,
+)
+from shockwave_tpu.runtime.protobuf.wire import (  # noqa: E402
+    encode_varint,
+    tag,
+)
+
+
+# ---------------------------------------------------------------------
+# Byte identity: no extension fields set -> protoc-identical bytes.
+# ---------------------------------------------------------------------
+LEGACY_PAIRS = [
+    (
+        "RegisterWorkerRequest",
+        lambda mod: mod.RegisterWorkerRequest(
+            worker_type="v100", num_accelerators=2,
+            ip_addr="10.0.0.7", port=50061,
+        ),
+    ),
+    (
+        "RegisterWorkerResponse",
+        lambda mod: mod.RegisterWorkerResponse(
+            success=True, worker_ids=[0, 1, 5], round_duration=30,
+        ),
+    ),
+    (
+        "RegisterWorkerResponse",
+        lambda mod: mod.RegisterWorkerResponse(
+            success=False, error_message="no capacity",
+        ),
+    ),
+    ("Heartbeat", lambda mod: mod.Heartbeat(worker_id=3)),
+    (
+        "DoneRequest",
+        lambda mod: mod.DoneRequest(
+            worker_id=1, job_id=[4, 5], num_steps=[0, 200],
+            execution_time=[1.5, 0.0], iterator_log=["steps=1", ""],
+        ),
+    ),
+]
+LEGACY_PAIRS_S2W = [
+    (
+        "JobDescription",
+        lambda mod: mod.JobDescription(
+            job_id=0, job_type="ResNet-18 (batch size 32)",
+            command="python3 main.py", num_steps_arg="-n",
+            num_steps=128, has_duration=True, duration=900,
+        ),
+    ),
+    (
+        "RunJobRequest",
+        lambda mod: mod.RunJobRequest(
+            job_descriptions=[
+                mod.JobDescription(job_id=7, job_type="t", command="c")
+            ],
+            worker_id=2, round_id=9,
+        ),
+    ),
+    ("KillJobRequest", lambda mod: mod.KillJobRequest(job_id=7)),
+]
+
+
+@pytest.mark.parametrize("name,make", LEGACY_PAIRS)
+def test_byte_identity_w2s(name, make):
+    assert (
+        make(w2s_new).SerializeToString()
+        == make(w2s_old).SerializeToString()
+    )
+
+
+@pytest.mark.parametrize("name,make", LEGACY_PAIRS_S2W)
+def test_byte_identity_s2w(name, make):
+    assert (
+        make(s2w_new).SerializeToString()
+        == make(s2w_old).SerializeToString()
+    )
+
+
+# ---------------------------------------------------------------------
+# New -> old: every extended message parses in a legacy reader with
+# the legacy fields intact (unknown fields skipped).
+# ---------------------------------------------------------------------
+def test_old_reader_register_request_with_clock():
+    new = w2s_new.RegisterWorkerRequest(
+        worker_type="v100", num_accelerators=2, ip_addr="10.0.0.7",
+        port=50061, client_send_s=1723772000.25,
+    )
+    old = w2s_old.RegisterWorkerRequest.FromString(new.SerializeToString())
+    assert old.worker_type == "v100"
+    assert old.num_accelerators == 2
+    assert old.ip_addr == "10.0.0.7"
+    assert old.port == 50061
+
+
+def test_old_reader_register_response_with_clock():
+    new = w2s_new.RegisterWorkerResponse(
+        success=True, worker_ids=[0, 1], round_duration=30,
+        sched_recv_s=100.5, sched_send_s=100.6,
+    )
+    old = w2s_old.RegisterWorkerResponse.FromString(
+        new.SerializeToString()
+    )
+    assert old.success and list(old.worker_ids) == [0, 1]
+    assert old.round_duration == 30
+
+
+def test_old_reader_heartbeat_with_clock_and_context():
+    new = w2s_new.Heartbeat(
+        worker_id=3, client_send_s=5.0, est_offset_s=-0.25,
+        est_rtt_s=0.002, trace_context="ab12-cd34-1",
+    )
+    old = w2s_old.Heartbeat.FromString(new.SerializeToString())
+    assert old.worker_id == 3
+
+
+def test_old_reader_done_with_contexts():
+    new = w2s_new.DoneRequest(
+        worker_id=1, job_id=[4, 5], num_steps=[10, 20],
+        execution_time=[0.5, 0.6], iterator_log=["a", "b"],
+        trace_context=["t1-s1-1", ""],
+    )
+    old = w2s_old.DoneRequest.FromString(new.SerializeToString())
+    assert list(old.job_id) == [4, 5]
+    assert list(old.num_steps) == [10, 20]
+    assert list(old.execution_time) == [0.5, 0.6]
+    assert list(old.iterator_log) == ["a", "b"]
+
+
+def test_old_reader_job_description_and_kill_with_context():
+    new = s2w_new.JobDescription(
+        job_id=3, job_type="t", command="c", trace_context="tr-sp-1"
+    )
+    old = s2w_old.JobDescription.FromString(new.SerializeToString())
+    assert old.job_id == 3 and old.command == "c"
+    kill = s2w_old.KillJobRequest.FromString(
+        s2w_new.KillJobRequest(
+            job_id=7, trace_context="tr-sp-1"
+        ).SerializeToString()
+    )
+    assert kill.job_id == 7
+
+
+def test_old_reader_heartbeat_ack_parses_as_empty():
+    ack = w2s_new.HeartbeatAck(sched_recv_s=1.0, sched_send_s=2.0)
+    # A legacy worker deserializes the SendHeartbeat response as Empty:
+    # both unknown fields skipped, no error.
+    common_pb2.Empty.FromString(ack.SerializeToString())
+
+
+def test_old_reader_metrics_request_parses_as_empty():
+    request = telemetry_pb2.MetricsRequest(trace_context="t-s-1")
+    common_pb2.Empty.FromString(request.SerializeToString())
+    # And an untraced request is wire-identical to Empty.
+    assert telemetry_pb2.MetricsRequest().SerializeToString() == b""
+
+
+# ---------------------------------------------------------------------
+# Old -> new: legacy messages parse in the new readers with the
+# extensions at their defaults (context absent -> fresh root).
+# ---------------------------------------------------------------------
+def test_new_reader_old_register_request():
+    old = w2s_old.RegisterWorkerRequest(
+        worker_type="v100", num_accelerators=2, ip_addr="10.0.0.7",
+        port=50061,
+    )
+    new = w2s_new.RegisterWorkerRequest.FromString(old.SerializeToString())
+    assert new.worker_type == "v100" and new.port == 50061
+    assert new.client_send_s == 0.0
+
+
+def test_new_reader_old_register_response_means_no_clock_sample():
+    from shockwave_tpu.runtime.rpc.worker_client import _clock_sample
+
+    old = w2s_old.RegisterWorkerResponse(
+        success=True, worker_ids=[0], round_duration=30
+    )
+    new = w2s_new.RegisterWorkerResponse.FromString(
+        old.SerializeToString()
+    )
+    assert list(new.worker_ids) == [0]
+    assert _clock_sample(1.0, new.sched_recv_s, new.sched_send_s, 2.0) is None
+
+
+def test_new_reader_old_heartbeat():
+    old = w2s_old.Heartbeat(worker_id=3)
+    new = w2s_new.Heartbeat.FromString(old.SerializeToString())
+    assert new.worker_id == 3
+    assert new.trace_context == "" and new.est_rtt_s == 0.0
+
+
+def test_new_reader_empty_heartbeat_response():
+    # Old scheduler answers SendHeartbeat with Empty (b"").
+    ack = w2s_new.HeartbeatAck.FromString(
+        common_pb2.Empty().SerializeToString()
+    )
+    assert ack.sched_recv_s == 0.0 and ack.sched_send_s == 0.0
+
+
+def test_new_reader_old_done_request():
+    old = w2s_old.DoneRequest(
+        worker_id=1, job_id=[4], num_steps=[10],
+        execution_time=[0.5], iterator_log=["x"],
+    )
+    new = w2s_new.DoneRequest.FromString(old.SerializeToString())
+    assert new.trace_context == []
+    assert list(new.job_id) == [4]
+
+
+def test_new_reader_old_job_description_yields_fresh_root():
+    old = s2w_old.JobDescription(job_id=3, job_type="t", command="c")
+    new = s2w_new.JobDescription.FromString(old.SerializeToString())
+    assert new.trace_context == ""
+    # Receiver semantics: absent context is never an error — the
+    # propagate layer just reports "no context" (fresh root territory).
+    assert propagate.from_wire(new.trace_context) is None
+
+
+def test_new_reader_old_run_job_request():
+    old = s2w_old.RunJobRequest(
+        job_descriptions=[
+            s2w_old.JobDescription(job_id=7, job_type="t", command="c")
+        ],
+        worker_id=2, round_id=9,
+    )
+    new = s2w_new.RunJobRequest.FromString(old.SerializeToString())
+    assert new.worker_id == 2 and new.round_id == 9
+    assert new.job_descriptions[0].job_id == 7
+    assert new.job_descriptions[0].trace_context == ""
+
+
+def test_new_reader_old_kill_request():
+    old = s2w_old.KillJobRequest(job_id=7)
+    new = s2w_new.KillJobRequest.FromString(old.SerializeToString())
+    assert new.job_id == 7 and new.trace_context == ""
+
+
+# ---------------------------------------------------------------------
+# Hand-rolled admission schema: the old reader is the same parser
+# minus field 13, i.e. unknown-field tolerance — exercised by feeding
+# bytes with the context field to a parse that ignores unknown ids,
+# and bytes WITHOUT it to the new parser.
+# ---------------------------------------------------------------------
+def test_admission_spec_context_roundtrip_and_absence():
+    spec = adm_pb2.JobSpec(
+        job_type="ResNet-18 (batch size 32)", total_steps=10,
+        scale_factor=1, trace_context="t1-s1-1",
+    )
+    parsed = adm_pb2.JobSpec.FromString(spec.SerializeToString())
+    assert parsed.trace_context == "t1-s1-1"
+    bare = adm_pb2.JobSpec(
+        job_type="ResNet-18 (batch size 32)", total_steps=10,
+        scale_factor=1,
+    )
+    # No context -> the field is absent on the wire entirely (legacy
+    # byte identity for untraced submissions).
+    assert b"t1-s1-1" not in bare.SerializeToString()
+    assert adm_pb2.JobSpec.FromString(
+        bare.SerializeToString()
+    ).trace_context == ""
+
+
+def test_admission_parser_skips_future_fields():
+    base = adm_pb2.SubmitJobsRequest(
+        token="tok",
+        jobs=[adm_pb2.JobSpec(job_type="x (batch size 1)", total_steps=1)],
+        trace_context="t-s-1",
+    ).SerializeToString()
+    # A peer two schema versions ahead appends varint + string fields.
+    future = base + tag(90, 0) + encode_varint(7) + (
+        tag(91, 2) + encode_varint(2) + b"hi"
+    )
+    parsed = adm_pb2.SubmitJobsRequest.FromString(future)
+    assert parsed.token == "tok" and parsed.trace_context == "t-s-1"
+    assert parsed.jobs[0].job_type == "x (batch size 1)"
+
+
+def test_new_parsers_skip_future_fields():
+    base = w2s_new.Heartbeat(
+        worker_id=3, est_offset_s=0.5, est_rtt_s=0.01
+    ).SerializeToString()
+    future = base + tag(77, 0) + encode_varint(1)
+    parsed = w2s_new.Heartbeat.FromString(future)
+    assert parsed.worker_id == 3 and parsed.est_offset_s == 0.5
+
+
+def test_unpacked_repeated_scalars_also_parse():
+    # proto2-style unpacked encoding of repeated varints must parse too
+    # (proto3 parsers accept both forms).
+    payload = b""
+    for job in (4, 5):
+        payload += tag(2, 0) + encode_varint(job)
+    parsed = w2s_new.DoneRequest.FromString(payload)
+    assert list(parsed.job_id) == [4, 5]
