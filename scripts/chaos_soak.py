@@ -166,6 +166,12 @@ def main(args) -> int:
         round_s=args.round_s,
         min_capacity=max(2, args.num_gpus // 4),
         solver_faults=args.solver_faults,
+        # Kill-the-brain drills: paired scheduler_crash/scheduler_restart
+        # events round-trip the whole control plane through the HA
+        # journal codec mid-soak (shockwave_tpu/ha/) — the campaign must
+        # absorb them like any other fault, with recoveries paired and
+        # the decision log still replaying exactly.
+        scheduler_faults=args.scheduler_faults,
     )
     stem = os.path.splitext(args.result_name)[0]
     plan_path = os.path.join(args.out, f"{stem}_fault_plan.json")
@@ -306,6 +312,11 @@ def build_parser():
     parser.add_argument("--target_events", type=int, default=1100)
     parser.add_argument("--min_events", type=int, default=1000)
     parser.add_argument("--solver_faults", type=int, default=6)
+    parser.add_argument(
+        "--scheduler_faults", type=int, default=2,
+        help="paired scheduler_crash/restart drills (HA journal "
+        "state roundtrips at round boundaries; 0 disables)",
+    )
     return parser
 
 

@@ -1,0 +1,440 @@
+#!/usr/bin/env python3
+"""CI gate: survivable control plane — SIGKILL the leader, lose nothing.
+
+Four arms, all seeded and reduced-scale (~2-3 min on a 2-CPU host):
+
+1. **sim drill** — a simulated shockwave campaign with injected
+   ``scheduler_crash``/``scheduler_restart`` events must finish
+   BIT-IDENTICAL to the uninterrupted run (the events round-trip the
+   whole control plane through the HA journal codec mid-run).
+2. **baseline** — a live localhost campaign under one HA leader
+   (journal armed, no crash): the makespan yardstick.
+3. **hot standby** — same campaign with a hot standby; the leader
+   SIGKILLs itself mid-round via the seeded ``scheduler_crash`` fault.
+   The standby must take over with a bumped fenced epoch, replay
+   checkpoint+tail, re-adopt the re-attaching workers, and finish with
+   ZERO lost and ZERO double-admitted jobs; a token retransmitted
+   across the failover must dedup against the restored ledger.
+4. **cold restart** — the leader dies with NO standby running; a
+   fresh node started afterwards resumes from the journal alone.
+
+Failover makespans must stay within noise of the baseline
+(lease TTL + re-attach + a couple of rounds on a loaded CI box).
+
+Regenerates ``results/ha/ha_smoke.json``; exits 1 on any violated
+invariant. Wired into the verify skill next to the chaos and churn
+gates.
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+sys.path.insert(0, REPO)
+
+JOB_STEPS = [600, 700, 800, 600, 700, 800]
+STEPS_PER_SEC = 200
+ROUND_S = 3.0
+LEASE_TTL_S = 2.0
+CRASH_AT_S = 4.5  # mid round 2, after real dispatches
+
+
+def _env(ha_dir=None, fault_plan=None):
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "SHOCKWAVE_HEARTBEAT_S": "0.5",
+        "SHOCKWAVE_OUTAGE_BEATS": "2",
+        "SHOCKWAVE_RPC_ATTEMPTS": "2",
+        "SHOCKWAVE_RPC_DEADLINE_S": "3",
+        "SHOCKWAVE_RPC_TIMEOUT_S": "2",
+    }
+    if ha_dir:
+        env["SHOCKWAVE_HA_DIR"] = ha_dir
+    if fault_plan:
+        env["SHOCKWAVE_FAULTS"] = fault_plan
+    else:
+        env.pop("SHOCKWAVE_FAULTS", None)
+    return env
+
+
+def _spawn_node(ha_dir, node, port, summary, workers=0, plan=None,
+                log=None):
+    cmd = [
+        sys.executable, "-m", "shockwave_tpu.ha.standby",
+        "--ha_dir", ha_dir, "--node", node, "--port", str(port),
+        "--round_s", str(ROUND_S), "--lease_ttl_s", str(LEASE_TTL_S),
+        "--completion_buffer_s", "6", "--heartbeat_timeout_s", "6",
+        "--reattach_timeout_s", "20", "--max_rounds", "40",
+        "--summary_out", summary,
+    ]
+    if workers:
+        cmd += ["--expect_workers", str(workers)]
+    if log:
+        cmd += ["--decision_log", log]
+    # Live stderr sink per node (failover triage evidence), not an
+    # artifact write.
+    # shockwave-lint: disable=non-atomic-artifact-write
+    sink = open(os.path.join(ha_dir, f"{node}.log"), "w")
+    return subprocess.Popen(
+        cmd, env=_env(ha_dir, plan), cwd=REPO,
+        stdout=sink, stderr=subprocess.STDOUT,
+    )
+
+
+def _spawn_worker(ha_dir, sched_port, port, tmp, tag, plan=None):
+    # shockwave-lint: disable=non-atomic-artifact-write
+    sink = open(os.path.join(ha_dir, f"worker_{tag}.log"), "w")
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "shockwave_tpu.runtime.worker",
+            "-t", "v100", "-n", "1",
+            "-a", "127.0.0.1", "-s", str(sched_port), "-p", str(port),
+            "--run_dir", os.path.join(tmp, f"run_{tag}"),
+            "--checkpoint_dir", os.path.join(tmp, f"ckpt_{tag}"),
+        ],
+        env=_env(ha_dir, plan),
+        cwd=REPO,
+        stdout=sink, stderr=subprocess.STDOUT,
+    )
+
+
+def _wait_file(path, timeout_s, what):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if os.path.exists(path):
+            with open(path) as f:
+                return json.load(f)
+        time.sleep(0.5)
+    raise TimeoutError(f"{what}: {path} not written in {timeout_s}s")
+
+
+def _submit_jobs(port):
+    """Submit the workload through the front door in two batches and a
+    close; returns (client, first_batch_jobs, first_token)."""
+    from shockwave_tpu.runtime.rpc.submitter_client import SubmitterClient
+    from shockwave_tpu.runtime.testing import make_synthetic_job
+
+    jobs = [
+        make_synthetic_job(steps, steps_per_sec=STEPS_PER_SEC)
+        for steps in JOB_STEPS
+    ]
+    client = SubmitterClient("127.0.0.1", port, client_id="hasmoke")
+    first_token = client.next_token()
+    r = client.submit(jobs[:3], token=first_token)
+    assert r.status == "ACCEPTED", r.status
+    r = client.submit(jobs[3:], close=True)
+    assert r.status == "ACCEPTED", r.status
+    return client, jobs[:3], first_token
+
+
+def _sim_drill():
+    """Arm 1: bit-identical sim crash/restart roundtrip with the real
+    planner."""
+    from shockwave_tpu.core.job import Job
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.data.profiles import synthesize_profiles
+    from shockwave_tpu.policies import get_policy
+    from shockwave_tpu.runtime import faults
+
+    config = {
+        "num_gpus": 2, "time_per_iteration": 120, "future_rounds": 4,
+        "lambda": 2.0, "k": 1e-3,
+        "log_approximation_bases": [0.0, 0.2, 0.4, 0.6, 0.8, 1.0],
+        "solver_rel_gap": 1e-3, "solver_timeout": 15,
+    }
+
+    def run(plan):
+        faults.reset()
+        if plan is not None:
+            faults.configure(plan)
+        jobs = [
+            Job(job_type="ResNet-18 (batch size 32)", command="x 32",
+                total_steps=4000 + 1307 * i, scale_factor=1,
+                mode=("gns" if i % 3 == 0 else "static"))
+            for i in range(6)
+        ]
+        oracle = generate_oracle()
+        sched = Scheduler(
+            get_policy("shockwave_tpu_pdhg"), throughputs=oracle,
+            time_per_iteration=120, seed=0,
+            profiles=synthesize_profiles(jobs, oracle),
+            shockwave_config=dict(config),
+        )
+        makespan = sched.simulate(
+            {"v100": 2}, [0.0, 10.0, 20.0, 30.0, 40.0, 50.0], jobs
+        )
+        result = (
+            makespan,
+            sched.get_average_jct(),
+            {str(k): v for k, v in sched._total_steps_run.items()},
+        )
+        faults.reset()
+        return result
+
+    base = run(None)
+    plan = faults.FaultPlan(seed=0, events=[
+        faults.FaultEvent(0, "scheduler_crash", at_s=200.0),
+        faults.FaultEvent(1, "scheduler_restart", at_s=260.0),
+    ])
+    drilled = run(plan)
+    return {
+        "makespan": base[0],
+        "bit_identical": base == drilled,
+        "drilled_makespan": drilled[0],
+    }
+
+
+def _crash_plan_file(tmp):
+    from shockwave_tpu.runtime import faults
+    from shockwave_tpu.utils.fileio import atomic_write_text
+
+    plan = faults.FaultPlan(seed=0, events=[
+        faults.FaultEvent(0, "scheduler_crash", at_s=CRASH_AT_S),
+        faults.FaultEvent(1, "scheduler_restart", at_s=CRASH_AT_S + 1.0),
+    ])
+    path = os.path.join(tmp, "crash_plan.json")
+    atomic_write_text(path, plan.to_json())
+    return path
+
+
+def _failover_arm(tmp, name, hot):
+    """Arms 3/4: live campaign, leader SIGKILLed by the seeded fault;
+    a hot standby (spawned before the crash) or a cold restart
+    (spawned after) resumes. Returns the arm report."""
+    from shockwave_tpu.ha.election import LeaseStore
+    from shockwave_tpu.ha.frontdoor import resolve_submit_target
+    from shockwave_tpu.utils.hostenv import free_port
+
+    ha_dir = os.path.join(tmp, name)
+    os.makedirs(ha_dir, exist_ok=True)
+    plan = _crash_plan_file(tmp)
+    leader_port, standby_port = free_port(), free_port()
+    w_ports = [free_port(), free_port()]
+    leader_sum = os.path.join(ha_dir, "leader.json")
+    succ_sum = os.path.join(ha_dir, "successor.json")
+    procs = []
+    try:
+        leader = _spawn_node(
+            ha_dir, "leader-0", leader_port, leader_sum, workers=2,
+            plan=plan, log=os.path.join(ha_dir, "leader_decisions.jsonl"),
+        )
+        procs.append(leader)
+        deadline = time.time() + 30
+        while LeaseStore(ha_dir).leader() is None:
+            if time.time() > deadline:
+                raise TimeoutError("leader never published its lease")
+            time.sleep(0.2)
+        for i, port in enumerate(w_ports):
+            procs.append(
+                _spawn_worker(ha_dir, leader_port, port, tmp,
+                              f"{name}_w{i}", plan=None)
+            )
+        client, first_jobs, first_token = _submit_jobs(leader_port)
+        successor = None
+        if hot:
+            successor = _spawn_node(
+                ha_dir, "standby-1", standby_port, succ_sum, plan=plan,
+                log=os.path.join(ha_dir, "succ_decisions.jsonl"),
+            )
+            procs.append(successor)
+        # The seeded fault SIGKILLs the leader at CRASH_AT_S.
+        leader.wait(timeout=60)
+        assert leader.returncode == -signal.SIGKILL, (
+            f"leader exited {leader.returncode}, expected SIGKILL "
+            "by the seeded scheduler_crash fault"
+        )
+        crash_wall = time.time()
+        if not hot:
+            successor = _spawn_node(
+                ha_dir, "restart-1", standby_port, succ_sum, plan=plan,
+                log=os.path.join(ha_dir, "succ_decisions.jsonl"),
+            )
+            procs.append(successor)
+        # Wait for the successor to take the lease at a higher epoch.
+        deadline = time.time() + 30
+        while True:
+            lease = LeaseStore(ha_dir).leader()
+            if lease is not None and lease.sched_port == standby_port:
+                break
+            if time.time() > deadline:
+                raise TimeoutError("successor never took the lease")
+            time.sleep(0.2)
+        takeover_s = time.time() - crash_wall
+        # Retransmit the FIRST (already-admitted) token verbatim: the
+        # successor's restored ledger must dedup, not double-admit.
+        target = resolve_submit_target(ha_dir, first_token)
+        assert target is not None
+        client.retarget(target[0], target[1])
+        r = client.submit(first_jobs, token=first_token)
+        assert r.status == "ACCEPTED", r.status
+        retransmit_admitted = r.admitted
+        summary = _wait_file(succ_sum, 120, f"{name} successor summary")
+        return {
+            "arm": name,
+            "leader_killed_by": "seeded scheduler_crash",
+            "takeover_s": round(takeover_s, 2),
+            "retransmit_admitted": retransmit_admitted,
+            "successor": summary,
+        }
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                pass
+
+
+def _baseline_arm(tmp):
+    from shockwave_tpu.ha.election import LeaseStore
+    from shockwave_tpu.utils.hostenv import free_port
+
+    ha_dir = os.path.join(tmp, "baseline")
+    os.makedirs(ha_dir, exist_ok=True)
+    port = free_port()
+    w_ports = [free_port(), free_port()]
+    summary_path = os.path.join(ha_dir, "leader.json")
+    procs = []
+    try:
+        procs.append(
+            _spawn_node(ha_dir, "leader-0", port, summary_path, workers=2)
+        )
+        deadline = time.time() + 30
+        while LeaseStore(ha_dir).leader() is None:
+            if time.time() > deadline:
+                raise TimeoutError("baseline leader never published")
+            time.sleep(0.2)
+        for i, wp in enumerate(w_ports):
+            procs.append(
+                _spawn_worker(ha_dir, port, wp, tmp, f"base_w{i}")
+            )
+        _submit_jobs(port)
+        return _wait_file(summary_path, 120, "baseline summary")
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+
+
+def check_arm(report, num_jobs):
+    """The survivability invariants for one failover arm."""
+    failures = []
+    succ = report["successor"]
+    if succ.get("outcome") != "completed":
+        failures.append(f"{report['arm']}: successor outcome "
+                        f"{succ.get('outcome')!r}")
+    if succ.get("epoch", 0) < 2:
+        failures.append(f"{report['arm']}: successor epoch "
+                        f"{succ.get('epoch')} not bumped")
+    if not succ.get("took_over"):
+        failures.append(f"{report['arm']}: successor saw no journal")
+    completed = succ.get("completed_jobs") or []
+    if len(completed) != num_jobs:
+        failures.append(
+            f"{report['arm']}: {len(completed)}/{num_jobs} jobs "
+            f"completed (lost or duplicated): {completed}"
+        )
+    if len(set(completed)) != len(completed):
+        failures.append(f"{report['arm']}: duplicate job ids {completed}")
+    if report.get("retransmit_admitted", -1) <= 0:
+        failures.append(
+            f"{report['arm']}: retransmitted token not acknowledged "
+            "via the restored ledger"
+        )
+    admission = succ.get("admission") or {}
+    if admission.get("deduped_batches", 0) < 1:
+        failures.append(
+            f"{report['arm']}: no ledger dedup recorded for the "
+            "retransmitted token"
+        )
+    return failures
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--out", default=os.path.join(REPO, "results", "ha")
+    )
+    parser.add_argument("--result_name", default="ha_smoke.json")
+    parser.add_argument(
+        "--skip-live", action="store_true",
+        help="sim drill only (no subprocess cluster)",
+    )
+    args = parser.parse_args(argv)
+
+    report = {"config": {
+        "job_steps": JOB_STEPS, "round_s": ROUND_S,
+        "lease_ttl_s": LEASE_TTL_S, "crash_at_s": CRASH_AT_S,
+    }}
+    failures = []
+
+    print("[ha_smoke] arm 1/4: sim crash/restart bit-identity drill")
+    report["sim_drill"] = _sim_drill()
+    if not report["sim_drill"]["bit_identical"]:
+        failures.append(
+            "sim drill: crash/restart roundtrip is NOT bit-identical "
+            f"({report['sim_drill']})"
+        )
+
+    if not args.skip_live:
+        with tempfile.TemporaryDirectory(prefix="ha_smoke_") as tmp:
+            print("[ha_smoke] arm 2/4: baseline live campaign")
+            base = _baseline_arm(tmp)
+            report["baseline"] = base
+            if len(base.get("completed_jobs") or []) != len(JOB_STEPS):
+                failures.append(
+                    f"baseline lost jobs: {base.get('completed_jobs')}"
+                )
+            print("[ha_smoke] arm 3/4: hot-standby failover")
+            hot = _failover_arm(tmp, "hot", hot=True)
+            report["hot_standby"] = hot
+            failures.extend(check_arm(hot, len(JOB_STEPS)))
+            print("[ha_smoke] arm 4/4: cold restart")
+            cold = _failover_arm(tmp, "cold", hot=False)
+            report["cold_restart"] = cold
+            failures.extend(check_arm(cold, len(JOB_STEPS)))
+            base_mk = base.get("makespan_s", 0.0)
+            for arm_name in ("hot_standby", "cold_restart"):
+                mk = report[arm_name]["successor"].get("makespan_s", 0.0)
+                report[arm_name]["makespan_delta_s"] = round(
+                    mk - base_mk, 2
+                )
+                # Noise budget: lease TTL + outage detection +
+                # re-attach + a couple of rounds, padded for a loaded
+                # 2-CPU CI host.
+                budget = LEASE_TTL_S + 6 * ROUND_S
+                if mk - base_mk > budget:
+                    failures.append(
+                        f"{arm_name}: makespan {mk:.1f}s vs baseline "
+                        f"{base_mk:.1f}s — failover cost exceeds the "
+                        f"{budget:.0f}s noise budget"
+                    )
+
+    report["failures"] = failures
+    report["pass"] = not failures
+    os.makedirs(args.out, exist_ok=True)
+    from shockwave_tpu.utils.fileio import atomic_write_json
+
+    out_path = os.path.join(args.out, args.result_name)
+    atomic_write_json(out_path, report)
+    print(f"[ha_smoke] wrote {out_path}")
+    for failure in failures:
+        print(f"[ha_smoke] FAIL: {failure}")
+    print(f"[ha_smoke] {'PASS' if not failures else 'FAIL'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

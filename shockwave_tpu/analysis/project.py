@@ -1279,4 +1279,13 @@ EXPLICIT_THREAD_ROOTS: Tuple[Tuple[str, str, bool], ...] = (
         False,
     ),
     ("obs.watchdog.Watchdog.check_round", "watchdog", False),
+    # HA survivability plane (shockwave_tpu/ha/): the lease-renewal
+    # daemon fences the scheduler from its own thread (on_lost ->
+    # _ha_fenced -> shutdown), concurrent with the round loop and RPC
+    # handlers; journal replay runs on the driver thread before the
+    # round loop starts but shares the journal's writer lock with
+    # every live hook. Rooted explicitly so their lock contracts are
+    # checked even if Thread-target discovery ever regresses.
+    ("ha.election.LeaderElection._renew_loop", "ha-renew", False),
+    ("core.physical.PhysicalScheduler._ha_fenced", "ha-fence", False),
 )

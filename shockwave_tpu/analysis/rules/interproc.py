@@ -506,7 +506,7 @@ class TransitiveHostSync(ProjectRule):
 
 # -- swallowed-exception ------------------------------------------------
 
-_SCOPE_PREFIXES = ("shockwave_tpu/runtime/",)
+_SCOPE_PREFIXES = ("shockwave_tpu/runtime/", "shockwave_tpu/ha/")
 _SCOPE_FILES = ("shockwave_tpu/core/physical.py",)
 
 _LOG_METHODS = {

@@ -22,7 +22,14 @@ from shockwave_tpu.analysis.core import (
     dotted_name,
 )
 
-_SCOPE_PREFIXES = ("shockwave_tpu/obs/", "shockwave_tpu/runtime/")
+_SCOPE_PREFIXES = (
+    "shockwave_tpu/obs/",
+    "shockwave_tpu/runtime/",
+    # The HA control plane: journal appends, lease renewals, and
+    # front-door servers run on RPC handler threads, the renewal
+    # daemon, and the round loop at once.
+    "shockwave_tpu/ha/",
+)
 
 _MUTATING_METHODS = {
     "append",

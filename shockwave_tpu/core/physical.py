@@ -33,6 +33,7 @@ from shockwave_tpu.core.scheduler import Scheduler
 from shockwave_tpu.data.workload_info import steps_per_epoch
 from shockwave_tpu.runtime import admission
 from shockwave_tpu.runtime.lease import INFINITY
+from shockwave_tpu.runtime.retry import PermanentRpcError
 
 LOG = logging.getLogger("core.physical")
 
@@ -68,6 +69,10 @@ class PhysicalScheduler(Scheduler):
         completion_buffer_seconds: float = JOB_COMPLETION_BUFFER_SECONDS,
         heartbeat_timeout_s: Optional[float] = None,
         metrics_port: Optional[int] = None,
+        ha_journal=None,
+        ha_election=None,
+        ha_checkpoint_rounds: Optional[int] = None,
+        ha_restore_pending: bool = False,
         **kwargs,
     ):
         # The reference's fixed 1920s reset throttle assumes 360s rounds
@@ -208,6 +213,46 @@ class PhysicalScheduler(Scheduler):
             )
             self._fleet.start(http_port=int(metrics_port))
 
+        # HA survivability plane (shockwave_tpu/ha/): when armed, every
+        # state-changing control-plane event appends to the write-ahead
+        # journal and the fenced epoch from the leader lease rides every
+        # dispatch/kill RPC. Both None (the default) keeps the legacy
+        # single-scheduler behavior at zero overhead (one attribute
+        # check per hook).
+        self._ha_journal = ha_journal
+        self._ha_election = ha_election
+        self._ha_epoch = (
+            int(ha_election.epoch) if ha_election is not None else 0
+        )
+        self._ha_deposed = False
+        self._ha_replaying = False
+        # Set by the HA driver on a successor BEFORE the journal
+        # restore runs: the gRPC server is live from construction, and
+        # a worker re-attaching into the not-yet-restored (empty)
+        # registry would be minted fresh ids that the restore then
+        # clobbers. Registrations bounce (transient error, the agent
+        # retries next beat) until restore_from_journal clears this.
+        self._ha_restore_pending = bool(ha_restore_pending)
+        self._ha_replay_admit_debt: Dict[str, int] = {}
+        # Token of the admission-queue entry currently being drained
+        # into add_job (round loop only, under _cv) so the admit journal
+        # entry can pop the matching pending entry at replay.
+        self._ha_drain_token: Optional[str] = None
+        # scheduler_crash fault-event ids already consumed by a previous
+        # incarnation (journaled before its SIGKILL): the successor must
+        # not re-apply them to itself.
+        self._ha_consumed_sched_faults: set = set()
+        if ha_checkpoint_rounds is None:
+            ha_checkpoint_rounds = int(
+                os.environ.get("SHOCKWAVE_HA_CHECKPOINT_ROUNDS", "1")
+            )
+        self._ha_checkpoint_rounds = max(1, int(ha_checkpoint_rounds))
+        if ha_election is not None:
+            obs.gauge(
+                "ha_leader_epoch", "this process's current fenced epoch"
+            ).set(float(self._ha_epoch))
+            ha_election.start_renewal(on_lost=self._ha_fenced)
+
         from shockwave_tpu.runtime.rpc import scheduler_server
 
         self._server = scheduler_server.serve(
@@ -219,6 +264,9 @@ class PhysicalScheduler(Scheduler):
                 "init_job": self._init_job_rpc,
                 "update_lease": self._update_lease_rpc,
                 "submit_jobs": self._submit_jobs_rpc,
+                # Fencing epoch echoed on heartbeat acks so workers
+                # track leadership changes passively.
+                "sched_epoch": lambda: self._ha_epoch,
                 # /metrics-style text dump: any client (or grpcurl-style
                 # tooling speaking the hand-rolled wire contract) can
                 # scrape the scheduler's live registry.
@@ -230,34 +278,146 @@ class PhysicalScheduler(Scheduler):
     def get_current_timestamp(self, in_seconds: bool = False) -> float:
         return time.time() - self._start_time
 
+    # -- HA survivability hooks (shockwave_tpu/ha/) ---------------------
+    def _ha_log(self, kind: str, payload: dict) -> None:
+        """Append one control-plane delta to the write-ahead journal.
+        No-op when HA is off, and during journal REPLAY — a replayed
+        add_job/done re-entering the journal would duplicate the tail
+        for the next failover (replay ends with a compacting
+        checkpoint instead)."""
+        if self._ha_journal is None or self._ha_replaying:
+            return
+        # The journal serializes appends under its OWN leaf lock (LSN
+        # mint + O_APPEND write); callers from the round loop and RPC
+        # handler threads need no shared lock here, and `_ha_journal`
+        # itself is never rebound after construction.
+        # shockwave-lint: disable=shared-state-race
+        self._ha_journal.append(kind, payload, epoch=self._ha_epoch)
+
+    def _ha_fenced(self) -> None:
+        """Deposed: a newer epoch owns the lease. Stop dispatching
+        immediately and shut down WITHOUT touching the workers — they
+        belong to the successor now, and our own dispatch/kill RPCs are
+        already bounced by the workers' epoch gates."""
+        LOG.error(
+            "deposed: leader lease lost to a newer epoch; fencing this "
+            "scheduler (epoch %d)", self._ha_epoch,
+        )
+        obs.counter(
+            "ha_deposed_total",
+            "leadership terms this process lost to a newer epoch",
+        ).inc()
+        self._ha_deposed = True
+        self.shutdown()
+
+    def _ha_checkpoint(self) -> None:
+        """Write one compacted journal checkpoint of the full
+        control-plane state. Capture + LSN reservation + encode run
+        atomically under the lock (reentrant — round-loop callers
+        already hold it): the reservation makes every lock-protected
+        WAL entry sort strictly before or after the checkpoint's
+        contents, and the encode IS the deep snapshot — ha_state_dict
+        returns references to live structures, so encoding them after
+        releasing _cv would tear (or crash on) concurrent handler
+        mutations. Only the JSON dump + disk write run unlocked."""
+        if self._ha_journal is None:
+            return
+        from shockwave_tpu.obs.recorder import encode as _encode
+
+        with self._cv:
+            seq, lsn = self._ha_journal.begin_checkpoint()
+            encoded = _encode(self.ha_state_dict())
+        self._ha_journal.commit_checkpoint(
+            seq, lsn, encoded, epoch=self._ha_epoch
+        )
+
     def add_job(self, job, timestamp=None):
         """In-process admission entry. The gRPC server is live from
         construction, so a worker registration or a Done report can
         interleave with a driver thread's add_job even before the
         round loop starts — the base (simulator) implementation
         mutates allocation state and must run under the lock here."""
+        from shockwave_tpu.ha import codec as ha_codec
+
         with self._cv:
             job_id = super().add_job(job, timestamp=timestamp)
+            # Payload built only when armed: the zero-overhead contract
+            # for legacy runs is one attribute check, not a vars() copy
+            # per admission.
+            if self._ha_journal is not None and not self._ha_replaying:
+                self._ha_log(
+                    "admit",
+                    {
+                        "job_id": job_id.integer,
+                        "job": ha_codec.job_state(job),
+                        "timestamp": self._per_job_start_timestamps[
+                            job_id
+                        ],
+                        "token": self._ha_drain_token,
+                    },
+                )
             self._cv.notify_all()
             return job_id
 
     # -- RPC callbacks --------------------------------------------------
-    def _register_worker_rpc(self, worker_type, num_accelerators, ip_addr, port):
-        """(reference: scheduler.py:2854-2940)"""
+    def _register_worker_rpc(
+        self,
+        worker_type,
+        num_accelerators,
+        ip_addr,
+        port,
+        prev_worker_ids=None,
+        outstanding_job_ids=None,
+    ):
+        """(reference: scheduler.py:2854-2940). With
+        ``prev_worker_ids`` (HA re-attach after a scheduler death), the
+        agent's previous identity is re-adopted when the restored
+        registry still carries it: connections are rebuilt onto the old
+        worker ids — no capacity is minted — and restored in-flight
+        micro-tasks the agent no longer carries (lost in the crash
+        window) are reconciled as fault completions."""
         from shockwave_tpu.runtime.rpc.scheduler_client import SchedulerRpcClient
 
+        if self._ha_restore_pending:
+            # Successor still replaying the journal: admitting this
+            # agent against the empty pre-restore registry would mint
+            # fresh ids the restore clobbers. Transient by design —
+            # the agent's outage loop retries next beat.
+            raise RuntimeError(
+                "scheduler is restoring from the HA journal; "
+                "re-register after failover completes"
+            )
         with self._cv:
             # Idempotency gate: registration is retried with backoff, so
             # an agent whose RegisterWorker response was lost re-sends
             # it; handing out a second set of worker ids would double
-            # the agent's capacity on paper.
+            # the agent's capacity on paper. A known address whose
+            # connections are GONE (journal-restored registry, workers
+            # not yet re-attached) falls through to the re-attach path.
             existing = sorted(
                 wid
                 for wid, addr in self._worker_addrs.items()
                 if addr == (ip_addr, port)
             )
-            if existing:
-                return existing, self._time_per_iteration
+            if existing and all(
+                wid in self._worker_connections for wid in existing
+            ):
+                return existing, self._time_per_iteration, self._ha_epoch, False
+            prev = [int(w) for w in (prev_worker_ids or [])] or existing
+            known = prev and all(
+                wid in self._worker_id_to_worker_type
+                and wid not in self._retired_workers
+                for wid in prev
+            )
+            if known:
+                worker_ids = self._reattach_worker_locked(
+                    prev, ip_addr, port, outstanding_job_ids or []
+                )
+                self._cv.notify_all()
+                return (
+                    worker_ids, self._time_per_iteration,
+                    self._ha_epoch, True,
+                )
             worker_ids = self.register_worker(
                 worker_type, num_gpus=num_accelerators
             )
@@ -265,26 +425,107 @@ class PhysicalScheduler(Scheduler):
             for worker_id in worker_ids:
                 self._worker_connections[worker_id] = client
                 self._worker_addrs[worker_id] = (ip_addr, port)
-            if self._fleet is not None:
-                # One scrape target per agent process, labeled by its
-                # lowest worker id (the label the merged fleet series
-                # carry as worker="<id>").
-                label = str(min(worker_ids))
-                for worker_id in worker_ids:
-                    self._fleet_agents[worker_id] = (
-                        label, (ip_addr, port)
-                    )
-                self._fleet.add_target(
-                    label, client.dump_worker_metrics
-                )
+            self._add_fleet_target(worker_ids, client, ip_addr, port)
             # Registration starts the liveness lease; see
             # _heartbeat_rpc / _dead_workers. Lock order _cv -> _hb_lock.
             now = time.monotonic()
             with self._hb_lock:
                 for worker_id in worker_ids:
                     self._last_heartbeat[worker_id] = now
+            self._ha_log(
+                "register",
+                {
+                    "worker_ids": list(worker_ids),
+                    "worker_type": str(worker_type),
+                    "num_accelerators": int(num_accelerators),
+                    "ip_addr": str(ip_addr),
+                    "port": int(port),
+                },
+            )
             self._cv.notify_all()
-        return worker_ids, self._time_per_iteration
+        return worker_ids, self._time_per_iteration, self._ha_epoch, False
+
+    def _add_fleet_target(self, worker_ids, client, ip_addr, port) -> None:
+        """Caller holds the lock (_cv). One scrape target per agent
+        process, labeled by its lowest worker id (the label the merged
+        fleet series carry as worker="<id>")."""
+        if self._fleet is None:
+            return
+        label = str(min(worker_ids))
+        for worker_id in worker_ids:
+            self._fleet_agents[worker_id] = (label, (ip_addr, port))
+        self._fleet.add_target(label, client.dump_worker_metrics)
+
+    def _reattach_worker_locked(
+        self, worker_ids, ip_addr, port, reported_job_ids
+    ) -> list:
+        """Caller holds the lock (_cv). Re-adopt a surviving agent
+        after a failover: rebuild its connections onto its previous
+        worker ids, seed its liveness lease, and reconcile the restored
+        outstanding set against the micro-task state it actually still
+        carries — anything the agent no longer has (its process died in
+        the crash window, or its Done was lost with the old leader's
+        ack) becomes a fault completion, so in-flight work is neither
+        lost nor double-charged."""
+        from shockwave_tpu.runtime.rpc.scheduler_client import SchedulerRpcClient
+
+        client = SchedulerRpcClient(ip_addr, port)
+        for worker_id in worker_ids:
+            self._worker_connections[worker_id] = client
+            self._worker_addrs[worker_id] = (ip_addr, port)
+        self._add_fleet_target(worker_ids, client, ip_addr, port)
+        now_mono = time.monotonic()
+        with self._hb_lock:
+            for worker_id in worker_ids:
+                self._last_heartbeat[worker_id] = now_mono
+        reported = {int(j) for j in reported_job_ids}
+        reconciled = self._reconcile_reattach_locked(worker_ids, reported)
+        obs.counter(
+            "ha_worker_reattach_total",
+            "agents re-adopted onto their previous worker ids after a "
+            "failover",
+        ).inc()
+        if reconciled:
+            LOG.warning(
+                "re-attach of workers %s reconciled lost in-flight "
+                "micro-tasks %s as fault completions",
+                worker_ids, reconciled,
+            )
+        self._ha_log(
+            "reattach",
+            {
+                "worker_ids": list(worker_ids),
+                "ip_addr": str(ip_addr),
+                "port": int(port),
+                "reported_job_ids": sorted(reported),
+                "reconciled": reconciled,
+            },
+        )
+        return list(worker_ids)
+
+    def _reconcile_reattach_locked(self, worker_ids, reported) -> list:
+        """Caller holds the lock (_cv). The ONE reconcile pass shared
+        by the live re-attach handler and its WAL replay (they must
+        mutate identically or a successor's replayed state diverges
+        from the state the dead leader actually had): every restored
+        in-flight micro-task on ``worker_ids`` that the agent no
+        longer carries (not in ``reported``) died in the crash window
+        — fault-complete it so the job requeues without a
+        failed-attempt charge. Returns the reconciled job keys."""
+        reconciled = []
+        for key, wid in list(self._outstanding):
+            if wid not in worker_ids:
+                continue
+            if any(j in reported for j in key.as_tuple()):
+                continue  # still running (or buffered) on the agent
+            self._outstanding.discard((key, wid))
+            self._jobs_with_extended_lease.discard(key)
+            zeros = [0] * len(key.singletons())
+            self._done_callback(
+                key, wid, zeros, [0.0] * len(key.singletons()), fault=True
+            )
+            reconciled.append(str(key))
+        return reconciled
 
     def _heartbeat_rpc(
         self, worker_id, est_offset_s: float = 0.0, est_rtt_s: float = 0.0
@@ -318,25 +559,48 @@ class PhysicalScheduler(Scheduler):
         wakeup notify touches _cv."""
         rpc_start = time.perf_counter()
         try:
-            # A malformed spec raises ValueError here, BEFORE anything
-            # is queued — the whole batch is rejected as INVALID so a
-            # token never resolves to a partial admission. Validation
-            # must be at least as strict as what add_job will demand at
-            # drain time: a wire-valid batch that ACCEPTED and then
-            # blew up the round loop at the round boundary would kill
-            # the whole cluster for one bad submitter.
-            jobs = [admission.job_from_spec_dict(s) for s in specs]
-            for job in jobs:
-                self._validate_job_runnable(job)
-            status, retry_after_s, admitted = self._admission.submit(
-                token, jobs, close=close
-            )
-            if status == admission.STATUS_ACCEPTED:
-                with self._cv:
-                    self._cv.notify_all()
-            return status, retry_after_s, admitted, self._admission.depth()
+            return self.submit_batch(token, specs, close)
         finally:
             self._observe_rpc("SubmitJobs", rpc_start)
+
+    def submit_batch(self, token, specs, close):
+        """The one admission entry behind every front-door socket (the
+        scheduler's own SubmitJobs handler and each HA admission-shard
+        slice): validate, offer to the bounded queue, journal the
+        accepted batch, wake the round loop."""
+        from shockwave_tpu.ha import codec as ha_codec
+
+        # A malformed spec raises ValueError here, BEFORE anything
+        # is queued — the whole batch is rejected as INVALID so a
+        # token never resolves to a partial admission. Validation
+        # must be at least as strict as what add_job will demand at
+        # drain time: a wire-valid batch that ACCEPTED and then
+        # blew up the round loop at the round boundary would kill
+        # the whole cluster for one bad submitter.
+        jobs = [admission.job_from_spec_dict(s) for s in specs]
+        for job in jobs:
+            self._validate_job_runnable(job)
+        status, retry_after_s, admitted = self._admission.submit(
+            token, jobs, close=close
+        )
+        if status == admission.STATUS_ACCEPTED:
+            # WAL: every ACCEPTED batch journals (a ledger-deduped
+            # retransmit included — replay is idempotent on the token,
+            # so the duplicate entry is a no-op there, and telling the
+            # two apart here would need a wider queue return contract).
+            # Payload built only when armed.
+            if self._ha_journal is not None and not self._ha_replaying:
+                self._ha_log(
+                    "submit",
+                    {
+                        "token": str(token),
+                        "jobs": [ha_codec.job_state(j) for j in jobs],
+                        "close": bool(close),
+                    },
+                )
+            with self._cv:
+                self._cv.notify_all()
+        return status, retry_after_s, admitted, self._admission.depth()
 
     def _validate_job_runnable(self, job) -> None:
         """Reject (ValueError -> INVALID on the wire) any job add_job
@@ -374,7 +638,18 @@ class PhysicalScheduler(Scheduler):
                 # a half-registered job; the validation reproduces the
                 # oracle check cheaply and raises before any mutation.
                 self._validate_job_runnable(job)
-                job_id = self.add_job(job, timestamp=enqueued_s)
+                # The admit journal entry carries the token so replay
+                # can pop the matching restored pending entry (round
+                # loop only, under _cv — no concurrent drains). The
+                # EMPTY string is a real front-door token (dedup
+                # disabled but the queue stores it) and must stay
+                # distinct from None (in-process add_job, nothing
+                # pending to pop at replay).
+                self._ha_drain_token = token
+                try:
+                    job_id = self.add_job(job, timestamp=enqueued_s)
+                finally:
+                    self._ha_drain_token = None
             except Exception:
                 LOG.error(
                     "admitted job %r (token %s) rejected at drain; "
@@ -422,6 +697,7 @@ class PhysicalScheduler(Scheduler):
         admitted job completes, the round loop exits instead of idling
         for more arrivals."""
         self._admission.close()
+        self._ha_log("close", {})
         with self._cv:
             self._cv.notify_all()
 
@@ -522,6 +798,9 @@ class PhysicalScheduler(Scheduler):
             )
         if self._shockwave is not None:
             self._shockwave.set_recompute_flag()
+        self._ha_log(
+            "retire", {"worker_id": int(worker_id), "kind": str(kind)}
+        )
         self._cv.notify_all()
         return requeued
 
@@ -543,6 +822,9 @@ class PhysicalScheduler(Scheduler):
                 "fault_injected_total",
                 "fault events delivered by the injector",
             ).inc(kind=event.kind)
+            if event.kind in faults_mod.SCHEDULER_KINDS:
+                self._apply_scheduler_fault(injector, event)
+                continue
             if event.kind == "worker_add":
                 LOG.warning(
                     "fault event %d (worker_add) skipped: physical mode "
@@ -582,6 +864,67 @@ class PhysicalScheduler(Scheduler):
                 event.event_id, how="requeued_and_replanned",
                 workers=victims,
             )
+
+    def _apply_scheduler_fault(self, injector, event) -> None:
+        """Caller holds the lock (_cv). The kill-the-brain drill:
+        ``scheduler_crash`` SIGKILLs THIS process at its scheduled time
+        — no cleanup, no flushes beyond what is already durable (the
+        WAL appends are) — and the hot standby (or a cold restart)
+        takes over through the journal. ``scheduler_restart`` has no
+        in-process action in physical mode: the successor IS the
+        restart. A successor whose journal shows the crash was already
+        taken (``sched_fault`` marker) records the recovery instead of
+        killing itself."""
+        import signal as _signal
+
+        recorder = obs.get_recorder()
+        if (
+            event.kind == "scheduler_restart"
+            or event.event_id in self._ha_consumed_sched_faults
+        ):
+            how = (
+                "successor_resumed"
+                if event.kind == "scheduler_crash"
+                else "standby_is_the_restart"
+            )
+            injector.mark_applied(event, skipped=how)
+            injector.mark_recovered(event.event_id, how=how)
+            if recorder.enabled and event.kind == "scheduler_crash":
+                recorder.record_recovery(
+                    {
+                        "fault_id": event.event_id,
+                        "kind": event.kind,
+                        "round": self._round_id,
+                        "time": self.get_current_timestamp(),
+                        "how": how,
+                        "epoch": self._ha_epoch,
+                    }
+                )
+            return
+        LOG.error(
+            "fault event %d: scheduler_crash — SIGKILLing the leader "
+            "(epoch %d) now", event.event_id, self._ha_epoch,
+        )
+        injector.mark_applied(event, epoch=self._ha_epoch)
+        if recorder.enabled:
+            recorder.record_fault(
+                {
+                    "fault_id": event.event_id,
+                    "kind": event.kind,
+                    "round": self._round_id,
+                    "time": self.get_current_timestamp(),
+                    "epoch": self._ha_epoch,
+                }
+            )
+            recorder.flush()
+        if self._ha_journal is not None:
+            # Durable marker: the successor must not re-apply this
+            # event to itself.
+            self._ha_journal.append(
+                "sched_fault", {"event_id": event.event_id},
+                epoch=self._ha_epoch,
+            )
+        os.kill(os.getpid(), _signal.SIGKILL)
 
     def remove_worker(self, worker_id: int) -> None:
         """Base removal plus the physical-only maps (connections,
@@ -667,6 +1010,23 @@ class PhysicalScheduler(Scheduler):
                 ).inc()
                 self._observe_rpc("Done", rpc_start)
                 return
+            # WAL: the progress credit must survive a crash between
+            # this report and the next checkpoint — a successor replays
+            # it through the same _done_callback path. (Logged after
+            # the idempotency gate, before the mutation: a crash in
+            # between just means the worker's retransmit re-applies.
+            # Payload built only when armed — legacy Done handling
+            # pays one attribute check.)
+            if self._ha_journal is not None and not self._ha_replaying:
+                self._ha_log(
+                    "done",
+                    {
+                        "job_ids": list(key.as_tuple()),
+                        "worker_id": int(worker_id),
+                        "steps": [int(s) for s in steps_list],
+                        "times": [float(t) for t in times_list],
+                    },
+                )
             now = self.get_current_timestamp()
             for single, log_text in zip(key.singletons(), logs):
                 if single in self._job_timelines:
@@ -786,6 +1146,21 @@ class PhysicalScheduler(Scheduler):
             # jobs (reference marks them at dispatch, scheduler.py:1935).
             self._running_jobs.add(single)
             self._per_job_latest_timestamps[single] = self.get_current_timestamp()
+        # WAL: a successor must know these micro-tasks are in flight —
+        # without the entry, a crash after dispatch and before the next
+        # checkpoint would leave the restored outstanding set empty and
+        # the workers' (buffered) Done reports would be dropped as
+        # duplicates, losing the round's progress. (Payload built only
+        # when armed.)
+        if self._ha_journal is not None and not self._ha_replaying:
+            self._ha_log(
+                "dispatch",
+                {
+                    "job_ids": list(key.as_tuple()),
+                    "worker_ids": [int(w) for w in worker_ids],
+                    "round": self._round_id,
+                },
+            )
         # Causal chain: one dispatch span per (possibly packed) key as a
         # child of each member job's root; the RunJob descriptions carry
         # the dispatch context so the worker's run spans hang under it.
@@ -843,8 +1218,18 @@ class PhysicalScheduler(Scheduler):
                     # The client retries with backoff internally; an
                     # exception here means every attempt failed.
                     client.run_job(
-                        descriptions, worker_id, self._round_id
+                        descriptions, worker_id, self._round_id,
+                        sched_epoch=self._ha_epoch,
                     )
+                except PermanentRpcError:
+                    # The worker's epoch gate bounced us: a newer
+                    # leader exists and every dispatch this process
+                    # sends is dead on arrival. Fence immediately —
+                    # do NOT fault-complete the micro-task; it is the
+                    # successor's to manage.
+                    self._outstanding.discard((key, worker_id))
+                    self._ha_fenced()
+                    return
                 except Exception:
                     # A dispatch that cannot reach its worker must not
                     # leave the micro-task outstanding (the round-end
@@ -1191,6 +1576,24 @@ class PhysicalScheduler(Scheduler):
             with self._cv:
                 self._round_id += 1
                 self._num_completed_rounds += 1
+                self._ha_log(
+                    "round",
+                    {
+                        "round_id": self._round_id,
+                        "completed": self._num_completed_rounds,
+                    },
+                )
+                should_checkpoint = (
+                    self._ha_journal is not None
+                    and self._round_id % self._ha_checkpoint_rounds == 0
+                )
+            # Periodic compaction: a full checkpoint every N rounds
+            # bounds failover replay to checkpoint + one short WAL
+            # tail. OUTSIDE the round-boundary lock block: the capture
+            # re-takes _cv briefly, but the encode + disk write must
+            # not stall RPC handlers for the whole serialization.
+            if should_checkpoint:
+                self._ha_checkpoint()
 
         self.shutdown()
 
@@ -1244,7 +1647,15 @@ class PhysicalScheduler(Scheduler):
                     # Retried with backoff inside the client
                     # (runtime/retry.py); reaching here means every
                     # attempt failed.
-                    client.kill_job(job_int, trace_context=kill_wire)
+                    client.kill_job(
+                        job_int, trace_context=kill_wire,
+                        sched_epoch=self._ha_epoch,
+                    )
+                except PermanentRpcError:
+                    # Fenced: a newer leader owns this worker. The kill
+                    # (and the job) are the successor's business now.
+                    self._ha_fenced()
+                    return
                 except Exception:
                     # The synthesized zero-progress Done below still
                     # converges bookkeeping, but a kill RPC that cannot
@@ -1336,12 +1747,313 @@ class PhysicalScheduler(Scheduler):
             return len(ids)
         return len(self._current_worker_assignments[job_id])
 
+    # -- HA checkpoint / journal replay ---------------------------------
+    def ha_state_dict(self) -> dict:
+        """Base control-plane snapshot plus the physical runtime's
+        own survival-critical state: the round cursor, worker registry
+        addresses, in-flight micro-tasks, lease/incumbency maps, and
+        the admission front door (token ledger + pending backlog +
+        tenant quotas)."""
+        state = super().ha_state_dict()
+        state["physical"] = {
+            "now": self.get_current_timestamp(),
+            "round_id": self._round_id,
+            # Scheduler-crash fault ids already taken by ANY past
+            # incarnation: compaction would otherwise erase the WAL
+            # markers and a later successor would re-apply a consumed
+            # crash to itself (SIGKILL ping-pong between drills).
+            "consumed_sched_faults": self._ha_consumed_sched_faults,
+            "num_expected_jobs": self._num_expected_jobs,
+            "dispatch_times": self._dispatch_times,
+            "extended_leases": self._jobs_with_extended_lease,
+            "next_assignments": self._next_assignments,
+            "max_steps_agreement": self._max_steps_agreement,
+            "last_lease_contact": self._last_lease_contact,
+            "outstanding": self._outstanding,
+            "dispatched_worker_ids": self._dispatched_worker_ids,
+            "worker_addrs": self._worker_addrs,
+            "retired_workers": self._retired_workers,
+            "admission": self._admission.state_dict(),
+        }
+        return state
+
+    def restore_ha_state(self, state: dict) -> None:
+        """Install a decoded snapshot into this freshly constructed
+        scheduler. Connections are NOT restored — workers re-attach to
+        the successor carrying their previous identity — but the
+        registry, addresses, and in-flight micro-task state are, so a
+        re-attaching worker slots straight back in."""
+        super().restore_ha_state(state)
+        if self._shockwave is not None:
+            # A real failover: the fleet may have churned during the
+            # outage — the restored planner must replan onto whatever
+            # actually re-attaches.
+            self._shockwave.set_recompute_flag()
+        p = state.get("physical") or {}
+        # The control-plane clock must CONTINUE across the failover
+        # (makespans span the outage; a reset clock would time-travel
+        # every restored timestamp).
+        now = float(p.get("now", self._current_timestamp))
+        self._start_time = time.time() - now
+        self._round_id = int(p.get("round_id", 0))
+        self._num_expected_jobs = p.get("num_expected_jobs")
+        self._dispatch_times = dict(p.get("dispatch_times") or {})
+        self._next_assignments = OrderedDict(
+            (key, tuple(ids))
+            for key, ids in (p.get("next_assignments") or {}).items()
+        )
+        self._max_steps_agreement = dict(p.get("max_steps_agreement") or {})
+        self._last_lease_contact = dict(p.get("last_lease_contact") or {})
+        self._outstanding = {
+            (key, int(wid)) for key, wid in (p.get("outstanding") or [])
+        }
+        self._dispatched_worker_ids = {
+            key: tuple(int(w) for w in ids)
+            for key, ids in (p.get("dispatched_worker_ids") or {}).items()
+        }
+        self._worker_addrs = {
+            int(wid): (str(addr[0]), int(addr[1]))
+            for wid, addr in (p.get("worker_addrs") or {}).items()
+        }
+        if "admission" in p:
+            self._admission.restore_state(p["admission"])
+        self._ha_consumed_sched_faults = set(
+            int(e) for e in (p.get("consumed_sched_faults") or [])
+        )
+        with self._hb_lock:
+            self._retired_workers = set(p.get("retired_workers") or [])
+            # Every restored worker gets a fresh liveness lease: the
+            # heartbeat-timeout grace period IS the re-attach window,
+            # and a worker that never comes back is reaped through the
+            # normal death path (requeue + capacity shrink).
+            now_mono = time.monotonic()
+            for wid in self._worker_id_to_worker_type:
+                self._last_heartbeat[wid] = now_mono
+        # In-flight micro-tasks keep running on the (re-attaching)
+        # workers through the outage: treat them as extended leases so
+        # the first post-failover round does not re-dispatch them, and
+        # reset their lease-contact clocks so the unresponsiveness
+        # check starts from the takeover, not from stamps made under
+        # the dead leader's clock.
+        self._jobs_with_extended_lease = set(
+            p.get("extended_leases") or []
+        )
+        for key, _wid in self._outstanding:
+            self._jobs_with_extended_lease.add(key)
+            self._last_lease_contact[key] = now
+
+    def restore_from_journal(self, snapshot) -> dict:
+        """Resume from a :meth:`ControlPlaneJournal.replay` snapshot:
+        install the checkpoint, re-apply the WAL tail in LSN order
+        through the same code paths the live events took, then write a
+        compacting checkpoint so the next failover replays from HERE
+        (and so nothing re-journaled during replay can duplicate the
+        tail). Returns {kind: count} of applied tail entries."""
+        applied: Dict[str, int] = {}
+        self._ha_replaying = True
+        # Out-of-order WAL reconciliation: submit_batch journals its
+        # 'submit' entry AFTER the queue accepted the batch (the queue
+        # work deliberately runs outside the round loop's lock), so a
+        # drain racing the append can journal the matching 'admit' at
+        # a LOWER LSN. Replay tracks admits whose submit hasn't been
+        # seen yet (discard_pending found nothing) and drops that many
+        # already-admitted jobs when the late 'submit' arrives.
+        self._ha_replay_admit_debt: Dict[str, int] = {}
+        try:
+            with self._cv:
+                if snapshot.checkpoint is not None:
+                    self.restore_ha_state(snapshot.checkpoint)
+                for entry in snapshot.entries:
+                    kind = entry["kind"]
+                    self._ha_apply_entry(kind, entry["payload"])
+                    applied[kind] = applied.get(kind, 0) + 1
+        finally:
+            self._ha_replaying = False
+            self._ha_replay_admit_debt = {}
+        recorder = obs.get_recorder()
+        if recorder.enabled:
+            recorder.record_recovery(
+                {
+                    "kind": "scheduler_failover",
+                    "how": "journal_replayed",
+                    "epoch": self._ha_epoch,
+                    "round": self._round_id,
+                    "checkpoint": snapshot.checkpoint is not None,
+                    "tail_entries": len(snapshot.entries),
+                }
+            )
+        obs.counter(
+            "ha_failover_restores_total",
+            "journal checkpoint+tail restores completed by a successor",
+        ).inc()
+        self._ha_checkpoint()
+        # Registrations may flow now: the registry is the restored one.
+        self._ha_restore_pending = False
+        LOG.warning(
+            "restored from journal: round %d, %d jobs live, %d workers "
+            "registered, %d in-flight micro-tasks, tail %s",
+            self._round_id, len(self._jobs),
+            len(self._worker_id_to_worker_type), len(self._outstanding),
+            applied or "empty",
+        )
+        return applied
+
+    def _ha_apply_entry(self, kind: str, payload: dict) -> None:
+        """Caller holds the lock (_cv), replay flag set. Re-apply one
+        WAL delta through the live code paths."""
+        from shockwave_tpu.ha import codec as ha_codec
+
+        if kind == "register":
+            # Mint the same ids the dead leader handed out (LSN order
+            # makes the counter walk identical); connections stay empty
+            # until the agent re-attaches.
+            self._worker_id_counter = min(payload["worker_ids"])
+            ids = self.register_worker(
+                payload["worker_type"],
+                num_gpus=int(payload["num_accelerators"]),
+            )
+            for wid in ids:
+                self._worker_addrs[wid] = (
+                    str(payload["ip_addr"]), int(payload["port"])
+                )
+            now_mono = time.monotonic()
+            with self._hb_lock:
+                for wid in ids:
+                    self._last_heartbeat[wid] = now_mono
+        elif kind == "reattach":
+            # Only the reconciliation mutated accounting; connections
+            # are rebuilt when the agent re-attaches to THIS process.
+            self._reconcile_reattach_locked(
+                list(payload["worker_ids"]),
+                {int(j) for j in payload.get("reported_job_ids", [])},
+            )
+        elif kind == "retire":
+            wid = int(payload["worker_id"])
+            if wid in self._worker_id_to_worker_type:
+                self._retire_worker(wid, kind=str(payload["kind"]))
+        elif kind == "submit":
+            jobs = [
+                ha_codec.job_from_state(j) for j in payload["jobs"]
+            ]
+            token = str(payload["token"])
+            # Jobs this token already admitted via LOWER-LSN 'admit'
+            # entries (the out-of-order append race) must not re-enter
+            # the backlog.
+            debt = self._ha_replay_admit_debt.pop(token, 0)
+            self._admission.restore_submission(
+                token, jobs[debt:],
+                close=bool(payload.get("close")),
+            )
+        elif kind == "close":
+            self._admission.close()
+        elif kind == "admit":
+            token = payload.get("token")
+            # None = in-process add_job (no queue entry to pop);
+            # "" = a tokenless front-door batch, whose pending entries
+            # ARE stored under "" and must still be consumed or the
+            # successor's drain admits the job a second time.
+            if token is not None:
+                # The restored queue still holds this job as pending
+                # (checkpoint or replayed submit); the drain consumed
+                # it before the crash. Nothing to discard = the token's
+                # 'submit' entry has a HIGHER LSN (the append race) —
+                # note the debt so its replay skips this job.
+                if self._admission.discard_pending(str(token), 1) == 0:
+                    self._ha_replay_admit_debt[str(token)] = (
+                        self._ha_replay_admit_debt.get(str(token), 0) + 1
+                    )
+            self._job_id_counter = int(payload["job_id"])
+            self.add_job(
+                ha_codec.job_from_state(payload["job"]),
+                timestamp=payload.get("timestamp"),
+            )
+        elif kind == "dispatch":
+            key = JobId(*payload["job_ids"])
+            worker_ids = tuple(int(w) for w in payload["worker_ids"])
+            self._dispatched_worker_ids[key] = worker_ids
+            self._dispatch_times[key] = self.get_current_timestamp()
+            for wid in worker_ids:
+                self._outstanding.add((key, wid))
+            for single in key.singletons():
+                if single in self._jobs:
+                    self._running_jobs.add(single)
+            # Still in flight across the failover: see restore_ha_state.
+            self._jobs_with_extended_lease.add(key)
+            self._last_lease_contact[key] = self.get_current_timestamp()
+        elif kind == "done":
+            key = JobId(*payload["job_ids"])
+            wid = int(payload["worker_id"])
+            if (key, wid) not in self._outstanding:
+                return  # duplicate entry (retransmit journaled twice)
+            self._outstanding.discard((key, wid))
+            if not any(
+                (key, w) in self._outstanding
+                for w in self._dispatched_worker_ids.get(key, ())
+            ):
+                self._jobs_with_extended_lease.discard(key)
+            self._done_callback(
+                key, wid,
+                [int(s) for s in payload["steps"]],
+                [float(t) for t in payload["times"]],
+            )
+        elif kind == "round":
+            self._round_id = int(payload["round_id"])
+            self._num_completed_rounds = int(
+                payload.get("completed", self._num_completed_rounds)
+            )
+        elif kind == "sched_fault":
+            self._ha_consumed_sched_faults.add(int(payload["event_id"]))
+        else:
+            LOG.warning("unknown WAL entry kind %r skipped", kind)
+
+    def wait_for_reattach(self, timeout: float = 30.0) -> list:
+        """After a journal restore, block until every restored worker
+        re-attached (heartbeat-ack failure drives agents to the
+        front-door map within a few beats). Workers that never come
+        back are retired through the normal death path — their
+        in-flight micro-tasks requeue as fault completions, exactly
+        once. Returns the retired worker ids."""
+        deadline = time.time() + timeout
+        with self._cv:
+            while True:
+                missing = [
+                    wid
+                    for wid in self._worker_ids
+                    if wid not in self._worker_connections
+                ]
+                if not missing or time.time() >= deadline:
+                    break
+                self._cv.wait(timeout=0.5)
+            for wid in missing:
+                LOG.warning(
+                    "worker %d never re-attached after failover; "
+                    "retiring it", wid,
+                )
+                self._retire_worker(wid, kind="failover_lost")
+            return missing
+
     def shutdown(self) -> None:
         if self._shutdown_requested.is_set():
             return
         self._shutdown_requested.set()
         if self._fleet is not None:
             self._fleet.stop()
+        if self._ha_deposed:
+            # Fenced: the workers belong to the successor now — sending
+            # them Shutdown would tear down the very fleet the new
+            # leader is resuming. Stop our own server and go quietly;
+            # the lease is already the successor's, so nothing to
+            # release.
+            LOG.warning(
+                "deposed scheduler (epoch %d) shutting down without "
+                "touching the fleet", self._ha_epoch,
+            )
+            if self._ha_election is not None:
+                self._ha_election.stop(release=False)
+            self._server.stop(grace=2)
+            with self._cv:
+                self._cv.notify_all()
+            return
         # Snapshot under the lock: a straggling RegisterWorker or a
         # concurrent reap mutates the connection map while this
         # iterates (the shutdown RPCs themselves stay outside the lock
@@ -1363,4 +2075,8 @@ class PhysicalScheduler(Scheduler):
                     "worker shutdown RPC failed (worker likely already "
                     "gone)", exc_info=True,
                 )
+        if self._ha_election is not None:
+            # Clean exit: hand the standby leadership immediately
+            # instead of making it wait out the lease TTL.
+            self._ha_election.stop(release=True)
         self._server.stop(grace=2)

@@ -462,15 +462,22 @@ class Scheduler:
     # Fault application (simulation path; physical mode detects real
     # worker death via heartbeat expiry in core/physical.py).
     # ------------------------------------------------------------------
-    def _apply_cluster_fault_events(self, injector, running_jobs) -> None:
+    def _apply_cluster_fault_events(
+        self, injector, running_jobs, queued_jobs=None
+    ) -> None:
         """Apply every due churn/reclaim event from the armed fault plan
         at this round boundary. Crashed or reclaimed workers take their
         running micro-tasks down with them: each affected task is
         force-completed with zero steps (``fault=True``, so the job is
         not charged a failed attempt), the job stays in the table for
         re-placement, capacity shrinks, and the planner is flagged to
-        replan. Every applied event is paired with a recovery record in
-        the flight recorder."""
+        replan. ``scheduler_crash`` / ``scheduler_restart`` events kill
+        the brain itself: in simulation both round-trip the FULL
+        control-plane state through the HA journal codec (capture ->
+        JSON -> restore, the exact on-disk transformation a failover
+        replays) and the run must continue bit-identically. Every
+        applied event is paired with a recovery record in the flight
+        recorder."""
         from shockwave_tpu.runtime import faults as faults_mod
 
         recorder = obs.get_recorder()
@@ -480,7 +487,16 @@ class Scheduler:
                 "fault_injected_total",
                 "fault events delivered by the injector",
             ).inc(kind=event.kind)
-            if event.kind == "worker_add":
+            if event.kind in faults_mod.SCHEDULER_KINDS:
+                detail = self._sim_scheduler_restart_roundtrip(
+                    running_jobs, queued_jobs
+                )
+                how = (
+                    "journal_state_restored"
+                    if detail.get("roundtrip_exact")
+                    else "journal_state_restored_INEXACT"
+                )
+            elif event.kind == "worker_add":
                 capacity = sum(self._cluster_spec.values())
                 count = event.count
                 if injector.plan.max_capacity is not None:
@@ -2125,7 +2141,7 @@ class Scheduler:
             # with it, not let the task complete normally first.
             if fault_injector is not None:
                 self._apply_cluster_fault_events(
-                    fault_injector, running_jobs
+                    fault_injector, running_jobs, queued_jobs=queued_jobs
                 )
 
             # Complete every running micro-task (they all end by round end).
@@ -2496,6 +2512,170 @@ class Scheduler:
                 "planner state"
             )
         return state["extra"]
+
+    # ------------------------------------------------------------------
+    # HA control-plane state (shockwave_tpu/ha/): the JSON-codec
+    # counterpart of save_checkpoint — everything a hot-standby or
+    # restarted scheduler needs to resume mid-round, expressed entirely
+    # in structures the flight-recorder codec round-trips exactly.
+    # ------------------------------------------------------------------
+    # Directly encodable fields (scalars, dicts, lists, tuples, numpy
+    # arrays, JobId keys). Sets travel separately so restore can coerce
+    # them back (the codec decodes a set as a list).
+    _HA_STATE_FIELDS = [
+        # clock / cursors
+        "_current_timestamp", "_num_completed_rounds", "_job_id_counter",
+        "_num_jobs_in_trace", "_need_to_update_allocation",
+        "_last_reset_time", "_num_lease_extensions",
+        "_num_lease_extension_opportunities", "_num_preemptions",
+        # per-job accounting
+        "_steps_run_so_far", "_total_steps_run", "_job_time_so_far",
+        "_job_cost_so_far", "_job_total_run_time", "_throughputs",
+        "_original_bs", "_bs_scale", "_job_id_to_job_type",
+        "_job_type_to_job_ids",
+        "_num_failures_per_job", "_per_job_start_timestamps",
+        "_per_job_latest_timestamps", "_pool_ftf_scale",
+        "_job_completion_times", "_job_priority_weights", "_slos",
+        "_in_progress_updates", "_job_timelines", "_round_log",
+        "_current_worker_assignments", "_current_round_scheduled_jobs",
+        # allocation state
+        "_allocation", "_priorities", "_deficits",
+        # worker registry (a successor restores the registry so
+        # re-attaching workers slot back into their old ids)
+        "_worker_id_counter", "_worker_ids", "_worker_types",
+        "_cluster_spec", "_worker_id_to_worker_type",
+        "_worker_type_to_worker_ids", "_worker_start_times",
+        "_cumulative_worker_time_so_far", "_worker_time_so_far",
+    ]
+    _HA_SET_FIELDS = (
+        "_completed_jobs", "_fault_tainted", "_available_worker_ids",
+        "_running_jobs",
+    )
+    # Scheduling decisions sample these; a resumed run diverges without
+    # their exact positions (random.Random.getstate round-trips as a
+    # tuple of ints).
+    _HA_RNG_FIELDS = (
+        "_job_generator", "_interarrival_time_generator",
+        "_worker_type_shuffler", "_slo_generator",
+    )
+
+    def ha_state_dict(self) -> dict:
+        """Full control-plane snapshot as recorder-codec-encodable
+        structures — the payload of one HA journal checkpoint. The
+        physical scheduler extends this with its runtime-only state
+        (outstanding micro-tasks, lease/incumbency maps, the
+        admission-token ledger, the round cursor)."""
+        from shockwave_tpu.ha import codec as ha_codec
+
+        state = {
+            "schema": "shockwave-ha-state-v1",
+            "fields": {
+                f: getattr(self, f) for f in self._HA_STATE_FIELDS
+            },
+            "sets": {f: getattr(self, f) for f in self._HA_SET_FIELDS},
+            "jobs": OrderedDict(
+                (job_id, ha_codec.job_state(job))
+                for job_id, job in self._jobs.items()
+            ),
+            "profiles": self._profiles,
+            "rng": {
+                name: getattr(self, name).getstate()
+                for name in self._HA_RNG_FIELDS
+            },
+        }
+        planner_state = ha_codec.planner_state_or_none(self)
+        if planner_state is not None:
+            state["planner"] = planner_state
+        return state
+
+    def restore_ha_state(self, state: dict) -> None:
+        """Install a decoded :meth:`ha_state_dict` snapshot. The
+        scheduler must be freshly constructed with the same policy and
+        configuration (policy/config are deployment facts, not journal
+        state)."""
+        from shockwave_tpu.ha import codec as ha_codec
+
+        fields = state["fields"]
+        for f in self._HA_STATE_FIELDS:
+            if f in fields:
+                setattr(self, f, fields[f])
+        for f in self._HA_SET_FIELDS:
+            if f in state["sets"]:
+                setattr(self, f, set(state["sets"][f]))
+        # Set-valued dict: decode() yields lists for the inner sets.
+        self._job_type_to_job_ids = {
+            key: set(ids)
+            for key, ids in fields.get(
+                "_job_type_to_job_ids", self._job_type_to_job_ids
+            ).items()
+        }
+        self._jobs = OrderedDict(
+            (job_id, ha_codec.job_from_state(job_fields))
+            for job_id, job_fields in state["jobs"].items()
+        )
+        self._profiles = dict(state.get("profiles") or {})
+        for name, rng_state in (state.get("rng") or {}).items():
+            if name in self._HA_RNG_FIELDS:
+                getattr(self, name).setstate(rng_state)
+        planner_state = state.get("planner")
+        if planner_state is not None:
+            from shockwave_tpu.policies.shockwave import planner_from_state
+
+            # The snapshot's own recompute_flag is restored verbatim:
+            # the simulator's crash/restart roundtrip must leave the
+            # run bit-identical. The PHYSICAL restore (a real failover,
+            # where the fleet may have changed under the outage) forces
+            # a replan on top — see PhysicalScheduler.restore_ha_state.
+            self._shockwave = planner_from_state(planner_state)
+
+    def _sim_scheduler_restart_roundtrip(
+        self, running_jobs, queued_jobs=None
+    ) -> dict:
+        """Simulation's ``scheduler_crash``/``scheduler_restart``: push
+        the ENTIRE control plane (scheduler + planner + the simulate
+        loop's running/queued job state) through the HA journal codec —
+        capture, JSON-serialize, decode, restore in place — exactly the
+        transformation a real failover replays from disk. Returns the
+        bit-exactness verdict for the fault record; the run continuing
+        bit-identically is the standing proof the checkpoint captures
+        every behavior-relevant field."""
+        import heapq as _heapq
+
+        from shockwave_tpu.ha import codec as ha_codec
+
+        state = self.ha_state_dict()
+        fp_before = ha_codec.state_fingerprint(state)
+        state["sim_loop"] = {
+            "running_jobs": [tuple(entry) for entry in running_jobs],
+            "queued_jobs": (
+                [
+                    (arrival, ha_codec.job_state(job))
+                    for arrival, job in queued_jobs
+                ]
+                if queued_jobs is not None
+                else None
+            ),
+        }
+        restored = ha_codec.json_roundtrip(state)
+        self.restore_ha_state(restored)
+        loop_state = restored.get("sim_loop") or {}
+        running_jobs[:] = [
+            tuple(entry) for entry in loop_state.get("running_jobs") or []
+        ]
+        _heapq.heapify(running_jobs)
+        if (
+            queued_jobs is not None
+            and loop_state.get("queued_jobs") is not None
+        ):
+            queued_jobs[:] = [
+                (arrival, ha_codec.job_from_state(job_fields))
+                for arrival, job_fields in loop_state["queued_jobs"]
+            ]
+        fp_after = ha_codec.state_fingerprint(self.ha_state_dict())
+        return {
+            "state_sha": fp_before[:16],
+            "roundtrip_exact": fp_before == fp_after,
+        }
 
     def save_round_log(self, path: str) -> None:
         """Write the structured event log (job / round / complete events)

@@ -1,0 +1,46 @@
+"""Survivable control plane: scheduler WAL + hot-standby failover.
+
+The runtime already survives worker death (heartbeat lease expiry),
+absorbs churn exactly-once (token-ledgered admission), and replans
+100k jobs per round — but the physical scheduler itself was one
+process whose SIGKILL ended the campaign. This package turns the
+flight-recorder codec from forensics into survivability:
+
+* :mod:`~shockwave_tpu.ha.journal` — a control-plane write-ahead
+  journal: durable JSONL deltas (admissions, dispatches, Done reports,
+  worker registry changes, round cursor) between periodic compacted
+  checkpoints of the FULL scheduler state (jobs, progress, planner,
+  admission-token ledger, tenant quotas, lease/incumbency state,
+  worker registry), all through the recorder's exact JSON codec.
+* :mod:`~shockwave_tpu.ha.election` — lease-based leader election
+  with monotonic fenced epochs. The lease record doubles as the
+  front-door map: workers and submitters resolve the CURRENT leader
+  (address, admission-shard sockets, epoch) from it, so failover is
+  a map flip, not a reconfiguration.
+* :mod:`~shockwave_tpu.ha.codec` — the scheduler-state capture/restore
+  pair behind both the journal checkpoints and the simulator's
+  deterministic ``scheduler_restart`` fault (a crash+restore roundtrip
+  that must leave the run bit-identical).
+* :mod:`~shockwave_tpu.ha.frontdoor` — the sharded per-cell admission
+  slices get real sockets: one gRPC server per shard, published in the
+  front-door map under the leader's epoch.
+* :mod:`~shockwave_tpu.ha.standby` — the HA node driver: leader
+  acquires the lease and serves; a hot standby blocks on the lease,
+  replays checkpoint+tail on takeover, and resumes mid-round with the
+  token ledger, quotas, leases, and in-flight micro-tasks intact.
+
+Fencing contract: every epoch is minted exactly once (the lease CAS
+increments it); scheduler->worker dispatch/kill RPCs carry the
+sender's epoch and workers reject anything below the highest epoch
+they have witnessed — a deposed leader cannot double-dispatch. Epoch 0
+means "HA off" (legacy single-scheduler runs are unfenced and
+byte-identical on the wire).
+"""
+
+from shockwave_tpu.ha.election import (  # noqa: F401
+    Lease,
+    LeaseLost,
+    LeaseStore,
+    LeaderElection,
+)
+from shockwave_tpu.ha.journal import ControlPlaneJournal  # noqa: F401

@@ -1,0 +1,75 @@
+"""Scheduler-state capture/restore helpers for the HA journal.
+
+The heavy lifting lives where the state lives —
+:meth:`Scheduler.ha_state_dict` / :meth:`Scheduler.restore_ha_state`
+(and the physical overrides) own the field lists; this module holds
+the pieces both sides and the tests share:
+
+* a :class:`~shockwave_tpu.core.job.Job` codec (dataclass fields plus
+  dynamically-attached extras like ``arrival_time``),
+* :func:`json_roundtrip` — encode -> JSON text -> decode through the
+  flight-recorder codec, the exact transformation a journal checkpoint
+  undergoes on disk. The simulator's deterministic
+  ``scheduler_restart`` fault pushes the whole control plane through
+  it mid-run and the run must come back bit-identical — the standing
+  proof that the checkpoint captures every behavior-relevant field.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+from shockwave_tpu.core.job import Job
+from shockwave_tpu.obs.recorder import decode, encode
+
+_JOB_FIELDS = tuple(f.name for f in dataclasses.fields(Job))
+
+
+def job_state(job: Job) -> dict:
+    """Every attribute of ``job``, declared dataclass fields and
+    dynamically-attached extras (``arrival_time``) alike — the journal
+    must restore the object the scheduler actually held, not the one
+    the trace format describes."""
+    return dict(vars(job))
+
+
+def job_from_state(state: dict) -> Job:
+    declared = {f: state[f] for f in _JOB_FIELDS if f in state}
+    job = Job(**declared)
+    for key, value in state.items():
+        if key not in _JOB_FIELDS:
+            setattr(job, key, value)
+    return job
+
+
+def json_roundtrip(state):
+    """The exact on-disk transformation of a journal checkpoint:
+    recorder-encode, serialize to JSON text, parse, recorder-decode.
+    Capture/restore must be exact through THIS, not through an
+    in-memory copy."""
+    return decode(json.loads(json.dumps(encode(state))))
+
+
+def state_fingerprint(state) -> str:
+    """Content hash of an encodable state (sorted-key JSON of the
+    encoded form) — the bit-exactness witness smoke gates compare
+    across a save/restore/save cycle. Dict entry ORDER is part of the
+    identity (the codec preserves it, and capture/restore walk the
+    same deterministic order), so compare captures, not hand-built
+    dicts."""
+    import hashlib
+
+    text = json.dumps(encode(state), sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def restore_sets(decoded, *, frozen: bool = False):
+    """Decode() returns lists for encoded sets; coerce back."""
+    return frozenset(decoded) if frozen else set(decoded)
+
+
+def planner_state_or_none(scheduler) -> Optional[dict]:
+    shockwave = getattr(scheduler, "_shockwave", None)
+    return shockwave.state_dict() if shockwave is not None else None

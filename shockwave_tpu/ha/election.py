@@ -1,0 +1,445 @@
+"""Lease-based leader election with monotonic fenced epochs.
+
+One small, durable lease record decides who the scheduler is. A node
+acquires leadership by a compare-and-swap on that record: if the lease
+is free or expired it installs itself with ``epoch = prev + 1``; the
+epoch is minted exactly once per leadership change and never reused,
+so it is a fencing token — workers reject dispatch/kill RPCs stamped
+with an epoch below the highest they have witnessed, and a deposed
+leader (paused GC, network partition, operator error) cannot
+double-dispatch work it no longer owns.
+
+The lease record doubles as the **front-door map**: it carries the
+leader's scheduler address and the per-shard admission socket ports,
+so workers re-attaching after a scheduler death and submitters
+following a failover resolve the current leader from one place that
+changes atomically with the epoch.
+
+The default store is file-backed (``flock`` around a read-modify-write,
+atomic temp+rename publish) — correct for the localhost/NFS clusters
+this repo's physical mode drives, and a stand-in with the exact same
+contract (CAS, TTL, monotonic epoch) an etcd/ZooKeeper store would
+implement for a multi-host deployment. Nothing outside this module
+knows how the lease is stored.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from shockwave_tpu import obs
+from shockwave_tpu.analysis import sanitize
+from shockwave_tpu.utils.fileio import atomic_write_json
+
+LEASE_FILE = "lease.json"
+LOCK_FILE = "lease.lock"
+
+# Default lease TTL. Renewal runs at TTL/3, so two consecutive renewal
+# failures still leave a third of the TTL before a standby can steal.
+DEFAULT_TTL_S = 10.0
+
+
+class LeaseLost(RuntimeError):
+    """Raised when a renew/release finds the lease held by a newer
+    epoch — the caller has been deposed and must fence itself."""
+
+
+@dataclass(frozen=True)
+class Lease:
+    """One leadership term. ``epoch`` is the fencing token."""
+
+    epoch: int
+    holder: str
+    expires_at: float
+    sched_addr: str = ""
+    sched_port: int = 0
+    # Front-door map: admission shard label -> port on sched_addr.
+    admission_ports: Dict[str, int] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "holder": self.holder,
+            "expires_at": self.expires_at,
+            "sched_addr": self.sched_addr,
+            "sched_port": self.sched_port,
+            "admission_ports": dict(self.admission_ports),
+        }
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "Lease":
+        return cls(
+            epoch=int(raw.get("epoch", 0)),
+            holder=str(raw.get("holder", "")),
+            expires_at=float(raw.get("expires_at", 0.0)),
+            sched_addr=str(raw.get("sched_addr", "")),
+            sched_port=int(raw.get("sched_port", 0)),
+            admission_ports={
+                str(k): int(v)
+                for k, v in (raw.get("admission_ports") or {}).items()
+            },
+        )
+
+
+class LeaseStore:
+    """File-backed lease record with CAS semantics.
+
+    Every mutation runs under an ``flock`` on a sidecar lock file (the
+    lease file itself is replaced by rename, so a stable inode is
+    needed for the lock) and publishes the new record atomically with
+    temp+rename — a reader never observes a torn lease, with or
+    without the lock.
+    """
+
+    def __init__(
+        self,
+        ha_dir: str,
+        ttl_s: float = DEFAULT_TTL_S,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.ha_dir = str(ha_dir)
+        self.ttl_s = float(ttl_s)
+        self._clock = clock
+        os.makedirs(self.ha_dir, exist_ok=True)
+        self._lease_path = os.path.join(self.ha_dir, LEASE_FILE)
+        self._lock_path = os.path.join(self.ha_dir, LOCK_FILE)
+
+    # -- readers (lockless: rename publication is atomic) ---------------
+    def read(self) -> Optional[Lease]:
+        try:
+            with open(self._lease_path) as f:
+                return Lease.from_dict(json.load(f))
+        except FileNotFoundError:
+            return None
+        except (json.JSONDecodeError, ValueError):
+            # A half-written record is impossible by construction
+            # (temp+rename); an unparseable one is operator damage —
+            # treat as no lease rather than wedging every node.
+            return None
+
+    def leader(self) -> Optional[Lease]:
+        """The current UNEXPIRED lease, or None."""
+        lease = self.read()
+        if lease is None or lease.expires_at <= self._clock():
+            return None
+        return lease
+
+    # -- CAS mutations ---------------------------------------------------
+    def _with_flock(self, fn):
+        fd = os.open(self._lock_path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            return fn()
+        finally:
+            # Releasing the flock before close is implicit in close, but
+            # be explicit for readers of this code.
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    def try_acquire(
+        self,
+        holder: str,
+        sched_addr: str = "",
+        sched_port: int = 0,
+        admission_ports: Optional[Dict[str, int]] = None,
+    ) -> Optional[Lease]:
+        """Take leadership if the lease is free, expired, or already
+        ours; returns the new lease (epoch bumped unless it was already
+        ours and unexpired) or None when another holder is alive."""
+
+        def cas():
+            now = self._clock()
+            current = self.read()
+            if (
+                current is not None
+                and current.expires_at > now
+                and current.holder != holder
+            ):
+                return None
+            prev_epoch = current.epoch if current is not None else 0
+            same_term = (
+                current is not None
+                and current.holder == holder
+                and current.expires_at > now
+            )
+            lease = Lease(
+                epoch=prev_epoch if same_term else prev_epoch + 1,
+                holder=holder,
+                expires_at=now + self.ttl_s,
+                sched_addr=sched_addr,
+                sched_port=int(sched_port),
+                admission_ports=dict(admission_ports or {}),
+            )
+            atomic_write_json(self._lease_path, lease.to_dict())
+            return lease
+
+        lease = self._with_flock(cas)
+        if lease is not None:
+            obs.counter(
+                "ha_lease_acquisitions_total",
+                "leadership terms started (epoch mints + same-term "
+                "re-acquires)",
+            ).inc()
+            obs.gauge(
+                "ha_leader_epoch", "this process's current fenced epoch"
+            ).set(float(lease.epoch))
+        return lease
+
+    def renew(self, lease: Lease) -> Lease:
+        """Extend ``lease``; raises :class:`LeaseLost` if a newer epoch
+        (or another holder) owns the record — the caller is deposed."""
+
+        def cas():
+            current = self.read()
+            if (
+                current is None
+                or current.epoch != lease.epoch
+                or current.holder != lease.holder
+            ):
+                raise LeaseLost(
+                    f"lease epoch {lease.epoch} (holder {lease.holder!r}) "
+                    f"superseded by "
+                    f"{current.epoch if current else '<none>'} "
+                    f"(holder {current.holder if current else '<none>'!r})"
+                )
+            if current.expires_at == 0.0:
+                # release() stamps exactly 0.0: a voluntary step-down.
+                # The holder's own renewal thread racing the release
+                # must NOT resurrect the term — the successor may
+                # already be acquiring. (An ordinary TTL expiry that
+                # nobody stole yet stays renewable: that is recovery
+                # from a store hiccup, not a step-down.)
+                raise LeaseLost(
+                    f"lease epoch {lease.epoch} was released by "
+                    f"{lease.holder!r}; the term is over"
+                )
+            renewed = Lease(
+                epoch=lease.epoch,
+                holder=lease.holder,
+                expires_at=self._clock() + self.ttl_s,
+                sched_addr=lease.sched_addr,
+                sched_port=lease.sched_port,
+                admission_ports=dict(lease.admission_ports),
+            )
+            atomic_write_json(self._lease_path, renewed.to_dict())
+            return renewed
+
+        return self._with_flock(cas)
+
+    def release(self, lease: Lease) -> None:
+        """Expire our own lease immediately (clean shutdown hands the
+        standby leadership without waiting out the TTL). A lost lease
+        is a no-op — the successor already owns the record."""
+
+        def cas():
+            current = self.read()
+            if (
+                current is None
+                or current.epoch != lease.epoch
+                or current.holder != lease.holder
+            ):
+                return
+            expired = Lease(
+                epoch=lease.epoch,
+                holder=lease.holder,
+                expires_at=0.0,
+                sched_addr=lease.sched_addr,
+                sched_port=lease.sched_port,
+                admission_ports=dict(lease.admission_ports),
+            )
+            atomic_write_json(self._lease_path, expired.to_dict())
+
+        self._with_flock(cas)
+
+
+class LeaderElection:
+    """One node's view of the election: acquire (blocking for a
+    standby), renew on a daemon thread, and fence on loss.
+
+    ``on_lost`` (set via :meth:`start_renewal`) is called at most once,
+    from the renewal thread, the moment a renew discovers a newer
+    epoch; the owner must stop dispatching immediately — its epoch is
+    dead and every fenced RPC it sends will be rejected anyway.
+    """
+
+    def __init__(
+        self,
+        store: LeaseStore,
+        holder: str,
+        renew_interval_s: Optional[float] = None,
+    ):
+        self.store = store
+        self.holder = str(holder)
+        self._renew_interval = (
+            float(renew_interval_s)
+            if renew_interval_s is not None
+            else store.ttl_s / 3.0
+        )
+        self._lock = sanitize.make_lock("ha.election.LeaderElection._lock")
+        self._lease: Optional[Lease] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._on_lost: Optional[Callable[[], None]] = None
+        self._lost_fired = False
+
+    @property
+    def lease(self) -> Optional[Lease]:
+        with self._lock:
+            return self._lease
+
+    @property
+    def epoch(self) -> int:
+        lease = self.lease
+        return lease.epoch if lease is not None else 0
+
+    def is_leader(self) -> bool:
+        lease = self.lease
+        return (
+            lease is not None
+            and lease.expires_at > self.store._clock()
+        )
+
+    def acquire(
+        self,
+        sched_addr: str = "",
+        sched_port: int = 0,
+        admission_ports: Optional[Dict[str, int]] = None,
+        block: bool = True,
+        poll_s: float = 0.5,
+        timeout_s: Optional[float] = None,
+    ) -> Optional[Lease]:
+        """Take (or wait for) leadership. A standby blocks here until
+        the incumbent's lease expires or is released, then wins the CAS
+        with the next epoch."""
+        deadline = (
+            time.monotonic() + timeout_s if timeout_s is not None else None
+        )
+        while True:
+            lease = self.store.try_acquire(
+                self.holder,
+                sched_addr=sched_addr,
+                sched_port=sched_port,
+                admission_ports=admission_ports,
+            )
+            if lease is not None:
+                with self._lock:
+                    self._lease = lease
+                    self._lost_fired = False
+                return lease
+            if not block:
+                return None
+            if deadline is not None and time.monotonic() > deadline:
+                return None
+            time.sleep(poll_s)
+
+    def publish(
+        self,
+        sched_addr: Optional[str] = None,
+        sched_port: Optional[int] = None,
+        admission_ports: Optional[Dict[str, int]] = None,
+    ) -> Lease:
+        """Update the front-door map fields of our own lease (same
+        epoch — the map follows the leader, it does not re-elect)."""
+        with self._lock:
+            lease = self._lease
+        if lease is None:
+            raise LeaseLost("cannot publish a map without a lease")
+        updated = Lease(
+            epoch=lease.epoch,
+            holder=lease.holder,
+            expires_at=lease.expires_at,
+            sched_addr=(
+                lease.sched_addr if sched_addr is None else str(sched_addr)
+            ),
+            sched_port=(
+                lease.sched_port if sched_port is None else int(sched_port)
+            ),
+            admission_ports=(
+                dict(lease.admission_ports)
+                if admission_ports is None
+                else dict(admission_ports)
+            ),
+        )
+        renewed = self.store.renew(updated)
+        with self._lock:
+            self._lease = renewed
+        return renewed
+
+    def start_renewal(
+        self, on_lost: Optional[Callable[[], None]] = None
+    ) -> None:
+        with self._lock:
+            self._on_lost = on_lost
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._renew_loop, daemon=True, name="ha-lease-renew"
+            )
+            self._thread.start()
+
+    def stop(self, release: bool = True) -> None:
+        self._stop.set()
+        with self._lock:
+            thread, self._thread = self._thread, None
+            lease = self._lease
+        if thread is not None and thread is not threading.current_thread():
+            # `is not current_thread`: the renewal thread itself reaches
+            # here when its on_lost callback shuts the scheduler down —
+            # joining itself would raise, and it exits right after the
+            # callback anyway.
+            thread.join(timeout=self._renew_interval * 2 + 1.0)
+        if release and lease is not None:
+            try:
+                self.store.release(lease)
+            except OSError:
+                pass  # the store directory may already be gone at teardown
+
+    def _renew_loop(self) -> None:
+        while not self._stop.wait(self._renew_interval):
+            with self._lock:
+                lease = self._lease
+            if lease is None:
+                continue
+            try:
+                renewed = self.store.renew(lease)
+            except LeaseLost:
+                self._fence()
+                return
+            except OSError:
+                # Store briefly unreachable (NFS hiccup): the lease is
+                # still ours until TTL; next tick retries. But once OUR
+                # OWN record's TTL has passed without a successful
+                # renew, we can no longer assert ownership — a standby
+                # may legitimately be taking epoch+1 right now, and an
+                # unfenced leader past its TTL is a split-brain writer.
+                obs.counter(
+                    "ha_lease_renew_errors_total",
+                    "lease renewals that failed on store I/O",
+                ).inc()
+                if self.store._clock() >= lease.expires_at:
+                    self._fence()
+                    return
+                continue
+            with self._lock:
+                self._lease = renewed
+
+    def _fence(self) -> None:
+        """Deposed: drop the lease and fire ``on_lost`` exactly once."""
+        with self._lock:
+            self._lease = None
+            fire = not self._lost_fired and self._on_lost is not None
+            self._lost_fired = True
+            callback = self._on_lost
+        obs.counter(
+            "ha_lease_lost_total",
+            "leadership terms ended by a newer epoch (deposed)",
+        ).inc()
+        if fire and callback is not None:
+            callback()

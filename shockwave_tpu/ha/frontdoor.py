@@ -1,0 +1,110 @@
+"""Real sockets for the sharded admission slices, behind the
+epoch-following front-door map.
+
+The cell-decomposed planner already shards the admission queue (one
+slice per cell, coordinator-rebalanced); until now every shard was
+reached through the scheduler's single gRPC port. This module gives
+each slice its own listener: one AdmissionToScheduler server per
+shard, all funneling into the SAME
+:meth:`PhysicalScheduler.submit_batch` entry (validation, token
+ledger, WAL journaling, round-loop wakeup — one code path however a
+batch arrives), so one hot submitter saturating its slice's socket
+cannot brown out its siblings' accept queues.
+
+The shard→port map is published in the leader lease
+(:class:`shockwave_tpu.ha.election.Lease.admission_ports`), so it
+follows the epoch: a failover atomically replaces the whole map, and
+submitters that route client-side (crc32(token) % shards — the same
+hash the sharded queue uses, so a retried token meets its own ledger)
+land on the successor's sockets the moment they re-read the lease.
+"""
+
+from __future__ import annotations
+
+import zlib
+from concurrent import futures
+from typing import Dict, List, Optional, Tuple
+
+import grpc
+
+from shockwave_tpu import obs
+from shockwave_tpu.runtime.rpc.wiring import add_servicer
+from shockwave_tpu.utils.hostenv import free_port
+
+
+class AdmissionFrontDoor:
+    """One gRPC AdmissionToScheduler server per admission shard."""
+
+    def __init__(
+        self,
+        scheduler,
+        ports: Optional[List[int]] = None,
+        max_workers_per_shard: int = 8,
+    ):
+        from shockwave_tpu.runtime.rpc.scheduler_server import (
+            _admission_handlers,
+        )
+
+        self._scheduler = scheduler
+        queue = scheduler._admission
+        num_shards = int(getattr(queue, "num_shards", 1) or 1)
+        self._servers: List[grpc.Server] = []
+        self.ports: Dict[str, int] = {}
+        handlers = _admission_handlers(
+            {"submit_jobs": scheduler._submit_jobs_rpc}
+        )
+        for i in range(num_shards):
+            port = (
+                int(ports[i])
+                if ports is not None and i < len(ports)
+                else free_port()
+            )
+            server = grpc.server(
+                futures.ThreadPoolExecutor(
+                    max_workers=max_workers_per_shard
+                )
+            )
+            add_servicer(server, "AdmissionToScheduler", handlers)
+            server.add_insecure_port(f"[::]:{port}")
+            server.start()
+            self._servers.append(server)
+            self.ports[f"s{i:02d}"] = port
+        obs.gauge(
+            "ha_frontdoor_shards",
+            "admission shard sockets served by this leader",
+        ).set(float(num_shards))
+
+    def stop(self, grace: float = 1.0) -> None:
+        for server in self._servers:
+            server.stop(grace=grace)
+
+
+def shard_port_for_token(
+    admission_ports: Dict[str, int], token: str
+) -> Optional[int]:
+    """Client-side shard routing: the SAME crc32 hash the sharded
+    queue routes by, so a retried token always reaches the shard
+    holding its ledger entry whichever socket generation it crossed."""
+    if not admission_ports:
+        return None
+    ordered = [admission_ports[k] for k in sorted(admission_ports)]
+    return ordered[zlib.crc32(str(token).encode("utf-8")) % len(ordered)]
+
+
+def resolve_submit_target(
+    ha_dir: str, token: str = ""
+) -> Optional[Tuple[str, int, int]]:
+    """(addr, port, epoch) of the current leader's admission socket
+    for ``token`` — the submitter-side half of the front-door map.
+    None when no unexpired leader is published."""
+    from shockwave_tpu.ha.election import LeaseStore
+
+    lease = LeaseStore(ha_dir).leader()
+    if lease is None or not lease.sched_addr:
+        return None
+    port = shard_port_for_token(lease.admission_ports, token)
+    return (
+        lease.sched_addr,
+        int(port if port else lease.sched_port),
+        lease.epoch,
+    )

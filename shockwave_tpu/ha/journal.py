@@ -1,0 +1,317 @@
+"""Control-plane write-ahead journal: checkpoint + tail replay.
+
+The flight recorder already proves the planner state round-trips
+exactly through its JSON codec (every committed campaign replays
+bit-identically). This module rides that same codec
+(:func:`shockwave_tpu.obs.recorder.encode` / ``decode``) to make the
+WHOLE control plane durable, not just the planner:
+
+* **Checkpoints** — periodic compacted snapshots of the full scheduler
+  state (jobs + progress, planner, admission-token ledger, tenant
+  quotas, worker registry, lease/incumbency state, round cursor),
+  written atomically as ``checkpoint-<seq>.json``.
+* **WAL segments** — between checkpoints, every state-changing
+  control-plane event (accepted submission batch, admission, dispatch,
+  Done report, worker register/retire, round advance) appends one
+  JSONL line to ``wal-<seq>.jsonl`` via a single ``O_APPEND`` write,
+  stamped with a monotonically increasing LSN and the writer's fenced
+  epoch.
+* **Replay** — a restarted or hot-standby scheduler loads the newest
+  valid checkpoint and re-applies its WAL tail in LSN order; a
+  truncated final line (the crash-interrupted append) is skipped, a
+  corrupt middle line raises — that is data loss, not a crash
+  artifact.
+
+A brand-new journal has no checkpoint: segment 0's WAL alone rebuilds
+the run from an empty scheduler (cold-start replay), so the journal is
+complete from the first append, not from the first checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from shockwave_tpu import obs
+from shockwave_tpu.analysis import sanitize
+from shockwave_tpu.obs.recorder import decode, encode
+from shockwave_tpu.utils.fileio import atomic_append_text, atomic_write_json
+
+SCHEMA = "shockwave-ha-journal-v1"
+
+_CKPT_RE = re.compile(r"^checkpoint-(\d{8})\.json$")
+_WAL_RE = re.compile(r"^wal-(\d{8})\.jsonl$")
+
+
+@dataclass
+class JournalSnapshot:
+    """What :func:`ControlPlaneJournal.replay` hands a successor."""
+
+    # Decoded checkpoint state, or None (cold-start replay from LSN 0).
+    checkpoint: Optional[dict]
+    # Decoded WAL tail entries after the checkpoint, LSN order.
+    entries: List[dict] = field(default_factory=list)
+    seq: int = 0
+    # Highest LSN seen (checkpoint's or last entry's); the successor
+    # continues from last_lsn + 1.
+    last_lsn: int = -1
+    # Highest writer epoch seen anywhere in the journal.
+    last_epoch: int = 0
+
+
+class ControlPlaneJournal:
+    """Append-only journal under one directory; safe for one writer
+    (the leader — epoch fencing guarantees there is exactly one) and
+    any number of concurrent readers."""
+
+    def __init__(self, journal_dir: str, retain: int = 2):
+        self.dir = str(journal_dir)
+        self.retain = max(1, int(retain))
+        os.makedirs(self.dir, exist_ok=True)
+        self._lock = sanitize.make_lock("ha.journal.ControlPlaneJournal._lock")
+        seq, last_lsn = self._discover()
+        self._seq = seq
+        self._lsn = last_lsn + 1
+        self.entries_appended = 0
+        self.checkpoints_written = 0
+
+    # -- discovery -------------------------------------------------------
+    def _segments(self):
+        ckpts, wals = {}, {}
+        for name in os.listdir(self.dir):
+            m = _CKPT_RE.match(name)
+            if m:
+                ckpts[int(m.group(1))] = os.path.join(self.dir, name)
+            m = _WAL_RE.match(name)
+            if m:
+                wals[int(m.group(1))] = os.path.join(self.dir, name)
+        return ckpts, wals
+
+    def _discover(self):
+        """Resume point for a writer re-opening an existing journal:
+        the newest segment, and the highest LSN recorded ANYWHERE in
+        the retained generations. Scanning every segment (not just the
+        newest) matters when the newest checkpoint is damaged and its
+        WAL empty: resuming below an older generation's LSNs would
+        mint entries that a fallback replay silently filters out as
+        pre-checkpoint history — durable writes vanishing without an
+        error."""
+        ckpts, wals = self._segments()
+        if not ckpts and not wals:
+            return 0, -1
+        seq = max(list(ckpts) + list(wals))
+        last_lsn = -1
+        for ckpt_path in ckpts.values():
+            header = self._read_checkpoint_header(ckpt_path)
+            if header is not None:
+                last_lsn = max(last_lsn, int(header.get("lsn", -1)))
+        for wal_path in wals.values():
+            for entry in self._iter_wal(wal_path):
+                last_lsn = max(last_lsn, int(entry.get("lsn", -1)))
+        return seq, last_lsn
+
+    @staticmethod
+    def _read_checkpoint_header(path: str) -> Optional[dict]:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            # atomic_write_json makes a torn checkpoint impossible; an
+            # unreadable one is damage — replay falls back a generation.
+            return None
+
+    @staticmethod
+    def _iter_wal(path: Optional[str]):
+        if path is None or not os.path.exists(path):
+            return
+        with open(path) as f:
+            lines = f.readlines()
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                if i == len(lines) - 1:
+                    return  # crash-interrupted final append
+                raise ValueError(
+                    f"{path}:{i + 1}: corrupt WAL record (not the final "
+                    "line, so not a truncated append)"
+                )
+
+    # -- writer side -----------------------------------------------------
+    def _wal_path(self, seq: int) -> str:
+        return os.path.join(self.dir, f"wal-{seq:08d}.jsonl")
+
+    def _ckpt_path(self, seq: int) -> str:
+        return os.path.join(self.dir, f"checkpoint-{seq:08d}.json")
+
+    def append(self, kind: str, payload: dict, epoch: int = 0) -> int:
+        """Durably log one control-plane delta; returns its LSN."""
+        with self._lock:
+            lsn = self._lsn
+            self._lsn += 1
+            path = self._wal_path(self._seq)
+            record = {
+                "lsn": lsn,
+                "epoch": int(epoch),
+                "kind": str(kind),
+                "payload": encode(payload),
+            }
+            atomic_append_text(
+                path, json.dumps(record, separators=(",", ":")) + "\n"
+            )
+            self.entries_appended += 1
+        obs.counter(
+            "ha_journal_entries_total", "control-plane WAL entries appended"
+        ).inc(kind=kind)
+        return lsn
+
+    def begin_checkpoint(self) -> tuple:
+        """Reserve the next segment seq + checkpoint LSN, rotating
+        subsequent appends into the new WAL segment. The caller must
+        hold whatever lock makes its state CAPTURE atomic with this
+        reservation (the physical scheduler holds ``_cv``), so no
+        lock-protected WAL entry can land between the captured state
+        and the checkpoint's LSN — an entry logged after the
+        reservation gets a higher LSN and replays on top of the
+        checkpoint; one logged before is inside it. Returns
+        ``(seq, lsn)`` for :meth:`commit_checkpoint`. A crash between
+        the two leaves a seq with no checkpoint file — replay falls
+        back a generation and re-applies both WAL segments."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            lsn = self._lsn
+            self._lsn += 1
+            return seq, lsn
+
+    def commit_checkpoint(
+        self, seq: int, lsn: int, encoded_state, epoch: int = 0
+    ) -> int:
+        """Write the checkpoint reserved by :meth:`begin_checkpoint`.
+        ``encoded_state`` must already be recorder-encoded (the
+        encode IS the deep snapshot — it must happen under the
+        caller's state lock; the JSON dump + disk write here need
+        not)."""
+        atomic_write_json(
+            self._ckpt_path(seq),
+            {
+                "event": "checkpoint",
+                "schema": SCHEMA,
+                "seq": seq,
+                "lsn": lsn,
+                "epoch": int(epoch),
+                "state": encoded_state,
+            },
+            indent=None,
+        )
+        with self._lock:
+            self.checkpoints_written += 1
+            self._gc_locked(seq)
+        obs.counter(
+            "ha_journal_checkpoints_total",
+            "compacted control-plane checkpoints written",
+        ).inc()
+        return seq
+
+    def checkpoint(self, state: dict, epoch: int = 0) -> int:
+        """Reserve + encode + write in one call, for callers whose
+        state is not concurrently mutated (tests, offline tools). The
+        live scheduler uses the split begin/commit pair so only the
+        capture+encode runs under its lock."""
+        seq, lsn = self.begin_checkpoint()
+        return self.commit_checkpoint(seq, lsn, encode(state), epoch=epoch)
+
+    def _gc_locked(self, current_seq: int) -> None:
+        """Caller holds the lock. Drop generations older than the last
+        ``retain`` (the current one included in the count)."""
+        floor = current_seq - self.retain + 1
+        ckpts, wals = self._segments()
+        for seq, path in list(ckpts.items()) + list(wals.items()):
+            if seq < floor:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass  # a concurrent reader on some OSes; retry next gc
+
+    # -- reader side -----------------------------------------------------
+    @classmethod
+    def replay(cls, journal_dir: str) -> JournalSnapshot:
+        """Load the newest valid checkpoint + its WAL tail. Falls back
+        one generation if the newest checkpoint is unreadable (its
+        predecessor plus BOTH WAL segments replays the same history)."""
+        journal_dir = str(journal_dir)
+        snapshot = JournalSnapshot(checkpoint=None)
+        if not os.path.isdir(journal_dir):
+            return snapshot
+        probe = cls.__new__(cls)
+        probe.dir = journal_dir
+        ckpts, wals = probe._segments()
+        if not ckpts and not wals:
+            return snapshot
+        top = max(list(ckpts) + list(wals))
+        # Newest seq with a readable checkpoint (or 0 = cold start).
+        base_seq = 0
+        header = None
+        for seq in sorted(ckpts, reverse=True):
+            header = cls._read_checkpoint_header(ckpts[seq])
+            if header is not None:
+                base_seq = seq
+                break
+            header = None
+        if header is not None:
+            snapshot.checkpoint = decode(header["state"])
+            snapshot.seq = base_seq
+            snapshot.last_lsn = int(header.get("lsn", -1))
+            snapshot.last_epoch = int(header.get("epoch", 0))
+        entries: List[dict] = []
+        for seq in range(base_seq, top + 1):
+            for raw in cls._iter_wal(wals.get(seq)):
+                lsn = int(raw.get("lsn", -1))
+                if lsn <= snapshot.last_lsn:
+                    continue  # pre-checkpoint history already compacted
+                entries.append(
+                    {
+                        "lsn": lsn,
+                        "epoch": int(raw.get("epoch", 0)),
+                        "kind": raw.get("kind"),
+                        "payload": decode(raw.get("payload")),
+                    }
+                )
+        entries.sort(key=lambda e: e["lsn"])
+        # LSNs are minted under one writer lock per epoch and fencing
+        # serializes epochs, so a duplicate here is journal damage.
+        for prev, cur in zip(entries, entries[1:]):
+            if cur["lsn"] == prev["lsn"]:
+                raise ValueError(
+                    f"{journal_dir}: duplicate WAL LSN {cur['lsn']}"
+                )
+        snapshot.entries = entries
+        if entries:
+            snapshot.last_lsn = entries[-1]["lsn"]
+            snapshot.last_epoch = max(
+                snapshot.last_epoch, max(e["epoch"] for e in entries)
+            )
+        snapshot.seq = max(snapshot.seq, top)
+        return snapshot
+
+    @classmethod
+    def summarize(cls, journal_dir: str) -> dict:
+        """Cheap structural summary (entry kinds, seq span, LSN span)
+        for smoke gates and triage."""
+        snapshot = cls.replay(journal_dir)
+        kinds: dict = {}
+        for entry in snapshot.entries:
+            kinds[entry["kind"]] = kinds.get(entry["kind"], 0) + 1
+        return {
+            "has_checkpoint": snapshot.checkpoint is not None,
+            "seq": snapshot.seq,
+            "last_lsn": snapshot.last_lsn,
+            "last_epoch": snapshot.last_epoch,
+            "tail_entries": len(snapshot.entries),
+            "tail_kinds": kinds,
+        }

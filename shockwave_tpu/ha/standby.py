@@ -1,0 +1,203 @@
+"""The HA node driver: leader, hot standby, or cold restart.
+
+One entry point runs every role. A node acquires the leader lease
+(standbys block on it — the incumbent's death or clean release is
+their cue), replays the control-plane journal (checkpoint + WAL tail;
+empty on a fresh campaign), publishes the front-door map (scheduler
+address + per-shard admission sockets under its freshly minted fenced
+epoch), waits for surviving workers to re-attach, and serves rounds
+until the campaign completes — or until IT is killed and the next
+node repeats the dance.
+
+CLI (the ha_smoke gate and the SIGKILL failover tests drive this as a
+subprocess)::
+
+    python -m shockwave_tpu.ha.standby --ha_dir /tmp/ha --node leader-0 \
+        --port 50200 --round_s 3 --expect_workers 2 \
+        --summary_out /tmp/ha/leader-0.json
+
+Jobs arrive through the streaming admission front door (SubmitJobs),
+never argv — a failover must find them in the journal, not in a
+command line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description="shockwave_tpu HA scheduler node (leader or standby)"
+    )
+    parser.add_argument("--ha_dir", required=True,
+                        help="shared HA directory (lease + journal)")
+    parser.add_argument("--node", required=True,
+                        help="this node's holder id (unique per process)")
+    parser.add_argument("--port", type=int, required=True,
+                        help="scheduler gRPC port for THIS node")
+    parser.add_argument("--policy", default="fifo")
+    parser.add_argument("--round_s", type=float, default=3.0)
+    parser.add_argument("--completion_buffer_s", type=float, default=6.0)
+    parser.add_argument("--heartbeat_timeout_s", type=float, default=4.0)
+    parser.add_argument("--lease_ttl_s", type=float, default=3.0)
+    parser.add_argument("--expect_workers", type=int, default=0,
+                        help="fresh-leader registration wait (0 = skip)")
+    parser.add_argument("--reattach_timeout_s", type=float, default=20.0)
+    parser.add_argument("--max_rounds", type=int, default=None)
+    parser.add_argument("--checkpoint_rounds", type=int, default=1)
+    parser.add_argument("--acquire_timeout_s", type=float, default=None,
+                        help="give up standing by after this long")
+    parser.add_argument("--summary_out", default=None)
+    parser.add_argument("--decision_log", default=None)
+    return parser
+
+
+def run_node(args) -> int:
+    from shockwave_tpu import obs
+    from shockwave_tpu.core.physical import PhysicalScheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.ha.election import LeaderElection, LeaseStore
+    from shockwave_tpu.ha.frontdoor import AdmissionFrontDoor
+    from shockwave_tpu.ha.journal import ControlPlaneJournal
+    from shockwave_tpu.policies import get_policy
+
+    if args.decision_log:
+        obs.get_recorder().configure(args.decision_log)
+
+    store = LeaseStore(args.ha_dir, ttl_s=args.lease_ttl_s)
+    election = LeaderElection(store, holder=args.node)
+    # Standby: this blocks until the incumbent dies (lease TTL) or
+    # releases; the CAS mints the next fenced epoch. The lease is
+    # taken WITHOUT an address: workers must not learn of this node
+    # until the journal restore has finished (publish() below flips
+    # the map atomically once the registry is the restored one).
+    lease = election.acquire(
+        block=True,
+        poll_s=min(0.25, args.lease_ttl_s / 4.0),
+        timeout_s=args.acquire_timeout_s,
+    )
+    if lease is None:
+        print(json.dumps({"node": args.node, "outcome": "never_leader"}))
+        return 3
+    # Renew from the moment the term starts: the journal replay below
+    # can outlast the lease TTL on a big checkpoint, and an unrenewed
+    # lease would let a second standby start ITS restore concurrently
+    # (two writers on one journal). The scheduler's constructor later
+    # swaps in its fencing on_lost callback.
+    election.start_renewal()
+
+    journal_dir = os.path.join(args.ha_dir, "journal")
+    snapshot = ControlPlaneJournal.replay(journal_dir)
+    journal = ControlPlaneJournal(journal_dir)
+    took_over = snapshot.checkpoint is not None or bool(snapshot.entries)
+
+    sched = PhysicalScheduler(
+        get_policy(args.policy),
+        port=args.port,
+        throughputs=generate_oracle(),
+        time_per_iteration=args.round_s,
+        completion_buffer_seconds=args.completion_buffer_s,
+        heartbeat_timeout_s=args.heartbeat_timeout_s,
+        minimum_time_between_allocation_resets=0.0,
+        ha_journal=journal,
+        ha_election=election,
+        ha_checkpoint_rounds=args.checkpoint_rounds,
+        # Registrations bounce until the restore installs the journaled
+        # registry (cold restarts on the dead leader's port would
+        # otherwise race the restore window).
+        ha_restore_pending=took_over,
+    )
+    restored = {}
+    if took_over:
+        restored = sched.restore_from_journal(snapshot)
+
+    if not election.is_leader():
+        # Deposed during the restore (renewal lost the lease while we
+        # replayed): serving now would be a split-brain writer. Flag
+        # BEFORE shutdown so it leaves the fleet to the real leader.
+        sched._ha_deposed = True
+        sched.shutdown()
+        print(json.dumps({"node": args.node, "outcome": "deposed"}))
+        return 4
+
+    # Real sockets for the admission shard slices, published in the
+    # lease so the map follows this epoch — only NOW do workers learn
+    # this node's address.
+    frontdoor = AdmissionFrontDoor(sched)
+    election.publish(
+        sched_addr="127.0.0.1",
+        sched_port=args.port,
+        admission_ports=frontdoor.ports,
+    )
+
+    sched.expect_stream()
+    lost_workers = []
+    if took_over:
+        lost_workers = sched.wait_for_reattach(
+            timeout=args.reattach_timeout_s
+        )
+    elif args.expect_workers > 0:
+        sched.wait_for_workers(args.expect_workers)
+
+    outcome = "completed"
+    try:
+        sched.run(max_rounds=args.max_rounds)
+    except BaseException:
+        # The summary below still gets written (finally), but it must
+        # say what actually happened — a crashed successor advertising
+        # "completed" would pass the very gates this driver exists to
+        # serve.
+        outcome = "crashed"
+        raise
+    finally:
+        if sched._ha_deposed:
+            outcome = "deposed"
+        frontdoor.stop()
+        summary = {
+            "node": args.node,
+            "outcome": outcome,
+            "epoch": sched._ha_epoch,
+            "took_over": took_over,
+            "restored_tail": restored,
+            "lost_workers": lost_workers,
+            "round_id": sched._round_id,
+            "makespan_s": sched.get_current_timestamp(),
+            "completed_jobs": sorted(
+                jid.integer
+                for jid, t in sched._job_completion_times.items()
+                if t is not None
+            ),
+            "completion_times": {
+                str(jid.integer): t
+                for jid, t in sched._job_completion_times.items()
+            },
+            "total_steps_run": {
+                str(jid.integer): int(steps)
+                for jid, steps in sched._total_steps_run.items()
+            },
+            "admission": sched._admission.summary(),
+            "journal": ControlPlaneJournal.summarize(journal_dir),
+        }
+        if args.summary_out:
+            from shockwave_tpu.utils.fileio import atomic_write_json
+
+            atomic_write_json(args.summary_out, summary)
+        if args.decision_log:
+            obs.get_recorder().close()
+        print(json.dumps({k: summary[k] for k in (
+            "node", "outcome", "epoch", "took_over", "round_id",
+        )}))
+    return 0
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return run_node(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

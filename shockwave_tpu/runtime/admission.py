@@ -189,6 +189,25 @@ class _TenantLedger:
             if self._pending[tenant] <= 0:
                 del self._pending[tenant]
 
+    def force_add(self, counts: Dict[str, int]) -> None:
+        """Re-apply pending tallies during an HA journal restore —
+        quota checks don't re-run (the batch was already admitted by
+        the previous leader; re-judging it could strand journaled
+        jobs)."""
+        with self._lock:
+            for tenant, count in counts.items():
+                self._pending[tenant] = self._pending.get(tenant, 0) + count
+
+    def state_dict(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._pending)
+
+    def restore_state(self, state: Dict[str, int]) -> None:
+        with self._lock:
+            self._pending = {
+                str(t): int(c) for t, c in (state or {}).items()
+            }
+
 
 class AdmissionQueue:
     """Bounded, token-deduplicated buffer between submitters and the
@@ -600,6 +619,114 @@ class AdmissionQueue:
         with self._lock:
             return max(0, self.capacity - len(self._pending))
 
+    # -- HA survivability (shockwave_tpu/ha/) ---------------------------
+    def state_dict(self, include_tenants: bool = True) -> dict:
+        """Snapshot for the control-plane journal: the token ledger
+        (exactly-once survives failover), the pending backlog, and the
+        stream open/close state. ``include_tenants=False`` for shards
+        of a sharded front door (the SHARED ledger is captured once by
+        the wrapper)."""
+        from shockwave_tpu.ha import codec as ha_codec
+
+        with self._lock:
+            state = {
+                "pending": [
+                    (token, ha_codec.job_state(job), enqueued, seq)
+                    for token, job, enqueued, seq in self._pending
+                ],
+                "seq": self._seq,
+                "token_jobs": OrderedDict(self._token_jobs),
+                "closed": self._closed,
+                "opened": self._opened,
+                "stats": dict(self.stats),
+            }
+        if include_tenants:
+            state["tenant_pending"] = self._tenants.state_dict()
+        return state
+
+    def restore_state(self, state: dict) -> None:
+        """Install a decoded :meth:`state_dict` snapshot (freshly
+        constructed queue with the same capacity/policy config)."""
+        from shockwave_tpu.ha import codec as ha_codec
+
+        with self._lock:
+            self._pending = deque(
+                (
+                    str(token),
+                    ha_codec.job_from_state(job_fields),
+                    float(enqueued),
+                    int(seq),
+                )
+                for token, job_fields, enqueued, seq in (
+                    state.get("pending") or []
+                )
+            )
+            self._seq = int(state.get("seq", 0))
+            self._token_jobs = OrderedDict(
+                (str(t), int(n))
+                for t, n in (state.get("token_jobs") or {}).items()
+            )
+            self._closed = bool(state.get("closed"))
+            self._opened = bool(state.get("opened"))
+            for key, value in (state.get("stats") or {}).items():
+                if key in self.stats:
+                    self.stats[key] = value
+            self._set_depth_gauge_locked()
+        if "tenant_pending" in state:
+            self._tenants.restore_state(state["tenant_pending"])
+
+    def restore_submission(
+        self, token: str, jobs: Sequence[Job], close: bool = False
+    ) -> int:
+        """WAL-tail replay of one ACCEPTED batch: force the token into
+        the ledger and its jobs into the backlog, bypassing quota and
+        backpressure (the previous leader already admitted it — this
+        queue must converge to that decision, not re-judge it).
+        Idempotent on the token. Returns the jobs queued."""
+        token = str(token)
+        with self._lock:
+            self._opened = True
+            if token and token in self._token_jobs:
+                if close:
+                    self._close_locked(token)
+                return 0  # checkpoint (or a duplicate entry) had it
+            now = self._clock()
+            for job in jobs:
+                self._pending.append((token, job, now, self._seq))
+                self._seq += 1
+            if token:
+                self._token_jobs[token] = len(jobs)
+            counts = _TenantLedger.batch_counts(jobs)
+            self._set_depth_gauge_locked()
+            if close:
+                self._close_locked(token)
+        if counts:
+            self._tenants.force_add(counts)
+        return len(jobs)
+
+    def discard_pending(self, token: str, count: int = 1) -> int:
+        """WAL-tail replay of an admission: the previous leader drained
+        ``count`` of this token's jobs into its scheduler (replayed
+        separately through add_job), so they must leave the restored
+        backlog or the successor's drain would admit them twice.
+        Returns the entries removed."""
+        token = str(token)
+        removed = 0
+        with self._lock:
+            kept = deque()
+            while self._pending:
+                entry = self._pending.popleft()
+                if removed < count and entry[0] == token:
+                    removed += 1
+                    tenant = str(getattr(entry[1], "tenant", "") or "")
+                    if tenant:
+                        self._tenants.dec(tenant)
+                    continue
+                kept.append(entry)
+            self._pending = kept
+            self._set_depth_gauge_locked()
+        return removed
+
     def depth(self) -> int:
         with self._lock:
             return len(self._pending)
@@ -857,6 +984,56 @@ class ShardedAdmissionQueue:
                 out.extend(shard.drain(max_jobs=take, now=now))
         self._set_depth_gauge()
         return out
+
+    # -- HA survivability (shockwave_tpu/ha/) ---------------------------
+    def state_dict(self) -> dict:
+        """Per-shard snapshots plus ONE copy of the shared tenant
+        ledger (capturing it per shard would restore N× the tallies)."""
+        return {
+            "shards": [
+                shard.state_dict(include_tenants=False)
+                for shard in self.shards
+            ],
+            "tenant_pending": self.shards[0]._tenants.state_dict(),
+        }
+
+    def restore_state(self, state: dict) -> None:
+        shard_states = state.get("shards") or []
+        if len(shard_states) != self.num_shards:
+            raise ValueError(
+                f"admission snapshot has {len(shard_states)} shards but "
+                f"this front door is configured with {self.num_shards} — "
+                "the successor must run the same cell/shard config"
+            )
+        for shard, shard_state in zip(self.shards, shard_states):
+            shard.restore_state(shard_state)
+        self.shards[0]._tenants.restore_state(
+            state.get("tenant_pending") or {}
+        )
+        self._set_depth_gauge()
+
+    def restore_submission(
+        self, token: str, jobs: Sequence[Job], close: bool = False
+    ) -> int:
+        queued = self._shard_of(token).restore_submission(
+            token, jobs, close=close
+        )
+        if close:
+            self.close(token)
+        self._set_depth_gauge()
+        return queued
+
+    def discard_pending(self, token: str, count: int = 1) -> int:
+        # Route like submit; rebalancing may have moved the entries to
+        # a sibling, so sweep the rest when the routing shard comes up
+        # short.
+        removed = self._shard_of(token).discard_pending(token, count)
+        for shard in self.shards:
+            if removed >= count:
+                break
+            removed += shard.discard_pending(token, count - removed)
+        self._set_depth_gauge()
+        return removed
 
     def depth(self) -> int:
         return sum(q.depth() for q in self.shards)

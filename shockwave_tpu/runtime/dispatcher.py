@@ -42,6 +42,7 @@ class Dispatcher:
         run_dir: str,
         checkpoint_dir: str,
         use_numactl: bool = False,
+        outage=None,
     ):
         self._round_duration = round_duration
         self._worker_rpc_client = worker_rpc_client
@@ -50,6 +51,13 @@ class Dispatcher:
         self._run_dir = run_dir
         self._checkpoint_dir = checkpoint_dir
         self._use_numactl = use_numactl and shutil.which("numactl") is not None
+        # Scheduler-outage tracker (runtime.retry.SchedulerOutage, HA
+        # runs): while the scheduler is declared unreachable, Done
+        # reports are BUFFERED instead of each burning its full
+        # retry/backoff budget against a dead address; the worker
+        # agent flushes the buffer to the successor after re-attach.
+        # None (legacy single-scheduler runs) keeps the old behavior.
+        self._outage = outage
 
         self._accelerator_queue: "queue.Queue[int]" = queue.Queue()
         for accel_id in accelerator_ids:
@@ -62,6 +70,10 @@ class Dispatcher:
         # several ranks on one multi-accelerator host.
         self._procs: Dict[tuple, subprocess.Popen] = {}
         self._kill_requested: set = set()
+        # Done reports awaiting a reachable scheduler, in completion
+        # order: (worker_id, job_ids, steps, durations, logs, contexts).
+        self._buffered_dones: "OrderedDict[int, tuple]" = OrderedDict()
+        self._buffered_seq = 0
         # RunJob idempotency: the scheduler's client retries with
         # backoff, so a dispatch whose response was lost can arrive
         # twice — launching the same micro-task twice would double its
@@ -169,6 +181,14 @@ class Dispatcher:
                 contexts.append(ctx_wire)
         finally:
             self._accelerator_queue.put(accel_id)
+        report = (worker_id, job_ids, steps, durations, logs, contexts)
+        if self._outage is not None and self._outage.in_outage():
+            # Scheduler declared unreachable: buffering immediately is
+            # the point — the per-call retry budget must not be burned
+            # against a dead address, and the report must survive to
+            # reach the successor (see runtime/retry.SchedulerOutage).
+            self._buffer_done(report)
+            return
         try:
             # The client retries with jittered backoff and per-call
             # deadlines (runtime/retry.py), so a transient scheduler
@@ -179,18 +199,122 @@ class Dispatcher:
                 trace_contexts=contexts,
             )
         except Exception:
-            # Every retry exhausted: either the scheduler is gone for
-            # good (shutdown) or this result is genuinely lost — the
+            # Every retry exhausted: the scheduler may be gone for good
+            # (shutdown) or mid-failover. With outage tracking armed
+            # the report is buffered for the successor; without it the
             # scheduler's straggler-kill path will reconcile the
-            # outstanding micro-task, but the loss must be loud.
+            # outstanding micro-task — either way the event is loud.
             LOG.error(
                 "Done notification failed after retries (jobs %s)",
                 job_ids, exc_info=True,
             )
             obs.counter(
                 "worker_done_notify_giveups_total",
-                "Done reports dropped after exhausting every retry",
+                "Done reports that exhausted every retry",
             ).inc()
+            if self._outage is not None:
+                self._buffer_done(report)
+
+    def _buffer_done(self, report) -> None:
+        with self._lock:
+            self._buffered_dones[self._buffered_seq] = report
+            self._buffered_seq += 1
+            depth = len(self._buffered_dones)
+        obs.counter(
+            "worker_done_buffered_total",
+            "Done reports buffered while the scheduler was unreachable",
+        ).inc()
+        obs.gauge(
+            "worker_done_buffer_depth",
+            "Done reports awaiting a reachable scheduler",
+        ).set(float(depth))
+        LOG.warning(
+            "buffered Done report for jobs %s (scheduler unreachable; "
+            "%d buffered)", report[1], depth,
+        )
+
+    def flush_buffered_dones(self) -> int:
+        """Deliver every buffered Done report (oldest first) to the —
+        possibly new — scheduler behind the shared RPC client. Stops at
+        the first failure (the rest stay buffered for the next flush).
+        Returns the number delivered. The scheduler side deduplicates
+        on its outstanding-set gate, so a report that WAS delivered but
+        whose ack was lost is safe to resend."""
+        delivered = 0
+        while True:
+            with self._lock:
+                if not self._buffered_dones:
+                    break
+                seq, report = next(iter(self._buffered_dones.items()))
+            worker_id, job_ids, steps, durations, logs, contexts = report
+            try:
+                self._worker_rpc_client.notify_scheduler(
+                    worker_id, job_ids, steps, durations, logs,
+                    trace_contexts=contexts,
+                )
+            except Exception:
+                LOG.warning(
+                    "buffered Done flush stopped at jobs %s (scheduler "
+                    "still unreachable)", job_ids, exc_info=True,
+                )
+                break
+            with self._lock:
+                self._buffered_dones.pop(seq, None)
+                depth = len(self._buffered_dones)
+            delivered += 1
+            obs.gauge(
+                "worker_done_buffer_depth",
+                "Done reports awaiting a reachable scheduler",
+            ).set(float(depth))
+        return delivered
+
+    def discard_buffered_dones(self, reason: str) -> int:
+        """Drop every buffered Done report — the loud path for reports
+        that can no longer be credited (the agent re-registered under
+        FRESH worker ids, so the successor already fault-completed and
+        requeued the old ids' micro-tasks; replaying the stale reports
+        would only bounce off its dedup gate). Returns the count."""
+        with self._lock:
+            dropped = len(self._buffered_dones)
+            self._buffered_dones.clear()
+        if dropped:
+            obs.counter(
+                "worker_done_buffer_discarded_total",
+                "buffered Done reports dropped as uncreditable after "
+                "a fresh (non-reattach) re-registration",
+            ).inc(dropped)
+            obs.gauge(
+                "worker_done_buffer_depth",
+                "Done reports awaiting a reachable scheduler",
+            ).set(0.0)
+            LOG.warning(
+                "discarded %d buffered Done report(s): %s — the "
+                "successor requeued this work under our previous "
+                "identity; the steps will be re-run",
+                dropped, reason,
+            )
+        return dropped
+
+    def outstanding_job_ids(self) -> List[int]:
+        """Job ids this host still carries state for: live training
+        processes plus buffered Done reports — the re-attach payload a
+        successor reconciles its restored outstanding set against."""
+        with self._lock:
+            running = {jid for jid, _ in self._procs}
+            buffered = {
+                int(j)
+                for report in self._buffered_dones.values()
+                for j in report[1]
+            }
+        return sorted(running | buffered)
+
+    def retarget_scheduler(self, sched_addr: str, sched_port: int) -> None:
+        """Follow a failover: subsequently-launched training processes
+        get the new leader's address in their iterator env (the shared
+        RPC client was already retargeted by the worker agent)."""
+        with self._lock:
+            self._sched_addr = sched_addr
+            self._sched_port = int(sched_port)
 
     def _launch_job(self, job, accel_id, worker_id, round_id):
         """Run one training subprocess to completion; returns

@@ -39,7 +39,18 @@ from typing import Dict, List, Optional, Tuple
 
 from shockwave_tpu.analysis import sanitize
 
-CLUSTER_KINDS = ("worker_crash", "capacity_reclaim", "worker_add")
+# Scheduler (control-plane) faults: kill the brain itself. In
+# simulation both kinds round-trip the full scheduler state through
+# the HA journal codec (shockwave_tpu/ha/) and the run must continue
+# bit-identically; in physical mode ``scheduler_crash`` SIGKILLs the
+# leader process at its scheduled time (the hot standby takes over via
+# lease expiry) and ``scheduler_restart`` is the standby's cue in
+# cold-restart drills. They ride the cluster-event queue: applied at
+# round boundaries, seeded and deterministic like every other fault.
+SCHEDULER_KINDS = ("scheduler_crash", "scheduler_restart")
+CLUSTER_KINDS = (
+    "worker_crash", "capacity_reclaim", "worker_add",
+) + SCHEDULER_KINDS
 SOLVER_KINDS = ("solver_slowdown", "solver_timeout")
 RPC_KINDS = ("rpc_error", "rpc_delay", "rpc_drop")
 
@@ -155,11 +166,15 @@ def generate_churn_plan(
     solver_faults: int = 6,
     crash_fraction: float = 0.5,
     restore_rounds: float = 2.0,
+    scheduler_faults: int = 0,
 ) -> FaultPlan:
     """A spot/reclaim + churn scenario: paired (reclaim-or-crash, add)
     events spread over ``horizon_s`` plus a sprinkle of solver
-    slowdown/timeout rounds for the degradation ladder. Fully
-    deterministic from ``seed``; the capacity trajectory stays within
+    slowdown/timeout rounds for the degradation ladder, and —
+    with ``scheduler_faults`` > 0 — paired
+    (``scheduler_crash``, ``scheduler_restart``) events that kill the
+    brain itself (the HA failover drill). Fully deterministic from
+    ``seed``; the capacity trajectory stays within
     [min_capacity, num_workers]."""
     rng = random.Random(seed)
     if min_capacity is None:
@@ -170,6 +185,17 @@ def generate_churn_plan(
         event = FaultEvent(event_id=len(events), kind=kind, **kwargs)
         events.append(event)
         return event
+
+    # Scheduler kill drills first so their event ids are stable under
+    # target_events growth: each crash pairs with a restart half a
+    # round later (in sim both round-trip state at the same boundary;
+    # physically the standby's takeover IS the restart).
+    for i in range(max(int(scheduler_faults), 0)):
+        t = round(horizon_s * (i + 1) / (scheduler_faults + 1), 3)
+        add_event("scheduler_crash", at_s=t)
+        add_event(
+            "scheduler_restart", at_s=round(t + round_s * 0.5, 3)
+        )
 
     n_rounds = max(int(horizon_s / max(round_s, 1e-9)), 2)
     for i, r in enumerate(

@@ -132,7 +132,33 @@ class ShockwaveIterator:
             )
         )
         if not lease_expired and refresh_due:
-            self._update_lease()
+            try:
+                self._update_lease()
+            # Logged to the iterator's STRUCTURED log below (this
+            # process's only channel the dispatcher actually collects);
+            # deliberately non-fatal — see the comment.
+            # shockwave-lint: disable=swallowed-exception
+            except Exception:
+                # Scheduler unreachable — e.g. the control plane is mid
+                # HA failover (shockwave_tpu/ha/): keep training on the
+                # CURRENT lease instead of crashing the process. The
+                # micro-task still ends at its existing step/duration
+                # bound, the worker agent re-attaches to the successor,
+                # and a control-plane blip must not forfeit a round of
+                # training progress. Back the refresh triggers off so
+                # the retry is next lease-fraction, not next step.
+                self._write_log(
+                    "LEASE", "WARNING",
+                    "lease update failed (scheduler unreachable); "
+                    "keeping current lease",
+                )
+                self._steps_until_next_lease_update = max(
+                    self._steps + max(int(self._lease.max_steps * 0.1), 1),
+                    self._steps_until_next_lease_update,
+                )
+                self._next_duration_refresh = (
+                    self._duration + 0.25 * max(self._lease.max_duration, 1.0)
+                )
         if lease_expired:
             self._write_log("LEASE", "INFO", "Lease expired")
             if self._barrier_fn is None:

@@ -11,10 +11,15 @@ over the legacy wire:
     spans as children so the job's cross-process chain stays connected
     (:mod:`shockwave_tpu.obs.propagate`).
   * ``KillJobRequest.trace_context`` (2, string) — same, for kills.
+  * ``RunJobRequest.sched_epoch`` (4, int64) and
+    ``KillJobRequest.sched_epoch`` (3, int64) — the sending leader's
+    fencing epoch (shockwave_tpu/ha/): workers reject dispatch/kill
+    RPCs below the highest epoch they have witnessed, so a deposed
+    leader cannot double-dispatch. 0 = HA off.
 
-Both are optional: absent on the wire they parse to ``""`` (fresh root
-at the receiver), and empty they serialize to zero bytes (legacy byte
-identity).
+All are optional: absent on the wire they parse to ``""``/0 (fresh
+root / unfenced at the receiver), and empty/zero they serialize to
+zero bytes (legacy byte identity).
 """
 
 from __future__ import annotations
@@ -98,19 +103,22 @@ class JobDescription:
 
 
 class RunJobRequest:
-    """message RunJobRequest { job_descriptions, worker_id, round_id }"""
+    """message RunJobRequest { job_descriptions, worker_id, round_id,
+    sched_epoch }"""
 
     def __init__(
         self,
         job_descriptions: Optional[List[JobDescription]] = None,
         worker_id: int = 0,
         round_id: int = 0,
+        sched_epoch: int = 0,
     ):
         self.job_descriptions = (
             list(job_descriptions) if job_descriptions else []
         )
         self.worker_id = int(worker_id)
         self.round_id = int(round_id)
+        self.sched_epoch = int(sched_epoch)
 
     def SerializeToString(self) -> bytes:  # noqa: N802
         out = bytearray()
@@ -118,6 +126,7 @@ class RunJobRequest:
             put_msg(out, 1, description.SerializeToString())
         put_varint(out, 2, self.worker_id)
         put_varint(out, 3, self.round_id)
+        put_varint(out, 4, self.sched_epoch)
         return bytes(out)
 
     @classmethod
@@ -130,20 +139,26 @@ class RunJobRequest:
                 msg.worker_id = int(value)
             elif field == 3 and wire_type == 0:
                 msg.round_id = int(value)
+            elif field == 4 and wire_type == 0:
+                msg.sched_epoch = int(value)
         return msg
 
 
 class KillJobRequest:
-    """message KillJobRequest { job_id, trace_context }"""
+    """message KillJobRequest { job_id, trace_context, sched_epoch }"""
 
-    def __init__(self, job_id: int = 0, trace_context: str = ""):
+    def __init__(
+        self, job_id: int = 0, trace_context: str = "", sched_epoch: int = 0
+    ):
         self.job_id = int(job_id)
         self.trace_context = trace_context
+        self.sched_epoch = int(sched_epoch)
 
     def SerializeToString(self) -> bytes:  # noqa: N802
         out = bytearray()
         put_varint(out, 1, self.job_id)
         put_str(out, 2, self.trace_context)
+        put_varint(out, 3, self.sched_epoch)
         return bytes(out)
 
     @classmethod
@@ -154,4 +169,6 @@ class KillJobRequest:
                 msg.job_id = int(value)
             elif field == 2 and wire_type == 2:
                 msg.trace_context = value.decode("utf-8")
+            elif field == 3 and wire_type == 0:
+                msg.sched_epoch = int(value)
         return msg

@@ -30,6 +30,16 @@ old and new peers interoperate in both directions):
     with unknown fields skipped.
   * ``DoneRequest.trace_context`` (6, repeated string) — one causal
     context per reported job, parallel to ``job_id``.
+  * HA re-attach + fenced epochs (shockwave_tpu/ha/):
+    ``RegisterWorkerRequest.prev_worker_ids`` (6, repeated int64) and
+    ``outstanding_job_ids`` (7, repeated int64) let a worker that
+    survived a scheduler death re-register with its previous identity
+    and its in-flight micro-task state, so a restored successor
+    re-adopts it instead of minting fresh capacity;
+    ``RegisterWorkerResponse.sched_epoch`` (7, int64) and
+    ``reattached`` (8, bool) plus ``HeartbeatAck.sched_epoch`` (3,
+    int64) carry the leader's fencing epoch (0 = HA off, serializes to
+    zero bytes — legacy byte identity).
 """
 
 from __future__ import annotations
@@ -51,7 +61,7 @@ from shockwave_tpu.runtime.protobuf.wire import (
 
 class RegisterWorkerRequest:
     """message RegisterWorkerRequest { worker_type, num_accelerators,
-    ip_addr, port, client_send_s }"""
+    ip_addr, port, client_send_s, prev_worker_ids, outstanding_job_ids }"""
 
     def __init__(
         self,
@@ -60,12 +70,22 @@ class RegisterWorkerRequest:
         ip_addr: str = "",
         port: int = 0,
         client_send_s: float = 0.0,
+        prev_worker_ids: Optional[List[int]] = None,
+        outstanding_job_ids: Optional[List[int]] = None,
     ):
         self.worker_type = worker_type
         self.num_accelerators = int(num_accelerators)
         self.ip_addr = ip_addr
         self.port = int(port)
         self.client_send_s = float(client_send_s)
+        # HA re-attach: the ids this agent held under the previous
+        # leader, and the micro-task job ids it still carries (running
+        # processes + buffered Done reports) — empty on a fresh
+        # registration (zero bytes on the wire).
+        self.prev_worker_ids = [int(w) for w in (prev_worker_ids or [])]
+        self.outstanding_job_ids = [
+            int(j) for j in (outstanding_job_ids or [])
+        ]
 
     def SerializeToString(self) -> bytes:  # noqa: N802 (protobuf API)
         out = bytearray()
@@ -74,6 +94,8 @@ class RegisterWorkerRequest:
         put_str(out, 3, self.ip_addr)
         put_varint(out, 4, self.port)
         put_double(out, 5, self.client_send_s)
+        put_packed_varints(out, 6, self.prev_worker_ids)
+        put_packed_varints(out, 7, self.outstanding_job_ids)
         return bytes(out)
 
     @classmethod
@@ -90,12 +112,21 @@ class RegisterWorkerRequest:
                 msg.port = int(value)
             elif field == 5 and wire_type == 1:
                 msg.client_send_s = value
+            elif field == 6 and wire_type == 2:
+                msg.prev_worker_ids.extend(unpack_packed_varints(value))
+            elif field == 6 and wire_type == 0:
+                msg.prev_worker_ids.append(int(value))
+            elif field == 7 and wire_type == 2:
+                msg.outstanding_job_ids.extend(unpack_packed_varints(value))
+            elif field == 7 and wire_type == 0:
+                msg.outstanding_job_ids.append(int(value))
         return msg
 
 
 class RegisterWorkerResponse:
     """message RegisterWorkerResponse { success, worker_ids,
-    round_duration, error_message, sched_recv_s, sched_send_s }"""
+    round_duration, error_message, sched_recv_s, sched_send_s,
+    sched_epoch, reattached }"""
 
     def __init__(
         self,
@@ -105,6 +136,8 @@ class RegisterWorkerResponse:
         error_message: str = "",
         sched_recv_s: float = 0.0,
         sched_send_s: float = 0.0,
+        sched_epoch: int = 0,
+        reattached: bool = False,
     ):
         self.success = bool(success)
         self.worker_ids = [int(w) for w in (worker_ids or [])]
@@ -112,6 +145,11 @@ class RegisterWorkerResponse:
         self.error_message = error_message
         self.sched_recv_s = float(sched_recv_s)
         self.sched_send_s = float(sched_send_s)
+        # Fencing epoch of the answering leader (0 = HA off) and
+        # whether this registration re-adopted the agent's previous
+        # worker ids instead of minting fresh capacity.
+        self.sched_epoch = int(sched_epoch)
+        self.reattached = bool(reattached)
 
     def SerializeToString(self) -> bytes:  # noqa: N802
         out = bytearray()
@@ -121,6 +159,8 @@ class RegisterWorkerResponse:
         put_str(out, 4, self.error_message)
         put_double(out, 5, self.sched_recv_s)
         put_double(out, 6, self.sched_send_s)
+        put_varint(out, 7, self.sched_epoch)
+        put_varint(out, 8, int(self.reattached))
         return bytes(out)
 
     @classmethod
@@ -141,6 +181,10 @@ class RegisterWorkerResponse:
                 msg.sched_recv_s = value
             elif field == 6 and wire_type == 1:
                 msg.sched_send_s = value
+            elif field == 7 and wire_type == 0:
+                msg.sched_epoch = int(value)
+            elif field == 8 and wire_type == 0:
+                msg.reattached = bool(value)
         return msg
 
 
@@ -220,18 +264,26 @@ class Heartbeat:
 
 
 class HeartbeatAck:
-    """message HeartbeatAck { sched_recv_s, sched_send_s } — the
-    scheduler's side of the NTP exchange. Wire-compatible with Empty in
-    both directions (all fields optional)."""
+    """message HeartbeatAck { sched_recv_s, sched_send_s, sched_epoch }
+    — the scheduler's side of the NTP exchange, plus its fencing epoch
+    (0 = HA off). Wire-compatible with Empty in both directions (all
+    fields optional)."""
 
-    def __init__(self, sched_recv_s: float = 0.0, sched_send_s: float = 0.0):
+    def __init__(
+        self,
+        sched_recv_s: float = 0.0,
+        sched_send_s: float = 0.0,
+        sched_epoch: int = 0,
+    ):
         self.sched_recv_s = float(sched_recv_s)
         self.sched_send_s = float(sched_send_s)
+        self.sched_epoch = int(sched_epoch)
 
     def SerializeToString(self) -> bytes:  # noqa: N802
         out = bytearray()
         put_double(out, 1, self.sched_recv_s)
         put_double(out, 2, self.sched_send_s)
+        put_varint(out, 3, self.sched_epoch)
         return bytes(out)
 
     @classmethod
@@ -242,6 +294,8 @@ class HeartbeatAck:
                 msg.sched_recv_s = value
             elif field == 2 and wire_type == 1:
                 msg.sched_send_s = value
+            elif field == 3 and wire_type == 0:
+                msg.sched_epoch = int(value)
         return msg
 
 

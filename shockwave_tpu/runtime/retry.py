@@ -44,6 +44,97 @@ _ENV_DEFAULTS = {
 _JITTER_RNG = random.Random()
 
 
+class PermanentRpcError(RuntimeError):
+    """A definitive rejection retrying can never fix — the fenced-epoch
+    refusal (this sender's epoch is superseded; every future attempt is
+    rejected identically) being the canonical case. call_with_retry
+    re-raises it immediately without consuming the retry budget."""
+
+
+class SchedulerOutage:
+    """Worker-side scheduler-unreachability tracker.
+
+    The per-call retry budget answers "did THIS call fail"; this class
+    answers the different question "is the SCHEDULER gone" — consecutive
+    heartbeat-ack failures past a threshold flip the worker into outage
+    mode, in which the dispatcher buffers Done notifications instead of
+    burning each report's full retry/backoff budget against a dead
+    address, and the agent starts hunting the front-door map for a
+    successor. Outage wall time is loud:
+    ``worker_scheduler_outage_seconds`` is the counter an operator's
+    dashboard alarms on.
+    """
+
+    def __init__(self, threshold: Optional[int] = None):
+        if threshold is None:
+            threshold = int(os.environ.get("SHOCKWAVE_OUTAGE_BEATS", "3"))
+        self.threshold = max(1, int(threshold))
+        # One leaf lock; nothing is called while held except the obs
+        # registry (an established leaf).
+        from shockwave_tpu.analysis import sanitize
+
+        self._lock = sanitize.make_lock(
+            "runtime.retry.SchedulerOutage._lock"
+        )
+        self._consecutive_failures = 0
+        self._outage_started_monotonic: Optional[float] = None
+        self._accounted_s = 0.0
+
+    def record_failure(self) -> bool:
+        """One failed heartbeat/ack exchange; returns True when this
+        crossed (or is past) the outage threshold."""
+        with self._lock:
+            self._consecutive_failures += 1
+            if (
+                self._consecutive_failures >= self.threshold
+                and self._outage_started_monotonic is None
+            ):
+                self._outage_started_monotonic = time.monotonic()
+                obs.counter(
+                    "worker_scheduler_outages_total",
+                    "times the scheduler was declared unreachable "
+                    "(consecutive heartbeat failures past threshold)",
+                ).inc()
+            self._account_locked()
+            return self._outage_started_monotonic is not None
+
+    def record_success(self) -> None:
+        """Contact restored (a heartbeat ack or a successful
+        re-register): close the outage window."""
+        with self._lock:
+            self._account_locked()
+            self._consecutive_failures = 0
+            self._outage_started_monotonic = None
+
+    def in_outage(self) -> bool:
+        with self._lock:
+            self._account_locked()
+            return self._outage_started_monotonic is not None
+
+    def outage_seconds(self) -> float:
+        """Total wall seconds spent in outage so far (accounted
+        incrementally into ``worker_scheduler_outage_seconds``)."""
+        with self._lock:
+            self._account_locked()
+            return self._accounted_s
+
+    def _account_locked(self) -> None:
+        """Caller holds the lock. Fold elapsed outage time into the
+        loud counter exactly once per elapsed second."""
+        if self._outage_started_monotonic is None:
+            return
+        now = time.monotonic()
+        elapsed = now - self._outage_started_monotonic
+        if elapsed > 0:
+            obs.counter(
+                "worker_scheduler_outage_seconds",
+                "wall seconds this worker spent with the scheduler "
+                "unreachable (Done reports buffered, not retried)",
+            ).inc(elapsed)
+            self._accounted_s += elapsed
+            self._outage_started_monotonic = now
+
+
 @dataclass(frozen=True)
 class RetryPolicy:
     attempts: int = 4
@@ -105,6 +196,11 @@ def call_with_retry(
             timeout = min(timeout, max(remaining, 1e-3))
         try:
             return attempt(timeout)
+        except PermanentRpcError:
+            # A fenced/definitive rejection: retrying re-asks a question
+            # whose answer cannot change. No giveup counter either —
+            # this is a verdict, not an exhausted budget.
+            raise
         except policy.retry_on as e:  # noqa: BLE001 - policy-defined
             last_error = e
             if i >= policy.attempts - 1:

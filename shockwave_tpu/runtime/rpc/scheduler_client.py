@@ -18,7 +18,11 @@ import grpc
 
 from shockwave_tpu.runtime import faults
 from shockwave_tpu.runtime.protobuf import common_pb2, scheduler_to_worker_pb2 as s2w_pb2
-from shockwave_tpu.runtime.retry import RetryPolicy, call_with_retry
+from shockwave_tpu.runtime.retry import (
+    PermanentRpcError,
+    RetryPolicy,
+    call_with_retry,
+)
 from shockwave_tpu.runtime.rpc.wiring import make_stubs
 
 
@@ -42,8 +46,21 @@ class SchedulerRpcClient:
     def _call(self, method: str, send, policy: Optional[RetryPolicy] = None):
         def attempt(timeout):
             faults.check_rpc(method)
-            with grpc.insecure_channel(self._addr) as channel:
-                result = send(self._stubs(channel), timeout)
+            try:
+                with grpc.insecure_channel(self._addr) as channel:
+                    result = send(self._stubs(channel), timeout)
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if code == grpc.StatusCode.FAILED_PRECONDITION:
+                    # The worker's fenced-epoch gate: this sender's
+                    # epoch is superseded and every retry would be
+                    # rejected identically — surface the deposition
+                    # immediately instead of burning the budget.
+                    raise PermanentRpcError(
+                        f"RPC {method} fenced by worker: "
+                        f"{e.details() if hasattr(e, 'details') else e}"
+                    ) from e
+                raise
             faults.note_rpc_success(method)
             return result
 
@@ -51,7 +68,13 @@ class SchedulerRpcClient:
             attempt, policy or self._retry, method=method
         )
 
-    def run_job(self, job_descriptions, worker_id: int, round_id: int) -> None:
+    def run_job(
+        self,
+        job_descriptions,
+        worker_id: int,
+        round_id: int,
+        sched_epoch: int = 0,
+    ) -> None:
         descriptions = [
             s2w_pb2.JobDescription(
                 job_id=d["job_id"],
@@ -71,15 +94,19 @@ class SchedulerRpcClient:
             job_descriptions=descriptions,
             worker_id=worker_id,
             round_id=round_id,
+            sched_epoch=sched_epoch,
         )
         self._call(
             "RunJob",
             lambda stubs, timeout: stubs.RunJob(request, timeout=timeout),
         )
 
-    def kill_job(self, job_id: int, trace_context: str = "") -> None:
+    def kill_job(
+        self, job_id: int, trace_context: str = "", sched_epoch: int = 0
+    ) -> None:
         request = s2w_pb2.KillJobRequest(
-            job_id=job_id, trace_context=trace_context
+            job_id=job_id, trace_context=trace_context,
+            sched_epoch=sched_epoch,
         )
         self._call(
             "KillJob",

@@ -36,12 +36,31 @@ def _worker_to_scheduler_handlers(callbacks):
 
         recv_s = time.time()
         try:
-            worker_ids, round_duration = callbacks["register_worker"](
+            # The HA re-attach fields (prev_worker_ids /
+            # outstanding_job_ids) ride as keywords so legacy callback
+            # implementations — and fixtures — that don't know them
+            # keep working against a new server.
+            kwargs = {}
+            if request.prev_worker_ids or request.outstanding_job_ids:
+                kwargs = {
+                    "prev_worker_ids": list(request.prev_worker_ids),
+                    "outstanding_job_ids": list(
+                        request.outstanding_job_ids
+                    ),
+                }
+            result = callbacks["register_worker"](
                 request.worker_type,
                 request.num_accelerators,
                 request.ip_addr,
                 request.port,
+                **kwargs,
             )
+            # Callback contract: (worker_ids, round_duration) from
+            # legacy schedulers; HA schedulers append (sched_epoch,
+            # reattached).
+            worker_ids, round_duration = result[0], result[1]
+            sched_epoch = result[2] if len(result) > 2 else 0
+            reattached = bool(result[3]) if len(result) > 3 else False
             # The scheduler's receive/send wall clock rides back so the
             # agent can take its first NTP-style clock-offset sample
             # (obs/propagate + merge_traces rely on these; a legacy
@@ -52,6 +71,8 @@ def _worker_to_scheduler_handlers(callbacks):
                 round_duration=int(round_duration),
                 sched_recv_s=recv_s,
                 sched_send_s=time.time(),
+                sched_epoch=int(sched_epoch),
+                reattached=reattached,
             )
         except Exception as e:  # noqa: BLE001 - reported to the caller
             LOG.exception("RegisterWorker failed")
@@ -70,8 +91,11 @@ def _worker_to_scheduler_handlers(callbacks):
                 est_offset_s=request.est_offset_s,
                 est_rtt_s=request.est_rtt_s,
             )
+        epoch_cb = callbacks.get("sched_epoch")
         return w2s_pb2.HeartbeatAck(
-            sched_recv_s=recv_s, sched_send_s=time.time()
+            sched_recv_s=recv_s,
+            sched_send_s=time.time(),
+            sched_epoch=int(epoch_cb()) if epoch_cb is not None else 0,
         )
 
     def Done(request, context):

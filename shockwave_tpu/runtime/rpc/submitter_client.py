@@ -91,6 +91,15 @@ class SubmitterClient:
         self._seq += 1
         return token
 
+    def retarget(self, sched_ip_addr: str, sched_port: int) -> None:
+        """Follow a scheduler failover: point subsequent submits at the
+        new leader (resolve it from the HA front-door map with
+        :func:`shockwave_tpu.ha.frontdoor.resolve_submit_target`). The
+        token namespace is unchanged — a batch retried across the flip
+        re-sends the same token and the successor's restored ledger
+        deduplicates it."""
+        self._addr = f"{sched_ip_addr}:{sched_port}"
+
     def submit(
         self,
         jobs: Sequence,

@@ -47,6 +47,19 @@ class WorkerRpcClient:
         # backoff pile-up behind a dead scheduler helps nobody.
         self._heartbeat_retry = self._retry.single_shot()
 
+    @property
+    def addr(self) -> str:
+        return self._addr
+
+    def retarget(self, sched_ip_addr: str, sched_port: int) -> None:
+        """Point every subsequent call at a different scheduler — the
+        worker agent's failover move after the front-door map names a
+        new leader. Channels are per-call (stateless against scheduler
+        restarts), so this is just the address swap; in-flight calls
+        finish against the old address and fail into their retry
+        discipline."""
+        self._addr = f"{sched_ip_addr}:{sched_port}"
+
     def _stubs(self, channel):
         return make_stubs(channel, "WorkerToScheduler")
 
@@ -67,13 +80,24 @@ class WorkerRpcClient:
         )
 
     def register_worker(
-        self, worker_type: str, num_accelerators: int, ip_addr: str, port: int
+        self,
+        worker_type: str,
+        num_accelerators: int,
+        ip_addr: str,
+        port: int,
+        prev_worker_ids=None,
+        outstanding_job_ids=None,
     ):
         """Returns (worker_ids, round_duration, error_message,
-        clock_sample) — ``clock_sample`` is the registration leg's
-        NTP-style (offset_s, rtt_s) estimate of
+        clock_sample, sched_epoch, reattached) — ``clock_sample`` is
+        the registration leg's NTP-style (offset_s, rtt_s) estimate of
         ``scheduler_clock - worker_clock``, or ``None`` against a
-        legacy scheduler that echoes no timestamps."""
+        legacy scheduler that echoes no timestamps. ``prev_worker_ids``
+        / ``outstanding_job_ids`` are the HA re-attach payload (the ids
+        this agent held under the previous leader and the micro-task
+        job ids it still carries); ``sched_epoch`` is the answering
+        leader's fencing epoch (0 = HA off) and ``reattached`` whether
+        the previous identity was re-adopted."""
         import time
 
         t0 = time.time()
@@ -83,6 +107,8 @@ class WorkerRpcClient:
             ip_addr=ip_addr,
             port=port,
             client_send_s=t0,
+            prev_worker_ids=prev_worker_ids,
+            outstanding_job_ids=outstanding_job_ids,
         )
         response = self._call(
             "RegisterWorker",
@@ -92,7 +118,7 @@ class WorkerRpcClient:
         )
         t3 = time.time()
         if not response.success:
-            return None, None, response.error_message, None
+            return None, None, response.error_message, None, 0, False
         sample = _clock_sample(t0, response.sched_recv_s,
                                response.sched_send_s, t3)
         return (
@@ -100,6 +126,8 @@ class WorkerRpcClient:
             response.round_duration,
             None,
             sample,
+            int(response.sched_epoch),
+            bool(response.reattached),
         )
 
     def send_heartbeat(
@@ -111,8 +139,10 @@ class WorkerRpcClient:
     ):
         """One liveness ping; doubles as a clock-offset exchange.
         Reports the worker's current best (offset, rtt) estimate to the
-        scheduler and returns this ping's fresh (offset_s, rtt_s)
-        sample — ``None`` against a legacy scheduler."""
+        scheduler and returns ``(clock_sample, sched_epoch)``: this
+        ping's fresh (offset_s, rtt_s) sample (``None`` against a
+        legacy scheduler) and the acking scheduler's fencing epoch
+        (0 = HA off / legacy)."""
         import time
 
         t0 = time.time()
@@ -130,9 +160,10 @@ class WorkerRpcClient:
             ),
             policy=self._heartbeat_retry,
         )
-        return _clock_sample(
+        sample = _clock_sample(
             t0, response.sched_recv_s, response.sched_send_s, time.time()
         )
+        return sample, int(getattr(response, "sched_epoch", 0))
 
     def dump_metrics(self, trace_context: str = "") -> str:
         """Scrape the scheduler's metrics registry (Prometheus

@@ -7,6 +7,13 @@ dispatching scheduler span's ``trace_context`` so the dispatcher's
 launch/run spans join the job's cross-process causal chain
 (obs/propagate.py). DumpMetrics serves the agent's own metrics
 registry to the scheduler's fleet telemetry plane (obs/fleet.py).
+
+Epoch fencing (shockwave_tpu/ha/): when the optional ``fence_epoch``
+callback is wired, RunJob/KillJob requests carrying a non-zero
+``sched_epoch`` below the highest epoch this worker has witnessed are
+rejected with FAILED_PRECONDITION — a deposed leader's dispatches and
+kills bounce instead of double-running work the successor owns.
+Requests with epoch 0 (legacy / HA-off schedulers) pass unfenced.
 """
 
 from __future__ import annotations
@@ -20,7 +27,33 @@ from shockwave_tpu.runtime.rpc.wiring import add_servicer
 
 
 def _handlers(callbacks):
+    def _fence(request, context, method: str):
+        """Reject a fenced (stale-epoch) control RPC; returns True when
+        the request was aborted."""
+        gate = callbacks.get("fence_epoch")
+        epoch = getattr(request, "sched_epoch", 0)
+        if gate is None or not epoch:
+            return False
+        witnessed = gate(int(epoch))
+        if witnessed <= int(epoch):
+            return False
+        from shockwave_tpu import obs
+
+        obs.counter(
+            "worker_fenced_rpcs_total",
+            "dispatch/kill RPCs rejected for carrying a superseded "
+            "scheduler epoch",
+        ).inc(method=method)
+        context.abort(
+            grpc.StatusCode.FAILED_PRECONDITION,
+            f"fenced: {method} carries scheduler epoch {epoch} but this "
+            f"worker has witnessed epoch {witnessed}",
+        )
+        return True  # unreachable (abort raises); keeps the contract clear
+
     def RunJob(request, context):
+        if _fence(request, context, "RunJob"):
+            return common_pb2.Empty()
         jobs = [
             {
                 "job_id": d.job_id,
@@ -42,6 +75,8 @@ def _handlers(callbacks):
         from shockwave_tpu import obs
         from shockwave_tpu.obs import propagate
 
+        if _fence(request, context, "KillJob"):
+            return common_pb2.Empty()
         kill_ctx = propagate.from_wire(request.trace_context)
         if kill_ctx is not None:
             # The kill lands in the job's causal chain as a child of

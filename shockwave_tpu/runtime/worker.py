@@ -21,8 +21,35 @@ import shutil
 import signal
 import socket
 import threading
+from typing import Optional
 
 LOG = logging.getLogger("runtime.worker")
+
+
+class _EpochWitness:
+    """Highest scheduler (fencing) epoch this agent has seen on any
+    RPC, guarded for the gRPC handler threads and the heartbeat loop
+    that both touch it."""
+
+    def __init__(self):
+        from shockwave_tpu.analysis import sanitize
+
+        self._lock = sanitize.make_lock(
+            "runtime.worker._EpochWitness._lock"
+        )
+        self._max_epoch = 0
+
+    def witness(self, epoch) -> int:
+        """Fold one observed epoch in; returns the highest witnessed."""
+        with self._lock:
+            epoch = int(epoch or 0)
+            if epoch > self._max_epoch:
+                self._max_epoch = epoch
+            return self._max_epoch
+
+    def max_epoch(self) -> int:
+        with self._lock:
+            return self._max_epoch
 
 
 class Worker:
@@ -37,21 +64,33 @@ class Worker:
         checkpoint_dir: str,
         use_numactl: bool = False,
         heartbeat_interval_s: float = 1.0,
+        ha_dir: Optional[str] = None,
     ):
         from shockwave_tpu import obs
         from shockwave_tpu.obs import propagate
         from shockwave_tpu.obs.fleet import ClockEstimator
         from shockwave_tpu.runtime.dispatcher import Dispatcher
+        from shockwave_tpu.runtime.retry import SchedulerOutage
         from shockwave_tpu.runtime.rpc import worker_server
         from shockwave_tpu.runtime.rpc.worker_client import WorkerRpcClient
 
         self._worker_type = worker_type
+        self._num_accelerators = int(num_accelerators)
         self._port = port
         self._rpc_client = WorkerRpcClient(sched_addr, sched_port)
         self._clock_sync = ClockEstimator()
         # The agent's own causal context: heartbeats carry it so even
         # control-plane pings are attributable to this agent's chain.
         self._agent_ctx = propagate.new_root()
+        # Scheduler-outage state (HA): consecutive heartbeat failures
+        # flip the agent into outage mode — Done reports buffer, and
+        # this loop hunts the front-door map (the HA lease record under
+        # ``ha_dir`` / SHOCKWAVE_HA_DIR) for a successor to re-attach
+        # to. The highest scheduler epoch witnessed fences stale
+        # leaders' RPCs (see worker_server.fence_epoch).
+        self._outage = SchedulerOutage()
+        self._ha_dir = ha_dir or os.environ.get("SHOCKWAVE_HA_DIR") or None
+        self._epoch = _EpochWitness()
 
         # Clear stale checkpoints from a previous incarnation
         # (reference: worker.py:86-93).
@@ -69,11 +108,13 @@ class Worker:
                 "kill_job": self._kill_job_callback,
                 "reset": self._reset_callback,
                 "shutdown": self._shutdown_callback,
+                "fence_epoch": self._witness_epoch,
             },
         )
 
         ip_addr = socket.gethostbyname(socket.gethostname())
-        worker_ids, round_duration, error, clock_sample = (
+        self._ip_addr = ip_addr
+        worker_ids, round_duration, error, clock_sample, epoch, _ = (
             self._rpc_client.register_worker(
                 worker_type, num_accelerators, ip_addr, port
             )
@@ -83,6 +124,7 @@ class Worker:
         self._worker_ids = worker_ids
         self._round_duration = round_duration
         self._clock_sync.add(clock_sample)
+        self._witness_epoch(epoch)
         if obs.trace_enabled():
             obs.get_tracer().set_meta(
                 {
@@ -101,6 +143,7 @@ class Worker:
             run_dir,
             checkpoint_dir,
             use_numactl=use_numactl,
+            outage=self._outage,
         )
         self._shutdown_event = threading.Event()
         # Liveness heartbeats: the scheduler's lease-expiry detection
@@ -114,10 +157,17 @@ class Worker:
                 target=self._heartbeat_loop, daemon=True
             ).start()
         LOG.info(
-            "Worker registered: ids=%s round_duration=%s",
+            "Worker registered: ids=%s round_duration=%s epoch=%s",
             worker_ids,
             round_duration,
+            epoch,
         )
+
+    def _witness_epoch(self, epoch: int) -> int:
+        """Record a scheduler epoch seen on any RPC; returns the highest
+        witnessed so far (the worker_server fencing gate compares an
+        incoming request's epoch against this)."""
+        return self._epoch.witness(epoch)
 
     def _export_clock_meta(self) -> None:
         """Stamp the current best clock-offset estimate into the trace
@@ -141,10 +191,18 @@ class Worker:
         from shockwave_tpu.obs import propagate
 
         while not self._shutdown_event.wait(self._heartbeat_interval):
+            if self._outage.in_outage():
+                # Scheduler declared dead: hunt the front-door map for
+                # a successor and re-attach, carrying our previous
+                # identity and in-flight micro-task state. Until that
+                # succeeds, heartbeats below double as liveness probes
+                # of the old address (a cold restart comes back there).
+                self._try_reattach()
             best = self._clock_sync.best()
+            any_ok = False
             for worker_id in self._worker_ids:
                 try:
-                    sample = self._rpc_client.send_heartbeat(
+                    sample, epoch = self._rpc_client.send_heartbeat(
                         worker_id,
                         est_offset_s=best[0] if best else 0.0,
                         est_rtt_s=best[1] if best else 0.0,
@@ -153,12 +211,110 @@ class Worker:
                 except Exception:
                     # Single-shot by policy: the next tick is the retry,
                     # and the scheduler being briefly unreachable is not
-                    # this worker's emergency.
+                    # this worker's emergency — until the outage
+                    # tracker's threshold says it is.
                     LOG.debug("heartbeat failed", exc_info=True)
                     continue
+                any_ok = True
+                self._witness_epoch(epoch)
                 self._clock_sync.add(sample)
+            if any_ok:
+                self._outage.record_success()
+                # Contact (re)established: deliver any Done reports
+                # buffered while the scheduler was unreachable. The
+                # scheduler's outstanding-set gate dedups resends.
+                self._dispatcher.flush_buffered_dones()
+            elif self._worker_ids:
+                self._outage.record_failure()
             if obs.trace_enabled():
                 self._export_clock_meta()
+
+    def _try_reattach(self) -> bool:
+        """Outage recovery: resolve the current leader from the HA
+        front-door map (when armed) and re-register there with our
+        previous worker ids + outstanding micro-task state. Without an
+        HA dir the re-register goes to the original address — the
+        cold-restart case, where the successor binds the same port."""
+        from shockwave_tpu import obs
+
+        if self._ha_dir:
+            try:
+                from shockwave_tpu.ha.election import LeaseStore
+
+                lease = LeaseStore(self._ha_dir).leader()
+            except OSError:
+                lease = None
+            if lease is None:
+                return False  # no live leader yet; keep waiting
+            if (
+                lease.epoch
+                and lease.epoch < self._epoch.max_epoch()
+            ):
+                return False  # stale map read mid-flip
+            if not (lease.sched_addr and lease.sched_port):
+                # Leader elected but its front-door map not published
+                # yet (it is still replaying the journal; its
+                # registrations would bounce anyway). Next beat.
+                return False
+            self._rpc_client.retarget(
+                lease.sched_addr, lease.sched_port
+            )
+            self._dispatcher.retarget_scheduler(
+                lease.sched_addr, lease.sched_port
+            )
+        try:
+            worker_ids, round_duration, error, sample, epoch, reattached = (
+                self._rpc_client.register_worker(
+                    self._worker_type,
+                    self._num_accelerators,
+                    self._ip_addr,
+                    self._port,
+                    prev_worker_ids=list(self._worker_ids),
+                    outstanding_job_ids=(
+                        self._dispatcher.outstanding_job_ids()
+                    ),
+                )
+            )
+        except Exception:
+            LOG.debug("re-attach attempt failed", exc_info=True)
+            return False
+        if error:
+            LOG.warning("re-attach rejected: %s", error)
+            return False
+        self._worker_ids = worker_ids
+        self._witness_epoch(epoch)
+        if sample is not None:
+            self._clock_sync.add(sample)
+        self._outage.record_success()
+        obs.counter(
+            "worker_reattach_total",
+            "successful re-registrations to a successor scheduler "
+            "after an outage",
+        ).inc(kind="reattached" if reattached else "fresh")
+        LOG.warning(
+            "re-attached to scheduler %s (epoch %s, ids %s, %s)",
+            self._rpc_client.addr, epoch, worker_ids,
+            "previous identity re-adopted" if reattached
+            else "fresh registration",
+        )
+        if reattached:
+            delivered = self._dispatcher.flush_buffered_dones()
+            if delivered:
+                LOG.info(
+                    "flushed %d buffered Done report(s) to the "
+                    "successor", delivered,
+                )
+        else:
+            # Fresh ids: the successor retired our previous identity
+            # (outage outlasted its re-attach window) and already
+            # requeued those micro-tasks as fault completions — the
+            # buffered reports reference dead (key, worker) pairs its
+            # dedup gate would silently swallow. Drop them LOUDLY.
+            self._dispatcher.discard_buffered_dones(
+                "successor issued fresh worker ids "
+                f"{worker_ids} (previous identity retired)"
+            )
+        return True
 
     # -- RPC callbacks --------------------------------------------------
     def _run_job_callback(self, job_descriptions, worker_id, round_id):

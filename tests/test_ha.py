@@ -1,0 +1,732 @@
+"""Survivable control plane (shockwave_tpu/ha/): WAL journal,
+lease-based election with fenced epochs, control-plane state codec,
+journal replay into a successor, and worker-side outage handling.
+
+The live SIGKILL-the-leader failover is covered by
+``tests/test_runtime.py::test_leader_sigkill_hot_standby_failover``
+(slow tier) and the ``scripts/ci/ha_smoke.py`` gate; this module is
+the fast tier — everything in-process, no subprocess cluster.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from shockwave_tpu.core.ids import JobId
+from shockwave_tpu.core.job import Job
+from shockwave_tpu.ha import codec as ha_codec
+from shockwave_tpu.ha.election import (
+    LeaderElection,
+    LeaseLost,
+    LeaseStore,
+)
+from shockwave_tpu.ha.journal import ControlPlaneJournal
+
+
+# ----------------------------------------------------------------------
+# Journal
+# ----------------------------------------------------------------------
+def test_journal_checkpoint_and_tail_replay(tmp_path):
+    jdir = str(tmp_path / "journal")
+    journal = ControlPlaneJournal(jdir)
+    journal.append("submit", {"token": "t0", "n": 2}, epoch=1)
+    journal.append("admit", {"job_id": 0}, epoch=1)
+    journal.checkpoint({"fields": {"round": 3}}, epoch=1)
+    journal.append(
+        "done", {"job_ids": (JobId(0),), "steps": np.asarray([5, 7])},
+        epoch=1,
+    )
+    snap = ControlPlaneJournal.replay(jdir)
+    assert snap.checkpoint == {"fields": {"round": 3}}
+    assert [e["kind"] for e in snap.entries] == ["done"]
+    # The recorder codec rides underneath: JobId and numpy round-trip.
+    payload = snap.entries[0]["payload"]
+    assert payload["job_ids"] == (JobId(0),)
+    assert payload["steps"].tolist() == [5, 7]
+    assert snap.last_epoch == 1
+
+
+def test_journal_cold_start_replays_wal_without_checkpoint(tmp_path):
+    jdir = str(tmp_path / "journal")
+    journal = ControlPlaneJournal(jdir)
+    journal.append("submit", {"token": "t0"}, epoch=1)
+    journal.append("admit", {"job_id": 0}, epoch=1)
+    snap = ControlPlaneJournal.replay(jdir)
+    assert snap.checkpoint is None
+    assert [e["kind"] for e in snap.entries] == ["submit", "admit"]
+
+
+def test_journal_writer_reopen_continues_lsn(tmp_path):
+    jdir = str(tmp_path / "journal")
+    first = ControlPlaneJournal(jdir)
+    first.append("a", {}, epoch=1)
+    first.checkpoint({"x": 1}, epoch=1)
+    first.append("b", {}, epoch=1)
+    reopened = ControlPlaneJournal(jdir)
+    lsn = reopened.append("c", {}, epoch=2)
+    snap = ControlPlaneJournal.replay(jdir)
+    assert [e["kind"] for e in snap.entries] == ["b", "c"]
+    assert snap.entries[-1]["lsn"] == lsn
+    assert snap.last_epoch == 2
+
+
+def test_journal_truncated_final_line_is_skipped(tmp_path):
+    jdir = str(tmp_path / "journal")
+    journal = ControlPlaneJournal(jdir)
+    journal.append("a", {"ok": True}, epoch=1)
+    wal = os.path.join(jdir, "wal-00000000.jsonl")
+    with open(wal, "a") as f:
+        f.write('{"lsn": 99, "kind": "tr')  # crash-interrupted append
+    snap = ControlPlaneJournal.replay(jdir)
+    assert [e["kind"] for e in snap.entries] == ["a"]
+
+
+def test_journal_corrupt_middle_line_raises(tmp_path):
+    jdir = str(tmp_path / "journal")
+    journal = ControlPlaneJournal(jdir)
+    journal.append("a", {}, epoch=1)
+    wal = os.path.join(jdir, "wal-00000000.jsonl")
+    with open(wal, "a") as f:
+        f.write("garbage\n")
+        f.write(json.dumps({"lsn": 5, "kind": "b", "payload": {}}) + "\n")
+    with pytest.raises(ValueError, match="corrupt WAL record"):
+        ControlPlaneJournal.replay(jdir)
+
+
+def test_journal_gc_retains_configured_generations(tmp_path):
+    jdir = str(tmp_path / "journal")
+    journal = ControlPlaneJournal(jdir, retain=2)
+    for i in range(5):
+        journal.append("tick", {"i": i}, epoch=1)
+        journal.checkpoint({"i": i}, epoch=1)
+    names = sorted(os.listdir(jdir))
+    ckpts = [n for n in names if n.startswith("checkpoint-")]
+    assert len(ckpts) == 2, names
+    snap = ControlPlaneJournal.replay(jdir)
+    assert snap.checkpoint == {"i": 4}
+
+
+def test_journal_falls_back_a_generation_on_damaged_checkpoint(tmp_path):
+    jdir = str(tmp_path / "journal")
+    journal = ControlPlaneJournal(jdir, retain=3)
+    journal.append("early", {}, epoch=1)
+    journal.checkpoint({"gen": 1}, epoch=1)
+    journal.append("mid", {}, epoch=1)
+    journal.checkpoint({"gen": 2}, epoch=1)
+    journal.append("late", {}, epoch=1)
+    # Operator damage to the newest checkpoint: replay must fall back
+    # to gen 1 and re-apply BOTH wal tails after it.
+    with open(os.path.join(jdir, "checkpoint-00000002.json"), "w") as f:
+        f.write("not json")
+    snap = ControlPlaneJournal.replay(jdir)
+    assert snap.checkpoint == {"gen": 1}
+    assert [e["kind"] for e in snap.entries] == ["mid", "late"]
+
+
+# ----------------------------------------------------------------------
+# Election / fenced epochs
+# ----------------------------------------------------------------------
+def test_lease_epoch_is_monotonic_and_exclusive(tmp_path):
+    store = LeaseStore(str(tmp_path), ttl_s=0.3)
+    a = LeaderElection(store, "A")
+    b = LeaderElection(store, "B")
+    lease_a = a.acquire(sched_addr="127.0.0.1", sched_port=1, block=False)
+    assert lease_a.epoch == 1
+    assert a.is_leader()
+    # B cannot steal an unexpired lease.
+    assert b.acquire(block=False) is None
+    time.sleep(0.4)
+    lease_b = b.acquire(sched_addr="127.0.0.1", sched_port=2, block=False)
+    assert lease_b.epoch == 2
+    # The deposed holder's renew fails loudly — its epoch is dead.
+    with pytest.raises(LeaseLost):
+        store.renew(lease_a)
+    # Same-term re-acquire by the live holder does NOT mint an epoch.
+    again = b.acquire(sched_addr="127.0.0.1", sched_port=2, block=False)
+    assert again.epoch == 2
+
+
+def test_lease_release_hands_over_without_ttl_wait(tmp_path):
+    store = LeaseStore(str(tmp_path), ttl_s=30.0)
+    a = LeaderElection(store, "A")
+    b = LeaderElection(store, "B")
+    lease_a = a.acquire(block=False)
+    assert b.acquire(block=False) is None
+    store.release(lease_a)
+    lease_b = b.acquire(block=False)
+    assert lease_b is not None and lease_b.epoch == 2
+
+
+def test_lease_doubles_as_front_door_map(tmp_path):
+    from shockwave_tpu.ha.frontdoor import (
+        resolve_submit_target,
+        shard_port_for_token,
+    )
+
+    store = LeaseStore(str(tmp_path), ttl_s=30.0)
+    election = LeaderElection(store, "A")
+    election.acquire(sched_addr="127.0.0.1", sched_port=5000, block=False)
+    election.publish(
+        admission_ports={"s00": 6000, "s01": 6001, "s02": 6002}
+    )
+    target = resolve_submit_target(str(tmp_path), "some-token")
+    assert target is not None
+    addr, port, epoch = target
+    assert addr == "127.0.0.1" and epoch == 1
+    assert port in (6000, 6001, 6002)
+    # Client-side routing matches the sharded queue's crc32 routing.
+    import zlib
+
+    expected = [6000, 6001, 6002][
+        zlib.crc32(b"some-token") % 3
+    ]
+    assert port == expected
+    assert shard_port_for_token({}, "t") is None
+
+
+def test_renewal_thread_fences_on_newer_epoch(tmp_path):
+    store = LeaseStore(str(tmp_path), ttl_s=0.4)
+    a = LeaderElection(store, "A", renew_interval_s=0.1)
+    b = LeaderElection(store, "B")
+    a.acquire(block=False)
+    fenced = []
+    a.start_renewal(on_lost=lambda: fenced.append(True))
+    # Forcibly steal: expire A's record, let B take epoch 2.
+    time.sleep(0.5)
+    # Stop A's renewals briefly won't happen in 0.5s? It renews every
+    # 0.1s, so the lease never expires — steal via release instead.
+    store.release(a.lease or store.read())
+    assert b.acquire(block=False) is not None
+    deadline = time.time() + 3
+    while not fenced and time.time() < deadline:
+        time.sleep(0.05)
+    a.stop(release=False)
+    assert fenced, "deposed holder's on_lost never fired"
+
+
+# ----------------------------------------------------------------------
+# Codec
+# ----------------------------------------------------------------------
+def test_job_codec_roundtrips_declared_and_dynamic_fields():
+    job = Job(
+        job_type="ResNet-18 (batch size 32)", command="x 32",
+        total_steps=100, scale_factor=2, mode="gns", tenant="teamA",
+    )
+    job.arrival_time = 12.5  # dynamically attached by the submitter
+    restored = ha_codec.job_from_state(
+        ha_codec.json_roundtrip(ha_codec.job_state(job))
+    )
+    assert vars(restored) == vars(job)
+
+
+def test_state_fingerprint_is_stable_and_content_sensitive():
+    a = {"x": np.arange(4), "y": (JobId(1), 2)}
+    same = {"x": np.arange(4), "y": (JobId(1), 2)}
+    assert ha_codec.state_fingerprint(a) == ha_codec.state_fingerprint(
+        same
+    )
+    # Roundtripping through the on-disk form preserves the fingerprint
+    # (the save/restore/save comparison the smoke gate makes).
+    assert ha_codec.state_fingerprint(
+        ha_codec.json_roundtrip(a)
+    ) == ha_codec.state_fingerprint(a)
+    c = {"x": np.arange(4), "y": (JobId(2), 2)}
+    assert ha_codec.state_fingerprint(a) != ha_codec.state_fingerprint(c)
+
+
+# ----------------------------------------------------------------------
+# Scheduler state capture / restore
+# ----------------------------------------------------------------------
+def _fresh_physical(port=None, **kwargs):
+    from shockwave_tpu.core.physical import PhysicalScheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.policies import get_policy
+    from shockwave_tpu.utils.hostenv import free_port
+
+    return PhysicalScheduler(
+        get_policy("fifo"),
+        port=port or free_port(),
+        throughputs=generate_oracle(),
+        time_per_iteration=3.0,
+        **kwargs,
+    )
+
+
+def _make_job(steps=400, **kwargs):
+    return Job(
+        job_type="ResNet-18 (batch size 32)", command="x 32",
+        total_steps=steps, scale_factor=1, mode="static", **kwargs,
+    )
+
+
+def _spec_dict(job):
+    from shockwave_tpu.runtime.admission import job_to_spec_dict
+
+    return job_to_spec_dict(job)
+
+
+def test_physical_state_roundtrips_exactly_modulo_clock():
+    s1 = _fresh_physical()
+    try:
+        s1.register_worker("v100", num_gpus=1)
+        s1.register_worker("v100", num_gpus=1)
+        s1.expect_stream()
+        status, _, _, _ = s1.submit_batch(
+            "tokA", [_spec_dict(_make_job(500))], False
+        )
+        assert status == "ACCEPTED"
+        for _ in range(3):
+            s1.add_job(_make_job())
+        assignments = s1._schedule_jobs_on_workers()
+        for key, wids in assignments.items():
+            s1._dispatched_worker_ids[key] = tuple(wids)
+            for wid in wids:
+                s1._outstanding.add((key, wid))
+            for single in key.singletons():
+                s1._running_jobs.add(single)
+                s1._per_job_latest_timestamps[single] = (
+                    s1.get_current_timestamp()
+                )
+        state = ha_codec.json_roundtrip(s1.ha_state_dict())
+    finally:
+        s1.shutdown()
+    s2 = _fresh_physical()
+    try:
+        s2.restore_ha_state(state)
+        # Exact modulo the continuing clock (now / _current_timestamp)
+        # and the deliberate failover adjustments (in-flight tasks
+        # granted extended leases + fresh unresponsiveness clocks).
+        recaptured = s2.ha_state_dict()
+        for side in (state, recaptured):
+            side["physical"]["now"] = 0.0
+            side["fields"]["_current_timestamp"] = 0.0
+            side["physical"]["last_lease_contact"] = {}
+            side["physical"]["extended_leases"] = set()
+        assert ha_codec.state_fingerprint(
+            state
+        ) == ha_codec.state_fingerprint(recaptured)
+        # The restored front door still dedups the pre-crash token.
+        status, _, admitted, _ = s2.submit_batch(
+            "tokA", [_spec_dict(_make_job(500))], False
+        )
+        assert status == "ACCEPTED" and admitted == 1
+        assert s2._admission.summary()["deduped_batches"] == 1
+        # In-flight micro-tasks are treated as extended leases (no
+        # re-dispatch) with a fresh unresponsiveness clock.
+        for key, _wid in s2._outstanding:
+            assert key in s2._jobs_with_extended_lease
+    finally:
+        s2.shutdown()
+
+
+def test_restored_job_completion_cleans_priorities():
+    """Regression: a restored job that completes must leave every
+    scheduling structure (found live: _job_type_to_job_ids missing
+    from the snapshot made _remove_job raise mid-way, stranding the
+    job in _priorities and crashing the next scheduling pass)."""
+    s1 = _fresh_physical()
+    try:
+        s1.register_worker("v100", num_gpus=1)
+        s1.register_worker("v100", num_gpus=1)
+        jids = [s1.add_job(_make_job()) for _ in range(3)]
+        assignments = s1._schedule_jobs_on_workers()
+        for key, wids in assignments.items():
+            s1._dispatched_worker_ids[key] = tuple(wids)
+            for single in key.singletons():
+                s1._running_jobs.add(single)
+                s1._per_job_latest_timestamps[single] = (
+                    s1.get_current_timestamp()
+                )
+        state = ha_codec.json_roundtrip(s1.ha_state_dict())
+    finally:
+        s1.shutdown()
+    s2 = _fresh_physical()
+    try:
+        s2.restore_ha_state(state)
+        key = jids[0]
+        worker_id = state["physical"]["dispatched_worker_ids"][key][0]
+        s2._done_callback(key, worker_id, [400], [2.0])
+        assert key not in s2._jobs
+        for per_type in s2._priorities.values():
+            assert key not in per_type
+        # The next scheduling pass must not crash on stale entries.
+        s2._schedule_jobs_on_workers()
+    finally:
+        s2.shutdown()
+
+
+def test_journal_replay_restores_jobs_ledger_and_outstanding(tmp_path):
+    """End-to-end in-process failover: leader journals a checkpoint
+    plus a WAL tail (submit, admit, dispatch, done), 'dies' (is
+    abandoned), and a successor rebuilt from the journal alone carries
+    the jobs, token ledger, progress credit, and in-flight set."""
+    jdir = str(tmp_path / "journal")
+    s1 = _fresh_physical(ha_journal=ControlPlaneJournal(jdir))
+    try:
+        s1.register_worker("v100", num_gpus=1)
+        s1.register_worker("v100", num_gpus=1)
+        s1.expect_stream()
+        with s1._cv:
+            s1._ha_checkpoint()  # checkpoint BEFORE any job exists
+        status, _, _, _ = s1.submit_batch(
+            "tok0", [_spec_dict(_make_job(600))], False
+        )
+        assert status == "ACCEPTED"
+        with s1._cv:
+            admitted = s1._drain_admission_queue()
+        assert admitted == 1
+        key = JobId(0)
+        with s1._cv:
+            s1._ha_log(
+                "dispatch",
+                {"job_ids": [0], "worker_ids": [0], "round": 0},
+            )
+            s1._outstanding.add((key, 0))
+            s1._dispatched_worker_ids[key] = (0,)
+            s1._running_jobs.add(key)
+            s1._per_job_latest_timestamps[key] = (
+                s1.get_current_timestamp()
+            )
+            s1._ha_log(
+                "done",
+                {"job_ids": [0], "worker_id": 0,
+                 "steps": [250], "times": [1.5]},
+            )
+            s1._outstanding.discard((key, 0))
+            s1._done_callback(key, 0, [250], [1.5])
+        # Second submitted-but-not-yet-drained batch stays pending.
+        s1.submit_batch("tok1", [_spec_dict(_make_job(500))], False)
+    finally:
+        s1.shutdown()
+
+    snap = ControlPlaneJournal.replay(jdir)
+    assert snap.checkpoint is not None
+    kinds = [e["kind"] for e in snap.entries]
+    assert kinds == ["submit", "admit", "dispatch", "done", "submit"]
+    s2 = _fresh_physical(ha_journal=ControlPlaneJournal(jdir))
+    try:
+        s2.restore_from_journal(snap)
+        assert list(s2._jobs) == [JobId(0)]
+        assert s2._total_steps_run[JobId(0)] == 250
+        # tok0's job was drained pre-crash: not pending again.
+        assert s2._admission.depth() == 1  # only tok1's job
+        summary = s2._admission.summary()
+        assert summary["tokens"] == 2  # both tokens in the ledger
+        # The replay ended with a compacting checkpoint: a THIRD
+        # failover would replay from it with an empty tail (nothing
+        # from the consumed tail can double-apply).
+        snap2 = ControlPlaneJournal.replay(jdir)
+        assert snap2.checkpoint is not None
+        assert [e["kind"] for e in snap2.entries] == []
+        # Retransmits of BOTH tokens dedup against the restored ledger.
+        for token in ("tok0", "tok1"):
+            status, _, _, _ = s2.submit_batch(
+                token, [_spec_dict(_make_job(500))], False
+            )
+            assert status == "ACCEPTED"
+        assert s2._admission.summary()["deduped_batches"] == 2
+    finally:
+        s2.shutdown()
+
+
+def test_sim_scheduler_crash_restart_is_bit_identical():
+    """The simulator's seeded scheduler_crash/scheduler_restart events
+    round-trip the whole control plane through the journal codec
+    mid-run; the campaign must finish bit-identically to an
+    uninterrupted one (fifo here; the shockwave-planner variant runs
+    in the ha_smoke gate's sim drill)."""
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.policies import get_policy
+    from shockwave_tpu.runtime import faults
+
+    def run(plan):
+        faults.reset()
+        if plan is not None:
+            faults.configure(plan)
+        sched = Scheduler(
+            get_policy("max_min_fairness"),
+            throughputs=generate_oracle(),
+            time_per_iteration=60.0, seed=0,
+        )
+        jobs = [_make_job(2000 + 307 * i) for i in range(4)]
+        makespan = sched.simulate(
+            {"v100": 2}, arrival_times=[0.0, 10.0, 20.0, 30.0],
+            jobs=jobs,
+        )
+        result = (
+            makespan,
+            sched.get_average_jct(),
+            {str(k): v for k, v in sched._total_steps_run.items()},
+        )
+        faults.reset()
+        return result
+
+    base = run(None)
+    plan = faults.FaultPlan(seed=0, events=[
+        faults.FaultEvent(0, "scheduler_crash", at_s=90.0),
+        faults.FaultEvent(1, "scheduler_restart", at_s=130.0),
+    ])
+    drilled = run(plan)
+    assert base == drilled
+
+
+def test_generate_churn_plan_scheduler_faults_are_paired():
+    from shockwave_tpu.runtime import faults
+
+    plan = faults.generate_churn_plan(
+        seed=3, horizon_s=600.0, num_workers=8, target_events=40,
+        scheduler_faults=2,
+    )
+    crashes = [e for e in plan.events if e.kind == "scheduler_crash"]
+    restarts = [e for e in plan.events if e.kind == "scheduler_restart"]
+    assert len(crashes) == 2 and len(restarts) == 2
+    for crash, restart in zip(crashes, restarts):
+        assert restart.at_s > crash.at_s
+    # Round-trips through the committed-plan JSON format.
+    restored = faults.FaultPlan.from_json(plan.to_json())
+    assert [e.kind for e in restored.events] == [
+        e.kind for e in plan.events
+    ]
+    # Scheduler kinds ride the cluster-event queue (popped by time).
+    injector = faults.FaultInjector(plan)
+    due = injector.due_cluster_events(crashes[0].at_s)
+    assert any(e.kind == "scheduler_crash" for e in due)
+
+
+# ----------------------------------------------------------------------
+# Fenced epochs on the wire
+# ----------------------------------------------------------------------
+def test_new_wire_fields_roundtrip_and_stay_legacy_compatible():
+    from shockwave_tpu.runtime.protobuf import (
+        scheduler_to_worker_pb2 as s2w,
+        worker_to_scheduler_pb2 as w2s,
+    )
+
+    req = w2s.RegisterWorkerRequest(
+        worker_type="v100", num_accelerators=2, ip_addr="10.0.0.1",
+        port=50061, prev_worker_ids=[3, 4],
+        outstanding_job_ids=[7, 9],
+    )
+    parsed = w2s.RegisterWorkerRequest.FromString(req.SerializeToString())
+    assert parsed.prev_worker_ids == [3, 4]
+    assert parsed.outstanding_job_ids == [7, 9]
+    resp = w2s.RegisterWorkerResponse(
+        success=True, worker_ids=[3, 4], round_duration=3,
+        sched_epoch=5, reattached=True,
+    )
+    parsed = w2s.RegisterWorkerResponse.FromString(
+        resp.SerializeToString()
+    )
+    assert parsed.sched_epoch == 5 and parsed.reattached
+    ack = w2s.HeartbeatAck.FromString(
+        w2s.HeartbeatAck(sched_epoch=4).SerializeToString()
+    )
+    assert ack.sched_epoch == 4
+    run = s2w.RunJobRequest.FromString(
+        s2w.RunJobRequest(
+            worker_id=1, round_id=2, sched_epoch=9
+        ).SerializeToString()
+    )
+    assert run.sched_epoch == 9
+    kill = s2w.KillJobRequest.FromString(
+        s2w.KillJobRequest(job_id=5, sched_epoch=9).SerializeToString()
+    )
+    assert kill.sched_epoch == 9
+    # Legacy byte identity: defaulted HA fields serialize to nothing.
+    legacy_bytes = w2s.RegisterWorkerRequest(
+        worker_type="v100", num_accelerators=2, ip_addr="10.0.0.1",
+        port=50061,
+    ).SerializeToString()
+    assert b"\x32" not in legacy_bytes[-2:]  # no field-6 tail
+    assert s2w.KillJobRequest(job_id=5).SerializeToString() == (
+        s2w.KillJobRequest(job_id=5, sched_epoch=0).SerializeToString()
+    )
+
+
+def test_worker_fences_stale_epoch_dispatch():
+    """A deposed leader's RunJob/KillJob bounce with a non-retryable
+    fencing error once the worker has witnessed a newer epoch; the
+    current epoch and unfenced (epoch-0 legacy) RPCs pass."""
+    from shockwave_tpu.runtime.retry import PermanentRpcError, RetryPolicy
+    from shockwave_tpu.runtime.rpc import worker_server
+    from shockwave_tpu.runtime.rpc.scheduler_client import (
+        SchedulerRpcClient,
+    )
+    from shockwave_tpu.runtime.worker import _EpochWitness
+    from shockwave_tpu.utils.hostenv import free_port
+
+    witness = _EpochWitness()
+    witness.witness(5)
+    ran = []
+    port = free_port()
+    server = worker_server.serve(
+        port,
+        {
+            "run_job": lambda jobs, wid, rid: ran.append(("run", rid)),
+            "kill_job": lambda job_id: ran.append(("kill", job_id)),
+            "reset": lambda: None,
+            "shutdown": lambda: None,
+            "fence_epoch": witness.witness,
+        },
+    )
+    try:
+        client = SchedulerRpcClient(
+            "127.0.0.1", port,
+            retry=RetryPolicy(attempts=2, deadline_s=5.0,
+                              call_timeout_s=2.0),
+        )
+        with pytest.raises(PermanentRpcError, match="fenced"):
+            client.run_job([], worker_id=0, round_id=1, sched_epoch=4)
+        with pytest.raises(PermanentRpcError, match="fenced"):
+            client.kill_job(3, sched_epoch=2)
+        assert ran == []
+        client.run_job([], worker_id=0, round_id=2, sched_epoch=5)
+        client.kill_job(3, sched_epoch=0)  # legacy unfenced passes
+        assert ran == [("run", 2), ("kill", 3)]
+        # Witnessing 6 through the gate fences epoch 5 afterwards.
+        witness.witness(6)
+        with pytest.raises(PermanentRpcError, match="fenced"):
+            client.run_job([], worker_id=0, round_id=3, sched_epoch=5)
+    finally:
+        server.stop(grace=1)
+
+
+# ----------------------------------------------------------------------
+# Worker-side outage tracking (runtime/retry.py satellite)
+# ----------------------------------------------------------------------
+def test_scheduler_outage_threshold_and_accounting():
+    from shockwave_tpu.runtime.retry import SchedulerOutage
+
+    outage = SchedulerOutage(threshold=3)
+    assert not outage.record_failure()
+    assert not outage.record_failure()
+    assert not outage.in_outage()
+    assert outage.record_failure()  # third consecutive -> outage
+    assert outage.in_outage()
+    time.sleep(0.05)
+    accounted = outage.outage_seconds()
+    assert accounted > 0.0
+    outage.record_success()
+    assert not outage.in_outage()
+    # The window's wall time stays accounted after recovery.
+    assert outage.outage_seconds() >= accounted
+    # One success resets the consecutive count entirely.
+    outage.record_failure()
+    assert not outage.in_outage()
+
+
+def test_outage_threshold_env_knob(monkeypatch):
+    from shockwave_tpu.runtime.retry import SchedulerOutage
+
+    monkeypatch.setenv("SHOCKWAVE_OUTAGE_BEATS", "1")
+    outage = SchedulerOutage()
+    assert outage.record_failure()  # first failure already flips
+
+
+def test_dispatcher_buffers_dones_during_outage(tmp_path):
+    """With the scheduler declared unreachable, Done reports buffer
+    instead of burning the per-call retry budget; the flush delivers
+    them (oldest first) once contact returns and stops at the first
+    failure."""
+    from shockwave_tpu.runtime.dispatcher import Dispatcher
+    from shockwave_tpu.runtime.retry import SchedulerOutage
+
+    class FlakyClient:
+        def __init__(self):
+            self.delivered = []
+            self.fail = True
+
+        def notify_scheduler(self, worker_id, job_ids, steps, durations,
+                             logs, trace_contexts=None):
+            if self.fail:
+                raise ConnectionError("scheduler down")
+            self.delivered.append((worker_id, tuple(job_ids)))
+
+    client = FlakyClient()
+    outage = SchedulerOutage(threshold=1)
+    outage.record_failure()
+    assert outage.in_outage()
+    dispatcher = Dispatcher(
+        3.0, [0], client, "127.0.0.1", 1, str(tmp_path / "run"),
+        str(tmp_path / "ckpt"), outage=outage,
+    )
+    for i in range(3):
+        dispatcher._buffer_done((0, [i], [10], [1.0], [""], [""]))
+    assert dispatcher.outstanding_job_ids() == [0, 1, 2]
+    assert dispatcher.flush_buffered_dones() == 0  # still down
+    client.fail = False
+    assert dispatcher.flush_buffered_dones() == 3
+    assert [jid for _, (jid,) in client.delivered] == [0, 1, 2]
+    assert dispatcher.outstanding_job_ids() == []
+    dispatcher.retarget_scheduler("10.0.0.9", 777)
+    assert dispatcher._sched_addr == "10.0.0.9"
+
+
+def test_registrations_bounce_until_journal_restore_completes(tmp_path):
+    """A successor's gRPC server is live from construction; an agent
+    re-attaching before the journal restore would be minted fresh ids
+    against the EMPTY registry that the restore then clobbers. With
+    ha_restore_pending the registration bounces (transient — the
+    agent's outage loop retries) until restore_from_journal installs
+    the restored registry."""
+    jdir = str(tmp_path / "journal")
+    journal = ControlPlaneJournal(jdir)
+    journal.append(
+        "register",
+        {"worker_ids": [0], "worker_type": "v100",
+         "num_accelerators": 1, "ip_addr": "127.0.0.1", "port": 1234},
+        epoch=1,
+    )
+    snapshot = ControlPlaneJournal.replay(jdir)
+    sched = _fresh_physical(
+        ha_journal=ControlPlaneJournal(jdir), ha_restore_pending=True
+    )
+    try:
+        with pytest.raises(RuntimeError, match="restoring"):
+            sched._register_worker_rpc("v100", 1, "127.0.0.1", 1234)
+        sched.restore_from_journal(snapshot)
+        ids, _, _, reattached = sched._register_worker_rpc(
+            "v100", 1, "127.0.0.1", 1234, prev_worker_ids=[0],
+            outstanding_job_ids=[],
+        )
+        assert ids == [0] and reattached
+    finally:
+        sched.shutdown()
+
+
+def test_replay_reconciles_out_of_order_submit_admit(tmp_path):
+    """The append race: submit_batch journals its 'submit' entry
+    outside every lock, so a racing drain can journal the matching
+    'admit' at a LOWER LSN. Replay must not re-queue the
+    already-admitted job (which would run it twice)."""
+    jdir = str(tmp_path / "journal")
+    journal = ControlPlaneJournal(jdir)
+    job_state = ha_codec.job_state(_make_job(500))
+    # admit BEFORE submit — the observed race ordering.
+    journal.append(
+        "admit",
+        {"job_id": 0, "job": job_state, "timestamp": 0.0,
+         "token": "raced"},
+        epoch=1,
+    )
+    journal.append(
+        "submit",
+        {"token": "raced", "jobs": [job_state, job_state],
+         "close": False},
+        epoch=1,
+    )
+    snapshot = ControlPlaneJournal.replay(jdir)
+    sched = _fresh_physical(ha_journal=ControlPlaneJournal(jdir))
+    try:
+        sched.restore_from_journal(snapshot)
+        assert list(sched._jobs) == [JobId(0)]
+        # Only the batch's SECOND (never-admitted) job is pending.
+        assert sched._admission.depth() == 1
+        drained = sched._admission.drain(now=1.0)
+        assert len(drained) == 1
+    finally:
+        sched.shutdown()

@@ -667,3 +667,143 @@ def test_distributed_gang_trains_under_scheduler(tmp_path, monkeypatch):
         assert sched._total_steps_run[job_id] >= 250
     finally:
         sched.shutdown()
+
+
+@_needs_parallel_cpus
+def test_leader_sigkill_hot_standby_failover(tmp_path):
+    """Survivable control plane, against a REAL killed scheduler: a
+    leader node (subprocess) journals a live campaign, gets SIGKILLed
+    mid-round, and the hot standby (second subprocess) must take the
+    lease at a bumped fenced epoch, replay checkpoint+tail, re-adopt
+    the re-attaching worker, and finish every job exactly once — a
+    token retransmitted across the failover dedups against the
+    restored ledger. (The scripts/ci/ha_smoke.py gate runs the same
+    drill plus a cold-restart arm at reduced scale.)"""
+    import json
+    import signal
+    import subprocess
+    import sys
+    import time as time_mod
+
+    from shockwave_tpu.ha.election import LeaseStore
+    from shockwave_tpu.ha.frontdoor import resolve_submit_target
+    from shockwave_tpu.runtime.rpc.submitter_client import SubmitterClient
+
+    ha_dir = str(tmp_path / "ha")
+    os.makedirs(ha_dir, exist_ok=True)
+    leader_port, standby_port, worker_port = (
+        free_port(), free_port(), free_port()
+    )
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "SHOCKWAVE_HA_DIR": ha_dir,
+        "SHOCKWAVE_HEARTBEAT_S": "0.5",
+        "SHOCKWAVE_OUTAGE_BEATS": "2",
+        "SHOCKWAVE_RPC_ATTEMPTS": "2",
+        "SHOCKWAVE_RPC_DEADLINE_S": "3",
+        "SHOCKWAVE_RPC_TIMEOUT_S": "2",
+    }
+
+    def spawn_node(node, port, summary):
+        return subprocess.Popen(
+            [
+                sys.executable, "-m", "shockwave_tpu.ha.standby",
+                "--ha_dir", ha_dir, "--node", node, "--port", str(port),
+                "--round_s", "3", "--lease_ttl_s", "2",
+                "--completion_buffer_s", "6",
+                "--heartbeat_timeout_s", "6",
+                "--expect_workers", "1" if node == "leader" else "0",
+                "--max_rounds", "40", "--summary_out", summary,
+            ],
+            env=env,
+        )
+
+    summary_path = str(tmp_path / "successor.json")
+    procs = []
+    try:
+        leader = spawn_node("leader", leader_port,
+                            str(tmp_path / "leader.json"))
+        procs.append(leader)
+        deadline = time_mod.time() + 30
+        while LeaseStore(ha_dir).leader() is None:
+            assert time_mod.time() < deadline, "leader never published"
+            time_mod.sleep(0.2)
+        worker = subprocess.Popen(
+            [
+                sys.executable, "-m", "shockwave_tpu.runtime.worker",
+                "-t", "v100", "-n", "2",
+                "-a", "127.0.0.1", "-s", str(leader_port),
+                "-p", str(worker_port),
+                "--run_dir", str(tmp_path / "run"),
+                "--checkpoint_dir", str(tmp_path / "ckpt"),
+            ],
+            env=env,
+        )
+        procs.append(worker)
+        client = SubmitterClient(
+            "127.0.0.1", leader_port, client_id="hatest"
+        )
+        jobs = [make_job(700) for _ in range(4)]
+        first_token = client.next_token()
+        assert client.submit(
+            jobs[:2], token=first_token
+        ).status == "ACCEPTED"
+        assert client.submit(jobs[2:], close=True).status == "ACCEPTED"
+        standby = spawn_node("standby", standby_port, summary_path)
+        procs.append(standby)
+        # Let the leader dispatch real work, then kill it dead.
+        from shockwave_tpu.ha.journal import ControlPlaneJournal
+
+        deadline = time_mod.time() + 40
+        while time_mod.time() < deadline:
+            summary = ControlPlaneJournal.summarize(
+                os.path.join(ha_dir, "journal")
+            )
+            if (
+                summary["tail_kinds"].get("dispatch")
+                or summary["has_checkpoint"]
+            ):
+                break
+            time_mod.sleep(0.3)
+        leader.send_signal(signal.SIGKILL)
+        # The standby must win the lease at epoch 2.
+        deadline = time_mod.time() + 30
+        while True:
+            lease = LeaseStore(ha_dir).leader()
+            if lease is not None and lease.sched_port == standby_port:
+                assert lease.epoch >= 2
+                break
+            assert time_mod.time() < deadline, "standby never took over"
+            time_mod.sleep(0.2)
+        # Retransmit the pre-crash token verbatim: exactly-once must
+        # survive the failover.
+        target = resolve_submit_target(ha_dir, first_token)
+        client.retarget(target[0], target[1])
+        assert client.submit(
+            jobs[:2], token=first_token
+        ).status == "ACCEPTED"
+        deadline = time_mod.time() + 120
+        while not os.path.exists(summary_path):
+            assert time_mod.time() < deadline, (
+                "successor never finished the campaign"
+            )
+            time_mod.sleep(0.5)
+        with open(summary_path) as f:
+            summary = json.load(f)
+        assert summary["outcome"] == "completed"
+        assert summary["took_over"] is True
+        assert summary["epoch"] >= 2
+        assert sorted(summary["completed_jobs"]) == [0, 1, 2, 3]
+        assert summary["admission"]["deduped_batches"] >= 1
+        for steps in summary["total_steps_run"].values():
+            assert steps >= 700
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                pass
