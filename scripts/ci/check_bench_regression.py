@@ -55,14 +55,28 @@ TRACKED = {
     "effective_overhead_pct": True,
     "speculation_hit_rate": False,
     "whatif_scenarios_per_s": False,
+    "ingest_submits_per_s": False,
+    "ingest_p99_ms": True,
 }
 
-# Absolute values below which a series is "as good as zero": a
-# relative gate on a ratio of milliseconds flaps on scheduler noise,
-# so when BOTH sides sit under the floor the series passes outright
-# (0.3% -> 0.5% exposed overhead is not a regression worth a red CI).
+# Absolute thresholds past which a series is "as good as it needs to
+# be": a relative gate on a ratio of milliseconds flaps on scheduler
+# noise, so when BOTH sides sit on the good side of the threshold the
+# series passes outright (0.3% -> 0.5% exposed overhead is not a
+# regression worth a red CI). Direction follows TRACKED: for
+# lower-is-better series both sides must sit UNDER the threshold; for
+# higher-is-better series both must sit OVER it (a capability floor —
+# e.g. the in-process ingest rate swings 360-590k jobs/s with sustained
+# co-tenant interference on the shared-core bench host, but the scalar
+# fallback path only reaches ~53k, so "both over 150k" proves the
+# vectorized path is intact without flapping on a 38% noise dip).
 NOISE_FLOOR = {
     "effective_overhead_pct": 2.0,
+    # The p99 of ~300 sub-ms in-process submit_many calls is the host-
+    # scheduling tail (observed 0.9-7 ms run to run on the shared-core
+    # bench host); only an order-of-magnitude blowup is signal.
+    "ingest_p99_ms": 10.0,
+    "ingest_submits_per_s": 150000.0,
 }
 
 
@@ -212,9 +226,14 @@ def main(argv=None):
                 )
                 continue
         floor = NOISE_FLOOR.get(series)
-        if floor is not None and cur <= floor and base <= floor:
+        if floor is not None and (
+            (cur <= floor and base <= floor)
+            if lower_is_better
+            else (cur >= floor and base >= floor)
+        ):
+            side = "under" if lower_is_better else "over"
             print(
-                f"  {series:<8} {base:.4g} -> {cur:.4g}  (both under "
+                f"  {series:<8} {base:.4g} -> {cur:.4g}  (both {side} "
                 f"the {floor:g} noise floor; pass)"
             )
             continue

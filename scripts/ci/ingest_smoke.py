@@ -1,0 +1,54 @@
+#!/usr/bin/env python3
+"""CI gate: short seeded line-rate ingest soak.
+
+A scaled-down :mod:`scripts.ingest_soak` campaign — a 2-process
+submitter fleet pushing pipelined SubmitJobs RPCs through the real
+wire handler into a group-commit admission queue under client-side
+chaos — asserting the ingest-plane contract: sustained throughput
+over the (CI-derated) floor, p99 admission-queue latency inside the
+budget, every token's jobs drained exactly once (zero lost, zero
+double-admitted) despite injected request/response loss, every fault
+recovered, and the lane-amortized pricing convoy engaging with a
+bit-identical per-lane audit. Regenerates
+``results/ingest/ingest_smoke.json``; exits 1 on any violated
+invariant. Wired into the verify skill next to ``churn_smoke.py``.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from ingest_soak import build_parser, main  # noqa: E402  (scripts/ on path)
+
+
+def run(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    # The smoke shape: small, seeded, fast (~15 s on a 2-CPU host).
+    # The rate floor is derated from the soak's 10k/s acceptance bar —
+    # a loaded CI container shares cores with the submitter fleet; the
+    # exactly-once and latency contracts stay at full strength.
+    args.result_name = "ingest_smoke.json"
+    args.workers = 2
+    args.jobs_per_worker = 1500
+    args.batch_size = 64
+    args.window = 8
+    args.tick_s = 0.005
+    args.chaos = 3
+    args.seed = 0
+    args.min_rate = 2500.0
+    args.p99_budget_ms = 50.0
+    args.pricing_lanes = 6
+    return main(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(run())

@@ -110,6 +110,25 @@ def run_physical_cluster(
             rules["replan_p99"] = {
                 "budget_s": round_s, **rules["replan_p99"]
             }
+        # Ingest-latency p99 budget: only meaningful when the operator
+        # sets one (SHOCKWAVE_INGEST_P99_BUDGET_S) — without it the
+        # rule stays inert, since "acceptable admission latency" is a
+        # deployment SLO, not derivable from the round length.
+        ingest_budget = os.environ.get(
+            "SHOCKWAVE_INGEST_P99_BUDGET_S", ""
+        ).strip()
+        if ingest_budget:
+            try:
+                budget_s = float(ingest_budget)
+            except ValueError:
+                budget_s = None
+            if budget_s and budget_s > 0:
+                if "ingest_p99" not in rules:
+                    rules["ingest_p99"] = {"budget_s": budget_s}
+                elif rules["ingest_p99"] not in (False, None):
+                    rules["ingest_p99"] = {
+                        "budget_s": budget_s, **rules["ingest_p99"]
+                    }
         obs.configure_watchdog(rules)
         obs.configure_calibration()
     worker_env = dict(worker_env)
@@ -295,6 +314,30 @@ def run_physical_cluster(
         # and the reject/dedup counts are the backpressure/idempotency
         # evidence an operator greps for first.
         summary["admission"] = sched._admission.summary()
+        # Ingest latency percentiles (p50/p99 of the time jobs waited
+        # in the admission queue) — the numbers the ingest_p99 rule
+        # and the line-rate soak judge; present whenever metrics ran
+        # and any job was admitted through the front door.
+        if metrics_out:
+            from shockwave_tpu.obs.watchdog import Watchdog
+
+            metric_snap = obs.get_registry().snapshot()["metrics"]
+            p50, admitted = Watchdog._histogram_quantile(
+                metric_snap, "admission_queue_latency_seconds", 0.5
+            )
+            p99, _ = Watchdog._histogram_quantile(
+                metric_snap, "admission_queue_latency_seconds", 0.99
+            )
+            if admitted:
+                summary["ingest"] = {
+                    "admitted_jobs": int(admitted),
+                    "queue_latency_p50_s": p50,
+                    "queue_latency_p99_s": p99,
+                    "tick_s": float(
+                        os.environ.get("SHOCKWAVE_INGEST_TICK_S", "0")
+                        or 0
+                    ),
+                }
         if obs.get_watchdog().enabled:
             summary["scheduler_health"] = obs.get_watchdog().summary()
         if extra_summary is not None:

@@ -1,0 +1,461 @@
+#!/usr/bin/env python3
+"""Line-rate ingest soak: a multi-process submitter fleet driving the
+REAL SubmitJobs RPC front door, with the serving-system contract
+asserted at rate.
+
+The parent process runs a standalone ingest plane — the production
+``scheduler_server.serve`` wire handler over a group-commit
+:class:`AdmissionQueue` and an event-driven drain tick (the same
+cadence knob ``SHOCKWAVE_INGEST_TICK_S`` gives the physical
+scheduler) feeding a counting sink. ``--workers`` child processes
+each open a persistent-channel :class:`SubmitterClient` and push
+``--jobs-per-worker`` jobs through :meth:`submit_pipelined` (window
+of in-flight RPCs, serial-retry fallback) under a seeded client-side
+chaos plan (pre-send ``rpc_error``, lost-response ``rpc_drop``,
+``rpc_delay``), so retransmits hammer the token ledger for real.
+
+Asserted invariants (exit 1 on any violation):
+
+  * sustained ingest >= ``--min-rate`` jobs/s across the fleet;
+  * p99 admission-queue latency (enqueue -> drain) <= ``--p99-budget-ms``;
+  * exactly-once under chaos: every submitted token's jobs drain
+    EXACTLY once — zero lost, zero double-admitted — cross-checked
+    three ways (per-token sink counts vs the submitters' own expected
+    manifests, queue stats, final depth 0);
+  * every injected fault recovered (no unrecovered chaos);
+  * lane-amortized pricing engages: concurrent priced submissions
+    convoy through fewer ``price_batch`` dispatches than calls, and a
+    full ``audit=True`` dispatch is bit-identical lane for lane.
+
+Writes ``ingest_soak.json`` (+ per-worker manifests) under ``--out``.
+The reduced-scale CI variant is ``scripts/ci/ingest_smoke.py``.
+"""
+
+import argparse
+import json
+import multiprocessing
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+MODELS = [("ResNet-18", 32), ("ResNet-50", 64)]
+
+
+# ----------------------------------------------------------------------
+# Child: one submitter process of the fleet.
+# ----------------------------------------------------------------------
+def submitter_main(
+    worker_id: int,
+    port: int,
+    num_jobs: int,
+    batch_size: int,
+    window: int,
+    seed: int,
+    chaos: int,
+    out_path: str,
+) -> None:
+    """Runs in a spawned child: pipelined submission of ``num_jobs``
+    jobs under a seeded chaos plan, then a manifest (token -> expected
+    job count, timings, fault summary) for the parent's exactly-once
+    accounting. Deliberately imports nothing heavy (no jax)."""
+    from shockwave_tpu.core.job import Job
+    from shockwave_tpu.data.workload_info import steps_per_epoch
+    from shockwave_tpu.runtime import faults
+    from shockwave_tpu.runtime.rpc.submitter_client import SubmitterClient
+
+    rng = np.random.default_rng(seed + worker_id)
+    events = []
+    for i in range(chaos):
+        kind = ("rpc_error", "rpc_drop", "rpc_delay")[i % 3]
+        events.append(
+            faults.FaultEvent(
+                i,
+                kind,
+                method="SubmitJobs",
+                delay_s=0.02 if kind == "rpc_delay" else 0.0,
+            )
+        )
+    injector = faults.configure(
+        faults.FaultPlan(seed=seed + worker_id, events=events)
+    )
+    jobs = []
+    for i in range(num_jobs):
+        model, bs = MODELS[int(rng.integers(len(MODELS)))]
+        jobs.append(
+            Job(
+                job_type=f"{model} (batch size {bs})",
+                command="python3 main.py",
+                total_steps=steps_per_epoch(model, bs),
+                scale_factor=1,
+                mode="static",
+            )
+        )
+    client = SubmitterClient(
+        "127.0.0.1", port, client_id=f"soak-w{worker_id}"
+    )
+    t0 = time.monotonic()
+    tokens = client.submit_pipelined(
+        jobs, batch_size=batch_size, window=window, close=False
+    )
+    t1 = time.monotonic()
+    client.close()
+    expected = {}
+    for i, token in enumerate(tokens):
+        expected[token] = len(jobs[i * batch_size:(i + 1) * batch_size])
+    summary = injector.summary()
+    manifest = {
+        "worker_id": worker_id,
+        "expected": expected,
+        "jobs": num_jobs,
+        "submit_s": round(t1 - t0, 4),
+        "start_s": t0,
+        "end_s": t1,
+        "faults_applied": summary["applied"],
+        "faults_unrecovered": summary["unrecovered"],
+    }
+    from shockwave_tpu.utils.fileio import atomic_write_json
+
+    atomic_write_json(out_path, manifest)
+
+
+# ----------------------------------------------------------------------
+# Parent: ingest plane + accounting + pricing phase.
+# ----------------------------------------------------------------------
+def _pricing_market(num_jobs: int = 6, num_gpus: int = 2):
+    """A saturated prebuilt EG market (every incumbent wants the whole
+    window), the shape the pricing tests use: any burst priced against
+    it moves real welfare."""
+    from shockwave_tpu.solver.eg_problem import EGProblem
+
+    return EGProblem(
+        priorities=np.ones(num_jobs),
+        completed_epochs=np.full(num_jobs, 2.0),
+        total_epochs=np.full(num_jobs, 20.0),
+        epoch_duration=np.full(num_jobs, 60.0),
+        remaining_runtime=np.full(num_jobs, 18 * 60.0),
+        nworkers=np.ones(num_jobs),
+        num_gpus=num_gpus,
+        round_duration=120.0,
+        future_rounds=8,
+        regularizer=1e-3,
+        log_bases=np.linspace(0.0, 1.0, num_jobs),
+        switch_cost=np.zeros(num_jobs),
+        incumbent=np.ones(num_jobs),
+    )
+
+
+def run_pricing_phase(num_lanes: int) -> dict:
+    """Lane-amortized pricing under concurrency: ``num_lanes`` threads
+    race ``PricingCollector.price`` (the convoy must amortize them
+    into fewer dispatches), then one explicit ``audit=True`` dispatch
+    proves every lane bit-identical to its standalone solve."""
+    from shockwave_tpu.core.job import Job
+    from shockwave_tpu.whatif.pricing import (
+        AdmissionPricer,
+        PricingCollector,
+    )
+
+    problem = _pricing_market()
+    holder = {"problem": problem, "s0": None}
+
+    dispatches = []
+
+    class _CountingPricer(AdmissionPricer):
+        def price_batch(self, bursts, audit=False):
+            dispatches.append(len(bursts))
+            return super().price_batch(bursts, audit=audit)
+
+    pricer = _CountingPricer(
+        lambda: holder, threshold=float("inf"), budget_s=600.0
+    )
+    collector = PricingCollector(pricer, max_lanes=32)
+
+    def burst(n):
+        return [
+            Job(
+                job_type="ResNet-18 (batch size 32)",
+                command="x",
+                total_steps=100,
+                scale_factor=2,
+                mode="static",
+                duration=4000.0,
+            )
+            for _ in range(n)
+        ]
+
+    results = {}
+    barrier = threading.Barrier(num_lanes)
+
+    def caller(k):
+        barrier.wait()
+        results[k] = collector.price(burst(1 + k % 3))
+
+    threads = [
+        threading.Thread(target=caller, args=(k,))
+        for k in range(num_lanes)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    convoy_s = time.monotonic() - t0
+    audit_t0 = time.monotonic()
+    pricer.price_batch([burst(2), burst(4), burst(1)], audit=True)
+    return {
+        "lanes_priced": num_lanes,
+        "decisions": sorted(
+            {d.action for d in results.values()}
+        ),
+        "dispatches": len(dispatches),
+        "max_convoy": max(dispatches) if dispatches else 0,
+        "convoy_s": round(convoy_s, 3),
+        "audit": dict(pricer.last_batch_audit),
+        "audit_s": round(time.monotonic() - audit_t0, 3),
+    }
+
+
+def main(args) -> int:
+    from shockwave_tpu import obs
+    from shockwave_tpu.obs.metrics import quantile_from_buckets
+    from shockwave_tpu.runtime import admission
+    from shockwave_tpu.runtime.rpc import scheduler_server
+    from shockwave_tpu.utils.fileio import atomic_write_json
+    from shockwave_tpu.utils.hostenv import free_port
+
+    os.makedirs(args.out, exist_ok=True)
+    obs.reset()
+    obs.configure(metrics=True)
+    queue = admission.build_queue(
+        capacity=args.capacity,
+        retry_delay_s=0.05,
+        group_commit=True,
+    )
+
+    def submit_jobs(token, specs, close):
+        jobs = [admission.job_from_spec_dict(s) for s in specs]
+        status, retry_after, admitted = queue.submit(
+            token, jobs, close=close
+        )
+        return status, retry_after, admitted, queue.depth()
+
+    port = free_port()
+    server = scheduler_server.serve(port, {"submit_jobs": submit_jobs})
+
+    # The sink the drain tick feeds: token -> jobs admitted (the
+    # scheduler-side half of the exactly-once ledger check).
+    admitted: dict = {}
+    stop = threading.Event()
+
+    def drain_loop():
+        while not stop.is_set():
+            stop.wait(args.tick_s)
+            for token, _job, _enq in queue.drain():
+                admitted[token] = admitted.get(token, 0) + 1
+
+    drainer = threading.Thread(
+        target=drain_loop, name="ingest-soak-drain", daemon=True
+    )
+    drainer.start()
+
+    ctx = multiprocessing.get_context("spawn")
+    # Manifests are namespaced by the campaign (soak vs CI smoke share
+    # the out dir; unprefixed names would let a smoke run clobber the
+    # committed full-soak evidence).
+    stem = os.path.splitext(args.result_name)[0]
+    manifests = [
+        os.path.join(args.out, f"{stem}_worker_{w}.json")
+        for w in range(args.workers)
+    ]
+    procs = [
+        ctx.Process(
+            target=submitter_main,
+            args=(
+                w,
+                port,
+                args.jobs_per_worker,
+                args.batch_size,
+                args.window,
+                args.seed,
+                args.chaos,
+                manifests[w],
+            ),
+        )
+        for w in range(args.workers)
+    ]
+    wall_t0 = time.monotonic()
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join(timeout=600)
+    failures = [p.exitcode for p in procs if p.exitcode != 0]
+    # Final drain: everything accepted must leave the queue.
+    deadline = time.monotonic() + 10.0
+    while queue.depth() and time.monotonic() < deadline:
+        time.sleep(args.tick_s)
+    stop.set()
+    drainer.join(timeout=5)
+    for token, _job, _enq in queue.drain():
+        admitted[token] = admitted.get(token, 0) + 1
+    server.stop(0)
+
+    # -- exactly-once accounting ------------------------------------
+    expected: dict = {}
+    fault_applied = 0
+    unrecovered = []
+    spans = []
+    for path in manifests:
+        with open(path) as f:
+            m = json.load(f)
+        expected.update(m["expected"])
+        fault_applied += m["faults_applied"]
+        unrecovered.extend(m["faults_unrecovered"])
+        spans.append((m["start_s"], m["end_s"]))
+    lost = {
+        t: n for t, n in expected.items() if admitted.get(t, 0) < n
+    }
+    double = {
+        t: (expected.get(t, 0), n)
+        for t, n in admitted.items()
+        if n != expected.get(t, 0)
+    }
+    total_jobs = sum(expected.values())
+    # Fleet-level sustained rate: first byte offered to last response
+    # resolved, across all submitters (children overlap).
+    fleet_span_s = max(e for _, e in spans) - min(s for s, _ in spans)
+    rate = total_jobs / max(fleet_span_s, 1e-9)
+
+    # -- admission latency (enqueue -> drain) ------------------------
+    snap = obs.get_registry().snapshot()["metrics"]
+    latency = snap.get("admission_queue_latency_seconds")
+    p50_ms = p99_ms = None
+    observed = 0
+    if latency and latency["series"]:
+        series = latency["series"][0]
+        observed = int(series["count"])
+        p50, _ = quantile_from_buckets(
+            series["buckets"], 0.5, series["max"]
+        )
+        p99, _ = quantile_from_buckets(
+            series["buckets"], 0.99, series["max"]
+        )
+        p50_ms = 1e3 * p50 if p50 is not None else None
+        p99_ms = 1e3 * p99 if p99 is not None else None
+
+    pricing = run_pricing_phase(args.pricing_lanes)
+
+    stats = queue.summary()
+    result = {
+        "config": {
+            "workers": args.workers,
+            "jobs_per_worker": args.jobs_per_worker,
+            "batch_size": args.batch_size,
+            "window": args.window,
+            "capacity": args.capacity,
+            "tick_s": args.tick_s,
+            "chaos_per_worker": args.chaos,
+            "seed": args.seed,
+        },
+        "throughput": {
+            "total_jobs": total_jobs,
+            "fleet_span_s": round(fleet_span_s, 4),
+            "submits_per_s": round(rate, 1),
+            "wall_s": round(time.monotonic() - wall_t0, 3),
+        },
+        "latency": {
+            "admitted_observed": observed,
+            "queue_p50_ms": round(p50_ms, 3) if p50_ms is not None else None,
+            "queue_p99_ms": round(p99_ms, 3) if p99_ms is not None else None,
+        },
+        "exactly_once": {
+            "lost": lost,
+            "double_admitted": double,
+            "deduped_batches": stats["deduped_batches"],
+            "faults_applied": fault_applied,
+            "faults_unrecovered": unrecovered,
+        },
+        "pricing": pricing,
+        "admission_summary": stats,
+    }
+
+    violations = []
+    if failures:
+        violations.append(f"submitter process failed: {failures}")
+    if lost:
+        violations.append(f"LOST jobs: {len(lost)} tokens short")
+    if double:
+        violations.append(
+            f"DOUBLE-ADMITTED jobs: {len(double)} tokens off"
+        )
+    if queue.depth():
+        violations.append(f"queue not drained: depth={queue.depth()}")
+    if unrecovered:
+        violations.append(f"unrecovered faults: {unrecovered}")
+    if args.chaos and fault_applied == 0:
+        violations.append("chaos plan never fired")
+    if rate < args.min_rate:
+        violations.append(
+            f"sustained rate {rate:.0f}/s under the "
+            f"{args.min_rate:.0f}/s floor"
+        )
+    if p99_ms is None:
+        violations.append("no admission latency observed")
+    elif p99_ms > args.p99_budget_ms:
+        violations.append(
+            f"p99 admission latency {p99_ms:.1f}ms over the "
+            f"{args.p99_budget_ms:.0f}ms budget"
+        )
+    if not pricing["audit"].get("bit_identical"):
+        violations.append(
+            f"pricing lane audit not bit-identical: {pricing['audit']}"
+        )
+    if pricing["dispatches"] >= pricing["lanes_priced"]:
+        violations.append(
+            "pricing convoy never amortized: "
+            f"{pricing['dispatches']} dispatches for "
+            f"{pricing['lanes_priced']} lanes"
+        )
+    result["violations"] = violations
+
+    out_json = os.path.join(args.out, args.result_name)
+    atomic_write_json(out_json, result)
+    print(json.dumps(result["throughput"] | result["latency"]))
+    if violations:
+        for v in violations:
+            print(f"VIOLATION: {v}", file=sys.stderr)
+        return 1
+    print(
+        f"OK: {total_jobs} jobs at {rate:.0f}/s, "
+        f"p99 {p99_ms:.1f}ms, exactly-once held under "
+        f"{fault_applied} injected faults -> {out_json}"
+    )
+    return 0
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", type=str, default="results/ingest")
+    parser.add_argument(
+        "--result_name", type=str, default="ingest_soak.json"
+    )
+    parser.add_argument("--workers", type=int, default=4)
+    parser.add_argument("--jobs-per-worker", type=int, default=12800)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--window", type=int, default=8)
+    parser.add_argument("--capacity", type=int, default=65536)
+    parser.add_argument("--tick-s", type=float, default=0.005)
+    parser.add_argument("--chaos", type=int, default=6)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--min-rate", type=float, default=10000.0)
+    parser.add_argument("--p99-budget-ms", type=float, default=50.0)
+    parser.add_argument("--pricing-lanes", type=int, default=8)
+    return parser
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(build_parser().parse_args()))

@@ -1302,12 +1302,65 @@ class PhysicalScheduler(Scheduler):
         with self._cv:
             self._num_expected_jobs = count
 
+    def _start_ingest_thread(self):
+        """Event-driven ingest: when ``SHOCKWAVE_INGEST_TICK_S`` is set
+        (> 0), a daemon thread drains the admission front door on its
+        own cadence instead of once per round boundary — mid-round
+        arrivals enter the job table immediately and flow into the
+        planner as incremental delta-replans (add_job raises the
+        recompute flag; the job axis stays inside its power-of-two
+        band, so a streamed arrival never recompiles), reconciling
+        with speculation at the next boundary exactly like a REPAIR.
+        Admission latency stops being quantized to the round length.
+        Unset/0 (the default) keeps the boundary-drain path
+        bit-identical to the legacy behavior. Returns the stop event,
+        or None when disabled."""
+        try:
+            tick_s = float(
+                os.environ.get("SHOCKWAVE_INGEST_TICK_S", "0") or 0
+            )
+        except ValueError:
+            tick_s = 0.0
+        if tick_s <= 0:
+            return None
+        stop = threading.Event()
+
+        def loop():
+            ticks = obs.counter(
+                "ingest_ticks_total",
+                "ingest-thread drain ticks that admitted jobs "
+                "mid-round",
+            )
+            while not (
+                stop.is_set() or self._shutdown_requested.is_set()
+            ):
+                stop.wait(tick_s)
+                if stop.is_set() or self._shutdown_requested.is_set():
+                    break
+                # Same single-drainer discipline as the boundary path:
+                # _drain_admission_queue requires _cv, so the round
+                # loop and this thread can never interleave a drain.
+                with self._cv:
+                    if self._admission.depth() == 0:
+                        continue
+                    admitted = self._drain_admission_queue()
+                    if admitted:
+                        ticks.inc()
+                        self._cv.notify_all()
+
+        thread = threading.Thread(
+            target=loop, name="shockwave-ingest", daemon=True
+        )
+        thread.start()
+        return stop
+
     def run(self, max_rounds: Optional[int] = None) -> None:
         """Drive rounds until every added job completes
         (reference: _schedule_with_rounds scheduler.py:2080-2129)."""
         from shockwave_tpu.runtime import faults
 
         fault_injector = faults.active()
+        ingest_stop = self._start_ingest_thread()
         while not self._shutdown_requested.is_set():
             with self._cv:
                 if fault_injector is not None:
@@ -1595,6 +1648,8 @@ class PhysicalScheduler(Scheduler):
             if should_checkpoint:
                 self._ha_checkpoint()
 
+        if ingest_stop is not None:
+            ingest_stop.set()
         self.shutdown()
 
     def _kill_job(self, key: JobId) -> None:

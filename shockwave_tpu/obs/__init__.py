@@ -95,6 +95,9 @@ class _NullInstrument:
     def observe(self, value, **labels):
         pass
 
+    def observe_many(self, values, **labels):
+        pass
+
     def remove(self, **labels):
         pass
 

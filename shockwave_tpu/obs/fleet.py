@@ -260,10 +260,30 @@ class FleetTelemetry:
         if ages:
             body["oldest_scrape_age_s"] = round(max(ages), 3)
         code = 200
+        # Ingest latency percentiles (when the admission front door has
+        # observed any queue latency): the live numbers an operator
+        # checks against SHOCKWAVE_INGEST_P99_BUDGET_S.
+        metrics_snapshot = obs.get_registry().snapshot()["metrics"]
+        ingest = metrics_snapshot.get("admission_queue_latency_seconds")
+        if ingest and ingest.get("series"):
+            from shockwave_tpu.obs.watchdog import Watchdog
+
+            p50, count = Watchdog._histogram_quantile(
+                metrics_snapshot, "admission_queue_latency_seconds", 0.5
+            )
+            p99, _ = Watchdog._histogram_quantile(
+                metrics_snapshot, "admission_queue_latency_seconds", 0.99
+            )
+            if count:
+                body["ingest"] = {
+                    "admitted_jobs": int(count),
+                    "queue_latency_p50_s": p50,
+                    "queue_latency_p99_s": p99,
+                }
         if watchdog.enabled:
             summary = watchdog.summary()
             body["watchdog"] = summary
-            metrics = obs.get_registry().snapshot()["metrics"]
+            metrics = metrics_snapshot
             gauge = metrics.get("scheduler_health")
             health = None
             if gauge and gauge["series"]:

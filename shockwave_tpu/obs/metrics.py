@@ -209,6 +209,47 @@ class Histogram(_Instrument):
             if idx < len(self._bounds):
                 series["buckets"][idx] += 1
 
+    def observe_many(self, values, **labels) -> None:
+        """Vectorized :meth:`observe`: one lock acquisition and one
+        ``searchsorted``/``bincount`` pass for a whole batch of
+        observations (the admission drain's per-job latency path, where
+        a 4k-job tick must not pay 4k ``bisect`` calls under the
+        registry lock). numpy is imported lazily so the registry stays
+        importable without it; with numpy absent the loop fallback
+        keeps the identical bucket math."""
+        registry = self._registry
+        if not registry.enabled:
+            return
+        try:
+            import numpy as np
+        except ImportError:
+            for value in values:
+                self.observe(value, **labels)
+            return
+        arr = np.asarray(values, dtype=np.float64).ravel()
+        if arr.size == 0:
+            return
+        # side="left" reproduces bisect_left: bucket i counts
+        # value <= bound (Prometheus-inclusive le).
+        idx = np.searchsorted(self._bounds, arr, side="left")
+        per_bucket = np.bincount(
+            idx[idx < len(self._bounds)], minlength=len(self._bounds)
+        )
+        lo, hi = float(arr.min()), float(arr.max())
+        total = float(arr.sum())
+        with registry._lock:
+            series = self._get_series(labels)
+            series["count"] += int(arr.size)
+            series["sum"] += total
+            if series["min"] is None or lo < series["min"]:
+                series["min"] = lo
+            if series["max"] is None or hi > series["max"]:
+                series["max"] = hi
+            buckets = series["buckets"]
+            for i, count in enumerate(per_bucket):
+                if count:
+                    buckets[i] += int(count)
+
     def _cumulative_buckets(self, series: dict) -> "Dict[str, int]":
         out = {}
         running = 0

@@ -45,6 +45,14 @@ Rules (all thresholds overridable via a config dict, e.g. the
                      default — drivers configure it from the round
                      duration (the replan budget); the rule is inert
                      until they do.
+``ingest_p99``       the p99 of ``admission_queue_latency_seconds``
+                     (time a job waited in the admission queue before
+                     a drain admitted it) exceeds ``budget_s`` once
+                     ``min_jobs`` jobs were admitted. Like
+                     ``replan_p99`` the budget has no universal
+                     default — drivers configure it from
+                     ``SHOCKWAVE_INGEST_P99_BUDGET_S``; inert until
+                     they do.
 ``cell_failure``     a cell-decomposed planner isolated a cell whose
                      solve exhausted every recovery rung
                      (``cells_cell_failures_total`` advanced by >=
@@ -90,6 +98,7 @@ DEFAULT_RULES: Dict[str, dict] = {
     "worker_death": {"min_workers": 1},
     "admission_backlog": {"fraction": 0.9, "min_depth": 8},
     "replan_p99": {"budget_s": None, "min_solves": 5, "quantile": 0.99},
+    "ingest_p99": {"budget_s": None, "min_jobs": 20, "quantile": 0.99},
     "cell_failure": {"min_events": 1},
     "clock_skew": {"max_offset_s": 1.0, "max_jump_s": 0.5},
 }
@@ -238,6 +247,8 @@ class Watchdog:
                 self._check_admission_backlog(metrics, round_index, fired)
             if "replan_p99" in self.rules:
                 self._check_replan_p99(metrics, round_index, fired)
+            if "ingest_p99" in self.rules:
+                self._check_ingest_p99(metrics, round_index, fired)
             if "cell_failure" in self.rules:
                 self._check_counter_delta(
                     metrics, "cell_failure",
@@ -374,6 +385,32 @@ class Watchdog:
             )
         else:
             self._rearm("replan_p99")
+
+    def _check_ingest_p99(self, metrics, round_index, fired) -> None:
+        """Caller holds the lock (check_round). p99 of the time a job
+        waited in the admission queue before a drain admitted it
+        (``admission_queue_latency_seconds``) vs the ingest-latency
+        budget — the SLO the event-driven ingest plane exists to hold.
+        Inert until a driver supplies ``budget_s`` (from
+        ``SHOCKWAVE_INGEST_P99_BUDGET_S``)."""
+        cfg = self.rules["ingest_p99"]
+        budget = cfg.get("budget_s")
+        if budget is None:
+            return  # inert until a driver supplies the ingest budget
+        p99, count = self._histogram_quantile(
+            metrics,
+            "admission_queue_latency_seconds",
+            cfg.get("quantile", 0.99),
+        )
+        if p99 is None or count < cfg["min_jobs"]:
+            return
+        if p99 > budget:
+            self._fire(
+                fired, "ingest_p99", round_index, p99, budget,
+                jobs=int(count),
+            )
+        else:
+            self._rearm("ingest_p99")
 
     def _check_clock_skew(self, metrics, round_index, fired) -> None:
         """Caller holds the lock (check_round). Per-worker (like

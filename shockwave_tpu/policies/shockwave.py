@@ -896,7 +896,7 @@ class ShockwavePlanner(SpeculativePlannerMixin):
                 for j in job_ids
             ]
         )
-        return warm_start.delta_patch_counts(
+        patched = warm_start.delta_patch_counts(
             prev_ids,
             np.array([float(prev_counts[j]) for j in prev_ids]),
             job_ids,
@@ -904,6 +904,20 @@ class ShockwavePlanner(SpeculativePlannerMixin):
             self.num_gpus,
             self.future_rounds,
         )
+        if patched is not None:
+            # Streamed mid-round arrivals ride this seeded-rows path
+            # (the ingest tick admits between boundaries); count them
+            # so a soak can verify delta-replans — not cold solves —
+            # absorbed the stream.
+            arrivals = sum(1 for j in job_ids if j not in prev_counts)
+            if arrivals:
+                obs.counter(
+                    "planner_delta_arrivals_total",
+                    "new jobs absorbed into a replan via the "
+                    "delta-patched warm start (no cold solve, no "
+                    "recompile)",
+                ).inc(arrivals)
+        return patched
 
     def _record_solve(
         self, seconds: float, backend: str, num_jobs: int,

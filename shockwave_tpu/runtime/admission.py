@@ -33,6 +33,10 @@ replayable forensic data, and surfaced as metrics for the
 
 from __future__ import annotations
 
+import bisect
+import os
+import re
+import threading
 import time
 from collections import OrderedDict, deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -62,6 +66,17 @@ STATUS_PRICED = "PRICED"
 # Default bound on pending (accepted-but-not-admitted) jobs; the env
 # knob SHOCKWAVE_ADMISSION_QUEUE_CAP overrides it in physical mode.
 DEFAULT_CAPACITY = 1024
+
+# Default recent-window size of the bounded token ledger
+# (SHOCKWAVE_LEDGER_WINDOW overrides): tokens past the window compact
+# into per-prefix resolved ranges — lossless dedup for the
+# ``prefix-NNNNNN`` shape both in-repo token mints use.
+DEFAULT_LEDGER_WINDOW = 4096
+
+# The compactable token shape: any prefix, a trailing dash-delimited
+# decimal sequence number (SubmitterClient and StreamingSubmitter both
+# mint ``f"{id}-{seq:06d}"``).
+_TOKEN_RANGE_RE = re.compile(r"^(.*)-(\d{1,18})$")
 
 
 def job_to_spec_dict(job: Job) -> dict:
@@ -198,6 +213,13 @@ class _TenantLedger:
             for tenant, count in counts.items():
                 self._pending[tenant] = self._pending.get(tenant, 0) + count
 
+    def pending_of(self, tenants: Sequence[str]) -> List[int]:
+        """Snapshot of the pending tallies for ``tenants`` (the
+        vectorized quota pass reads these once, then commits its
+        accepted total through one atomic :meth:`reserve`)."""
+        with self._lock:
+            return [self._pending.get(t, 0) for t in tenants]
+
     def state_dict(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._pending)
@@ -207,6 +229,179 @@ class _TenantLedger:
             self._pending = {
                 str(t): int(c) for t, c in (state or {}).items()
             }
+
+
+class _TokenLedger:
+    """Bounded exactly-once token ledger.
+
+    The original ledger (token -> admitted count, retained forever)
+    is unbounded memory at line rate: 10k submits/s is ~1 GB of token
+    strings a day. This structure keeps a RECENT window of tokens with
+    their admitted counts (OrderedDict, FIFO-evicted past ``window``)
+    and compacts each evicted token of the form ``prefix-NNNN…`` —
+    the shape both in-repo token mints use — into per-prefix sorted
+    disjoint integer ranges. Range compaction is LOSSLESS for
+    membership (dedup holds arbitrarily long after eviction at
+    O(prefixes + gaps) memory); only the admitted-count metadata is
+    lost, so a range-hit dedup ack reports ``admitted=0`` (both
+    in-repo submitters ignore the field on dedup — see USAGE.md).
+    A token that does not parse is dropped outright on eviction —
+    dedup coverage genuinely lost — and counted loudly
+    (``admission_ledger_evictions_total{reason="dropped"}``).
+
+    Not thread-safe: owned by one AdmissionQueue under its lock.
+    """
+
+    def __init__(self, window: int = DEFAULT_LEDGER_WINDOW):
+        self.window = max(1, int(window))
+        self._recent: "OrderedDict[str, int]" = OrderedDict()
+        # prefix -> sorted disjoint [lo, hi] spans (inclusive).
+        self._ranges: Dict[str, list] = {}
+        # Lazily-rebuilt sorted int64 hashes of _recent's keys for the
+        # vectorized membership probe; None = dirty. In-memory only
+        # (str hashes are per-process), never serialized.
+        self._hash_cache = None
+        self.evictions = {"compacted": 0, "dropped": 0}
+
+    def __contains__(self, token) -> bool:
+        return self.get(token) is not None
+
+    def get(self, token: str) -> Optional[int]:
+        """Admitted count recorded under ``token``; 0 when the token
+        resolved but its count was compacted away; None when absent."""
+        count = self._recent.get(token)
+        if count is not None:
+            return count
+        match = _TOKEN_RANGE_RE.match(token)
+        if match and self._in_ranges(match.group(1), int(match.group(2))):
+            return 0
+        return None
+
+    def _in_ranges(self, prefix: str, seq: int) -> bool:
+        spans = self._ranges.get(prefix)
+        if not spans:
+            return False
+        i = bisect.bisect_right(spans, [seq, float("inf")]) - 1
+        return i >= 0 and spans[i][0] <= seq <= spans[i][1]
+
+    def add(self, token: str, count: int) -> None:
+        self._recent[token] = int(count)
+        self._hash_cache = None
+        while len(self._recent) > self.window:
+            self._evict_oldest()
+
+    def _evict_oldest(self) -> None:
+        token, _count = self._recent.popitem(last=False)
+        match = _TOKEN_RANGE_RE.match(token)
+        if match:
+            self._merge_range(match.group(1), int(match.group(2)))
+            self.evictions["compacted"] += 1
+            reason = "compacted"
+        else:
+            self.evictions["dropped"] += 1
+            reason = "dropped"
+        obs.counter(
+            "admission_ledger_evictions_total",
+            "tokens evicted from the bounded ledger's recent window "
+            "(compacted = lossless range merge; dropped = unparseable "
+            "token, dedup coverage LOST past the window)",
+        ).inc(reason=reason)
+
+    def _merge_range(self, prefix: str, seq: int) -> None:
+        spans = self._ranges.setdefault(prefix, [])
+        i = bisect.bisect_left(spans, [seq, seq])
+        if i > 0 and spans[i - 1][1] >= seq - 1:
+            i -= 1
+            if seq <= spans[i][1]:
+                return  # already covered
+            spans[i][1] = seq
+        else:
+            spans.insert(i, [seq, seq])
+        if i + 1 < len(spans) and spans[i + 1][0] <= spans[i][1] + 1:
+            spans[i][1] = max(spans[i][1], spans[i + 1][1])
+            del spans[i + 1]
+
+    def contains_many(self, tokens: Sequence[str]):
+        """Vectorized membership: one sorted-hash ``searchsorted``
+        probe over the recent window (possible hits confirmed against
+        the dict, killing hash collisions) plus a per-prefix range
+        probe for the misses. Returns a bool array aligned with
+        ``tokens``."""
+        import numpy as np
+
+        out = np.zeros(len(tokens), dtype=bool)
+        if not len(tokens):
+            return out
+        if self._recent:
+            if self._hash_cache is None:
+                self._hash_cache = np.sort(
+                    np.fromiter(
+                        (hash(t) for t in self._recent),
+                        dtype=np.int64,
+                        count=len(self._recent),
+                    )
+                )
+            cache = self._hash_cache
+            probe = np.fromiter(
+                (hash(t) for t in tokens),
+                dtype=np.int64,
+                count=len(tokens),
+            )
+            pos = np.minimum(
+                np.searchsorted(cache, probe), len(cache) - 1
+            )
+            for i in np.nonzero(cache[pos] == probe)[0]:
+                out[i] = tokens[i] in self._recent
+        if self._ranges:
+            for i in np.nonzero(~out)[0]:
+                match = _TOKEN_RANGE_RE.match(tokens[i])
+                if match and self._in_ranges(
+                    match.group(1), int(match.group(2))
+                ):
+                    out[i] = True
+        return out
+
+    def size(self) -> int:
+        """Total tokens the ledger still answers for (window + every
+        range-compacted token)."""
+        return len(self._recent) + sum(
+            hi - lo + 1
+            for spans in self._ranges.values()
+            for lo, hi in spans
+        )
+
+    def state_dict(self) -> dict:
+        """Checkpointable snapshot. ``token_jobs`` keeps the legacy key
+        (old snapshots restore into the window unchanged); the ranges
+        ride alongside."""
+        return {
+            "token_jobs": OrderedDict(self._recent),
+            "token_ranges": {
+                prefix: [list(span) for span in spans]
+                for prefix, spans in self._ranges.items()
+            },
+            "ledger_evictions": dict(self.evictions),
+        }
+
+    def restore(self, recent, ranges=None, evictions=None) -> None:
+        self._recent = OrderedDict(
+            (str(t), int(n)) for t, n in (recent or {}).items()
+        )
+        self._ranges = {
+            str(prefix): sorted(
+                [int(lo), int(hi)] for lo, hi in spans
+            )
+            for prefix, spans in (ranges or {}).items()
+        }
+        for key, value in (evictions or {}).items():
+            if key in self.evictions:
+                self.evictions[key] = int(value)
+        self._hash_cache = None
+        # A legacy (unbounded) snapshot restores into the window and
+        # compacts down to the bound here — exactly-once is preserved
+        # through the ranges, the memory bound through the eviction.
+        while len(self._recent) > self.window:
+            self._evict_oldest()
 
 
 class AdmissionQueue:
@@ -228,6 +423,8 @@ class AdmissionQueue:
         shard_label: Optional[str] = None,
         tenant_ledger: Optional[_TenantLedger] = None,
         pricer=None,
+        ledger_window: Optional[int] = None,
+        group_commit: bool = False,
     ):
         self.capacity = max(1, int(capacity))
         # Base unit of the queue-depth-derived backpressure delay: a
@@ -280,9 +477,29 @@ class AdmissionQueue:
         # unlabeled aggregate the watchdog's backlog rule reads).
         self._shard_label = shard_label
         # token -> number of jobs recorded under it (the idempotency
-        # ledger; retained for the queue's lifetime so a token can
-        # never be admitted twice, even long after its batch drained).
-        self._token_jobs: "OrderedDict[str, int]" = OrderedDict()
+        # ledger). Bounded: tokens past the recent window compact into
+        # per-prefix resolved ranges, so a token can still never be
+        # admitted twice, even long after its batch drained, without
+        # the ledger growing without bound at line rate.
+        if ledger_window is None:
+            ledger_window = int(
+                os.environ.get(
+                    "SHOCKWAVE_LEDGER_WINDOW", DEFAULT_LEDGER_WINDOW
+                )
+            )
+        self._tokens = _TokenLedger(window=ledger_window)
+        # Group commit: concurrent submit() calls convoy behind one
+        # leader thread that prices and admits the whole convoy as a
+        # single vectorized submit_many pass — N handler threads pay
+        # one lock walk and one lane-amortized pricing dispatch
+        # instead of N. Zero added latency when idle (a lone submit is
+        # its own leader).
+        self._group_commit = bool(group_commit)
+        self._group_lock = sanitize.make_lock(
+            "runtime.admission.AdmissionQueue._group_lock"
+        )
+        self._group_staged: list = []
+        self._group_leader = False
         self._closed = False
         self._opened = False  # any submit ever arrived
         # Counters mirrored into the metrics registry (kept here too so
@@ -333,47 +550,277 @@ class AdmissionQueue:
     ) -> Tuple[str, float, int]:
         """Offer one batch. Returns ``(status, retry_after_s, admitted)``
         where ``admitted`` is the number of jobs recorded under the
-        token (0 on rejection). Close may ride any accepted batch (or
-        an empty one) and is idempotent."""
+        token (0 on rejection; also 0 on a dedup ack whose count was
+        compacted out of the bounded ledger's window). Close may ride
+        any accepted batch (or an empty one) and is idempotent."""
         token = str(token)
         now = self._clock() if now is None else now
         if self._pricer is not None and jobs:
             status = self._maybe_price(token, jobs)
             if status is not None:
                 return status, 0.0, 0
+        if self._group_commit and not close:
+            return self._submit_grouped(token, jobs, now)
         with self._lock:
-            self._opened = True
-            if token and token in self._token_jobs:
-                # Retried submit: the token already resolved — ack
-                # without re-admitting. Close still applies (the retry
-                # may be the close-carrying resend).
-                if close:
-                    self._close_locked()
+            return self._submit_locked(token, jobs, close, now)
+
+    def _submit_locked(
+        self,
+        token: str,
+        jobs: Sequence[Job],
+        close: bool,
+        now: float,
+    ) -> Tuple[str, float, int]:
+        """Caller holds the lock. The scalar REFERENCE admission path
+        (dedup -> closed -> quota -> backpressure -> append); the
+        vectorized :meth:`submit_many` must be decision-for-decision
+        equivalent to running batches through here in order, and the
+        exactly-once property test holds it to that."""
+        self._opened = True
+        if token and token in self._tokens:
+            # Retried submit: the token already resolved — ack
+            # without re-admitting. Close still applies (the retry
+            # may be the close-carrying resend).
+            if close:
+                self._close_locked()
+            self.stats["deduped_batches"] += 1
+            obs.counter(
+                "admission_deduped_total",
+                "retried submissions acknowledged via the token "
+                "ledger without re-admitting",
+            ).inc()
+            return STATUS_ACCEPTED, 0.0, self._tokens.get(token) or 0
+        if self._closed:
+            self.stats["closed_rejects"] += 1
+            obs.counter(
+                "admission_rejected_total",
+                "submissions rejected (backpressure, quota, or "
+                "closed stream)",
+            ).inc(reason="closed")
+            return STATUS_CLOSED, 0.0, 0
+        # Check-and-reserve in one ledger critical section: the
+        # reservation is released below if backpressure then
+        # bounces the batch.
+        batch_counts = _TenantLedger.batch_counts(jobs)
+        over_quota = (
+            self._tenants.reserve(batch_counts, self.tenant_quotas)
+            if batch_counts
+            else None
+        )
+        if over_quota is not None:
+            self.stats["quota_rejects"] += 1
+            obs.counter(
+                "admission_rejected_total",
+                "submissions rejected (backpressure, quota, or "
+                "closed stream)",
+            ).inc(reason="quota")
+            self._record_event_locked(
+                "rejected", token, len(jobs), len(self._pending),
+                reason="quota", tenant=over_quota,
+            )
+            return STATUS_QUOTA, 0.0, 0
+        depth = len(self._pending)
+        # The bound is on BACKLOG, not on a single batch: an empty
+        # queue admits any batch (otherwise a batch larger than
+        # the capacity could never be admitted and its submitter
+        # would retry the same token forever — a livelock, since
+        # rejection never shrinks the batch).
+        if jobs and depth and depth + len(jobs) > self.capacity:
+            if batch_counts:
+                self._tenants.release(batch_counts)
+            overflow = depth + len(jobs) - self.capacity
+            # Depth-derived delay: how full the queue already is,
+            # plus how far over this batch would push it — a deeper
+            # backlog earns a longer wait, so a thundering herd
+            # spreads out instead of hammering a full queue.
+            retry_after = self.retry_delay_s * (
+                depth / self.capacity + overflow / max(len(jobs), 1)
+            )
+            self.stats["rejected_batches"] += 1
+            obs.counter(
+                "admission_rejected_total",
+                "submissions rejected (backpressure or closed "
+                "stream)",
+            ).inc(reason="backpressure")
+            self._record_event_locked(
+                "rejected", token, len(jobs), depth,
+                retry_after_s=round(retry_after, 3),
+            )
+            return STATUS_RETRY_AFTER, retry_after, 0
+        for job in jobs:
+            self._pending.append((token, job, now, self._seq))
+            self._seq += 1
+        if token:
+            self._tokens.add(token, len(jobs))
+        self.stats["accepted_batches"] += 1
+        self.stats["accepted_jobs"] += len(jobs)
+        obs.counter(
+            "admission_accepted_total", "submission batches accepted"
+        ).inc()
+        self._set_depth_gauge_locked()
+        self._record_event_locked(
+            "accepted", token, len(jobs), len(self._pending)
+        )
+        if close:
+            self._close_locked()
+        return STATUS_ACCEPTED, 0.0, len(jobs)
+
+    def submit_many(
+        self,
+        requests: Sequence[tuple],
+        now: Optional[float] = None,
+    ) -> List[Tuple[str, float, int]]:
+        """Vectorized :meth:`submit` for a whole drain tick's worth of
+        batches: ``requests`` is a sequence of ``(token, jobs)`` or
+        ``(token, jobs, close)`` tuples; returns one
+        ``(status, retry_after_s, admitted)`` per request, aligned.
+
+        Decision-for-decision equivalent to submitting the requests
+        through the scalar path in order — token dedup is one hashed
+        ledger probe for the whole batch, quota check-and-reserve one
+        segmented reduction over the per-tenant count matrix, and
+        backpressure one prefix-sum over the depth vector — so a
+        4k-submission tick costs one lock walk, not 4k. Requests that
+        carry a close flag or repeat a token within the call fall back
+        to the scalar path (close ordering and intra-call dedup are
+        inherently sequential)."""
+        now = self._clock() if now is None else now
+        reqs = []
+        for request in requests:
+            token, jobs = str(request[0]), list(request[1])
+            close = bool(request[2]) if len(request) > 2 else False
+            reqs.append((token, jobs, close))
+        tokens = [r[0] for r in reqs]
+        if (
+            not reqs
+            or any(r[2] for r in reqs)
+            or len(set(tokens)) != len(tokens)
+        ):
+            return [
+                self.submit(token, jobs, close=close, now=now)
+                for token, jobs, close in reqs
+            ]
+        results: List[Optional[Tuple[str, float, int]]] = [None] * len(reqs)
+        if self._pricer is not None:
+            self._price_many(reqs, results, now)
+        with self._lock:
+            self._submit_many_locked(reqs, results, now)
+        return results  # type: ignore[return-value]
+
+    def _submit_many_locked(self, reqs, results, now) -> None:
+        """Caller holds the lock: the vectorized dedup / quota /
+        backpressure / commit pass. ``results`` already carries PRICED
+        verdicts for shed batches; every other slot is filled here."""
+        import numpy as np
+
+        self._opened = True
+        n = len(reqs)
+        live = [i for i in range(n) if results[i] is None]
+        # -- dedup: one hashed-ledger probe for the whole batch -------
+        if live:
+            dup = self._tokens.contains_many(
+                [reqs[i][0] for i in live]
+            )
+            deduped = [i for k, i in enumerate(live) if dup[k]]
+            for i in deduped:
                 self.stats["deduped_batches"] += 1
+                results[i] = (
+                    STATUS_ACCEPTED,
+                    0.0,
+                    self._tokens.get(reqs[i][0]) or 0,
+                )
+            if deduped:
                 obs.counter(
                     "admission_deduped_total",
                     "retried submissions acknowledged via the token "
                     "ledger without re-admitting",
-                ).inc()
-                return STATUS_ACCEPTED, 0.0, self._token_jobs[token]
-            if self._closed:
+                ).inc(len(deduped))
+            live = [i for k, i in enumerate(live) if not dup[k]]
+        if self._closed:
+            for i in live:
                 self.stats["closed_rejects"] += 1
                 obs.counter(
                     "admission_rejected_total",
                     "submissions rejected (backpressure, quota, or "
                     "closed stream)",
                 ).inc(reason="closed")
-                return STATUS_CLOSED, 0.0, 0
-            # Check-and-reserve in one ledger critical section: the
-            # reservation is released below if backpressure then
-            # bounces the batch.
-            batch_counts = _TenantLedger.batch_counts(jobs)
-            over_quota = (
-                self._tenants.reserve(batch_counts, self.tenant_quotas)
-                if batch_counts
-                else None
+                results[i] = (STATUS_CLOSED, 0.0, 0)
+            return
+        if not live:
+            return
+        # -- quota + backpressure fixpoint ----------------------------
+        # Vector state for the candidates: batch sizes, the
+        # per-candidate × quota-tenant count matrix, and the tenants'
+        # pending tallies as of this tick. The scalar path evaluates
+        # candidates in order, each seeing its predecessors' accepted
+        # reservations/appends; the prefix-sum reproduces exactly
+        # that, and each rejection only ever SHRINKS later candidates'
+        # prefix sums — so knocking out the earliest failure and
+        # re-running converges in <= len(live) passes with the same
+        # verdicts the sequential walk would give.
+        sizes = np.array([len(reqs[i][1]) for i in live], dtype=np.int64)
+        counts = [_TenantLedger.batch_counts(reqs[i][1]) for i in live]
+        qt = sorted(self.tenant_quotas)
+        quota_vec = np.array(
+            [self.tenant_quotas[t] for t in qt], dtype=np.int64
+        )
+        cmat = np.array(
+            [[c.get(t, 0) for t in qt] for c in counts], dtype=np.int64
+        ) if qt else np.zeros((len(live), 0), dtype=np.int64)
+        pending0 = np.array(
+            self._tenants.pending_of(qt), dtype=np.int64
+        ) if qt else np.zeros(0, dtype=np.int64)
+        depth0 = len(self._pending)
+        mask = np.ones(len(live), dtype=bool)
+        while True:
+            sized = sizes * mask
+            before = depth0 + np.concatenate(
+                ([0], np.cumsum(sized)[:-1])
             )
-            if over_quota is not None:
+            prior = np.concatenate(
+                (
+                    np.zeros((1, len(qt)), dtype=np.int64),
+                    np.cumsum(cmat * mask[:, None], axis=0)[:-1],
+                ),
+                axis=0,
+            ) if qt else np.zeros((len(live), 0), dtype=np.int64)
+            # Quota first (scalar order: quota precedes backpressure).
+            quota_fail = mask & (
+                ((pending0 + prior + cmat > quota_vec) & (cmat > 0)).any(
+                    axis=1
+                )
+                if qt
+                else np.zeros(len(live), dtype=bool)
+            )
+            cap_fail = (
+                mask
+                & ~quota_fail
+                & (sizes > 0)
+                & (before > 0)
+                & (before + sizes > self.capacity)
+            )
+            fails = np.nonzero(quota_fail | cap_fail)[0]
+            if not len(fails):
+                break
+            k = int(fails[0])
+            i = live[k]
+            token, jobs, _close = reqs[i]
+            mask[k] = False
+            if quota_fail[k]:
+                # Name the over-quota tenant the way the scalar walk
+                # would: first tenant in the batch's iteration order
+                # that the reservation would push past its quota.
+                tally = pending0 + prior[k]
+                over = next(
+                    (
+                        t
+                        for t in counts[k]
+                        if t in self.tenant_quotas
+                        and tally[qt.index(t)] + counts[k][t]
+                        > self.tenant_quotas[t]
+                    ),
+                    next(iter(counts[k]), ""),
+                )
                 self.stats["quota_rejects"] += 1
                 obs.counter(
                     "admission_rejected_total",
@@ -381,24 +828,13 @@ class AdmissionQueue:
                     "closed stream)",
                 ).inc(reason="quota")
                 self._record_event_locked(
-                    "rejected", token, len(jobs), len(self._pending),
-                    reason="quota", tenant=over_quota,
+                    "rejected", token, len(jobs), int(before[k]),
+                    reason="quota", tenant=over,
                 )
-                return STATUS_QUOTA, 0.0, 0
-            depth = len(self._pending)
-            # The bound is on BACKLOG, not on a single batch: an empty
-            # queue admits any batch (otherwise a batch larger than
-            # the capacity could never be admitted and its submitter
-            # would retry the same token forever — a livelock, since
-            # rejection never shrinks the batch).
-            if jobs and depth and depth + len(jobs) > self.capacity:
-                if batch_counts:
-                    self._tenants.release(batch_counts)
+                results[i] = (STATUS_QUOTA, 0.0, 0)
+            else:
+                depth = int(before[k])
                 overflow = depth + len(jobs) - self.capacity
-                # Depth-derived delay: how full the queue already is,
-                # plus how far over this batch would push it — a deeper
-                # backlog earns a longer wait, so a thundering herd
-                # spreads out instead of hammering a full queue.
                 retry_after = self.retry_delay_s * (
                     depth / self.capacity + overflow / max(len(jobs), 1)
                 )
@@ -412,24 +848,167 @@ class AdmissionQueue:
                     "rejected", token, len(jobs), depth,
                     retry_after_s=round(retry_after, 3),
                 )
-                return STATUS_RETRY_AFTER, retry_after, 0
+                results[i] = (STATUS_RETRY_AFTER, retry_after, 0)
+        # -- commit the accepted candidates in one pass ---------------
+        accepted = [live[k] for k in np.nonzero(mask)[0]]
+        if not accepted:
+            return
+        merged: Dict[str, int] = {}
+        for k in np.nonzero(mask)[0]:
+            for tenant, count in counts[k].items():
+                merged[tenant] = merged.get(tenant, 0) + count
+        if merged and self._tenants.reserve(
+            merged, self.tenant_quotas
+        ) is not None:
+            # The shared ledger moved under us (a sibling shard raced a
+            # reservation between our snapshot and the commit): replay
+            # the accepted candidates through the scalar reference path
+            # — rare, and correctness beats the vector win here.
+            for i in accepted:
+                token, jobs, close = reqs[i]
+                results[i] = self._submit_locked(token, jobs, close, now)
+            return
+        for i in accepted:
+            token, jobs, _close = reqs[i]
             for job in jobs:
                 self._pending.append((token, job, now, self._seq))
                 self._seq += 1
             if token:
-                self._token_jobs[token] = len(jobs)
+                self._tokens.add(token, len(jobs))
             self.stats["accepted_batches"] += 1
             self.stats["accepted_jobs"] += len(jobs)
-            obs.counter(
-                "admission_accepted_total", "submission batches accepted"
-            ).inc()
-            self._set_depth_gauge_locked()
             self._record_event_locked(
                 "accepted", token, len(jobs), len(self._pending)
             )
-            if close:
-                self._close_locked()
-            return STATUS_ACCEPTED, 0.0, len(jobs)
+            results[i] = (STATUS_ACCEPTED, 0.0, len(jobs))
+        obs.counter(
+            "admission_accepted_total", "submission batches accepted"
+        ).inc(len(accepted))
+        self._set_depth_gauge_locked()
+
+    def _price_many(self, reqs, results, now) -> None:
+        """Lane-amortized pricing for the fresh, unpriced batches in
+        ``reqs``: ONE ScenarioBatch dispatch with a masked overlay lane
+        per burst (pricer.price_batch) instead of one 2-scenario solve
+        each. Runs OUTSIDE the queue lock; fills ``results`` slots for
+        shed batches (STATUS_PRICED) and leaves the rest None for the
+        vectorized admission pass."""
+        fresh = []
+        with self._lock:
+            self._opened = True
+            for i, (token, jobs, _close) in enumerate(reqs):
+                if not jobs:
+                    continue
+                if (token and token in self._tokens) or self._closed:
+                    continue  # dedup / closed semantics own this one
+                if token and token in self._priced_tokens:
+                    if self._priced_tokens[token] is not None:
+                        results[i] = (self._priced_tokens[token], 0.0, 0)
+                    continue
+                fresh.append(i)
+        if not fresh:
+            return
+        price_batch = getattr(self._pricer, "price_batch", None)
+        if price_batch is not None:
+            decisions = price_batch([reqs[i][1] for i in fresh])
+        else:
+            decisions = [self._pricer.price(reqs[i][1]) for i in fresh]
+        priced_rejects = 0
+        with self._lock:
+            for i, decision in zip(fresh, decisions):
+                token = reqs[i][0]
+                if token and token in self._priced_tokens:
+                    # Raced a concurrent scalar submit: first verdict
+                    # wins, exactly like _maybe_price.
+                    if self._priced_tokens[token] is not None:
+                        results[i] = (self._priced_tokens[token], 0.0, 0)
+                    continue
+                stat = {
+                    "accept": "priced_accepts",
+                    "reject": "priced_rejects",
+                    "fallback": "priced_fallbacks",
+                }.get(decision.action, "priced_fallbacks")
+                verdict = (
+                    STATUS_PRICED if decision.action == "reject" else None
+                )
+                self.stats[stat] += 1
+                if token:
+                    self._priced_tokens[token] = verdict
+                    while len(self._priced_tokens) > 1024:
+                        self._priced_tokens.popitem(last=False)
+                self._record_event_locked(
+                    "priced", token, len(reqs[i][1]), len(self._pending),
+                    **decision.as_record(),
+                )
+                if verdict is not None:
+                    priced_rejects += 1
+                    results[i] = (verdict, 0.0, 0)
+        if priced_rejects:
+            obs.counter(
+                "admission_rejected_total",
+                "submissions rejected (backpressure, quota, pricing, "
+                "or closed stream)",
+            ).inc(priced_rejects, reason="priced")
+
+    def _submit_grouped(
+        self, token: str, jobs: Sequence[Job], now: float
+    ) -> Tuple[str, float, int]:
+        """Group commit: stage this submission; the first thread to
+        find no leader running becomes the leader and commits every
+        staged entry (its own included, plus any that pile up while it
+        works) through one vectorized :meth:`submit_many` pass per
+        convoy. Followers block on their entry's event and return the
+        leader's verdict — N concurrent handler threads pay one lock
+        walk and one lane-amortized pricing dispatch."""
+        entry = [token, list(jobs), now, threading.Event(), None, None]
+        with self._group_lock:
+            self._group_staged.append(entry)
+            if self._group_leader:
+                leader = False
+            else:
+                self._group_leader = True
+                leader = True
+        if not leader:
+            entry[3].wait()
+            if entry[5] is not None:
+                raise entry[5]
+            return entry[4]
+        try:
+            while True:
+                with self._group_lock:
+                    convoy = self._group_staged
+                    self._group_staged = []
+                    if not convoy:
+                        self._group_leader = False
+                        break
+                try:
+                    outs = self.submit_many(
+                        [(e[0], e[1]) for e in convoy],
+                        now=min(e[2] for e in convoy),
+                    )
+                    for e, out in zip(convoy, outs):
+                        e[4] = out
+                        e[3].set()
+                except BaseException as exc:
+                    for e in convoy:
+                        if e[4] is None:
+                            e[5] = exc
+                        e[3].set()
+                    raise
+        except BaseException:
+            with self._group_lock:
+                self._group_leader = False
+                leftover = self._group_staged
+                self._group_staged = []
+            for e in leftover:
+                e[5] = e[5] or RuntimeError(
+                    "group-commit leader died before this entry"
+                )
+                e[3].set()
+            raise
+        if entry[5] is not None:
+            raise entry[5]
+        return entry[4]
 
     def _maybe_price(self, token: str, jobs: Sequence[Job]):
         """Marginal-price pass for one fresh batch, OUTSIDE the queue
@@ -442,7 +1021,7 @@ class AdmissionQueue:
         still admits exactly one."""
         with self._lock:
             self._opened = True
-            if (token and token in self._token_jobs) or self._closed:
+            if (token and token in self._tokens) or self._closed:
                 return None  # dedup / closed-stream semantics own this
             if token and token in self._priced_tokens:
                 # A backpressure-bounced retry of an already-priced
@@ -553,19 +1132,35 @@ class AdmissionQueue:
                     ),
                 )
                 self._pending = deque(ordered)
-            out = []
             latency = obs.histogram(
                 "admission_queue_latency_seconds",
                 "time a job waited in the admission queue before the "
                 "round loop admitted it",
             )
-            while self._pending and len(out) < budget:
-                token, job, enqueued, _seq = self._pending.popleft()
-                tenant = str(getattr(job, "tenant", "") or "")
-                if tenant:
-                    self._tenants.dec(tenant)
-                out.append((token, job, enqueued))
-                latency.observe(max(now - enqueued, 0.0))
+            if budget >= len(self._pending):
+                # Full drain: take the whole deque in one move instead
+                # of 4k popleft calls on a line-rate tick.
+                entries = list(self._pending)
+                self._pending.clear()
+            else:
+                entries = [
+                    self._pending.popleft()
+                    for _ in range(max(0, int(budget)))
+                    if self._pending
+                ]
+            out = [
+                (token, job, enqueued)
+                for token, job, enqueued, _seq in entries
+            ]
+            if entries:
+                released = _TenantLedger.batch_counts(
+                    [e[1] for e in entries]
+                )
+                if released:
+                    self._tenants.release(released)
+                latency.observe_many(
+                    [max(now - e[2], 0.0) for e in entries]
+                )
             if out:
                 self.stats["admitted_jobs"] += len(out)
                 obs.counter(
@@ -635,10 +1230,12 @@ class AdmissionQueue:
                     for token, job, enqueued, seq in self._pending
                 ],
                 "seq": self._seq,
-                "token_jobs": OrderedDict(self._token_jobs),
                 "closed": self._closed,
                 "opened": self._opened,
                 "stats": dict(self.stats),
+                # token_jobs (the legacy key) + token_ranges +
+                # ledger_evictions: the bounded ledger's snapshot.
+                **self._tokens.state_dict(),
             }
         if include_tenants:
             state["tenant_pending"] = self._tenants.state_dict()
@@ -662,9 +1259,10 @@ class AdmissionQueue:
                 )
             )
             self._seq = int(state.get("seq", 0))
-            self._token_jobs = OrderedDict(
-                (str(t), int(n))
-                for t, n in (state.get("token_jobs") or {}).items()
+            self._tokens.restore(
+                state.get("token_jobs"),
+                state.get("token_ranges"),
+                state.get("ledger_evictions"),
             )
             self._closed = bool(state.get("closed"))
             self._opened = bool(state.get("opened"))
@@ -686,7 +1284,7 @@ class AdmissionQueue:
         token = str(token)
         with self._lock:
             self._opened = True
-            if token and token in self._token_jobs:
+            if token and token in self._tokens:
                 if close:
                     self._close_locked(token)
                 return 0  # checkpoint (or a duplicate entry) had it
@@ -695,7 +1293,7 @@ class AdmissionQueue:
                 self._pending.append((token, job, now, self._seq))
                 self._seq += 1
             if token:
-                self._token_jobs[token] = len(jobs)
+                self._tokens.add(token, len(jobs))
             counts = _TenantLedger.batch_counts(jobs)
             self._set_depth_gauge_locked()
             if close:
@@ -750,7 +1348,9 @@ class AdmissionQueue:
                 "capacity": self.capacity,
                 "depth": len(self._pending),
                 "closed": self._closed,
-                "tokens": len(self._token_jobs),
+                "tokens": self._tokens.size(),
+                "ledger_window": len(self._tokens._recent),
+                "ledger_evictions": dict(self._tokens.evictions),
                 **dict(self.stats),
             }
 
@@ -787,6 +1387,8 @@ class ShardedAdmissionQueue:
         priority_aware: bool = False,
         tenant_quotas: Optional[dict] = None,
         pricer=None,
+        ledger_window: Optional[int] = None,
+        group_commit: bool = False,
     ):
         self.num_shards = max(1, int(num_shards))
         self.capacity = max(self.num_shards, int(capacity))
@@ -812,6 +1414,8 @@ class ShardedAdmissionQueue:
                 # fleet-wide quantity, whichever shard a token hashes
                 # to.
                 pricer=pricer,
+                ledger_window=ledger_window,
+                group_commit=group_commit,
             )
             for i in range(self.num_shards)
         ]
@@ -824,12 +1428,13 @@ class ShardedAdmissionQueue:
             "admission_queue_shards", "admission front-door shard count"
         ).set(float(self.num_shards))
 
-    def _shard_of(self, token: str) -> AdmissionQueue:
+    def _shard_index(self, token: str) -> int:
         import zlib
 
-        return self.shards[
-            zlib.crc32(str(token).encode("utf-8")) % self.num_shards
-        ]
+        return zlib.crc32(str(token).encode("utf-8")) % self.num_shards
+
+    def _shard_of(self, token: str) -> AdmissionQueue:
+        return self.shards[self._shard_index(token)]
 
     def _set_depth_gauge(self) -> None:
         obs.gauge(
@@ -898,6 +1503,47 @@ class ShardedAdmissionQueue:
             self.close(token)
         self._set_depth_gauge()
         return status, retry_after, admitted
+
+    def submit_many(
+        self,
+        requests: Sequence[tuple],
+        now: Optional[float] = None,
+    ) -> List[Tuple[str, float, int]]:
+        """Vectorized submit across the fleet: requests partition by
+        token hash, one vector pass per shard. A batch the vector pass
+        bounced with RETRY_AFTER gets the same second chance the
+        scalar path gives — rebalance room out of its routing shard,
+        then one scalar re-offer."""
+        reqs = []
+        for request in requests:
+            token, jobs = str(request[0]), list(request[1])
+            close = bool(request[2]) if len(request) > 2 else False
+            reqs.append((token, jobs, close))
+        results: List[Optional[Tuple[str, float, int]]] = [None] * len(reqs)
+        by_shard: Dict[int, List[int]] = {}
+        for i, (token, _jobs, _close) in enumerate(reqs):
+            by_shard.setdefault(self._shard_index(token), []).append(i)
+        for shard_i, positions in by_shard.items():
+            shard = self.shards[shard_i]
+            outs = shard.submit_many(
+                [reqs[i] for i in positions], now=now
+            )
+            for i, out in zip(positions, outs):
+                results[i] = out
+        for i, out in enumerate(results):
+            if out is None or out[0] != STATUS_RETRY_AFTER:
+                continue
+            token, jobs, close = reqs[i]
+            shard = self.shards[self._shard_index(token)]
+            if self._make_room(shard, len(jobs)):
+                results[i] = shard.submit(
+                    token, jobs, close=close, now=now
+                )
+        for i, out in enumerate(results):
+            if reqs[i][2] and out is not None and out[0] == STATUS_ACCEPTED:
+                self.close(reqs[i][0])
+        self._set_depth_gauge()
+        return results  # type: ignore[return-value]
 
     def _make_room(self, shard: AdmissionQueue, incoming: int) -> int:
         """Spill backlog out of ``shard`` until ``incoming`` more jobs
@@ -1073,14 +1719,20 @@ def build_queue(
     priority_aware: Optional[bool] = None,
     tenant_quotas: Optional[dict] = None,
     pricer=None,
+    group_commit: Optional[bool] = None,
 ):
     """Front-door factory: one queue, or a sharded one when the planner
     is cell-decomposed. Env knobs fill unset policy arguments:
     ``SHOCKWAVE_ADMISSION_PRIORITY=1`` turns on priority-aware drain,
     ``SHOCKWAVE_ADMISSION_QUOTAS="teamA=32,teamB=8"`` sets per-tenant
-    pending quotas."""
-    import os
-
+    pending quotas, ``SHOCKWAVE_ADMISSION_GROUP_COMMIT=1`` convoys
+    concurrent handler threads through the vectorized group-commit
+    path, and ``SHOCKWAVE_LEDGER_WINDOW`` sizes the bounded token
+    ledger's recent window."""
+    if group_commit is None:
+        group_commit = os.environ.get(
+            "SHOCKWAVE_ADMISSION_GROUP_COMMIT", ""
+        ).strip() in ("1", "true", "yes")
     if priority_aware is None:
         priority_aware = os.environ.get(
             "SHOCKWAVE_ADMISSION_PRIORITY", ""
@@ -1102,6 +1754,7 @@ def build_queue(
             priority_aware=priority_aware,
             tenant_quotas=tenant_quotas,
             pricer=pricer,
+            group_commit=group_commit,
         )
     return AdmissionQueue(
         capacity=capacity,
@@ -1110,6 +1763,7 @@ def build_queue(
         priority_aware=priority_aware,
         tenant_quotas=tenant_quotas,
         pricer=pricer,
+        group_commit=group_commit,
     )
 
 

@@ -23,8 +23,10 @@ resubmitting the same token, and sends the end-of-stream close.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 import uuid
+from collections import deque
 from typing import Callable, List, Optional, Sequence
 
 import grpc
@@ -85,11 +87,48 @@ class SubmitterClient:
         # never collide in the scheduler's ledger.
         self.client_id = client_id or uuid.uuid4().hex[:12]
         self._seq = 0
+        # ONE persistent channel per client, created lazily and reused
+        # across every submit (channel setup used to be paid per RPC
+        # attempt — at line rate that's a TCP+HTTP/2 handshake per
+        # batch). Reset on transport errors and retarget; gRPC channels
+        # are thread-safe, the lock only guards create/teardown.
+        self._channel_lock = threading.Lock()
+        self._channel = None
+        self._stubs = None
 
     def next_token(self) -> str:
-        token = f"{self.client_id}-{self._seq:06d}"
-        self._seq += 1
-        return token
+        with self._channel_lock:
+            seq = self._seq
+            self._seq += 1
+        return f"{self.client_id}-{seq:06d}"
+
+    def _get_stubs(self):
+        with self._channel_lock:
+            if self._stubs is None:
+                self._channel = grpc.insecure_channel(self._addr)
+                self._stubs = make_stubs(
+                    self._channel, "AdmissionToScheduler"
+                )
+            return self._stubs
+
+    def _reset_channel(self) -> None:
+        """Tear down the persistent channel (transport error or a
+        failover retarget); the next submit rebuilds it."""
+        with self._channel_lock:
+            channel, self._channel, self._stubs = self._channel, None, None
+        if channel is not None:
+            try:
+                channel.close()
+            except Exception as e:
+                # Best-effort teardown: the channel is already detached
+                # from the client, so a close() failure cannot wedge a
+                # later submit — but it should not vanish either.
+                LOG.warning("channel close failed: %s", e)
+
+    def close(self) -> None:
+        """Release the persistent channel. The client stays usable —
+        a later submit reopens it."""
+        self._reset_channel()
 
     def retarget(self, sched_ip_addr: str, sched_port: int) -> None:
         """Follow a scheduler failover: point subsequent submits at the
@@ -98,7 +137,9 @@ class SubmitterClient:
         token namespace is unchanged — a batch retried across the flip
         re-sends the same token and the successor's restored ledger
         deduplicates it."""
-        self._addr = f"{sched_ip_addr}:{sched_port}"
+        with self._channel_lock:
+            self._addr = f"{sched_ip_addr}:{sched_port}"
+        self._reset_channel()
 
     def submit(
         self,
@@ -113,6 +154,45 @@ class SubmitterClient:
         queue_depth); raises :class:`SubmissionRejected` on INVALID/
         ERROR statuses."""
         token = token if token is not None else self.next_token()
+        request, batch_ctx = self._build_request(token, jobs, close)
+
+        def attempt(timeout):
+            # Pre-send faults: the request never reaches the wire.
+            faults.check_rpc(
+                "SubmitJobs", kinds=("rpc_error", "rpc_delay")
+            )
+            try:
+                response = self._get_stubs().SubmitJobs(
+                    request, timeout=timeout
+                )
+            except grpc.RpcError:
+                # The persistent channel may be the casualty (server
+                # restart, failover): rebuild it before the retry
+                # policy re-offers the same token.
+                self._reset_channel()
+                raise
+            # Post-send faults: the scheduler processed the batch but
+            # the response is lost — the retry re-sends the SAME token
+            # and must be deduplicated server-side.
+            faults.check_rpc("SubmitJobs", kinds=("rpc_drop",))
+            faults.note_rpc_success("SubmitJobs")
+            return response
+
+        with obs.span(
+            "submit_jobs", cat="rpc", pid="submitter", tid="rpc",
+            args={"token": token, "jobs": len(request.jobs),
+                  **propagate.ctx_args(batch_ctx)},
+        ):
+            response = call_with_retry(
+                attempt, self._retry, method="SubmitJobs"
+            )
+        return self._check_response(response, len(jobs))
+
+    def _build_request(self, token: str, jobs: Sequence, close: bool):
+        """SubmitJobsRequest + its batch trace context for one batch
+        (built ONCE per batch — transport retries and pipelined
+        re-offers re-send the same request bytes with the same
+        token)."""
         spec_dicts = [
             dict(j) if isinstance(j, dict) else job_to_spec_dict(j)
             for j in jobs
@@ -147,30 +227,10 @@ class SubmitterClient:
             close=close,
             trace_context=propagate.ctx_wire(batch_ctx),
         )
+        return request, batch_ctx
 
-        def attempt(timeout):
-            # Pre-send faults: the request never reaches the wire.
-            faults.check_rpc(
-                "SubmitJobs", kinds=("rpc_error", "rpc_delay")
-            )
-            with grpc.insecure_channel(self._addr) as channel:
-                stubs = make_stubs(channel, "AdmissionToScheduler")
-                response = stubs.SubmitJobs(request, timeout=timeout)
-            # Post-send faults: the scheduler processed the batch but
-            # the response is lost — the retry re-sends the SAME token
-            # and must be deduplicated server-side.
-            faults.check_rpc("SubmitJobs", kinds=("rpc_drop",))
-            faults.note_rpc_success("SubmitJobs")
-            return response
-
-        with obs.span(
-            "submit_jobs", cat="rpc", pid="submitter", tid="rpc",
-            args={"token": token, "jobs": len(spec_dicts),
-                  **propagate.ctx_args(batch_ctx)},
-        ):
-            response = call_with_retry(
-                attempt, self._retry, method="SubmitJobs"
-            )
+    @staticmethod
+    def _check_response(response, num_jobs: int):
         if response.status in ("INVALID", "ERROR"):
             raise SubmissionRejected(response.status, response.error)
         if response.status == "QUOTA":
@@ -180,10 +240,10 @@ class SubmitterClient:
             raise SubmissionRejected(
                 "QUOTA",
                 response.error
-                or f"tenant over admission quota; batch of {len(jobs)} "
+                or f"tenant over admission quota; batch of {num_jobs} "
                 "not queued",
             )
-        if response.status == "CLOSED" and jobs:
+        if response.status == "CLOSED" and num_jobs:
             # The stream is closed and this batch was NOT admitted;
             # returning it as a normal response would silently drop the
             # jobs (a second submitter racing a close, or a late batch
@@ -191,7 +251,7 @@ class SubmitterClient:
             # CLOSED is just an idempotent re-close and stays benign.
             raise SubmissionRejected(
                 "CLOSED",
-                f"stream already closed; batch of {len(jobs)} not "
+                f"stream already closed; batch of {num_jobs} not "
                 "admitted",
             )
         return response
@@ -261,6 +321,130 @@ class SubmitterClient:
             # Even a failing submitter ends the stream — the round
             # loop must finish what was admitted, not idle forever on
             # a stream nobody will close.
+            if close:
+                try:
+                    self.close_stream()
+                except Exception:
+                    LOG.warning(
+                        "end-of-stream close failed", exc_info=True
+                    )
+        return tokens
+
+    def submit_pipelined(
+        self,
+        jobs: Sequence,
+        batch_size: int = 8,
+        window: int = 8,
+        close: bool = True,
+        max_backpressure_s: float = 300.0,
+        sleep=time.sleep,
+    ) -> List[str]:
+        """:meth:`submit_stream` at line rate: keep up to ``window``
+        SubmitJobs RPCs in flight on the persistent channel instead of
+        one serial request/response per batch, so client throughput is
+        bounded by server-side admission, not by per-batch round trips.
+        Responses resolve in submission order. Any batch the fast path
+        cannot finish — a transport error, an injected fault, or a
+        RETRY_AFTER bounce — falls back to the serial :meth:`submit`
+        path with the SAME token, so retries stay exactly-once through
+        the ledger and backpressure is honored with the usual sleep
+        loop. Returns the tokens used (one per batch)."""
+        tokens: List[str] = []
+        batch_size = max(1, int(batch_size))
+        window = max(1, int(window))
+        # (token, batch, future) in flight, submission order.
+        inflight: deque = deque()
+
+        def resolve(entry) -> None:
+            token, batch, future = entry
+            response = None
+            if future is not None:
+                try:
+                    response = future.result()
+                    # Post-receive faults: response lost after the
+                    # server processed the batch — the serial fallback
+                    # re-offers the same token and dedups.
+                    faults.check_rpc("SubmitJobs", kinds=("rpc_drop",))
+                    faults.note_rpc_success("SubmitJobs")
+                except (grpc.RpcError, faults.InjectedRpcError):
+                    self._reset_channel()
+                    response = None
+            if response is not None and response.status not in (
+                "RETRY_AFTER",
+            ):
+                self._check_response(response, len(batch))
+                return
+            # Slow path: serial submit with the SAME token (transport
+            # retries inside; backpressure honored here).
+            waited = 0.0
+            while True:
+                response = self.submit(batch, token=token)
+                if response.status != "RETRY_AFTER":
+                    return
+                delay = max(float(response.retry_after_s), 0.05)
+                waited += delay
+                if waited > max_backpressure_s:
+                    raise TimeoutError(
+                        f"batch {token} backpressured for "
+                        f"{waited:.1f}s (> {max_backpressure_s}s); "
+                        "the scheduler is not draining its "
+                        "admission queue"
+                    )
+                obs.counter(
+                    "admission_client_backpressure_total",
+                    "RETRY_AFTER responses honored by the submitter",
+                ).inc()
+                sleep(delay)
+
+        try:
+            for batch in _tenant_batches(jobs, batch_size):
+                token = self.next_token()
+                tokens.append(token)
+                request, _ctx = self._build_request(token, batch, False)
+                try:
+                    # Pre-send faults: the request never reached the
+                    # wire — no future to wait on, straight to the
+                    # serial fallback (same token).
+                    faults.check_rpc(
+                        "SubmitJobs", kinds=("rpc_error", "rpc_delay")
+                    )
+                    future = self._get_stubs().SubmitJobs.future(
+                        request, timeout=self._retry.call_timeout_s
+                    )
+                except (grpc.RpcError, faults.InjectedRpcError):
+                    self._reset_channel()
+                    future = None
+                inflight.append((token, batch, future))
+                obs.counter(
+                    "admission_client_pipelined_total",
+                    "SubmitJobs batches issued through the pipelined "
+                    "in-flight window",
+                ).inc()
+                while len(inflight) >= window:
+                    try:
+                        resolve(inflight.popleft())
+                    except SubmissionRejected as e:
+                        if e.status != "QUOTA":
+                            raise
+                        LOG.warning("batch shed: %s", e)
+                        obs.counter(
+                            "admission_client_quota_shed_total",
+                            "batches shed by the submitter on a QUOTA "
+                            "rejection",
+                        ).inc()
+            while inflight:
+                try:
+                    resolve(inflight.popleft())
+                except SubmissionRejected as e:
+                    if e.status != "QUOTA":
+                        raise
+                    LOG.warning("batch shed: %s", e)
+                    obs.counter(
+                        "admission_client_quota_shed_total",
+                        "batches shed by the submitter on a QUOTA "
+                        "rejection",
+                    ).inc()
+        finally:
             if close:
                 try:
                     self.close_stream()

@@ -338,6 +338,12 @@ def align_rows(prev_ids, prev_values, new_ids, fill: float = 0.0):
     way."""
     import numpy as np
 
+    if len(prev_ids) == len(new_ids) and all(
+        a is b or a == b for a, b in zip(prev_ids, new_ids)
+    ):
+        # No churn (the common steady-state tick between arrivals):
+        # identity alignment, skip the index build + per-row lookups.
+        return np.asarray(prev_values, dtype=np.float64).copy()
     index = {j: i for i, j in enumerate(prev_ids)}
     out = np.full(len(new_ids), float(fill), dtype=np.float64)
     for i, job in enumerate(new_ids):

@@ -349,3 +349,284 @@ class AdmissionPricer:
             welfare_delta=delta, burst_welfare=burst_welfare,
             solve_s=solve_s,
         )
+
+    # -- lane-amortized batch pricing -----------------------------------
+    def price_batch(
+        self, bursts: Sequence[Sequence], audit: bool = False
+    ) -> List[PricingDecision]:
+        """Price N queued bursts as lanes of ONE ScenarioBatch dispatch:
+        lane 0 is the live market alone, lane k a masked overlay that
+        admits burst k on top of it — one chunked vmap instead of N
+        sequential 2-scenario solves, with identical per-burst
+        accept/reject semantics (each burst is judged against the SAME
+        no-burst base it would see priced alone, under its own
+        sequential normalization). The wall-clock budget covers the
+        whole dispatch; an overrun abstains every lane and feeds the
+        same circuit breaker :meth:`price` uses. ``audit=True`` stores
+        an ``audit_lanes`` report (every lane re-solved unbatched,
+        compared bitwise) on ``self.last_batch_audit``."""
+        t0 = self._clock()
+        bursts = [list(jobs) for jobs in bursts]
+        if not bursts:
+            return []
+        if self._consecutive_overruns >= _CIRCUIT_OPEN_AFTER:
+            self._open_skips += 1
+            if self._open_skips % _CIRCUIT_PROBE_EVERY != 0:
+                decisions = [
+                    PricingDecision(
+                        action="fallback", reason="circuit_open"
+                    )
+                    for _ in bursts
+                ]
+                self._count_batch(decisions, 0.0)
+                return decisions
+        try:
+            decisions = self._price_batch_inner(bursts, t0, audit)
+        except Exception as e:
+            logger.warning(
+                "lane-amortized admission pricing failed (%s: %s); "
+                "falling back to quota-only admission",
+                type(e).__name__,
+                e,
+            )
+            solve_s = self._clock() - t0
+            decisions = [
+                PricingDecision(
+                    action="fallback",
+                    reason=f"error:{type(e).__name__}",
+                    solve_s=solve_s,
+                )
+                for _ in bursts
+            ]
+        if any(d.reason == "budget_exceeded" for d in decisions):
+            # One dispatch, one overrun — however many lanes rode it.
+            self._consecutive_overruns += 1
+        elif any(d.action in ("accept", "reject") for d in decisions):
+            self._consecutive_overruns = 0
+            self._open_skips = 0
+        self._count_batch(decisions, decisions[0].solve_s)
+        return decisions
+
+    def _count_batch(
+        self, decisions: List[PricingDecision], solve_s: float
+    ) -> None:
+        counter = obs.counter(
+            "admission_priced_total",
+            "submission batches priced by the marginal-welfare "
+            "admission pricer",
+        )
+        for decision in decisions:
+            counter.inc(decision=decision.action)
+        obs.counter(
+            "admission_pricing_lanes_total",
+            "burst lanes priced through lane-amortized batch dispatches",
+        ).inc(len(decisions))
+        obs.histogram(
+            "admission_pricing_solve_seconds",
+            "wall-clock of one 2-scenario marginal-price solve",
+        ).observe(solve_s)
+
+    def _price_batch_inner(
+        self, bursts: List[List], t0: float, audit: bool
+    ) -> List[PricingDecision]:
+        from shockwave_tpu.whatif.scenario import (
+            Scenario,
+            ScenarioBatch,
+            audit_lanes,
+            solve_scenarios,
+        )
+        from shockwave_tpu.whatif.seed import base_problem_from_state
+
+        def all_fallback(reason: str) -> List[PricingDecision]:
+            solve_s = self._clock() - t0
+            return [
+                PricingDecision(
+                    action="fallback", reason=reason, solve_s=solve_s
+                )
+                if jobs
+                else PricingDecision(
+                    action="fallback", reason="empty_batch",
+                    solve_s=solve_s,
+                )
+                for jobs in bursts
+            ]
+
+        live = [k for k, jobs in enumerate(bursts) if jobs]
+        if not live:
+            return all_fallback("empty_batch")
+        state = self._provider()
+        if state is None:
+            return all_fallback("no_planner_state")
+        if isinstance(state, dict) and isinstance(
+            state.get("problem"), EGProblem
+        ):
+            problem = state["problem"]
+            s0 = state.get("s0")
+        else:
+            try:
+                problem, _keys, s0 = base_problem_from_state(state)
+            except ValueError:
+                return all_fallback("empty_market")
+        J = problem.num_jobs
+        flat: List = []
+        spans = []  # k -> (row_lo, row_hi) of burst k's rows
+        for k in live:
+            spans.append((J + len(flat), J + len(flat) + len(bursts[k])))
+            flat.extend(bursts[k])
+        B = len(flat)
+        augmented = burst_problem(problem, flat)
+        if s0 is not None and len(s0) == J:
+            from shockwave_tpu.solver.eg_pdhg import _default_s0
+
+            s0_aug = np.concatenate(
+                [np.asarray(s0, np.float64), _default_s0(augmented)[J:]]
+            )
+        else:
+            s0_aug = None
+        incumbent_rows = np.concatenate([np.ones(J), np.zeros(B)])
+        scenarios = [
+            Scenario(name="without_burst", job_mask=incumbent_rows)
+        ]
+        for idx, (lo, hi) in enumerate(spans):
+            mask = incumbent_rows.copy()
+            mask[lo:hi] = 1.0
+            scenarios.append(
+                Scenario(name=f"burst_{live[idx]:03d}", job_mask=mask)
+            )
+        batch = ScenarioBatch(augmented, scenarios, s0=s0_aug)
+        s_list, _, _ = solve_scenarios(batch, max_cycles=self.max_cycles)
+        if audit:
+            # Bit-exactness contract: every lane of the batched dispatch
+            # re-solved standalone and compared bitwise (f32), the same
+            # audit the what-if plane ships with.
+            self.last_batch_audit = audit_lanes(
+                batch,
+                s_list,
+                indices=tuple(range(len(scenarios))),
+                max_cycles=self.max_cycles,
+            )
+        solve_s = self._clock() - t0
+        decisions: List[PricingDecision] = [
+            PricingDecision(
+                action="fallback", reason="empty_batch", solve_s=solve_s
+            )
+            for _ in bursts
+        ]
+        over_budget = solve_s > self.budget_s
+        for idx, k in enumerate(live):
+            lo, hi = spans[idx]
+            burst_rows = np.zeros(J + B)
+            burst_rows[lo:hi] = 1.0
+            # Each burst keeps the normalization it would get priced
+            # ALONE ((J + B_k) x window): the lane answers the same
+            # question the sequential 2-scenario solve answers, just
+            # amortized.
+            norm = float(J + (hi - lo)) * float(problem.future_rounds)
+            w_with = _welfare(
+                augmented, s_list[idx + 1], incumbent_rows, norm
+            )
+            w_without = _welfare(
+                augmented, s_list[0], incumbent_rows, norm
+            )
+            burst_welfare = _welfare(
+                augmented, s_list[idx + 1], burst_rows, norm
+            )
+            delta = w_with - w_without
+            if over_budget:
+                decisions[k] = PricingDecision(
+                    action="fallback", reason="budget_exceeded",
+                    welfare_delta=delta, burst_welfare=burst_welfare,
+                    solve_s=solve_s,
+                )
+            elif delta < -self.threshold:
+                decisions[k] = PricingDecision(
+                    action="reject", reason="negative_externality",
+                    welfare_delta=delta, burst_welfare=burst_welfare,
+                    solve_s=solve_s,
+                )
+            else:
+                decisions[k] = PricingDecision(
+                    action="accept", reason="priced",
+                    welfare_delta=delta, burst_welfare=burst_welfare,
+                    solve_s=solve_s,
+                )
+        return decisions
+
+
+class PricingCollector:
+    """Convoying front for an :class:`AdmissionPricer`: concurrent
+    ``price()`` calls (RPC handler threads racing the same drain tick)
+    stage their bursts and one leader prices the whole convoy through
+    ONE :meth:`AdmissionPricer.price_batch` dispatch; followers block
+    and collect their lane's decision. A lone caller pays exactly one
+    dispatch — no added latency when idle. Drop-in where a pricer is
+    expected (the admission queue only calls ``price``/``price_batch``).
+    """
+
+    def __init__(self, pricer: AdmissionPricer, max_lanes: int = 32):
+        import threading
+
+        self._pricer = pricer
+        self.max_lanes = max(1, int(max_lanes))
+        self._lock = threading.Lock()
+        self._staged: list = []
+        self._leader = False
+        self._threading = threading
+
+    def price_batch(self, bursts, audit=False):
+        return self._pricer.price_batch(bursts, audit=audit)
+
+    def __getattr__(self, name):
+        # Budget/threshold/circuit state reads pass through to the
+        # wrapped pricer.
+        return getattr(self._pricer, name)
+
+    def price(self, jobs: Sequence) -> PricingDecision:
+        entry = [list(jobs), self._threading.Event(), None, None]
+        with self._lock:
+            self._staged.append(entry)
+            if self._leader:
+                leader = False
+            else:
+                self._leader = True
+                leader = True
+        if not leader:
+            entry[1].wait()
+            if entry[3] is not None:
+                raise entry[3]
+            return entry[2]
+        try:
+            while True:
+                with self._lock:
+                    convoy = self._staged[: self.max_lanes]
+                    self._staged = self._staged[self.max_lanes:]
+                    if not convoy:
+                        self._leader = False
+                        break
+                try:
+                    decisions = self._pricer.price_batch(
+                        [e[0] for e in convoy]
+                    )
+                    for e, decision in zip(convoy, decisions):
+                        e[2] = decision
+                        e[1].set()
+                except BaseException as exc:
+                    for e in convoy:
+                        if e[2] is None:
+                            e[3] = exc
+                        e[1].set()
+                    raise
+        except BaseException:
+            with self._lock:
+                self._leader = False
+                leftover = self._staged
+                self._staged = []
+            for e in leftover:
+                e[3] = RuntimeError(
+                    "pricing convoy leader died before this entry"
+                )
+                e[1].set()
+            raise
+        if entry[3] is not None:
+            raise entry[3]
+        return entry[2]

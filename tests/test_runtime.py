@@ -807,3 +807,98 @@ def test_leader_sigkill_hot_standby_failover(tmp_path):
                 proc.wait(timeout=10)
             except Exception:
                 pass
+
+
+def test_ingest_tick_admits_mid_round(cluster, monkeypatch):
+    """With SHOCKWAVE_INGEST_TICK_S set, the ingest thread drains the
+    front door on its own cadence: a batch submitted mid-round enters
+    the job table before the next round boundary, and the tick counter
+    proves the thread (not the boundary drain) did the admitting."""
+    from shockwave_tpu import obs
+    from shockwave_tpu.runtime.rpc.submitter_client import SubmitterClient
+
+    monkeypatch.setenv("SHOCKWAVE_INGEST_TICK_S", "0.2")
+    obs.configure(metrics=True)
+    try:
+        sched, tmp_path = cluster
+        sched.expect_stream()
+        runner = threading.Thread(
+            target=sched.run, kwargs={"max_rounds": 30}
+        )
+        runner.start()
+        client = SubmitterClient("127.0.0.1", sched._port, client_id="ig")
+        client.submit([make_job(400)])
+        # Land the second batch squarely inside a running round: the
+        # 0.2s tick must admit it long before the 3s boundary.
+        time.sleep(1.0)
+        client.submit([make_job(400)])
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and len(sched._jobs) < 2:
+            time.sleep(0.05)
+        ticks = sum(
+            s["value"]
+            for s in obs.counter(
+                "ingest_ticks_total", ""
+            ).snapshot_series()
+        )
+        client.close_stream()
+        runner.join(timeout=120)
+        assert not runner.is_alive()
+        assert ticks >= 1, "ingest thread never admitted mid-round"
+        assert len(sched._job_completion_times) == 2
+        assert all(
+            t is not None for t in sched._job_completion_times.values()
+        )
+    finally:
+        sched._shutdown_requested.set()
+        obs.reset()
+
+
+def test_ingest_mid_round_arrivals_replay_exactly(tmp_path, monkeypatch):
+    """Acceptance for the event-driven ingest plane: mid-round
+    delta-admissions (streamed arrivals absorbed into the planner via
+    the delta-patched warm start) leave a flight-recorder log that
+    replays BIT-EXACTLY — the streaming path must not break replay
+    forensics."""
+    from shockwave_tpu import obs
+    from shockwave_tpu.obs import recorder as rec
+    from shockwave_tpu.runtime.rpc.submitter_client import SubmitterClient
+
+    monkeypatch.setenv("SHOCKWAVE_INGEST_TICK_S", "0.2")
+    log = str(tmp_path / "decisions.jsonl")
+    obs.configure_recorder(log)
+    sched = start_local_cluster(
+        "shockwave_tpu_pdhg", 2,
+        run_dir=str(tmp_path / "run"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        shockwave_config={
+            "num_gpus": 2,
+            "time_per_iteration": 3.0,
+            "future_rounds": 6,
+            "lambda": 5.0,
+            "k": 10.0,
+        },
+    )
+    try:
+        sched.expect_stream()
+        runner = threading.Thread(
+            target=sched.run, kwargs={"max_rounds": 30}
+        )
+        runner.start()
+        client = SubmitterClient("127.0.0.1", sched._port, client_id="rp")
+        client.submit([make_job(400)])
+        time.sleep(1.0)  # the second arrival is a mid-round delta
+        client.submit([make_job(400)])
+        client.close_stream()
+        runner.join(timeout=120)
+        assert not runner.is_alive()
+        assert len(sched._job_completion_times) == 2
+    finally:
+        sched.shutdown()
+        obs.get_recorder().close()
+    results = rec.replay_log(log)
+    assert results, "no plan records recorded"
+    assert all(not r["diff"] for r in results), [
+        r["round"] for r in results if r["diff"]
+    ]
+    obs.reset()

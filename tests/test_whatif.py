@@ -583,3 +583,164 @@ class TestCLI:
             "accept", "reject"
         )
         assert report["quota_only_decision"] == "accept"
+
+
+# ----------------------------------------------------------------------
+# Lane-amortized pricing: one ScenarioBatch dispatch prices N bursts.
+# ----------------------------------------------------------------------
+class TestLaneAmortizedPricing:
+    def _bursts(self):
+        return [
+            _burst(n=6, scale=2),  # heavy: negative externality
+            _burst(n=1, scale=1, duration=100.0),  # light
+            _burst(n=3, scale=2),
+        ]
+
+    def test_single_lane_batch_is_bit_identical_to_sequential(self):
+        """A one-burst dispatch prices the exact problem the sequential
+        path prices (same rows, same two lanes): delta and verdict must
+        match to the bit, not to a tolerance."""
+        problem = contended_problem(num_jobs=6, num_gpus=2)
+        for jobs in self._bursts():
+            lane = AdmissionPricer(
+                _prebuilt_provider(problem), threshold=0.0, budget_s=600.0
+            ).price_batch([jobs])[0]
+            alone = AdmissionPricer(
+                _prebuilt_provider(problem), threshold=0.0, budget_s=600.0
+            ).price(jobs)
+            assert lane.action == alone.action
+            assert lane.reason == alone.reason
+            assert lane.welfare_delta == alone.welfare_delta
+            assert lane.burst_welfare == alone.burst_welfare
+
+    def test_batch_matches_sequential_verdicts(self):
+        """Co-batched lanes ride a larger padded problem, so deltas
+        agree with the sequential path to solver tolerance rather than
+        bitwise — but the VERDICTS (sign of the externality against
+        the threshold) must match lane for lane."""
+        problem = contended_problem(num_jobs=6, num_gpus=2)
+        bursts = self._bursts()
+        batched = AdmissionPricer(
+            _prebuilt_provider(problem), threshold=0.0, budget_s=600.0
+        ).price_batch(bursts)
+        sequential = [
+            AdmissionPricer(
+                _prebuilt_provider(problem), threshold=0.0, budget_s=600.0
+            ).price(jobs)
+            for jobs in bursts
+        ]
+        assert [d.action for d in batched] == [
+            d.action for d in sequential
+        ]
+        assert [d.reason for d in batched] == [d.reason for d in sequential]
+        # On this saturated market every burst crowds incumbents out:
+        # both paths price a strictly negative externality.
+        assert all(d.welfare_delta < 0 for d in batched)
+        lenient = AdmissionPricer(
+            _prebuilt_provider(problem),
+            threshold=float("inf"),
+            budget_s=600.0,
+        ).price_batch(bursts)
+        assert [d.action for d in lenient] == ["accept"] * 3
+
+    def test_batch_audit_is_bit_identical(self):
+        """audit=True re-solves every lane standalone and compares the
+        f32 allocations bitwise — the what-if plane's exactness
+        contract, now holding for the pricing fast path too."""
+        problem = contended_problem(num_jobs=6, num_gpus=2)
+        pricer = AdmissionPricer(
+            _prebuilt_provider(problem), threshold=0.0, budget_s=600.0
+        )
+        pricer.price_batch(self._bursts(), audit=True)
+        report = pricer.last_batch_audit
+        assert report["audited"] == 4  # no-burst lane + 3 burst lanes
+        assert report["mismatched"] == []
+        assert report["bit_identical"] is True
+
+    def test_batch_budget_overrun_abstains_every_lane_once(self):
+        pricer = AdmissionPricer(
+            _prebuilt_provider(contended_problem()),
+            threshold=0.0,
+            budget_s=0.0,
+        )
+        decisions = pricer.price_batch(self._bursts())
+        assert all(d.action == "fallback" for d in decisions)
+        assert all(d.reason == "budget_exceeded" for d in decisions)
+        # Deltas still ride along (the solve DID happen) ...
+        assert all(d.welfare_delta is not None for d in decisions)
+        # ... and the whole dispatch feeds the breaker exactly once.
+        assert pricer._consecutive_overruns == 1
+
+    def test_batch_empty_and_error_lanes(self):
+        pricer = AdmissionPricer(
+            _prebuilt_provider(contended_problem()),
+            threshold=0.0,
+            budget_s=600.0,
+        )
+        decisions = pricer.price_batch([[], _burst(n=1)])
+        assert decisions[0].action == "fallback"
+        assert decisions[0].reason == "empty_batch"
+        assert decisions[1].action in ("accept", "reject")
+        assert pricer.price_batch([]) == []
+
+        def boom():
+            raise RuntimeError("planner exploded")
+
+        failed = AdmissionPricer(boom).price_batch(self._bursts())
+        assert all(d.reason == "error:RuntimeError" for d in failed)
+
+    def test_collector_convoys_concurrent_price_calls(self):
+        import threading
+
+        import time as _time
+
+        class _BatchCountingPricer:
+            def __init__(self):
+                self.dispatches = []
+
+            def price_batch(self, bursts, audit=False):
+                # The first dispatch takes real wall clock (a solve
+                # does), giving the other callers time to stage behind
+                # the leader — that's the window convoying exploits.
+                if not self.dispatches:
+                    _time.sleep(0.1)
+                self.dispatches.append(len(bursts))
+                return [
+                    PricingDecision(
+                        action="accept", reason="priced",
+                        welfare_delta=float(len(jobs)),
+                    )
+                    for jobs in bursts
+                ]
+
+        from shockwave_tpu.whatif.pricing import PricingCollector
+
+        inner = _BatchCountingPricer()
+        collector = PricingCollector(inner, max_lanes=32)
+        results = {}
+        barrier = threading.Barrier(8)
+
+        def worker(k):
+            barrier.wait()
+            results[k] = collector.price(_burst(n=k + 1))
+
+        threads = [
+            threading.Thread(target=worker, args=(k,)) for k in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # Every caller got ITS OWN burst's decision back ...
+        assert all(
+            results[k].welfare_delta == float(k + 1) for k in range(8)
+        )
+        # ... and the 8 calls rode strictly fewer dispatches, with at
+        # least one real convoy behind the slow leader.
+        assert sum(inner.dispatches) == 8
+        assert len(inner.dispatches) < 8
+        assert max(inner.dispatches) >= 2
+        # Idle again: a lone call is its own leader, one lane.
+        lone = collector.price(_burst(n=2))
+        assert lone.welfare_delta == 2.0
+        assert inner.dispatches[-1] == 1
