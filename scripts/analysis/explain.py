@@ -1,0 +1,150 @@
+#!/usr/bin/env python3
+"""Offline decision-narrative CLI: why the market did what it did.
+
+Derives a job's full decision narrative — admission verdict → queue
+wait → per-round share/price trail → preemptions with the charged
+switch cost → degraded rounds → forecast vs realized — from a
+flight-recorder decision log alone, via the SAME builder the live
+``ExplainJob`` RPC uses (shockwave_tpu/obs/explain.py). Against the
+same log the two answers are equal field for field — the property
+scripts/ci/explain_smoke.py gates.
+
+  # one job, human-readable
+  python scripts/analysis/explain.py \
+      --log results/flight_recorder/decisions.jsonl --job 3
+
+  # every job, machine-readable
+  python scripts/analysis/explain.py \
+      --log results/flight_recorder/decisions.jsonl --json
+
+See docs/USAGE.md "Market explainability".
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+
+def _fmt(v, nd=4):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}g}"
+    return str(v)
+
+
+def _render_one(n, out):
+    out.write(f"job {n['job']}\n")
+    adm = n.get("admission")
+    if adm is not None:
+        out.write(
+            f"  admitted: round {_fmt(adm.get('round'))} "
+            f"t={_fmt(adm.get('time_s'))}s token={adm.get('token') or '-'}\n"
+        )
+    else:
+        out.write("  admitted: (no admission record — pre-loaded job)\n")
+    out.write(
+        f"  queue wait: {_fmt(n.get('queue_wait_rounds'))} rounds; "
+        f"scheduled rounds {_fmt(n.get('first_scheduled_round'))}.."
+        f"{_fmt(n.get('last_scheduled_round'))} "
+        f"({n.get('rounds_run')} run)\n"
+    )
+    trail = n.get("trail") or []
+    if trail:
+        out.write(
+            "  round  share  fair   price     spend     bonus      "
+            "drift   flags\n"
+        )
+        for e in trail:
+            flags = []
+            if e.get("bonus_state") and e["bonus_state"] != "none":
+                flags.append(f"bonus:{e['bonus_state']}")
+            if e.get("degraded"):
+                flags.append("degraded")
+            if e.get("makespan_binding"):
+                flags.append("binding")
+            if e.get("cell") is not None:
+                flags.append(f"cell:{e['cell']}")
+            out.write(
+                f"  {e['round']:>5}  {_fmt(e.get('share'), 3):>5}  "
+                f"{_fmt(e.get('fair_share'), 3):>5}  "
+                f"{_fmt(e.get('price')):>8}  {_fmt(e.get('spend')):>8}  "
+                f"{_fmt(e.get('bonus')):>9}  "
+                f"{_fmt(e.get('fairness_drift'), 3):>6}  "
+                f"{' '.join(flags)}\n"
+            )
+    else:
+        out.write("  (no attribution trail in this log)\n")
+    for p in n.get("preemptions") or []:
+        charged = p.get("switch_cost_charged")
+        out.write(
+            f"  preempted at round {p['round']} "
+            f"(t={_fmt(p.get('time_s'))}s), switch cost charged: "
+            f"{_fmt(charged) if charged is not None else 'none'}\n"
+        )
+    for m in n.get("migrations") or []:
+        out.write(
+            f"  migrated round {m['round']}: {m.get('src')} -> "
+            f"{m.get('dst')} (gain {_fmt(m.get('gain'))}, "
+            f"cost {_fmt(m.get('cost'))})\n"
+        )
+    if n.get("degraded_rounds"):
+        out.write(f"  degraded rounds: {n['degraded_rounds']}\n")
+    fc = n.get("forecast") or {}
+    rz = n.get("realized") or {}
+    out.write(
+        f"  forecast finish: first {_fmt(fc.get('first_predicted_finish_s'))}s"
+        f" -> last {_fmt(fc.get('last_predicted_finish_s'))}s; "
+        f"realized: last ran round {_fmt(rz.get('last_run_round'))} "
+        f"at t={_fmt(rz.get('last_run_time_s'))}s\n"
+    )
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Derive per-job market decision narratives from a "
+        "flight-recorder decision log."
+    )
+    parser.add_argument(
+        "--log", required=True, help="decision log (.jsonl or .jsonl.gz)"
+    )
+    parser.add_argument(
+        "--job",
+        default=None,
+        help="job key (e.g. 3, or '(3, 4)' for a colocated pair); "
+        "omit for every job in the log",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the narrative(s) as canonical JSON instead of text",
+    )
+    args = parser.parse_args(argv)
+
+    from shockwave_tpu.obs.explain import narrative_from_log
+
+    result = narrative_from_log(args.log, job_id=args.job)
+    if args.job is not None and result is None:
+        print(f"no decision trail for job {args.job!r} in {args.log}")
+        return 1
+    if args.json:
+        print(json.dumps(result, sort_keys=True, separators=(",", ":")))
+        return 0
+    narratives = (
+        [result] if args.job is not None else list(result["jobs"].values())
+    )
+    for n in narratives:
+        _render_one(n, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

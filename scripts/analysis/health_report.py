@@ -33,8 +33,11 @@ from scripts.analysis.report_run import (  # noqa: E402
     _fmt,
     calibration_fleet,
     calibration_rows,
+    ingest_stats,
     load_json_input,
     load_metrics,
+    market_price_trail,
+    market_stats,
 )
 
 
@@ -67,6 +70,11 @@ def collect(metrics_path, trace_path=None, decisions_path=None) -> dict:
         },
         "health_events": [],
         "decisions": None,
+        # PR-16 ingest block and the market explainability plane; {}
+        # when the run predates them (sections degrade to a note).
+        "ingest": ingest_stats(m),
+        "market": market_stats(m),
+        "market_trail": [],
     }
     if trace_path:
         trace = load_json_input(trace_path, "trace")
@@ -88,6 +96,7 @@ def collect(metrics_path, trace_path=None, decisions_path=None) -> dict:
             data["decisions"]["path"] = decisions_path
         except ValueError as e:
             _fail(str(e))
+        data["market_trail"] = market_price_trail(decisions_path)
     return data
 
 
@@ -137,6 +146,54 @@ def render_text(data: dict) -> str:
             f"rejects {int(sum(rejected.values()))} "
             f"({', '.join(f'{k}={int(v)}' for k, v in sorted(rejected.items())) or 'none'}), "
             f"dedups {_fmt(adm.get('deduped_batches'))}"
+        )
+    ingest = data.get("ingest") or {}
+    lines.append("")
+    if ingest:
+        lines.append(
+            "Ingest: "
+            f"{_fmt(ingest.get('jobs_admitted'))} jobs admitted, "
+            "queue latency "
+            f"p50 {_fmt(ingest.get('queue_latency_p50_s'))} s / "
+            f"p99 {_fmt(ingest.get('queue_latency_p99_s'))} s, "
+            f"{_fmt(ingest.get('ingest_ticks', 0))} mid-round ticks"
+        )
+    else:
+        lines.append("Ingest: no metrics (streaming admission off)")
+    market = data.get("market") or {}
+    trail = data.get("market_trail") or []
+    if market or trail:
+        lines.append("")
+        lines.append(
+            "Market: "
+            f"price {_fmt(market.get('price'))}, "
+            f"fairness drift {_fmt(market.get('fairness_drift'))}"
+            + (
+                "; spend "
+                + ", ".join(
+                    f"{t}={_fmt(v)}"
+                    for t, v in sorted(
+                        (market.get("tenant_spend") or {}).items()
+                    )
+                )
+                if market.get("tenant_spend")
+                else ""
+            )
+        )
+        if trail:
+            lines.append("  price trail (round: price / drift):")
+            for row in trail:
+                rnd, _backend, price, drift, jobs, degraded = row
+                lines.append(
+                    f"    round {rnd:>4}: {_fmt(price)} / "
+                    f"{_fmt(drift)}  ({jobs} jobs"
+                    + (", degraded)" if degraded else ")")
+                )
+    else:
+        lines.append("")
+        lines.append(
+            "Market: no price data (not the market planner, or run "
+            "predates the explainability plane)"
         )
     fleet = data["calibration_fleet"]
     if fleet:
@@ -230,6 +287,30 @@ def render_html(data: dict) -> str:
             f"rejects {int(sum(rejected.values()))}; "
             f"dedups {_fmt(adm.get('deduped_batches'))}</p>"
         )
+    market = data.get("market") or {}
+    trail = data.get("market_trail") or []
+    if market or trail:
+        parts.append("<h2>Market price trail</h2>")
+        parts.append(
+            "<p>"
+            f"price {_fmt(market.get('price'))}, fairness drift "
+            f"{_fmt(market.get('fairness_drift'))}</p>"
+        )
+        if market.get("tenant_spend"):
+            parts.append(
+                table(
+                    ["tenant", "spend (chip-rounds)"],
+                    sorted(market["tenant_spend"].items()),
+                )
+            )
+        if trail:
+            parts.append(
+                table(
+                    ["round", "backend", "price", "fairness drift",
+                     "jobs", "degraded"],
+                    trail,
+                )
+            )
     if data["health_events"]:
         parts.append("<h2>Alert timeline</h2>")
         parts.append(

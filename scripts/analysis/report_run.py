@@ -182,6 +182,173 @@ def calibration_rows(m: Metrics):
     ]
 
 
+def _counter_total(m: Metrics, name):
+    series = m.series(name)
+    if not series:
+        return None
+    return sum(s["value"] for s in series)
+
+
+def _histogram_quantile(m: Metrics, name, q):
+    """Quantile over every label series' merged cumulative buckets
+    (None when the metric is absent or bucket-less)."""
+    merged, observed_max, count = {}, None, 0
+    for series in m.series(name):
+        count += series["count"]
+        if series.get("max") is not None:
+            observed_max = (
+                series["max"]
+                if observed_max is None
+                else max(observed_max, series["max"])
+            )
+        for le, cum in (series.get("buckets") or {}).items():
+            merged[le] = merged.get(le, 0) + cum
+    if count <= 0 or not merged:
+        return None
+    value, _ = quantile_from_buckets(merged, q, observed_max)
+    return value
+
+
+def ingest_stats(m: Metrics):
+    """The streaming-admission block ({} when the run never saw the
+    admission front door — e.g. a plain simulate run)."""
+    stats = {}
+    for key, name in [
+        ("jobs_admitted", "admission_jobs_admitted_total"),
+        ("batches_accepted", "admission_accepted_total"),
+        ("batches_rejected", "admission_rejected_total"),
+        ("batches_deduped", "admission_deduped_total"),
+        ("ingest_ticks", "ingest_ticks_total"),
+        ("drain_failures", "admission_drain_failures_total"),
+    ]:
+        value = _counter_total(m, name)
+        if value is not None:
+            stats[key] = value
+    for key, name in [
+        ("queue_depth", "admission_queue_depth"),
+        ("queue_capacity", "admission_queue_capacity"),
+        ("queue_shards", "admission_queue_shards"),
+    ]:
+        value = m.value(name)
+        if value is not None:
+            stats[key] = value
+    for key, q in [("queue_latency_p50_s", 0.5), ("queue_latency_p99_s", 0.99)]:
+        value = _histogram_quantile(m, "admission_queue_latency_seconds", q)
+        if value is not None:
+            stats[key] = value
+    return stats
+
+
+def ingest_section(m: Metrics):
+    """Markdown for the streaming-ingest block; degrades to a one-line
+    note when the dump has no admission metrics."""
+    lines = ["## Ingest (streaming admission)", ""]
+    stats = ingest_stats(m)
+    if not stats:
+        lines.append(
+            "_No ingest metrics in this dump (the run did not use the "
+            "streaming admission front door)._"
+        )
+        return "\n".join(lines)
+    rows = []
+    for label, key, unit in [
+        ("Jobs admitted", "jobs_admitted", ""),
+        ("Batches accepted", "batches_accepted", ""),
+        ("Batches rejected (backpressure)", "batches_rejected", ""),
+        ("Batches deduped (token ledger)", "batches_deduped", ""),
+        ("Queue latency p50", "queue_latency_p50_s", " s"),
+        ("Queue latency p99", "queue_latency_p99_s", " s"),
+        ("Mid-round ingest ticks", "ingest_ticks", ""),
+        ("Drain failures", "drain_failures", ""),
+        ("Queue depth (final)", "queue_depth", ""),
+        ("Queue capacity", "queue_capacity", ""),
+        ("Queue shards", "queue_shards", ""),
+    ]:
+        if key in stats:
+            rows.append((label, f"{_fmt(stats[key])}{unit}"))
+    lines.append(_table(["metric", "value"], rows))
+    return "\n".join(lines)
+
+
+def market_stats(m: Metrics):
+    """The market price block from the gauges the planners publish
+    ({} when the run's policy was not the market planner or metrics
+    predate the explainability plane)."""
+    stats = {}
+    for key, name in [
+        ("price", "market_price"),
+        ("fairness_drift", "market_fairness_drift"),
+    ]:
+        value = m.value(name)
+        if value is not None:
+            stats[key] = value
+    tenants = m.labeled_values("market_tenant_spend", "tenant")
+    if tenants:
+        stats["tenant_spend"] = tenants
+    return stats
+
+
+def market_price_trail(decision_log):
+    """Per-round price trail rows from a decision log's attribution
+    records: (round, backend, price, drift, jobs, degraded). Only
+    records that governed a round (live, or committed speculative)."""
+    from shockwave_tpu.obs.explain import _resolve_attributions
+    from shockwave_tpu.obs.recorder import iter_records
+
+    rows = []
+    for att in _resolve_attributions(list(iter_records(decision_log))):
+        market = att.get("market") or {}
+        rows.append(
+            (
+                att.get("round"),
+                att.get("backend"),
+                market.get("budget_dual"),
+                market.get("fairness_drift"),
+                len((att.get("jobs") or {}).get("keys") or []),
+                "yes" if att.get("degraded") else "",
+            )
+        )
+    return rows
+
+
+def market_section(m: Metrics, decision_log=None):
+    """Markdown for the market price block; degrades to a one-line
+    note when neither the gauges nor a decision log carry prices."""
+    lines = ["## Market price trail", ""]
+    stats = market_stats(m)
+    trail = market_price_trail(decision_log) if decision_log else []
+    if not stats and not trail:
+        lines.append(
+            "_No market price data (run predates the explainability "
+            "plane, or the policy is not the market planner)._"
+        )
+        return "\n".join(lines)
+    if stats:
+        lines.append(
+            f"Final fleet congestion price {_fmt(stats.get('price'))}, "
+            f"fairness drift {_fmt(stats.get('fairness_drift'))}."
+        )
+        lines.append("")
+    tenants = stats.get("tenant_spend")
+    if tenants:
+        lines.append(
+            _table(
+                ["tenant", "spend (chip-rounds)"],
+                sorted(tenants.items()),
+            )
+        )
+        lines.append("")
+    if trail:
+        lines.append(
+            _table(
+                ["round", "backend", "price", "fairness drift", "jobs",
+                 "degraded"],
+                trail,
+            )
+        )
+    return "\n".join(line for line in lines if line is not None).rstrip()
+
+
 def _series_p99(series):
     """p99 from a snapshot series' cumulative buckets (the shared
     obs.metrics.quantile_from_buckets math; None pre-PR-4 dumps had no
@@ -329,12 +496,14 @@ def load_metrics(metrics_path) -> Metrics:
         _fail(str(e))
 
 
-def build_report(metrics_path, trace_path=None):
+def build_report(metrics_path, trace_path=None, decision_log=None):
     m = load_metrics(metrics_path)
 
     out = [f"# Run report — `{os.path.basename(metrics_path)}`", ""]
     out += ["## Outcome", ""]
     out.append(_table(["metric", "value"], overview_rows(m)))
+    out += ["", ingest_section(m)]
+    out += ["", market_section(m, decision_log)]
 
     solver = histogram_rows(m, "shockwave_solve_seconds", ["backend", "ok"])
     if solver:
@@ -484,12 +653,14 @@ def trace_latency_budgets(trace: dict):
     return latency_budget(events)
 
 
-def build_json(metrics_path, trace_path=None) -> dict:
+def build_json(metrics_path, trace_path=None, decision_log=None) -> dict:
     """The same report as one machine-readable object (--json; CI
     consumption)."""
     m = load_metrics(metrics_path)
     data = {
         "metrics_file": metrics_path,
+        "ingest": ingest_stats(m),
+        "market": market_stats(m),
         "overview": {
             name: m.value(name)
             for _, name, _, _ in OVERVIEW_METRICS
@@ -517,6 +688,18 @@ def build_json(metrics_path, trace_path=None) -> dict:
             )
             for row in histogram_rows(
                 m, "shockwave_plan_phase_seconds", ["phase"]
+            )
+        ],
+        "market_trail": [
+            dict(
+                zip(
+                    ("round", "backend", "price", "fairness_drift",
+                     "jobs", "degraded"),
+                    row,
+                )
+            )
+            for row in (
+                market_price_trail(decision_log) if decision_log else []
             )
         ],
         "health_alerts": m.labeled_values(
@@ -563,6 +746,12 @@ def main(argv=None):
     parser.add_argument(
         "--trace", default=None, help="trace-event JSON (--trace-out)"
     )
+    parser.add_argument(
+        "--decision-log",
+        default=None,
+        help="flight-recorder decision log: adds the per-round market "
+        "price trail to the market section",
+    )
     parser.add_argument("-o", "--output", default=None, help="write here "
                         "instead of stdout")
     parser.add_argument(
@@ -572,9 +761,12 @@ def main(argv=None):
     )
     args = parser.parse_args(argv)
     if args.json:
-        report = json.dumps(build_json(args.metrics, args.trace), indent=1)
+        report = json.dumps(
+            build_json(args.metrics, args.trace, args.decision_log),
+            indent=1,
+        )
     else:
-        report = build_report(args.metrics, args.trace)
+        report = build_report(args.metrics, args.trace, args.decision_log)
     if args.output:
         from shockwave_tpu.utils.fileio import atomic_write_text
 

@@ -32,6 +32,13 @@ Usage (the standing gate; see docs/USAGE.md "Health & forensics"):
   python bench.py                      # appends to results/bench_history.json
   python scripts/ci/check_bench_regression.py
 
+``--window N`` (default 1) gates each series against the MEDIAN of the
+last N same-platform history entries that carry it (same-mode for
+``cold_s``) instead of the single most recent one — one noisy baseline
+run stops being able to mask a real regression (or fail a healthy
+one). Entries missing a series don't consume window slots. The default
+keeps the single-entry comparison exactly as before.
+
 With no same-platform baseline (first run on a platform, empty
 history) the gate passes with a notice — there is nothing to regress
 against.
@@ -40,6 +47,7 @@ against.
 import argparse
 import json
 import os
+import statistics
 import sys
 
 REPO_ROOT = os.path.dirname(
@@ -117,6 +125,62 @@ def pick_baseline(history, current):
     return None
 
 
+def _gate_series(
+    series, cur, base, lower_is_better, max_regression, failures
+):
+    """Noise floor + direction-aware relative comparison for one
+    series; appends to ``failures`` past ``max_regression``."""
+    floor = NOISE_FLOOR.get(series)
+    if floor is not None and (
+        (cur <= floor and base <= floor)
+        if lower_is_better
+        else (cur >= floor and base >= floor)
+    ):
+        side = "under" if lower_is_better else "over"
+        print(
+            f"  {series:<8} {base:.4g} -> {cur:.4g}  (both {side} "
+            f"the {floor:g} noise floor; pass)"
+        )
+        return
+    change = (cur - base) / base if lower_is_better else (base - cur) / base
+    direction = "regression" if change > 0 else "improvement"
+    print(
+        f"  {series:<8} {base:.4g} -> {cur:.4g}  "
+        f"({100 * abs(change):.1f}% {direction})"
+    )
+    if change > max_regression:
+        failures.append(
+            f"{series}: {base:.4g} -> {cur:.4g} "
+            f"(+{100 * change:.1f}% > {100 * max_regression:.0f}%)"
+        )
+
+
+def windowed_values(history, current, series, window, cur_mode=None):
+    """Up to ``window`` most recent same-platform prior values of
+    ``series`` (newest first). For ``cold_s`` (``cur_mode`` set when
+    the current record names its warm-cache mode) entries in the OTHER
+    known mode are excluded — the two modes are different
+    measurements. Entries missing the series don't consume slots."""
+    platform = current.get("platform")
+    values = []
+    for entry in reversed(history):
+        if entry is current or entry.get("ts") == current.get("ts"):
+            continue
+        if platform and entry.get("platform") != platform:
+            continue
+        if cur_mode is not None:
+            entry_mode = entry.get("cold_via_warm_cache")
+            if entry_mode is not None and entry_mode != cur_mode:
+                continue
+        value = entry.get(series)
+        if value is None or value == 0:
+            continue
+        values.append(value)
+        if len(values) >= window:
+            break
+    return values
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
@@ -142,6 +206,14 @@ def main(argv=None):
         default=sorted(TRACKED),
         choices=sorted(TRACKED),
         help="tracked series to gate on",
+    )
+    parser.add_argument(
+        "--window",
+        type=int,
+        default=1,
+        help="gate against the median of the last N same-platform "
+        "entries carrying each series (default 1: the single most "
+        "recent entry, the legacy behavior)",
     )
     args = parser.parse_args(argv)
 
@@ -178,6 +250,35 @@ def main(argv=None):
     for series in args.series:
         lower_is_better = TRACKED[series]
         cur, base = current.get(series), baseline.get(series)
+        if args.window > 1:
+            if cur is None:
+                print(f"  {series:<8} skipped (missing in current)")
+                continue
+            cur_mode = (
+                current.get("cold_via_warm_cache")
+                if series == "cold_s"
+                else None
+            )
+            values = windowed_values(
+                history, current, series, args.window, cur_mode
+            )
+            if not values:
+                print(
+                    f"  {series:<8} skipped (no same-platform history "
+                    "entry carries it)"
+                )
+                continue
+            base = statistics.median(values)
+            if len(values) > 1:
+                print(
+                    f"  {series:<8} baseline = median {base:.4g} of "
+                    f"last {len(values)} entries"
+                )
+            _gate_series(
+                series, cur, base, lower_is_better,
+                args.max_regression, failures,
+            )
+            continue
         if cur is None or base is None or base == 0:
             print(f"  {series:<8} skipped (missing in current or baseline)")
             continue
@@ -225,29 +326,10 @@ def main(argv=None):
                     "baseline)"
                 )
                 continue
-        floor = NOISE_FLOOR.get(series)
-        if floor is not None and (
-            (cur <= floor and base <= floor)
-            if lower_is_better
-            else (cur >= floor and base >= floor)
-        ):
-            side = "under" if lower_is_better else "over"
-            print(
-                f"  {series:<8} {base:.4g} -> {cur:.4g}  (both {side} "
-                f"the {floor:g} noise floor; pass)"
-            )
-            continue
-        change = (cur - base) / base if lower_is_better else (base - cur) / base
-        direction = "regression" if change > 0 else "improvement"
-        print(
-            f"  {series:<8} {base:.4g} -> {cur:.4g}  "
-            f"({100 * abs(change):.1f}% {direction})"
+        _gate_series(
+            series, cur, base, lower_is_better,
+            args.max_regression, failures,
         )
-        if change > args.max_regression:
-            failures.append(
-                f"{series}: {base:.4g} -> {cur:.4g} "
-                f"(+{100 * change:.1f}% > {100 * args.max_regression:.0f}%)"
-            )
     if failures:
         print("FAIL: " + "; ".join(failures))
         return 1

@@ -1,0 +1,515 @@
+#!/usr/bin/env python3
+"""CI smoke gate for the market explainability plane: dual/price
+attribution, the ExplainJob RPC, and the offline narrative parity.
+
+Three phases, ~1 min total on CPU:
+
+1. **Campaign** — a committed sim campaign (the 12-job dynamic trace
+   on 2 chips, PDHG backend, measured preemption overheads, plan-ahead
+   speculation on) with the decision log and metrics enabled. Asserts
+   every job earns a market trail, committed attribution records pair
+   1:1 with committed plans, at least one committed replan priced
+   capacity (nonzero budget dual), and the price gauges landed. Writes
+   ``results/explain/decisions.jsonl`` (the committed forensics
+   artifact) + the derived ``narratives.json``.
+
+2. **Duals vs finite difference** — the independent audit of the
+   reported duals: seed the base EG problem from the committed log
+   through the what-if seeding path (the SAME ``_build_problem`` the
+   production replan ran), recompute the DualReport at the recorded
+   allocation, and check (a) the recomputed marginals agree with the
+   recorded attribution bit-for-bit (replay determinism) and (b) each
+   strictly-unmet job's reported marginal welfare matches a central
+   finite difference of ``welfare_at`` to first order. Writes the
+   per-job agreement table to ``duals_vs_fd.json``.
+
+3. **Live** — a real PhysicalScheduler with two worker agent
+   subprocesses and the decision log on; 3 jobs through the streaming
+   front door. After the round loop finishes, the ``ExplainJob`` RPC
+   is called for every job and its wire narrative must equal — field
+   for field — the narrative ``scripts/analysis/explain.py`` derives
+   offline from a copy of the same log. An unknown job must answer
+   ``found=false`` without erroring.
+
+Exits non-zero on any violated invariant; writes
+``results/explain/explain_smoke.json`` (the gate verdict). Wired into
+the verify skill next to the other smokes.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+OUT = os.path.join(REPO, "results", "explain")
+
+FD_REL_TOL = 1e-4
+# Per-job FD step: this fraction of the job's curvature scale
+# x_j / beta_j (x_j = A + eps + beta*s is the log argument). A fixed
+# step would be too coarse for near-zero-progress jobs, whose
+# marginals blow up as 1/x, and needlessly noisy for sated ones.
+FD_CURVE_FRAC = 1e-3
+
+
+# Measured per-family relaunch overheads (tests/test_preemption_aware):
+# they arm the switching-cost market term, so the campaign's
+# attribution records carry real bonus/switch-cost columns.
+OVERHEADS = {
+    "LM": 32.4,
+    "Recommendation": 32.6,
+    "ResNet-18": 92.8,
+    "ResNet-50": 99.1,
+    "Transformer": 31.8,
+}
+
+
+def campaign_phase(failures):
+    from shockwave_tpu import obs
+    from shockwave_tpu.core.scheduler import Scheduler
+    from shockwave_tpu.data import parse_trace
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.data.profiles import synthesize_profiles
+    from shockwave_tpu.obs import recorder as rec
+    from shockwave_tpu.obs.explain import narrative_from_log
+    from shockwave_tpu.policies import get_policy
+    from shockwave_tpu.utils.fileio import atomic_write_json
+
+    log = os.path.join(OUT, "decisions.jsonl")
+    if os.path.exists(log):
+        os.remove(log)
+    obs.reset()
+    obs.configure_recorder(log)
+    obs.configure(metrics=True)
+    # The 12-job dynamic trace on 2 chips: sustained contention, real
+    # preemptions, and speculation churn — committed replans price a
+    # full market (nonzero congestion price, fairness drift), which is
+    # what makes the price trail and the FD audit non-trivial.
+    jobs, arrivals = parse_trace(
+        os.path.join(REPO, "traces", "small_12_dynamic.trace")
+    )
+    oracle = generate_oracle()
+    profiles = synthesize_profiles(jobs, oracle)
+    for i, job in enumerate(jobs):
+        job.duration = sum(profiles[i]["duration_every_epoch"])
+        job.tenant = "alpha" if i % 2 == 0 else "beta"
+    sched = Scheduler(
+        get_policy("shockwave_tpu_pdhg"),
+        throughputs=oracle,
+        seed=0,
+        time_per_iteration=60,
+        profiles=profiles,
+        preemption_overheads=dict(OVERHEADS),
+        shockwave_config={
+            "num_gpus": 2,
+            "time_per_iteration": 60,
+            "future_rounds": 20,
+            "lambda": 5.0,
+            "k": 10.0,
+            "solver_rel_gap": 1e-3,
+            "solver_timeout": 15,
+            "speculate": True,
+        },
+    )
+    makespan = sched.simulate({"v100": 2}, list(arrivals), list(jobs))
+    obs.get_recorder().close()
+
+    records = list(rec.iter_records(log))
+    plans = [
+        r for r in records
+        if r["event"] == "plan" and not r.get("speculative")
+    ]
+    atts = [
+        r for r in records
+        if r["event"] == "attribution" and not r.get("speculative")
+    ]
+    if not plans:
+        failures.append("campaign recorded no committed plans")
+    if len(atts) != len(plans):
+        failures.append(
+            f"attribution records ({len(atts)}) do not pair 1:1 with "
+            f"committed plans ({len(plans)})"
+        )
+    if not any(a["market"]["budget_dual"] > 0 for a in atts):
+        failures.append(
+            "no committed replan priced capacity (budget_dual stayed 0 "
+            "through a 12-job campaign on 2 chips)"
+        )
+
+    names = set(obs.get_registry().snapshot()["metrics"])
+    for gauge in (
+        "market_price", "market_fairness_drift", "market_tenant_spend"
+    ):
+        if gauge not in names:
+            failures.append(f"campaign published no {gauge} gauge")
+    obs.export_metrics(os.path.join(OUT, "campaign_metrics.json"))
+
+    narratives = narrative_from_log(log)["jobs"]
+    if set(narratives) != {str(j) for j in range(12)}:
+        failures.append(
+            f"narratives cover {sorted(narratives)}, expected jobs 0-11"
+        )
+    for key, n in narratives.items():
+        if not n["trail"]:
+            failures.append(f"job {key} has an empty market trail")
+    if not any(n["preemptions"] for n in narratives.values()):
+        failures.append(
+            "no narrative carries a preemption on a campaign with "
+            "hundreds of them"
+        )
+    atomic_write_json(
+        os.path.join(OUT, "narratives.json"), {"jobs": narratives}
+    )
+    obs.reset()
+    return {
+        "makespan_s": makespan,
+        "committed_plans": len(plans),
+        "attributions": len(atts),
+        "speculative_attributions": sum(
+            1 for r in records
+            if r["event"] == "attribution" and r.get("speculative")
+        ),
+        "preemptions": sched.get_num_preemptions(),
+        "jobs_with_trail": sum(1 for n in narratives.values() if n["trail"]),
+    }
+
+
+def duals_vs_fd_phase(failures):
+    import numpy as np
+
+    from shockwave_tpu.obs import recorder as rec
+    from shockwave_tpu.solver.duals import dual_report, welfare_at
+    from shockwave_tpu.utils.fileio import atomic_write_json
+    from shockwave_tpu.whatif.seed import base_problem_from_log
+
+    log = os.path.join(OUT, "decisions.jsonl")
+    # Audit the busiest committed replan — the late rounds have one or
+    # two stragglers left, which would make the FD table trivially thin.
+    att = None
+    for record in rec.iter_records(log):
+        if record.get("event") == "attribution" and not record.get(
+            "speculative"
+        ):
+            if att is None or len(record["jobs"]["keys"]) > len(
+                att["jobs"]["keys"]
+            ):
+                att = record
+    if att is None:
+        failures.append("no committed attribution record in the campaign")
+        return {"rows": []}
+    rnd = int(att["round"])
+    problem, keys, _s0, seed_rnd = base_problem_from_log(
+        log, round_index=rnd
+    )
+    if seed_rnd != rnd:
+        failures.append(
+            f"what-if seed resolved round {seed_rnd}, wanted {rnd}"
+        )
+    if att["jobs"]["keys"] != keys:
+        failures.append(
+            "attribution job keys disagree with the what-if seed's "
+            f"problem rows: {att['jobs']['keys']} vs {keys}"
+        )
+        return {"round": rnd, "rows": []}
+
+    s = np.asarray(att["jobs"]["share"], np.float64)
+    report = dual_report(problem, s=s)
+    recorded = np.asarray(att["jobs"]["marginal"], np.float64)
+    drift = float(np.max(np.abs(report.marginal_welfare - recorded)))
+    if drift > 1e-9:
+        failures.append(
+            "recomputed marginals drifted from the recorded attribution "
+            f"(max abs {drift:.3e}) — the DualReport is not replay-stable"
+        )
+
+    # The independent oracle: central finite differences of the same
+    # fixed-normalization welfare the marginals claim to differentiate.
+    # Jobs sitting ON the satiation cap are skipped (the kink has no
+    # two-sided derivative); strictly-sated jobs must FD to zero.
+    from shockwave_tpu.solver.duals import _EPS
+
+    dur = max(float(problem.round_duration), 1e-9)
+    total_ep = np.maximum(
+        np.asarray(problem.total_epochs, np.float64), _EPS
+    )
+    epoch_dur = np.maximum(
+        np.asarray(problem.epoch_duration, np.float64), _EPS
+    )
+    completed = np.asarray(problem.completed_epochs, np.float64)
+    A = completed / total_ep
+    beta = dur / (epoch_dur * total_ep)
+    need_sec = np.maximum(
+        np.asarray(problem.total_epochs, np.float64) - completed, 0.0
+    ) * epoch_dur
+    xcap = need_sec / dur
+    # The log argument the marginal differentiates; the step is a small
+    # fraction of its curvature scale so the central difference stays
+    # first-order accurate even for near-zero-progress jobs.
+    x = A + _EPS + beta * s
+    rows = []
+    audited = 0
+    for j, key in enumerate(keys):
+        step = FD_CURVE_FRAC * float(x[j]) / float(beta[j])
+        row = {
+            "job": key,
+            "share_rounds": float(s[j]),
+            "reported_marginal": float(recorded[j]),
+        }
+        if abs(s[j] - xcap[j]) <= step:
+            row["verdict"] = "skipped (allocation at the satiation cap)"
+            rows.append(row)
+            continue
+        up, dn = s.copy(), s.copy()
+        up[j] += step
+        dn[j] -= step
+        fd = (welfare_at(problem, up) - welfare_at(problem, dn)) / (
+            2 * step
+        )
+        scale = max(abs(fd), abs(float(recorded[j])), 1e-12)
+        rel_err = abs(fd - float(recorded[j])) / scale
+        ok = rel_err <= FD_REL_TOL or (
+            recorded[j] == 0.0 and abs(fd) <= 1e-9
+        )
+        row.update(
+            {
+                "finite_difference": fd,
+                "rel_err": rel_err,
+                "verdict": "agree" if ok else "DISAGREE",
+            }
+        )
+        rows.append(row)
+        audited += 1
+        if not ok:
+            failures.append(
+                f"job {key}: reported marginal {recorded[j]:.6g} vs FD "
+                f"{fd:.6g} (rel err {rel_err:.2e} > {FD_REL_TOL:g})"
+            )
+    if audited == 0:
+        failures.append(
+            "finite-difference audit exercised zero jobs (all at the "
+            "satiation kink?)"
+        )
+    result = {
+        "round": rnd,
+        "budget_dual": float(report.budget_dual),
+        "fairness_drift": float(report.fairness_drift),
+        "marginal_replay_max_abs_drift": drift,
+        "fd_curvature_fraction": FD_CURVE_FRAC,
+        "fd_rel_tol": FD_REL_TOL,
+        "rows": rows,
+    }
+    atomic_write_json(os.path.join(OUT, "duals_vs_fd.json"), result)
+    return {
+        "round": rnd,
+        "jobs_audited": audited,
+        "max_rel_err": max(
+            (r["rel_err"] for r in rows if "rel_err" in r), default=None
+        ),
+    }
+
+
+def live_phase(failures):
+    import grpc
+
+    from shockwave_tpu import obs
+    from shockwave_tpu.core.physical import PhysicalScheduler
+    from shockwave_tpu.data.default_oracle import generate_oracle
+    from shockwave_tpu.policies import get_policy
+    from shockwave_tpu.runtime.protobuf import explain_pb2
+    from shockwave_tpu.runtime.rpc.submitter_client import SubmitterClient
+    from shockwave_tpu.runtime.rpc.wiring import make_stubs
+    from shockwave_tpu.runtime.testing import make_synthetic_job
+    from shockwave_tpu.utils.fileio import atomic_write_json
+    from shockwave_tpu.utils.hostenv import free_port
+
+    import tempfile
+
+    log = os.path.join(OUT, "live_decisions.jsonl")
+    if os.path.exists(log):
+        os.remove(log)
+    obs.reset()
+    obs.configure_recorder(log)
+
+    run_dir = tempfile.mkdtemp(prefix="explain_smoke_")
+    sched_port = free_port()
+    sched = PhysicalScheduler(
+        get_policy("fifo"),
+        port=sched_port,
+        throughputs=generate_oracle(),
+        time_per_iteration=3.0,
+        completion_buffer_seconds=8.0,
+        minimum_time_between_allocation_resets=0.0,
+    )
+    workers = []
+    live = {}
+    unknown_found = None
+    try:
+        for i in range(2):
+            env = dict(os.environ)
+            env.update(
+                {"SHOCKWAVE_HEARTBEAT_S": "0.3", "JAX_PLATFORMS": "cpu"}
+            )
+            workers.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m",
+                        "shockwave_tpu.runtime.worker",
+                        "-t", "v100", "-n", "1",
+                        "-a", "127.0.0.1", "-s", str(sched_port),
+                        "-p", str(free_port()),
+                        "--run_dir", os.path.join(run_dir, f"w{i}"),
+                        "--checkpoint_dir",
+                        os.path.join(run_dir, f"ckpt{i}"),
+                    ],
+                    env=env,
+                    cwd=REPO,
+                )
+            )
+        sched.wait_for_workers(2, timeout=60)
+
+        jobs = [
+            make_synthetic_job(total_steps=400, steps_per_sec=200)
+            for _ in range(3)
+        ]
+        sched.expect_stream()
+        client = SubmitterClient(
+            "127.0.0.1", sched_port, client_id="explain-smoke"
+        )
+        # Keep the stream OPEN: run() tears the server down the moment
+        # the loop exits, and ExplainJob must be asked of the LIVE
+        # scheduler. The loop idles (no rounds, no new records) once
+        # every job completes, which is exactly the quiescent window
+        # the field-for-field comparison needs.
+        client.submit_stream(jobs, batch_size=2, close=False)
+        runner = threading.Thread(
+            target=lambda: sched.run(max_rounds=40), daemon=True
+        )
+        runner.start()
+
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            done = sum(
+                1 for t in sched._job_completion_times.values()
+                if t is not None
+            )
+            if done == len(jobs):
+                break
+            time.sleep(1.0)
+        else:
+            failures.append("jobs did not complete in 120 s")
+
+        with grpc.insecure_channel(f"127.0.0.1:{sched_port}") as channel:
+            stubs = make_stubs(channel, "WorkerToScheduler")
+            for i in range(len(jobs)):
+                resp = stubs.ExplainJob(
+                    explain_pb2.ExplainJobRequest(job_id=str(i)),
+                    timeout=30,
+                )
+                if not resp.found:
+                    failures.append(
+                        f"ExplainJob({i}) answered found=false: "
+                        f"{resp.error!r}"
+                    )
+                    continue
+                live[str(i)] = json.loads(resp.narrative_json)
+            miss = stubs.ExplainJob(
+                explain_pb2.ExplainJobRequest(job_id="no-such-job"),
+                timeout=30,
+            )
+            unknown_found = miss.found
+            if miss.found:
+                failures.append(
+                    "ExplainJob for an unknown job answered found=true"
+                )
+
+        # Snapshot the log for the offline derivation BEFORE anything
+        # else can append to it — same records, by construction.
+        obs.get_recorder().flush()
+        shutil.copyfile(log, os.path.join(OUT, "live_decisions_copy.jsonl"))
+        client.close_stream()
+        client.close()
+        runner.join(timeout=60)
+        if runner.is_alive():
+            failures.append("round loop did not exit after stream close")
+    finally:
+        sched.shutdown()
+        for proc in workers:
+            try:
+                proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        obs.get_recorder().close()
+        obs.reset()
+
+    # Offline parity: the SAME narrative, derived by the analysis CLI
+    # from the copied log, with the live scheduler out of the loop.
+    copy = os.path.join(OUT, "live_decisions_copy.jsonl")
+    mismatched = []
+    for key in sorted(live):
+        out = subprocess.run(
+            [
+                sys.executable, "scripts/analysis/explain.py",
+                "--log", copy, "--job", key, "--json",
+            ],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+        )
+        if out.returncode != 0:
+            failures.append(
+                f"offline explain.py failed for job {key}: {out.stderr}"
+            )
+            continue
+        offline = json.loads(out.stdout)
+        if offline != live[key]:
+            mismatched.append(key)
+            failures.append(
+                f"job {key}: live ExplainJob narrative != offline "
+                "narrative (field-for-field equality broken)"
+            )
+    os.remove(copy)
+    atomic_write_json(
+        os.path.join(OUT, "live_vs_offline.json"),
+        {
+            "jobs": sorted(live),
+            "field_for_field_equal": not mismatched,
+            "mismatched": mismatched,
+            "unknown_job_found": unknown_found,
+            "narratives": live,
+        },
+    )
+    return {
+        "jobs_explained": len(live),
+        "field_for_field_equal": not mismatched,
+        "unknown_job_found": unknown_found,
+    }
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    from shockwave_tpu.utils.fileio import atomic_write_json
+
+    failures = []
+    result = {"campaign": campaign_phase(failures)}
+    result["duals_vs_fd"] = duals_vs_fd_phase(failures)
+    result["live"] = live_phase(failures)
+    result["failures"] = failures
+    result["ok"] = not failures
+    atomic_write_json(os.path.join(OUT, "explain_smoke.json"), result)
+    print(json.dumps(result, indent=1))
+    if failures:
+        print("\nEXPLAIN SMOKE: FAIL", file=sys.stderr)
+        for failure in failures:
+            print(f"  - {failure}", file=sys.stderr)
+        return 1
+    print("\nEXPLAIN SMOKE: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -507,7 +507,14 @@ class TransitiveHostSync(ProjectRule):
 # -- swallowed-exception ------------------------------------------------
 
 _SCOPE_PREFIXES = ("shockwave_tpu/runtime/", "shockwave_tpu/ha/")
-_SCOPE_FILES = ("shockwave_tpu/core/physical.py",)
+# physical.py hosts the RPC callbacks; explain.py and duals.py feed the
+# ExplainJob handler — a swallowed error in any of them turns a live
+# narrative request into a silent found=false.
+_SCOPE_FILES = (
+    "shockwave_tpu/core/physical.py",
+    "shockwave_tpu/obs/explain.py",
+    "shockwave_tpu/solver/duals.py",
+)
 
 _LOG_METHODS = {
     "debug", "info", "warning", "error", "exception", "critical", "log",

@@ -31,6 +31,10 @@ _SCOPE_PREFIXES = (
     "shockwave_tpu/ha/",
 )
 
+# Individual modules outside the threaded packages that the ExplainJob
+# RPC path reads from handler threads while the round loop writes.
+_SCOPE_FILES = ("shockwave_tpu/solver/duals.py",)
+
 _MUTATING_METHODS = {
     "append",
     "extend",
@@ -122,7 +126,10 @@ class LockDiscipline(Rule):
     )
 
     def applies_to(self, relpath: str) -> bool:
-        return relpath.startswith(_SCOPE_PREFIXES)
+        return (
+            relpath.startswith(_SCOPE_PREFIXES)
+            or relpath in _SCOPE_FILES
+        )
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         for cls in ast.walk(ctx.tree):

@@ -127,6 +127,9 @@ class CellPlanner(SpeculativePlannerMixin):
         self.spares: Dict[str, int] = {n: 0 for n in self.cells}
         self.imbalance_rounds = 0
         self.migrations_total = 0
+        # Last committed replan's per-job spend snapshot across the
+        # re-solved cells (scheduler tenant-spend gauges; NOT replayed).
+        self.last_market: Optional[dict] = None
         self.pdhg_tol = float(config.get("pdhg_tol", 1e-4))
         raw_deadline = config.get("plan_deadline_s")
         self.plan_deadline_s = (
@@ -984,6 +987,7 @@ class CellPlanner(SpeculativePlannerMixin):
             "cells_coordinated_replan_seconds",
             "wall time of one coordinated (batched) cell replan",
         ).observe(solve_seconds)
+        self._market_attribution(built, solved, migrations)
         if pre_state is None:
             return
         pre_state["cells_replay"] = {
@@ -1028,6 +1032,131 @@ class CellPlanner(SpeculativePlannerMixin):
             },
             tags=self._plan_record_tags,
         )
+
+    def _market_attribution(self, built, solved, migrations) -> None:
+        """Market explainability tap for the cells market: per-cell
+        dual reports at the final (post-stickiness/backfill) schedules,
+        fleet price gauges, and one attribution record spanning every
+        re-solved cell — each job row carries its cell id, and the
+        record carries the coordinator's reconcile prices and this
+        replan's migrations (with their gain/cost prices). Jobs in
+        cells that kept their cached plan re-enter the trail when
+        their cell next goes stale. Pure reads; one boolean check when
+        both the recorder and metrics are off."""
+        speculative = bool(
+            self._plan_record_tags
+            and self._plan_record_tags.get("speculative")
+        )
+        recorder = obs.get_recorder()
+        if not (recorder.enabled or obs.metrics_enabled()):
+            return
+        from shockwave_tpu.solver.duals import dual_report
+
+        reports = {}
+        for name, info in solved.items():
+            problem = built[name][0]
+            if problem is None:
+                continue
+            reports[name] = dual_report(problem, Y=info["Y"])
+        fleet_price = max(
+            (r.budget_dual for r in reports.values()), default=0.0
+        )
+        chips = {n: float(self.cells[n]) for n in reports}
+        total_chips = sum(chips.values()) or 1.0
+        fleet_drift = sum(
+            reports[n].fairness_drift * chips[n] for n in reports
+        ) / total_chips
+        if not speculative:
+            obs.gauge(
+                "market_price",
+                "fleet congestion price (budget dual) of the last plan",
+            ).set(fleet_price)
+            obs.gauge(
+                "market_fairness_drift",
+                "budget-weighted fair-share deficit of the last plan "
+                "[0,1]",
+            ).set(fleet_drift)
+            # Per-job spend snapshot for the scheduler's tenant-spend
+            # gauges (see ShockwavePlanner._market_attribution).
+            self.last_market = {
+                "round": int(self.round_index),
+                "keys": [
+                    str(j)
+                    for name in reports
+                    for j in built[name][1]
+                ],
+                "spend": [
+                    float(x)
+                    for name in reports
+                    for x in reports[name].spend
+                ],
+                "price": float(fleet_price),
+            }
+        if not recorder.enabled or not reports:
+            return
+        from shockwave_tpu.obs.recorder import _job_key
+
+        jobs = {
+            "keys": [], "cell": [], "share": [], "fair_share": [],
+            "welfare": [], "marginal": [], "price": [], "spend": [],
+            "bonus": [], "bonus_state": [], "switch_cost": [],
+            "makespan_binding": [], "predicted_finish_s": [],
+        }
+        for name, report in reports.items():
+            problem, job_ids = built[name]
+            child = self.children[name]
+            bonus = problem.switch_bonus()
+            granted = report.s >= 0.5
+            jobs["keys"].extend(_job_key(j) for j in job_ids)
+            jobs["cell"].extend([name] * len(job_ids))
+            jobs["share"].extend(float(x) for x in report.s)
+            jobs["fair_share"].extend(float(x) for x in report.fair_share)
+            jobs["welfare"].extend(
+                float(x) for x in report.welfare_contribution
+            )
+            jobs["marginal"].extend(
+                float(x) for x in report.marginal_welfare
+            )
+            jobs["price"].extend(float(x) for x in report.price)
+            jobs["spend"].extend(float(x) for x in report.spend)
+            jobs["bonus"].extend(float(x) for x in bonus)
+            jobs["bonus_state"].extend(
+                ("applied" if g else "forfeited") if b > 0.0 else "none"
+                for b, g in zip(bonus, granted)
+            )
+            jobs["switch_cost"].extend(
+                float(x) for x in problem.switch_cost
+            )
+            jobs["makespan_binding"].extend(
+                int(x) for x in report.makespan_binding
+            )
+            jobs["predicted_finish_s"].extend(
+                float(child.finish_time_estimates[j][-1][1])
+                if child.finish_time_estimates.get(j)
+                else None
+                for j in job_ids
+            )
+        detail = {
+            "round": int(self.round_index),
+            "backend": "cells",
+            "market": {
+                "budget_dual": float(fleet_price),
+                "fairness_drift": float(fleet_drift),
+                "cell_prices": {
+                    n: float(r.budget_dual) for n, r in reports.items()
+                },
+                "coordinator_prices": {
+                    n: float(p) for n, p in self.prices.items()
+                },
+            },
+            "degraded": any(info["fallback"] for info in solved.values()),
+            "fallback_from": None,
+            "migrations": [dict(m) for m in migrations],
+            "jobs": jobs,
+        }
+        if speculative:
+            detail["speculative"] = True
+        recorder.record_attribution(detail)
 
     # -- serialization --------------------------------------------------
     def state_dict(self) -> dict:

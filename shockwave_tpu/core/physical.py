@@ -271,6 +271,9 @@ class PhysicalScheduler(Scheduler):
                 # tooling speaking the hand-rolled wire contract) can
                 # scrape the scheduler's live registry.
                 "dump_metrics": obs.render_prometheus,
+                # Market explainability: one job's decision narrative,
+                # derived from the live decision log (see obs/explain).
+                "explain_job": self._explain_job_rpc,
             },
         )
 
@@ -549,6 +552,26 @@ class PhysicalScheduler(Scheduler):
                 offset_gauge, rtt_gauge = _clock_gauges()
                 offset_gauge.set(est_offset_s, worker=str(worker_id))
                 rtt_gauge.set(est_rtt_s, worker=str(worker_id))
+
+    def _explain_job_rpc(self, job_id):
+        """ExplainJob handler: the job's decision narrative, derived
+        from the live decision log via the SAME builder the offline
+        scripts/analysis/explain.py uses — so the live answer equals
+        the offline replay-derived one field for field. Returns None
+        (-> found=false on the wire) when the decision log is off."""
+        rpc_start = time.perf_counter()
+        try:
+            recorder = obs.get_recorder()
+            if not recorder.enabled or recorder.path is None:
+                return None
+            from shockwave_tpu.obs.explain import narrative_from_log
+
+            # Flush so the log on disk covers every committed round up
+            # to now; the builder tolerates a mid-write truncated tail.
+            recorder.flush()
+            return narrative_from_log(recorder.path, job_id=str(job_id))
+        finally:
+            self._observe_rpc("ExplainJob", rpc_start)
 
     def _submit_jobs_rpc(self, token, specs, close):
         """Streaming-admission handler: validate the batch, offer it to

@@ -179,6 +179,11 @@ class Scheduler:
         # Job state.
         self._job_id_counter = 0
         self._jobs: "OrderedDict[JobId, Job]" = OrderedDict()
+        # Tenant-spend gauge bookkeeping: the planner replan round last
+        # published and the tenant labels last set (so a tenant whose
+        # jobs all left is zeroed instead of frozen).
+        self._tenant_spend_round: Optional[int] = None
+        self._tenant_spend_seen: set = set()
         self._completed_jobs: set = set()
         self._running_jobs: set = set()
         self._steps_run_so_far: Dict[JobId, Dict[str, int]] = {}
@@ -1780,6 +1785,16 @@ class Scheduler:
         recorder = obs.get_recorder()
         calibration = obs.get_calibration()
         watchdog = obs.get_watchdog()
+        metrics_on = obs.metrics_enabled()
+        if not (
+            recorder.enabled
+            or calibration.enabled
+            or watchdog.enabled
+            or metrics_on
+        ):
+            return
+        if metrics_on:
+            self._publish_tenant_spend()
         if not (recorder.enabled or calibration.enabled or watchdog.enabled):
             return
         now = self.get_current_timestamp()
@@ -1834,6 +1849,39 @@ class Scheduler:
                     for s in key.singletons()
                 ],
             )
+
+    def _publish_tenant_spend(self) -> None:
+        """Per-tenant spend gauges from the planner's last committed
+        replan: ``market_tenant_spend{tenant}`` sums each tenant's
+        chip-rounds in the plan (the market's per-job ``spend``
+        column). Tenants ride the admission wire
+        (admission_pb2.JobSpec.tenant); jobs without one land under
+        ``default``. A tenant whose jobs all finished is zeroed, not
+        left frozen at its last value. One dict lookup per round when
+        the snapshot is unchanged (or the planner isn't the market)."""
+        market = getattr(self._shockwave, "last_market", None)
+        if market is None or market["round"] == self._tenant_spend_round:
+            return
+        self._tenant_spend_round = market["round"]
+        tenant_by_key = {
+            str(j): (job.tenant or "default")
+            for j, job in self._jobs.items()
+        }
+        by_tenant: dict = {}
+        for key, spend in zip(market["keys"], market["spend"]):
+            tenant = tenant_by_key.get(key)
+            if tenant is None:
+                continue  # departed since the replan
+            by_tenant[tenant] = by_tenant.get(tenant, 0.0) + spend
+        gauge = obs.gauge(
+            "market_tenant_spend",
+            "chip-rounds of the last committed plan per tenant",
+        )
+        for tenant in self._tenant_spend_seen - set(by_tenant):
+            gauge.set(0.0, tenant=tenant)
+        for tenant, spend in by_tenant.items():
+            gauge.set(float(spend), tenant=tenant)
+        self._tenant_spend_seen = set(by_tenant)
 
     # ------------------------------------------------------------------
     # Plan-ahead pipelining (shockwave_tpu/policies/speculation.py).

@@ -544,6 +544,19 @@ class FlightRecorder:
             return
         self._append({"event": "recovery", **detail})
 
+    def record_attribution(self, detail: dict) -> None:
+        """Per-(job, round) market attribution for one replan: the
+        dual/price block (budget dual, makespan dual, fairness drift)
+        plus each job's share vs fair-share baseline, welfare
+        contribution, marginal price, switching-bonus state, ladder
+        rung, and — in cells mode — cell id and migration prices.
+        Everything in ``detail`` is a deterministic function of the
+        paired plan record's inputs, so replay re-derives it exactly
+        (tests pin this)."""
+        if not self.enabled:
+            return
+        self._append({"event": "attribution", **detail})
+
     def record_admission(self, detail: dict) -> None:
         """One streaming-admission front-door event: an accepted or
         rejected (backpressure) submission batch, a token-ledger dedup,

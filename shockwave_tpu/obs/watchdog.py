@@ -66,6 +66,19 @@ Rules (all thresholds overridable via a config dict, e.g. the
                      ``max_jump_s`` between checks — either way the
                      merged fleet trace's alignment (and any
                      cross-host latency attribution) is suspect.
+``price_spike``      the fleet congestion price (``market_price``, the
+                     budget dual the planner publishes per replan)
+                     exceeds ``factor`` x its rolling median over the
+                     last ``window`` priced rounds (and ``min_price``)
+                     — demand just outran capacity; admission pricing
+                     and queue waits are about to move.
+``fairness_drift``   the fleet fairness drift (``market_fairness_drift``,
+                     the spend-weighted fraction of fair share the
+                     market is withholding from under-served jobs)
+                     stayed above ``threshold`` for ``rounds``
+                     consecutive checks — the welfare objective is
+                     systematically starving someone, not just
+                     transiently rebalancing.
 
 A rule re-fires only when its value worsens past the last fired value
 (no per-round alert spam while a breach persists). Disabled by default
@@ -101,6 +114,13 @@ DEFAULT_RULES: Dict[str, dict] = {
     "ingest_p99": {"budget_s": None, "min_jobs": 20, "quantile": 0.99},
     "cell_failure": {"min_events": 1},
     "clock_skew": {"max_offset_s": 1.0, "max_jump_s": 0.5},
+    "price_spike": {
+        "factor": 3.0,
+        "window": 20,
+        "min_history_rounds": 5,
+        "min_price": 1e-9,
+    },
+    "fairness_drift": {"threshold": 0.25, "rounds": 3},
 }
 
 
@@ -137,6 +157,10 @@ class Watchdog:
         # worker -> [last offset seen, currently-breached flag] for the
         # clock_skew rule's per-worker hysteresis.
         self._clock_offsets: Dict[str, list] = {}
+        # Rolling market_price samples (price_spike) and the count of
+        # consecutive over-threshold checks (fairness_drift).
+        self._price_history: deque = deque()
+        self._drift_rounds = 0
         # Jobs granted workers at the PREVIOUS check: the steps delta a
         # check observes covers the previous round's execution.
         self._prev_scheduled: set = set()
@@ -161,6 +185,8 @@ class Watchdog:
             self._preemption_deltas.clear()
             self._progress.clear()
             self._clock_offsets.clear()
+            self._price_history.clear()
+            self._drift_rounds = 0
             self._prev_scheduled.clear()
             self._last_fired.clear()
 
@@ -258,6 +284,10 @@ class Watchdog:
                 )
             if "clock_skew" in self.rules:
                 self._check_clock_skew(metrics, round_index, fired)
+            if "price_spike" in self.rules:
+                self._check_price_spike(metrics, round_index, fired)
+            if "fairness_drift" in self.rules:
+                self._check_fairness_drift(metrics, round_index, fired)
 
             for alert in fired:
                 alert["time_s"] = float(now_s)
@@ -452,6 +482,56 @@ class Watchdog:
             self._clock_offsets[worker] = [offset, breach]
         for gone in [w for w in self._clock_offsets if w not in seen]:
             del self._clock_offsets[gone]
+
+    def _check_price_spike(self, metrics, round_index, fired) -> None:
+        """Caller holds the lock (check_round). The fleet congestion
+        price (the budget dual from the planner's last committed
+        replan) spiking past ``factor`` x its rolling median means
+        demand just outran capacity — the market is about to start
+        charging for admission and shaving shares. The median (not
+        mean) baseline keeps one previous spike from inflating the
+        bar; ``min_price`` keeps an uncongested fleet (price pinned
+        at 0) from firing on float dust."""
+        cfg = self.rules["price_spike"]
+        price = self._gauge_value(metrics, "market_price")
+        if price is None:
+            return  # no market planner publishing prices
+        history = sorted(self._price_history)
+        self._price_history.append(float(price))
+        while len(self._price_history) > cfg["window"]:
+            self._price_history.popleft()
+        if len(history) < cfg["min_history_rounds"]:
+            return
+        median = history[len(history) // 2]
+        threshold = max(cfg["factor"] * median, cfg["min_price"])
+        if price > threshold:
+            self._fire(
+                fired, "price_spike", round_index, price, threshold,
+                median=round(median, 9),
+            )
+        else:
+            self._rearm("price_spike")
+
+    def _check_fairness_drift(self, metrics, round_index, fired) -> None:
+        """Caller holds the lock (check_round). Sustained (``rounds``
+        consecutive checks) fairness drift above ``threshold``: the
+        welfare maximizer is persistently holding some jobs under
+        their proportional fair share — systematic starvation, not the
+        transient rebalancing a single hot round produces."""
+        cfg = self.rules["fairness_drift"]
+        drift = self._gauge_value(metrics, "market_fairness_drift")
+        if drift is None:
+            return  # no market planner publishing drift
+        if drift > cfg["threshold"]:
+            self._drift_rounds += 1
+            if self._drift_rounds >= cfg["rounds"]:
+                self._fire(
+                    fired, "fairness_drift", round_index, drift,
+                    cfg["threshold"], consecutive=self._drift_rounds,
+                )
+        else:
+            self._drift_rounds = 0
+            self._rearm("fairness_drift")
 
     def _check_worst_ftf(self, metrics, round_index, fired) -> None:
         """Caller holds the lock (check_round)."""

@@ -146,6 +146,10 @@ class ShockwavePlanner(SpeculativePlannerMixin):
         # Worker-type tag when owned by a PoolSetPlanner (flight-recorder
         # records carry it so per-pool decisions stay attributable).
         self.pool_label: Optional[str] = None
+        # Last committed replan's per-job spend snapshot (job key ->
+        # chip-rounds) for the scheduler's per-tenant spend gauges.
+        # Observability-only: NOT part of state_dict/replay.
+        self.last_market: Optional[dict] = None
 
     # -- scheduler-facing interface -------------------------------------
     def add_job(
@@ -1053,6 +1057,99 @@ class ShockwavePlanner(SpeculativePlannerMixin):
                     pool=self.pool_label,
                     tags=self._plan_record_tags,
                 )
+            self._market_attribution(problem, job_ids, Y, backend_used)
+
+    def _market_attribution(
+        self,
+        problem: EGProblem,
+        job_ids: list,
+        Y: np.ndarray,
+        backend_used: str,
+    ) -> None:
+        """Market explainability tap: extract the dual/price report at
+        the final plan, publish the fleet price gauges, and — when the
+        flight recorder is on — stamp the per-(job, round) attribution
+        record that pairs with this replan's plan record. Pure reads of
+        ``(problem, Y)``: the plan itself is untouched, and with both
+        the recorder and metrics off this is one boolean check."""
+        speculative = bool(
+            self._plan_record_tags
+            and self._plan_record_tags.get("speculative")
+        )
+        recorder = obs.get_recorder()
+        if not (recorder.enabled or obs.metrics_enabled()):
+            return
+        from shockwave_tpu.solver.duals import dual_report
+
+        report = dual_report(problem, Y=Y)
+        if not speculative:
+            # Clone prices commit only if the reconcile accepts the
+            # speculative plan; the gauges track committed plans.
+            obs.gauge(
+                "market_price",
+                "fleet congestion price (budget dual) of the last plan",
+            ).set(report.budget_dual)
+            obs.gauge(
+                "market_fairness_drift",
+                "budget-weighted fair-share deficit of the last plan "
+                "[0,1]",
+            ).set(report.fairness_drift)
+            # Per-job spend snapshot for the scheduler's tenant-spend
+            # gauges (the planner has no tenant notion; the scheduler
+            # owns the job -> tenant map).
+            self.last_market = {
+                "round": int(self.round_index),
+                "keys": [str(j) for j in job_ids],
+                "spend": [float(x) for x in report.spend],
+                "price": float(report.budget_dual),
+            }
+        if not recorder.enabled:
+            return
+        from shockwave_tpu.obs.recorder import _job_key
+
+        bonus = problem.switch_bonus()
+        granted = report.s >= 0.5
+        bonus_state = [
+            ("applied" if g else "forfeited") if b > 0.0 else "none"
+            for b, g in zip(bonus, granted)
+        ]
+        solve_record = self.solve_records[-1] if self.solve_records else {}
+        detail = {
+            "round": int(self.round_index),
+            "backend": backend_used,
+            "market": report.to_dict(),
+            "degraded": bool(solve_record.get("degraded", False)),
+            "fallback_from": solve_record.get("fallback_from"),
+            "jobs": {
+                "keys": [_job_key(j) for j in job_ids],
+                "share": [float(x) for x in report.s],
+                "fair_share": [float(x) for x in report.fair_share],
+                "welfare": [float(x) for x in report.welfare_contribution],
+                "marginal": [float(x) for x in report.marginal_welfare],
+                "price": [float(x) for x in report.price],
+                "spend": [float(x) for x in report.spend],
+                "bonus": [float(x) for x in bonus],
+                "bonus_state": bonus_state,
+                "switch_cost": [float(x) for x in problem.switch_cost],
+                "makespan_binding": [
+                    int(x) for x in report.makespan_binding
+                ],
+                "predicted_finish_s": [
+                    float(self.finish_time_estimates[j][-1][1])
+                    if self.finish_time_estimates.get(j)
+                    else None
+                    for j in job_ids
+                ],
+            },
+        }
+        if self.pool_label is not None:
+            detail["pool"] = self.pool_label
+        if speculative:
+            # The narrative builder admits this record only when the
+            # round-boundary reconcile commits the speculative plan
+            # (``speculation`` record, kind ``hit``).
+            detail["speculative"] = True
+        recorder.record_attribution(detail)
 
     def _apply_stickiness(self, Y: np.ndarray, problem: EGProblem) -> np.ndarray:
         """Lease stickiness: pull granted incumbents into the plan's first

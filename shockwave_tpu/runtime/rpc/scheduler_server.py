@@ -11,6 +11,9 @@ Callbacks supplied by the scheduler:
   submit_jobs(token, specs, close)
       -> (status, retry_after_s, admitted, queue_depth)
       (the streaming-admission front door; see runtime/admission.py)
+  explain_job(job_id) -> narrative dict or None
+      (market explainability; optional — the ExplainJob method is
+      registered only when this callback is wired, see obs/explain.py)
 """
 
 from __future__ import annotations
@@ -116,12 +119,44 @@ def _worker_to_scheduler_handlers(callbacks):
         text = cb() if cb is not None else "# no metrics callback wired\n"
         return telemetry_pb2.MetricsDump(text=text)
 
-    return {
+    def ExplainJob(request, context):
+        import json
+
+        from shockwave_tpu.runtime.protobuf import explain_pb2
+
+        try:
+            narrative = callbacks["explain_job"](request.job_id)
+        except KeyError as e:
+            return explain_pb2.ExplainJobResponse(
+                found=False, error=f"unknown job: {e}"
+            )
+        except Exception as e:  # noqa: BLE001 - reported to the caller
+            LOG.exception("ExplainJob failed")
+            return explain_pb2.ExplainJobResponse(
+                found=False, error=str(e)
+            )
+        if narrative is None:
+            return explain_pb2.ExplainJobResponse(
+                found=False,
+                error=f"no decision trail for job {request.job_id!r} "
+                "(is the decision log enabled?)",
+            )
+        return explain_pb2.ExplainJobResponse(
+            found=True,
+            narrative_json=json.dumps(
+                narrative, sort_keys=True, separators=(",", ":")
+            ),
+        )
+
+    handlers = {
         "RegisterWorker": RegisterWorker,
         "SendHeartbeat": SendHeartbeat,
         "Done": Done,
         "DumpMetrics": DumpMetrics,
     }
+    if "explain_job" in callbacks:
+        handlers["ExplainJob"] = ExplainJob
+    return handlers
 
 
 def _iterator_to_scheduler_handlers(callbacks):

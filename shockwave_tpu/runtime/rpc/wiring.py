@@ -17,6 +17,7 @@ import grpc
 from shockwave_tpu.runtime.protobuf import (
     admission_pb2 as adm_pb2,
     common_pb2,
+    explain_pb2,
     iterator_to_scheduler_pb2 as it_pb2,
     scheduler_to_worker_pb2 as s2w_pb2,
     telemetry_pb2,
@@ -44,6 +45,16 @@ SERVICES = {
         "DumpMetrics": (
             telemetry_pb2.MetricsRequest,
             telemetry_pb2.MetricsDump,
+        ),
+        # Market explainability: one job's full decision narrative
+        # (admission → queue wait → per-round share/price trail →
+        # preemptions → forecast vs realized), derived from the same
+        # decision log scripts/analysis/explain.py reads offline.
+        # Registered only when the scheduler wires an explain_job
+        # callback, like the admission front door.
+        "ExplainJob": (
+            explain_pb2.ExplainJobRequest,
+            explain_pb2.ExplainJobResponse,
         ),
     },
     "SchedulerToWorker": {

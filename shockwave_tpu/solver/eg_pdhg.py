@@ -803,6 +803,50 @@ def polish_relaxed(
     return s2
 
 
+def solve_eg_pdhg_with_duals(
+    problem: EGProblem,
+    s0: Optional[np.ndarray] = None,
+    polish: bool = True,
+    max_cycles: int = DEFAULT_MAX_CYCLES,
+    inner_iters: int = DEFAULT_INNER_ITERS,
+    tol: float = DEFAULT_TOL,
+):
+    """The PDHG backend solve plus its :class:`~shockwave_tpu.solver.
+    duals.DualReport`, extracted at the CONVERGED RELAXED iterate
+    (before integer rounding — the point where the saddle's duals are
+    exact). Returns ``(Y, report)``. The report is a deterministic
+    host-side function of ``(problem, s)``, so replaying the same
+    inputs reproduces it bit-for-bit; the relaxed/level backends get
+    the same contract via ``duals.dual_report(problem, Y=Y)`` over
+    their converged iterates."""
+    from shockwave_tpu.solver.duals import dual_report
+    from shockwave_tpu.solver.eg_jax import counts_to_schedule
+    from shockwave_tpu.solver.rounding import round_counts
+
+    with obs.backend_phases("pdhg", problem.num_jobs) as bp:
+        if (
+            problem.num_jobs >= sharded_min_jobs()
+            and len(jax.devices()) > 1
+        ):
+            s, _, _ = solve_pdhg_relaxed_sharded(
+                problem, s0=s0, max_cycles=max_cycles,
+                inner_iters=inner_iters, tol=tol,
+            )
+        else:
+            s, _, _ = solve_pdhg_relaxed(
+                problem, s0=s0, max_cycles=max_cycles,
+                inner_iters=inner_iters, tol=tol,
+            )
+        bp.phase("device")
+        report = dual_report(problem, s=s)
+        counts = round_counts(
+            s, problem.nworkers, problem.num_gpus, problem.future_rounds
+        )
+        Y = counts_to_schedule(counts, problem, polish=polish)
+        bp.phase("host")
+    return Y, report
+
+
 def solve_eg_pdhg(
     problem: EGProblem,
     s0: Optional[np.ndarray] = None,

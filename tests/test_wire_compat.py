@@ -25,6 +25,7 @@ from shockwave_tpu.obs import propagate  # noqa: E402
 from shockwave_tpu.runtime.protobuf import (  # noqa: E402
     admission_pb2 as adm_pb2,
     common_pb2,
+    explain_pb2,
     scheduler_to_worker_pb2 as s2w_new,
     telemetry_pb2,
     worker_to_scheduler_pb2 as w2s_new,
@@ -324,3 +325,63 @@ def test_unpacked_repeated_scalars_also_parse():
         payload += tag(2, 0) + encode_varint(job)
     parsed = w2s_new.DoneRequest.FromString(payload)
     assert list(parsed.job_id) == [4, 5]
+
+
+# ---------------------------------------------------------------------
+# ExplainJob: canonical proto3 bytes, roundtrip, unknown-field skip.
+# ---------------------------------------------------------------------
+def test_explain_request_canonical_bytes_and_roundtrip():
+    # Field-by-field canonical proto3 layout (what protoc would emit):
+    # string fields in field order, defaults omitted.
+    req = explain_pb2.ExplainJobRequest(job_id="7", trace_context="t-s-1")
+    expected = (
+        tag(1, 2) + encode_varint(1) + b"7"
+        + tag(2, 2) + encode_varint(5) + b"t-s-1"
+    )
+    assert req.SerializeToString() == expected
+    back = explain_pb2.ExplainJobRequest.FromString(expected)
+    assert back.job_id == "7" and back.trace_context == "t-s-1"
+    # proto3 default omission: an all-default message is zero bytes.
+    assert explain_pb2.ExplainJobRequest().SerializeToString() == b""
+
+
+def test_explain_response_roundtrip_carries_the_narrative():
+    narrative = '{"job":"7","trail":[{"round":0,"share":2.0}]}'
+    resp = explain_pb2.ExplainJobResponse(
+        found=True, narrative_json=narrative
+    )
+    back = explain_pb2.ExplainJobResponse.FromString(
+        resp.SerializeToString()
+    )
+    assert back.found is True
+    assert back.narrative_json == narrative
+    assert back.error == ""
+    # The not-found shape: found stays default-false, error set.
+    miss = explain_pb2.ExplainJobResponse.FromString(
+        explain_pb2.ExplainJobResponse(
+            error="decision log disabled"
+        ).SerializeToString()
+    )
+    assert miss.found is False and miss.error == "decision log disabled"
+
+
+def test_explain_parsers_skip_future_fields():
+    # A peer one schema version ahead appends a varint and a
+    # length-delimited field; both sides must skip them per proto3.
+    req_base = explain_pb2.ExplainJobRequest(
+        job_id="3", trace_context="t-s-9"
+    ).SerializeToString()
+    future_req = req_base + tag(9, 0) + encode_varint(4) + (
+        tag(10, 2) + encode_varint(2) + b"xx"
+    )
+    parsed_req = explain_pb2.ExplainJobRequest.FromString(future_req)
+    assert parsed_req.job_id == "3"
+    assert parsed_req.trace_context == "t-s-9"
+
+    resp_base = explain_pb2.ExplainJobResponse(
+        found=True, narrative_json='{"job":"3"}'
+    ).SerializeToString()
+    future_resp = resp_base + tag(12, 2) + encode_varint(3) + b"abc"
+    parsed_resp = explain_pb2.ExplainJobResponse.FromString(future_resp)
+    assert parsed_resp.found is True
+    assert parsed_resp.narrative_json == '{"job":"3"}'
