@@ -254,6 +254,129 @@ def ingest_phase():
     }
 
 
+def wire_phase():
+    """Wire-path line rate, the two layers the fastwire codec owns.
+
+    ``wire_decode_jobs_per_s`` (gated, higher is better): in-process
+    columnar decode — negotiated SubmitJobs frame bytes through
+    ``FastSubmitRequest.FromString`` + ``jobs_from_columns`` to Job
+    objects, 32 frames x 256 jobs per rep, min of 10 reps. Isolates
+    the codec: a regression here points at fastwire/admission column
+    handling, not grpc or the ledger.
+
+    ``wire_submits_per_s`` (gated, higher is better): end-to-end
+    localhost gRPC — one pipelined submitter driving the production
+    serve() handler (fastwire deserializer, _SubmitCoalescer,
+    vectorized ``submit_jobs_many``) with client and server sharing
+    this host's cores, min of 3 passes. The multi-process campaign
+    number lives in scripts/ingest_soak.py; this is the single-channel
+    sanity series the regression gate can afford every round."""
+    import threading
+
+    from shockwave_tpu.runtime import admission
+    from shockwave_tpu.runtime.protobuf import (
+        admission_pb2 as adm_pb2,
+        fastwire,
+    )
+    from shockwave_tpu.runtime.rpc import scheduler_server
+    from shockwave_tpu.runtime.rpc.submitter_client import SubmitterClient
+    from shockwave_tpu.utils.hostenv import free_port
+
+    # -- in-process columnar decode ----------------------------------
+    frames, jobs_per_frame = 32, 256
+    spec = {
+        "job_type": "ResNet-18 (batch size 32)",
+        "command": "python3 main.py",
+        "num_steps_arg": "-n",
+        "total_steps": 200,
+        "scale_factor": 1,
+        "mode": "static",
+        "tenant": "bench",
+    }
+    frame_bytes = [
+        adm_pb2.SubmitJobsRequest(
+            token=f"wire-{k}",
+            jobs_columnar=fastwire.encode_columnar_block(
+                [dict(spec) for _ in range(jobs_per_frame)]
+            ),
+            wire_caps=fastwire.CAP_COLUMNAR,
+        ).SerializeToString()
+        for k in range(frames)
+    ]
+    decode_best = float("inf")
+    for _ in range(11):  # rep 0 warms allocators, outside the timed set
+        t0 = time.time()
+        for data in frame_bytes:
+            request = fastwire.FastSubmitRequest.FromString(data)
+            jobs = admission.jobs_from_columns(request.columns)
+        dt = time.time() - t0
+        if decode_best == float("inf") or dt < decode_best:
+            decode_best = dt
+        assert len(jobs) == jobs_per_frame
+    decode_rate = frames * jobs_per_frame / decode_best
+
+    # -- end-to-end localhost RPC ------------------------------------
+    queue = admission.build_queue(
+        capacity=262144, retry_delay_s=0.05, group_commit=False
+    )
+
+    def submit_jobs_many(requests):
+        outs = queue.submit_many(requests)
+        depth = queue.depth()
+        return [(s, r, a, depth) for (s, r, a) in outs]
+
+    port = free_port()
+    server = scheduler_server.serve(
+        port, {"submit_jobs_many": submit_jobs_many}
+    )
+    stop = threading.Event()
+
+    def drain_loop():
+        while not stop.is_set():
+            stop.wait(0.005)
+            queue.drain()
+
+    drainer = threading.Thread(target=drain_loop, daemon=True)
+    drainer.start()
+    from shockwave_tpu.core.job import Job
+
+    job = Job(
+        job_type="ResNet-18 (batch size 32)",
+        command="python3 main.py",
+        total_steps=200,
+        scale_factor=1,
+        mode="static",
+    )
+    num_jobs, batch_size, window = 8192, 128, 8
+    client = SubmitterClient("127.0.0.1", port, client_id="bench-wire")
+    rpc_best = float("inf")
+    for rep in range(4):  # rep 0 is connect + negotiation warmup
+        t0 = time.time()
+        client.submit_pipelined(
+            [job] * num_jobs,
+            batch_size=batch_size,
+            window=window,
+            close=False,
+        )
+        dt = time.time() - t0
+        if rep:
+            rpc_best = min(rpc_best, dt)
+    client.close()
+    stop.set()
+    drainer.join(timeout=5)
+    queue.drain()
+    server.stop(0)
+    return {
+        "wire_decode_jobs_per_s": round(decode_rate, 1),
+        "wire_submits_per_s": round(num_jobs / rpc_best, 1),
+        "wire_config": (
+            f"decode {frames}x{jobs_per_frame} columnar frames; "
+            f"rpc {num_jobs} jobs x{batch_size} window {window}, "
+            "localhost, coalesced submit_jobs_many"
+        ),
+    }
+
+
 def main():
     from shockwave_tpu.solver.eg_jax import (
         counts_to_schedule,
@@ -604,6 +727,10 @@ def main():
         # (ingest_submits_per_s and ingest_p99_ms gated by
         # check_bench_regression.py).
         **ingest_phase(),
+        # Wire path: columnar codec + end-to-end localhost RPC
+        # (wire_decode_jobs_per_s and wire_submits_per_s gated by
+        # check_bench_regression.py).
+        **wire_phase(),
         "config": "1000 jobs x 256 gpus x 50 rounds",
     }
 

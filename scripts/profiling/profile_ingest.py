@@ -1,0 +1,245 @@
+#!/usr/bin/env python3
+"""Per-stage profile of the ingest wire path: where a SubmitJobs
+batch's time actually goes, measured stage by stage in-process.
+
+Stages (all over the same generated batches, ns/job + jobs/s each):
+
+  * ``encode_scalar`` / ``encode_columnar`` — client-side request
+    build + serialize (legacy JobSpec list vs columnar frame);
+  * ``decode_scalar`` — the pre-fastwire server path: per-message
+    ``admission_pb2`` parse -> per-spec dict -> ``job_from_spec_dict``
+    per job;
+  * ``decode_columnar_legacy`` — fastwire over legacy BYTES: one-pass
+    scan + arena columns + ``jobs_from_columns`` (what the server now
+    does for a legacy peer);
+  * ``decode_columnar_frame`` — fastwire over the negotiated columnar
+    frame (the steady-state wire path);
+  * ``ledger`` — vectorized admission: ``AdmissionQueue.submit_many``
+    of the decoded batches (dedup probe + quota + backpressure), with
+    a drain between repeats so depth stays bounded;
+  * ``ack_encode`` — ``SubmitJobsResponse`` serialize per ack.
+
+Writes the committed breakdown to ``results/ingest/profile_ingest.json``
+(``--out``). The scalar stages double as the pre-change attribution:
+rerun after codec work and compare in place.
+
+Usage:
+  python scripts/profiling/profile_ingest.py -o results/ingest/profile_ingest.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+import numpy as np
+
+MODELS = [("ResNet-18", 32), ("ResNet-50", 64)]
+
+
+def make_spec_dicts(num_jobs: int, seed: int = 0):
+    from shockwave_tpu.data.workload_info import steps_per_epoch
+
+    rng = np.random.default_rng(seed)
+    specs = []
+    for i in range(num_jobs):
+        model, bs = MODELS[int(rng.integers(len(MODELS)))]
+        specs.append(
+            {
+                "job_type": f"{model} (batch size {bs})",
+                "command": "python3 main.py",
+                "working_directory": "",
+                "num_steps_arg": "-n",
+                "total_steps": steps_per_epoch(model, bs),
+                "scale_factor": 1,
+                "mode": "static",
+                "priority_weight": 0.0,
+                "slo": 0.0,
+                "duration": 0.0,
+                "needs_data_dir": False,
+                "tenant": f"t{i % 3}",
+                "trace_context": "",
+            }
+        )
+    return specs
+
+
+def timed(fn, batches, jobs_per_batch: int, repeats: int) -> dict:
+    """ns/job + jobs/s for ``fn(batch)`` over every batch, best of
+    ``repeats`` full sweeps (min cancels scheduler noise on a busy
+    host)."""
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter_ns()
+        for batch in batches:
+            fn(batch)
+        best = min(best, time.perf_counter_ns() - t0)
+    total_jobs = jobs_per_batch * len(batches)
+    return {
+        "ns_per_job": round(best / total_jobs, 1),
+        "jobs_per_s": round(total_jobs / (best / 1e9), 1),
+    }
+
+
+def main(args) -> int:
+    from shockwave_tpu.runtime import admission
+    from shockwave_tpu.runtime.protobuf import (
+        admission_pb2 as adm_pb2,
+        fastwire,
+    )
+    from shockwave_tpu.runtime.rpc.scheduler_server import _spec_dict
+    from shockwave_tpu.utils.fileio import atomic_write_json
+
+    n, b = args.batches, args.batch_size
+    spec_batches = [
+        make_spec_dicts(b, seed=k) for k in range(n)
+    ]
+
+    # -- encode ------------------------------------------------------
+    def encode_scalar(specs):
+        return adm_pb2.SubmitJobsRequest(
+            token="tok",
+            jobs=[adm_pb2.JobSpec(**s) for s in specs],
+        ).SerializeToString()
+
+    def encode_columnar(specs):
+        return adm_pb2.SubmitJobsRequest(
+            token="tok",
+            jobs_columnar=fastwire.encode_columnar_block(specs),
+            wire_caps=fastwire.CAP_COLUMNAR,
+        ).SerializeToString()
+
+    stages = {}
+    stages["encode_scalar"] = timed(
+        encode_scalar, spec_batches, b, args.repeats
+    )
+    stages["encode_columnar"] = timed(
+        encode_columnar, spec_batches, b, args.repeats
+    )
+
+    legacy_bytes = [encode_scalar(s) for s in spec_batches]
+    frame_bytes = [encode_columnar(s) for s in spec_batches]
+    wire_bytes = {
+        "legacy_bytes_per_job": round(
+            sum(map(len, legacy_bytes)) / (n * b), 1
+        ),
+        "columnar_bytes_per_job": round(
+            sum(map(len, frame_bytes)) / (n * b), 1
+        ),
+    }
+
+    # -- decode ------------------------------------------------------
+    def decode_scalar(data):
+        request = adm_pb2.SubmitJobsRequest.FromString(data)
+        return [
+            admission.job_from_spec_dict(_spec_dict(spec))
+            for spec in request.jobs
+        ]
+
+    def decode_columnar(data):
+        request = fastwire.FastSubmitRequest.FromString(data)
+        return admission.jobs_from_columns(request.columns)
+
+    stages["decode_scalar"] = timed(
+        decode_scalar, legacy_bytes, b, args.repeats
+    )
+    stages["decode_columnar_legacy"] = timed(
+        decode_columnar, legacy_bytes, b, args.repeats
+    )
+    stages["decode_columnar_frame"] = timed(
+        decode_columnar, frame_bytes, b, args.repeats
+    )
+
+    # Decision identity while we are here: the profile must never
+    # measure a decoder that disagrees with the authority.
+    for data in legacy_bytes[:2]:
+        assert decode_scalar(data) == decode_columnar(data)
+
+    # -- ledger ------------------------------------------------------
+    queue = admission.build_queue(
+        capacity=max(65536, 2 * n * b), retry_delay_s=0.05
+    )
+    job_batches = [decode_columnar(data) for data in frame_bytes]
+    counter = {"k": 0}
+
+    def ledger(jobs):
+        counter["k"] += 1
+        queue.submit_many([(f"tok-{counter['k']}", jobs)])
+
+    best = float("inf")
+    for _ in range(args.repeats):
+        t0 = time.perf_counter_ns()
+        for jobs in job_batches:
+            ledger(jobs)
+        best = min(best, time.perf_counter_ns() - t0)
+        queue.drain()
+    stages["ledger"] = {
+        "ns_per_job": round(best / (n * b), 1),
+        "jobs_per_s": round((n * b) / (best / 1e9), 1),
+    }
+
+    # -- ack encode --------------------------------------------------
+    ack = adm_pb2.SubmitJobsResponse(
+        status="ACCEPTED", admitted=b, queue_depth=1234
+    )
+    stages["ack_encode"] = timed(
+        lambda _: ack.SerializeToString(),
+        spec_batches,
+        b,
+        args.repeats,
+    )
+
+    # Attribution: the serial per-batch server cost pre vs post (the
+    # RPC transport itself is measured by the soak, not here).
+    def path_ns(*names):
+        return round(sum(stages[s]["ns_per_job"] for s in names), 1)
+
+    result = {
+        "config": {
+            "batches": n,
+            "batch_size": b,
+            "repeats": args.repeats,
+            "cpu_count": os.cpu_count(),
+        },
+        "wire_bytes": wire_bytes,
+        "stages": stages,
+        "server_path_ns_per_job": {
+            "scalar_pre": path_ns(
+                "decode_scalar", "ledger", "ack_encode"
+            ),
+            "columnar_legacy_peer": path_ns(
+                "decode_columnar_legacy", "ledger", "ack_encode"
+            ),
+            "columnar_negotiated": path_ns(
+                "decode_columnar_frame", "ledger", "ack_encode"
+            ),
+        },
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    atomic_write_json(args.out, result)
+    print(json.dumps(result["server_path_ns_per_job"]))
+    print(f"wrote {args.out}")
+    return 0
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "-o", "--out", default="results/ingest/profile_ingest.json"
+    )
+    parser.add_argument("--batches", type=int, default=64)
+    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--repeats", type=int, default=5)
+    return parser
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(build_parser().parse_args()))

@@ -261,6 +261,10 @@ class PhysicalScheduler(Scheduler):
                 "register_worker": self._register_worker_rpc,
                 "done": self._done_rpc,
                 "heartbeat": self._heartbeat_rpc,
+                # Coalesced metrics push: a heartbeat carrying the
+                # agent's rendered registry lands here and pre-empts
+                # the fleet plane's next DumpMetrics poll for it.
+                "worker_metrics": self._worker_metrics_rpc,
                 "init_job": self._init_job_rpc,
                 "update_lease": self._update_lease_rpc,
                 "submit_jobs": self._submit_jobs_rpc,
@@ -552,6 +556,18 @@ class PhysicalScheduler(Scheduler):
                 offset_gauge, rtt_gauge = _clock_gauges()
                 offset_gauge.set(est_offset_s, worker=str(worker_id))
                 rtt_gauge.set(est_rtt_s, worker=str(worker_id))
+
+    def _worker_metrics_rpc(self, worker_id, text: str) -> None:
+        """Coalesced metrics push riding a heartbeat: store the agent's
+        rendered registry under the SAME fleet label the poll path
+        uses (min worker id of the agent), so the next poll tick skips
+        that target — one RPC where the wire carried beat + dump."""
+        with self._cv:
+            fleet = self._fleet
+            entry = self._fleet_agents.get(int(worker_id))
+        if fleet is None or entry is None:
+            return
+        fleet.accept_push(entry[0], text)
 
     def _explain_job_rpc(self, job_id):
         """ExplainJob handler: the job's decision narrative, derived

@@ -42,6 +42,7 @@ class AdmissionFrontDoor:
         max_workers_per_shard: int = 8,
     ):
         from shockwave_tpu.runtime.rpc.scheduler_server import (
+            _admission_deserializers,
             _admission_handlers,
         )
 
@@ -64,7 +65,12 @@ class AdmissionFrontDoor:
                     max_workers=max_workers_per_shard
                 )
             )
-            add_servicer(server, "AdmissionToScheduler", handlers)
+            add_servicer(
+                server,
+                "AdmissionToScheduler",
+                handlers,
+                request_deserializers=_admission_deserializers(),
+            )
             server.add_insecure_port(f"[::]:{port}")
             server.start()
             self._servers.append(server)

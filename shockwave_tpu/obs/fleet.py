@@ -199,17 +199,50 @@ class FleetTelemetry:
         with self._lock:
             return len(self._targets)
 
-    # -- polling --------------------------------------------------------
-    def poll_once(self) -> int:
-        """Scrape every target now; returns how many answered. Failures
-        are counted and logged at debug (a dead worker's reaper, not
-        the telemetry plane, is the authority on its death)."""
+    def accept_push(self, label: str, text: str) -> bool:
+        """Coalesced push: a worker's heartbeat carried its rendered
+        registry, so store it exactly where the pull path would have
+        (same dict, same freshness stamp) and let :meth:`poll_once`
+        skip that target while the push is younger than the poll
+        interval. Unknown labels are dropped — a push can race the
+        agent's retirement, and resurrecting a removed target would
+        leak a dead worker's series into the merge forever."""
         from shockwave_tpu import obs
 
+        label = str(label)
+        with self._lock:
+            if label not in self._targets:
+                return False
+            self._dumps[label] = (str(text), time.time())
+        obs.counter(
+            "fleet_pushes_total",
+            "worker metrics dumps coalesced onto heartbeats",
+        ).inc(worker=label)
+        return True
+
+    # -- polling --------------------------------------------------------
+    def poll_once(self) -> int:
+        """Scrape every target now; returns how many answered (pushed
+        counts as answered). Targets whose dump is younger than the
+        poll interval — a heartbeat-coalesced push landed since the
+        last tick — are skipped: the wire already carried their data.
+        Failures are counted and logged at debug (a dead worker's
+        reaper, not the telemetry plane, is the authority on its
+        death)."""
+        from shockwave_tpu import obs
+
+        now = time.time()
         with self._lock:
             targets = dict(self._targets)
-        answered = 0
+            fresh = {
+                label
+                for label, (_, ts) in self._dumps.items()
+                if now - ts < self._interval_s
+            }
+        answered = len(targets.keys() & fresh)
         for label, scrape_fn in targets.items():
+            if label in fresh:
+                continue
             try:
                 text = scrape_fn()
             except Exception:

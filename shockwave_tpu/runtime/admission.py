@@ -144,6 +144,89 @@ def job_from_spec_dict(spec: dict) -> Job:
     )
 
 
+def jobs_from_columns(cols) -> List[Job]:
+    """Vectorized :func:`job_from_spec_dict` over one
+    :class:`~shockwave_tpu.runtime.protobuf.fastwire.JobColumns` block:
+    validation is per-UNIQUE job_type plus three array comparisons, and
+    no per-job spec dict ever exists. Decision-identical to mapping
+    ``job_from_spec_dict`` over the batch in order — the same Jobs on
+    success, and on failure the same ValueError (same message) for the
+    FIRST offending job, checked in the same per-job order (job_type,
+    then total_steps, then scale_factor) — pinned by
+    tests/test_admission.py and the ingest smoke parity gate."""
+    import numpy as np
+
+    from shockwave_tpu.data.workload_info import parse_job_type
+
+    n = cols.n
+    if n == 0:
+        return []
+    job_types = cols.strs(0)
+    # Batches are homogeneous in practice: validate each DISTINCT
+    # job_type once instead of regex-free parsing n strings.
+    type_ok = {}
+    for jt in set(job_types):
+        try:
+            model, batch_size = parse_job_type(jt)
+            type_ok[jt] = bool(model) and batch_size > 0
+        except ValueError:
+            type_ok[jt] = False
+    total_steps = cols.total_steps
+    # Scalar contract: int(spec.get("scale_factor", 1)) or 1 -> an
+    # absent/zero scale is 1, and only then is < 1 an error.
+    scale = np.where(cols.scale_factor == 0, 1, cols.scale_factor)
+    bad_type = np.fromiter(
+        (not type_ok[jt] for jt in job_types), dtype=bool, count=n
+    )
+    bad_steps = total_steps <= 0
+    bad_scale = scale < 1
+    bad = bad_type | bad_steps | bad_scale
+    if bad.any():
+        i = int(np.argmax(bad))
+        if bad_type[i]:
+            raise ValueError(
+                f"job_type {job_types[i]!r} is not of the form "
+                "'Model (batch size N)'"
+            )
+        if bad_steps[i]:
+            raise ValueError(
+                f"total_steps must be positive, got {int(total_steps[i])}"
+            )
+        raise ValueError(
+            f"scale_factor must be >= 1, got {int(scale[i])}"
+        )
+    commands = cols.strs(1)
+    working_dirs = cols.strs(2)
+    num_steps_args = cols.strs(3)
+    modes = cols.strs(4)
+    tenants = cols.strs(5)
+    trace_contexts = cols.strs(6)
+    steps_list = total_steps.tolist()
+    scale_list = scale.tolist()
+    pw_list = cols.priority_weight.tolist()
+    slo_list = cols.slo.tolist()
+    dur_list = cols.duration.tolist()
+    ndd_list = cols.needs_data_dir.tolist()
+    return [
+        Job(
+            job_type=job_types[i],
+            command=commands[i],
+            working_directory=working_dirs[i],
+            num_steps_arg=num_steps_args[i] or "-n",
+            total_steps=steps_list[i],
+            scale_factor=scale_list[i],
+            mode=modes[i] or "static",
+            priority_weight=pw_list[i] or 1.0,
+            SLO=slo_list[i] if slo_list[i] > 0 else None,
+            duration=dur_list[i] if dur_list[i] > 0 else None,
+            needs_data_dir=bool(ndd_list[i]),
+            tenant=tenants[i],
+            trace_context=trace_contexts[i],
+        )
+        for i in range(n)
+    ]
+
+
 class _TenantLedger:
     """Pending-job counts per tenant. One private instance per plain
     queue; ONE SHARED instance across every shard of a sharded front

@@ -16,6 +16,16 @@ ROOT context — the span every scheduler/worker span of that job's life
 hangs under — and ``SubmitJobsRequest.trace_context`` (4, string) the
 batch RPC's own context. Both optional and default-empty, so untraced
 submissions stay byte-identical to the legacy wire.
+
+Columnar wire extensions (:mod:`.fastwire`):
+``SubmitJobsRequest.jobs_columnar`` (5, bytes) carries a whole batch
+as one ColumnarJobBlock frame instead of repeated ``jobs`` messages,
+and ``wire_caps`` (6 on both request and response, varint bitmask —
+bit 1 = columnar) is the capability negotiation: a submitter
+advertises on its first (legacy-encoded) request of a channel, a
+capable server echoes, and only then does the client switch to the
+frame. All three default to unset, so legacy traffic stays
+byte-identical.
 """
 
 from __future__ import annotations
@@ -126,11 +136,15 @@ class SubmitJobsRequest:
         jobs: List[JobSpec] = None,
         close: bool = False,
         trace_context: str = "",
+        jobs_columnar: bytes = b"",
+        wire_caps: int = 0,
     ):
         self.token = token
         self.jobs = list(jobs) if jobs else []
         self.close = bool(close)
         self.trace_context = trace_context
+        self.jobs_columnar = bytes(jobs_columnar)
+        self.wire_caps = int(wire_caps)
 
     def SerializeToString(self) -> bytes:  # noqa: N802
         out = bytearray()
@@ -139,6 +153,9 @@ class SubmitJobsRequest:
             put_msg(out, 2, spec.SerializeToString())
         put_varint(out, 3, int(self.close))
         put_str(out, 4, self.trace_context)
+        if self.jobs_columnar:
+            put_msg(out, 5, self.jobs_columnar)
+        put_varint(out, 6, self.wire_caps)
         return bytes(out)
 
     @classmethod
@@ -153,6 +170,10 @@ class SubmitJobsRequest:
                 request.close = bool(value)
             elif field == 4 and wire_type == 2:
                 request.trace_context = value.decode("utf-8")
+            elif field == 5 and wire_type == 2:
+                request.jobs_columnar = bytes(value)
+            elif field == 6 and wire_type == 0:
+                request.wire_caps = int(value)
         return request
 
 
@@ -167,12 +188,14 @@ class SubmitJobsResponse:
         admitted: int = 0,
         error: str = "",
         queue_depth: int = 0,
+        wire_caps: int = 0,
     ):
         self.status = status
         self.retry_after_s = float(retry_after_s)
         self.admitted = int(admitted)
         self.error = error
         self.queue_depth = int(queue_depth)
+        self.wire_caps = int(wire_caps)
 
     def SerializeToString(self) -> bytes:  # noqa: N802
         out = bytearray()
@@ -181,6 +204,7 @@ class SubmitJobsResponse:
         put_varint(out, 3, self.admitted)
         put_str(out, 4, self.error)
         put_varint(out, 5, self.queue_depth)
+        put_varint(out, 6, self.wire_caps)
         return bytes(out)
 
     @classmethod
@@ -197,4 +221,6 @@ class SubmitJobsResponse:
                 response.error = value.decode("utf-8")
             elif field == 5 and wire_type == 0:
                 response.queue_depth = int(value)
+            elif field == 6 and wire_type == 0:
+                response.wire_caps = int(value)
         return response

@@ -73,10 +73,26 @@ def put_msg(out: bytearray, field: int, payload: bytes) -> None:
     out += tag(field, 2) + encode_varint(len(payload)) + payload
 
 
+# Above these sizes the numpy bulk codec in fastwire wins over the
+# per-value Python loop (crossover measured well below both; the
+# margin keeps tiny messages — heartbeats, single acks — on the
+# allocation-free scalar path). fastwire is imported lazily so wire.py
+# stays importable without numpy-dependent module init ordering.
+_BULK_VALUES = 32
+_BULK_BYTES = 64
+
+
 def put_packed_varints(out: bytearray, field: int, values) -> None:
     """Packed repeated varint field (proto3's default for repeated
-    scalars; empty lists are omitted)."""
+    scalars; empty lists are omitted). Large lists take the
+    vectorized encoder — byte-identical output, ~20x fewer Python
+    ops per value (the Done / DumpMetrics hot paths)."""
     if not values:
+        return
+    if len(values) >= _BULK_VALUES:
+        from shockwave_tpu.runtime.protobuf import fastwire
+
+        put_msg(out, field, fastwire.encode_varints(values))
         return
     payload = b"".join(encode_varint(int(v)) for v in values)
     put_msg(out, field, payload)
@@ -85,11 +101,20 @@ def put_packed_varints(out: bytearray, field: int, values) -> None:
 def put_packed_doubles(out: bytearray, field: int, values) -> None:
     if not values:
         return
+    if len(values) >= _BULK_VALUES:
+        from shockwave_tpu.runtime.protobuf import fastwire
+
+        put_msg(out, field, fastwire.encode_doubles(values))
+        return
     payload = b"".join(struct.pack("<d", float(v)) for v in values)
     put_msg(out, field, payload)
 
 
 def unpack_packed_varints(payload: bytes) -> List[int]:
+    if len(payload) >= _BULK_BYTES:
+        from shockwave_tpu.runtime.protobuf import fastwire
+
+        return fastwire.decode_varints(payload).tolist()
     values = []
     pos = 0
     while pos < len(payload):
@@ -101,6 +126,10 @@ def unpack_packed_varints(payload: bytes) -> List[int]:
 def unpack_packed_doubles(payload: bytes) -> List[float]:
     if len(payload) % 8:
         raise ValueError("truncated packed double field")
+    if len(payload) >= _BULK_BYTES:
+        from shockwave_tpu.runtime.protobuf import fastwire
+
+        return fastwire.decode_doubles(payload).tolist()
     return [v[0] for v in struct.iter_unpack("<d", payload)]
 
 

@@ -215,7 +215,14 @@ class JobState:
 
 class Heartbeat:
     """message Heartbeat { worker_id, job_state, client_send_s,
-    est_offset_s, est_rtt_s, trace_context }"""
+    est_offset_s, est_rtt_s, trace_context, metrics_text }
+
+    ``metrics_text`` (field 7) piggy-backs the agent's rendered
+    Prometheus registry on a due heartbeat, coalescing the separate
+    DumpMetrics poll into the RPC that already crosses the wire every
+    interval. Empty (the default, and what legacy workers send) means
+    "no dump attached" — the scheduler's pull path still covers that
+    peer, so both generations interoperate."""
 
     def __init__(
         self,
@@ -225,6 +232,7 @@ class Heartbeat:
         est_offset_s: float = 0.0,
         est_rtt_s: float = 0.0,
         trace_context: str = "",
+        metrics_text: str = "",
     ):
         self.worker_id = int(worker_id)
         self.job_state = list(job_state) if job_state else []
@@ -232,6 +240,7 @@ class Heartbeat:
         self.est_offset_s = float(est_offset_s)
         self.est_rtt_s = float(est_rtt_s)
         self.trace_context = trace_context
+        self.metrics_text = metrics_text
 
     def SerializeToString(self) -> bytes:  # noqa: N802
         out = bytearray()
@@ -242,6 +251,7 @@ class Heartbeat:
         put_double(out, 4, self.est_offset_s)
         put_double(out, 5, self.est_rtt_s)
         put_str(out, 6, self.trace_context)
+        put_str(out, 7, self.metrics_text)
         return bytes(out)
 
     @classmethod
@@ -260,6 +270,8 @@ class Heartbeat:
                 msg.est_rtt_s = value
             elif field == 6 and wire_type == 2:
                 msg.trace_context = value.decode("utf-8")
+            elif field == 7 and wire_type == 2:
+                msg.metrics_text = value.decode("utf-8")
         return msg
 
 

@@ -11,18 +11,36 @@ Callbacks supplied by the scheduler:
   submit_jobs(token, specs, close)
       -> (status, retry_after_s, admitted, queue_depth)
       (the streaming-admission front door; see runtime/admission.py)
+  submit_jobs_many(requests) -> aligned [(status, retry_after_s,
+      admitted, queue_depth)] for requests = [(token, jobs, close)]
+      with Job objects (optional — arms the read-loop frame
+      coalescer: concurrent SubmitJobs handler threads decode their
+      frames in parallel, then convoy through ONE vectorized call
+      here instead of N scalar ones; see _SubmitCoalescer)
+  worker_metrics(worker_id, text)
+      (optional — a heartbeat that coalesced the worker's due metrics
+      dump delivers the Prometheus text here, saving the fleet
+      telemetry pull RPC; see obs/fleet.py)
   explain_job(job_id) -> narrative dict or None
       (market explainability; optional — the ExplainJob method is
       registered only when this callback is wired, see obs/explain.py)
+
+SubmitJobs requests are deserialized by fastwire's columnar-aware
+scanner (one top-level pass; the received buffer IS the string arena —
+no per-job message objects for either the legacy or the columnar
+encoding). Handlers stay duck-compatible with plain
+admission_pb2.SubmitJobsRequest objects for direct callers in tests.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
 from concurrent import futures
 
 import grpc
 
+from shockwave_tpu.analysis import sanitize
 from shockwave_tpu.runtime.protobuf import (
     common_pb2,
     iterator_to_scheduler_pb2 as it_pb2,
@@ -94,6 +112,16 @@ def _worker_to_scheduler_handlers(callbacks):
                 est_offset_s=request.est_offset_s,
                 est_rtt_s=request.est_rtt_s,
             )
+        # Heartbeat-coalesced metrics push: a beat that carries the
+        # worker's due Prometheus dump feeds the fleet store directly,
+        # replacing that cycle's DumpMetrics pull RPC. The liveness
+        # callback above already ran — a fat beat is never less alive
+        # than a thin one.
+        text = getattr(request, "metrics_text", "")
+        if text:
+            metrics_cb = callbacks.get("worker_metrics")
+            if metrics_cb is not None:
+                metrics_cb(request.worker_id, text)
         epoch_cb = callbacks.get("sched_epoch")
         return w2s_pb2.HeartbeatAck(
             sched_recv_s=recv_s,
@@ -188,37 +216,131 @@ def _iterator_to_scheduler_handlers(callbacks):
     return {"InitJob": InitJob, "UpdateLease": UpdateLease}
 
 
+class _SubmitCoalescer:
+    """Read-loop frame coalescing for the admission front door:
+    concurrent SubmitJobs handler threads have already decoded their
+    frames (in parallel, zero-copy over their recv buffers); they stage
+    the decoded ``(token, jobs, close)`` here, and the first thread to
+    find no leader running commits the whole convoy — its own entry
+    plus everything that piled up while it worked — through ONE
+    ``submit_jobs_many`` call. Followers block on their entry's event
+    and return the leader's aligned verdict. Mirrors the group-commit
+    convoy in runtime/admission.py, lifted to the wire handler so the
+    vectorized admission pass also absorbs the per-request callback
+    overhead."""
+
+    def __init__(self, submit_many):
+        self._submit_many = submit_many
+        self._lock = sanitize.make_lock(
+            "runtime.rpc.scheduler_server._SubmitCoalescer._lock"
+        )
+        self._staged: list = []
+        self._leader = False
+
+    def submit(self, token, jobs, close):
+        entry = [token, jobs, close, threading.Event(), None, None]
+        with self._lock:
+            self._staged.append(entry)
+            if self._leader:
+                leader = False
+            else:
+                self._leader = True
+                leader = True
+        if not leader:
+            entry[3].wait()
+            if entry[5] is not None:
+                raise entry[5]
+            return entry[4]
+        try:
+            while True:
+                with self._lock:
+                    convoy = self._staged
+                    self._staged = []
+                    if not convoy:
+                        self._leader = False
+                        break
+                try:
+                    outs = self._submit_many(
+                        [(e[0], e[1], e[2]) for e in convoy]
+                    )
+                    for e, out in zip(convoy, outs):
+                        e[4] = out
+                        e[3].set()
+                except BaseException as exc:
+                    for e in convoy:
+                        if e[4] is None:
+                            e[5] = exc
+                        e[3].set()
+                    raise
+        except BaseException:
+            with self._lock:
+                self._leader = False
+                leftover = self._staged
+                self._staged = []
+            for e in leftover:
+                e[5] = e[5] or RuntimeError(
+                    "submit coalescer leader died before this entry"
+                )
+                e[3].set()
+            raise
+        if entry[5] is not None:
+            raise entry[5]
+        return entry[4]
+
+
 def _admission_handlers(callbacks):
+    from shockwave_tpu.runtime import admission
     from shockwave_tpu.runtime.protobuf import admission_pb2 as adm_pb2
+    from shockwave_tpu.runtime.protobuf import fastwire
+
+    submit_many = callbacks.get("submit_jobs_many")
+    coalescer = (
+        _SubmitCoalescer(submit_many) if submit_many is not None else None
+    )
 
     def SubmitJobs(request, context):
+        caps = int(getattr(request, "wire_caps", 0))
         try:
-            specs = [
-                {
-                    "job_type": spec.job_type,
-                    "command": spec.command,
-                    "working_directory": spec.working_directory,
-                    "num_steps_arg": spec.num_steps_arg,
-                    "total_steps": spec.total_steps,
-                    "scale_factor": spec.scale_factor,
-                    "mode": spec.mode,
-                    "priority_weight": spec.priority_weight,
-                    "slo": spec.slo,
-                    "duration": spec.duration,
-                    "needs_data_dir": spec.needs_data_dir,
-                    "tenant": spec.tenant,
-                    "trace_context": spec.trace_context,
-                }
-                for spec in request.jobs
-            ]
-            status, retry_after_s, admitted, depth = callbacks[
-                "submit_jobs"
-            ](request.token, specs, bool(request.close))
+            # fastwire-deserialized requests carry the batch as
+            # columns (whichever encoding the peer sent); plain
+            # admission_pb2 requests from direct callers still carry
+            # JobSpec objects.
+            cols = getattr(request, "columns", None)
+            if coalescer is not None:
+                jobs = (
+                    admission.jobs_from_columns(cols)
+                    if cols is not None
+                    else [
+                        admission.job_from_spec_dict(
+                            _spec_dict(spec)
+                        )
+                        for spec in request.jobs
+                    ]
+                )
+                status, retry_after_s, admitted, depth = coalescer.submit(
+                    request.token, jobs, bool(request.close)
+                )
+            else:
+                specs = (
+                    cols.to_spec_dicts()
+                    if cols is not None
+                    else [_spec_dict(spec) for spec in request.jobs]
+                )
+                status, retry_after_s, admitted, depth = callbacks[
+                    "submit_jobs"
+                ](request.token, specs, bool(request.close))
             return adm_pb2.SubmitJobsResponse(
                 status=status,
                 retry_after_s=float(retry_after_s),
                 admitted=int(admitted),
                 queue_depth=int(depth),
+                # Echo columnar support only to peers that asked, so a
+                # legacy client's response bytes stay byte-identical.
+                wire_caps=(
+                    fastwire.CAP_COLUMNAR
+                    if caps & fastwire.CAP_COLUMNAR
+                    else 0
+                ),
             )
         except ValueError as e:
             # A malformed spec is the SUBMITTER's bug: report it on the
@@ -234,6 +356,32 @@ def _admission_handlers(callbacks):
     return {"SubmitJobs": SubmitJobs}
 
 
+def _spec_dict(spec) -> dict:
+    """Wire-facing spec dict from one admission_pb2.JobSpec (the legacy
+    per-message decode path for direct/test callers)."""
+    return {
+        "job_type": spec.job_type,
+        "command": spec.command,
+        "working_directory": spec.working_directory,
+        "num_steps_arg": spec.num_steps_arg,
+        "total_steps": spec.total_steps,
+        "scale_factor": spec.scale_factor,
+        "mode": spec.mode,
+        "priority_weight": spec.priority_weight,
+        "slo": spec.slo,
+        "duration": spec.duration,
+        "needs_data_dir": spec.needs_data_dir,
+        "tenant": spec.tenant,
+        "trace_context": spec.trace_context,
+    }
+
+
+def _admission_deserializers() -> dict:
+    from shockwave_tpu.runtime.protobuf import fastwire
+
+    return {"SubmitJobs": fastwire.FastSubmitRequest.FromString}
+
+
 def serve(port: int, callbacks: dict, max_workers: int = 32) -> grpc.Server:
     """Start (and return) the scheduler's gRPC server; non-blocking."""
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
@@ -245,9 +393,12 @@ def serve(port: int, callbacks: dict, max_workers: int = 32) -> grpc.Server:
         "IteratorToScheduler",
         _iterator_to_scheduler_handlers(callbacks),
     )
-    if "submit_jobs" in callbacks:
+    if "submit_jobs" in callbacks or "submit_jobs_many" in callbacks:
         add_servicer(
-            server, "AdmissionToScheduler", _admission_handlers(callbacks)
+            server,
+            "AdmissionToScheduler",
+            _admission_handlers(callbacks),
+            request_deserializers=_admission_deserializers(),
         )
     server.add_insecure_port(f"[::]:{port}")
     server.start()

@@ -18,11 +18,23 @@ exercises the token ledger). See runtime/faults.py.
 :meth:`submit_stream` is the convenience loop a driver uses: batches a
 whole trace, honors ``RETRY_AFTER`` backpressure by sleeping and
 resubmitting the same token, and sends the end-of-stream close.
+
+Columnar wire negotiation (:mod:`..protobuf.fastwire`): every request
+advertises ``CAP_COLUMNAR`` while the knob ``SHOCKWAVE_WIRE_COLUMNAR``
+is on (the default); the first batch of a fresh channel still rides
+the legacy repeated-JobSpec encoding (it doubles as the caps probe),
+and once the server echoes the bit, later batches switch to the
+columnar frame — one per-batch numpy encode instead of 13 field
+encoders per job. Against a legacy server the echo never comes and
+every byte stays identical to the legacy wire. Retries re-encode per
+attempt, so a failover to a legacy peer mid-retry falls back to the
+legacy encoding with the SAME token and trace roots.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 import uuid
@@ -38,6 +50,7 @@ from shockwave_tpu.obs import propagate
 from shockwave_tpu.runtime import faults
 from shockwave_tpu.runtime.admission import job_to_spec_dict
 from shockwave_tpu.runtime.protobuf import admission_pb2 as adm_pb2
+from shockwave_tpu.runtime.protobuf import fastwire
 from shockwave_tpu.runtime.retry import RetryPolicy, call_with_retry
 from shockwave_tpu.runtime.rpc.wiring import make_stubs
 
@@ -95,6 +108,17 @@ class SubmitterClient:
         self._channel_lock = threading.Lock()
         self._channel = None
         self._stubs = None
+        # Columnar wire negotiation (fastwire): while enabled, every
+        # request advertises CAP_COLUMNAR; once the peer echoes it,
+        # batches on THIS channel switch to the columnar frame. Cleared
+        # with the channel — a failover target must re-prove support
+        # before any frame is sent blind (a legacy server would parse
+        # the unknown field as an empty batch and burn the token).
+        # SHOCKWAVE_WIRE_COLUMNAR=0 pins pure legacy bytes end to end.
+        self._columnar_enabled = os.environ.get(
+            "SHOCKWAVE_WIRE_COLUMNAR", "1"
+        ).lower() not in ("0", "false", "no", "off")
+        self._peer_caps = 0
 
     def next_token(self) -> str:
         with self._channel_lock:
@@ -113,9 +137,11 @@ class SubmitterClient:
 
     def _reset_channel(self) -> None:
         """Tear down the persistent channel (transport error or a
-        failover retarget); the next submit rebuilds it."""
+        failover retarget); the next submit rebuilds it and
+        re-negotiates wire capabilities from scratch."""
         with self._channel_lock:
             channel, self._channel, self._stubs = self._channel, None, None
+            self._peer_caps = 0
         if channel is not None:
             try:
                 channel.close()
@@ -154,12 +180,20 @@ class SubmitterClient:
         queue_depth); raises :class:`SubmissionRejected` on INVALID/
         ERROR statuses."""
         token = token if token is not None else self.next_token()
-        request, batch_ctx = self._build_request(token, jobs, close)
+        spec_dicts, batch_ctx = self._prepare_specs(token, jobs)
 
         def attempt(timeout):
             # Pre-send faults: the request never reaches the wire.
             faults.check_rpc(
                 "SubmitJobs", kinds=("rpc_error", "rpc_delay")
+            )
+            # Encoded per attempt against the CURRENT channel's
+            # negotiated capabilities: a retry that crossed a channel
+            # reset (failover to a possibly-legacy server) re-sends the
+            # same token and trace roots in the legacy encoding until
+            # the new peer re-proves columnar support.
+            request = self._encode_request(
+                token, spec_dicts, close, batch_ctx
             )
             try:
                 response = self._get_stubs().SubmitJobs(
@@ -171,6 +205,7 @@ class SubmitterClient:
                 # policy re-offers the same token.
                 self._reset_channel()
                 raise
+            self._note_peer_caps(response)
             # Post-send faults: the scheduler processed the batch but
             # the response is lost — the retry re-sends the SAME token
             # and must be deduplicated server-side.
@@ -180,7 +215,7 @@ class SubmitterClient:
 
         with obs.span(
             "submit_jobs", cat="rpc", pid="submitter", tid="rpc",
-            args={"token": token, "jobs": len(request.jobs),
+            args={"token": token, "jobs": len(spec_dicts),
                   **propagate.ctx_args(batch_ctx)},
         ):
             response = call_with_retry(
@@ -188,11 +223,12 @@ class SubmitterClient:
             )
         return self._check_response(response, len(jobs))
 
-    def _build_request(self, token: str, jobs: Sequence, close: bool):
-        """SubmitJobsRequest + its batch trace context for one batch
-        (built ONCE per batch — transport retries and pipelined
-        re-offers re-send the same request bytes with the same
-        token)."""
+    def _prepare_specs(self, token: str, jobs: Sequence):
+        """Spec dicts + the batch trace context for one batch (built
+        ONCE per batch — transport retries and pipelined re-offers
+        re-send the same specs, trace roots, and token; only the wire
+        ENCODING is chosen per attempt against the current channel's
+        negotiated capabilities)."""
         spec_dicts = [
             dict(j) if isinstance(j, dict) else job_to_spec_dict(j)
             for j in jobs
@@ -201,7 +237,10 @@ class SubmitterClient:
         # under the context minted HERE (submit is the chain's first
         # event). Created once per call, BEFORE the retry loop — a
         # transport retry re-sends the same context with the same token.
-        for spec in spec_dicts:
+        # Gated ONCE per batch: with tracing off, new_root() would
+        # no-op per job, but at line rate even a no-op call per job is
+        # measurable on the submit path.
+        for spec in spec_dicts if obs.trace_enabled() else ():
             if spec.get("trace_context"):
                 continue
             ctx = propagate.new_root()
@@ -221,13 +260,47 @@ class SubmitterClient:
         batch_ctx = None
         if any(spec.get("trace_context") for spec in spec_dicts):
             batch_ctx = propagate.new_root(force_sample=True)
-        request = adm_pb2.SubmitJobsRequest(
+        return spec_dicts, batch_ctx
+
+    def _note_peer_caps(self, response) -> None:
+        """Record the peer's capability echo for the current channel
+        (monotonic per channel: the echo can only turn columnar ON;
+        only a channel reset clears it)."""
+        caps = int(getattr(response, "wire_caps", 0))
+        if caps & fastwire.CAP_COLUMNAR:
+            with self._channel_lock:
+                self._peer_caps |= fastwire.CAP_COLUMNAR
+
+    def _encode_request(self, token, spec_dicts, close, batch_ctx):
+        """One SubmitJobsRequest for a prepared batch, encoded for the
+        CURRENT channel: the columnar frame once the peer has echoed
+        CAP_COLUMNAR, the byte-identical legacy encoding otherwise
+        (including every request while negotiation is still open — the
+        first batch on a fresh channel doubles as the caps probe)."""
+        if not self._columnar_enabled:
+            return adm_pb2.SubmitJobsRequest(
+                token=token,
+                jobs=[adm_pb2.JobSpec(**spec) for spec in spec_dicts],
+                close=close,
+                trace_context=propagate.ctx_wire(batch_ctx),
+            )
+        with self._channel_lock:
+            columnar = bool(self._peer_caps & fastwire.CAP_COLUMNAR)
+        if columnar and spec_dicts:
+            return adm_pb2.SubmitJobsRequest(
+                token=token,
+                close=close,
+                trace_context=propagate.ctx_wire(batch_ctx),
+                jobs_columnar=fastwire.encode_columnar_block(spec_dicts),
+                wire_caps=fastwire.CAP_COLUMNAR,
+            )
+        return adm_pb2.SubmitJobsRequest(
             token=token,
             jobs=[adm_pb2.JobSpec(**spec) for spec in spec_dicts],
             close=close,
             trace_context=propagate.ctx_wire(batch_ctx),
+            wire_caps=fastwire.CAP_COLUMNAR,
         )
-        return request, batch_ctx
 
     @staticmethod
     def _check_response(response, num_jobs: int):
@@ -361,6 +434,7 @@ class SubmitterClient:
             if future is not None:
                 try:
                     response = future.result()
+                    self._note_peer_caps(response)
                     # Post-receive faults: response lost after the
                     # server processed the batch — the serial fallback
                     # re-offers the same token and dedups.
@@ -400,13 +474,16 @@ class SubmitterClient:
             for batch in _tenant_batches(jobs, batch_size):
                 token = self.next_token()
                 tokens.append(token)
-                request, _ctx = self._build_request(token, batch, False)
+                spec_dicts, batch_ctx = self._prepare_specs(token, batch)
                 try:
                     # Pre-send faults: the request never reached the
                     # wire — no future to wait on, straight to the
                     # serial fallback (same token).
                     faults.check_rpc(
                         "SubmitJobs", kinds=("rpc_error", "rpc_delay")
+                    )
+                    request = self._encode_request(
+                        token, spec_dicts, False, batch_ctx
                     )
                     future = self._get_stubs().SubmitJobs.future(
                         request, timeout=self._retry.call_timeout_s

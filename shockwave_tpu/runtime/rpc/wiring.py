@@ -98,15 +98,26 @@ def make_stubs(channel: grpc.Channel, service: str) -> SimpleNamespace:
     return SimpleNamespace(**stubs)
 
 
-def add_servicer(server: grpc.Server, service: str, handlers: dict) -> None:
+def add_servicer(
+    server: grpc.Server,
+    service: str,
+    handlers: dict,
+    request_deserializers: dict = None,
+) -> None:
     """Register ``handlers`` ({method: fn(request, context) -> response})
-    for ``service`` on a grpc server."""
+    for ``service`` on a grpc server. ``request_deserializers``
+    overrides the request decoder per method (the admission server
+    swaps in fastwire's columnar-aware scan for SubmitJobs); the bytes
+    on the wire are unchanged — only who parses them."""
     method_handlers = {}
     for method, fn in handlers.items():
         req_cls, resp_cls = SERVICES[service][method]
+        deserializer = (request_deserializers or {}).get(
+            method, req_cls.FromString
+        )
         method_handlers[method] = grpc.unary_unary_rpc_method_handler(
             fn,
-            request_deserializer=req_cls.FromString,
+            request_deserializer=deserializer,
             response_serializer=resp_cls.SerializeToString,
         )
     server.add_generic_rpc_handlers(

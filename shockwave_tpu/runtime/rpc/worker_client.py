@@ -136,13 +136,16 @@ class WorkerRpcClient:
         est_offset_s: float = 0.0,
         est_rtt_s: float = 0.0,
         trace_context: str = "",
+        metrics_text: str = "",
     ):
         """One liveness ping; doubles as a clock-offset exchange.
         Reports the worker's current best (offset, rtt) estimate to the
         scheduler and returns ``(clock_sample, sched_epoch)``: this
         ping's fresh (offset_s, rtt_s) sample (``None`` against a
         legacy scheduler) and the acking scheduler's fencing epoch
-        (0 = HA off / legacy)."""
+        (0 = HA off / legacy). ``metrics_text`` piggy-backs a rendered
+        metrics dump on the beat (one RPC instead of beat + poll); a
+        legacy scheduler skips the unknown field harmlessly."""
         import time
 
         t0 = time.time()
@@ -155,6 +158,7 @@ class WorkerRpcClient:
                     est_offset_s=est_offset_s,
                     est_rtt_s=est_rtt_s,
                     trace_context=trace_context,
+                    metrics_text=metrics_text,
                 ),
                 timeout=timeout,
             ),

@@ -21,6 +21,7 @@ import shutil
 import signal
 import socket
 import threading
+import time
 from typing import Optional
 
 LOG = logging.getLogger("runtime.worker")
@@ -152,6 +153,15 @@ class Worker:
         self._heartbeat_interval = float(
             os.environ.get("SHOCKWAVE_HEARTBEAT_S", heartbeat_interval_s)
         )
+        # Coalesced metrics push: when a dump is due, the next beat
+        # carries the rendered registry (Heartbeat.metrics_text), so
+        # the fleet plane's poll for this agent becomes a no-op — one
+        # RPC where the wire used to carry beat + DumpMetrics. <= 0
+        # disables (pull-only, the legacy shape).
+        self._metrics_push_interval = float(
+            os.environ.get("SHOCKWAVE_METRICS_PUSH_S", 5.0)
+        )
+        self._last_metrics_push = 0.0
         if self._heartbeat_interval > 0:
             threading.Thread(
                 target=self._heartbeat_loop, daemon=True
@@ -200,13 +210,18 @@ class Worker:
                 self._try_reattach()
             best = self._clock_sync.best()
             any_ok = False
-            for worker_id in self._worker_ids:
+            push_text = self._render_metrics_push()
+            for index, worker_id in enumerate(self._worker_ids):
                 try:
                     sample, epoch = self._rpc_client.send_heartbeat(
                         worker_id,
                         est_offset_s=best[0] if best else 0.0,
                         est_rtt_s=best[1] if best else 0.0,
                         trace_context=propagate.ctx_wire(self._agent_ctx),
+                        # One dump per agent per due interval, riding
+                        # the first id's beat (the fleet plane keys the
+                        # whole agent on min(worker_ids)).
+                        metrics_text=push_text if index == 0 else "",
                     )
                 except Exception:
                     # Single-shot by policy: the next tick is the retry,
@@ -216,6 +231,10 @@ class Worker:
                     LOG.debug("heartbeat failed", exc_info=True)
                     continue
                 any_ok = True
+                if index == 0 and push_text:
+                    # Delivered: a failed beat leaves the stamp alone,
+                    # so the next tick re-attaches a fresh render.
+                    self._last_metrics_push = time.monotonic()
                 self._witness_epoch(epoch)
                 self._clock_sync.add(sample)
             if any_ok:
@@ -228,6 +247,21 @@ class Worker:
                 self._outage.record_failure()
             if obs.trace_enabled():
                 self._export_clock_meta()
+
+    def _render_metrics_push(self) -> str:
+        """Rendered Prometheus text when a coalesced push is due, else
+        "". Due = metrics enabled, pushing enabled, and at least
+        SHOCKWAVE_METRICS_PUSH_S since the last delivered push."""
+        from shockwave_tpu import obs
+
+        if self._metrics_push_interval <= 0 or not obs.metrics_enabled():
+            return ""
+        if (
+            time.monotonic() - self._last_metrics_push
+            < self._metrics_push_interval
+        ):
+            return ""
+        return obs.render_prometheus()
 
     def _try_reattach(self) -> bool:
         """Outage recovery: resolve the current leader from the HA

@@ -621,6 +621,80 @@ def test_submit_many_matches_scalar_reference():
         assert vec.summary() == ref.summary()
 
 
+def test_jobs_from_columns_matches_scalar_decode():
+    """The vectorized column materializer must be decision-identical
+    to per-spec ``job_from_spec_dict``: same Jobs (defaults applied
+    the same way) and, for invalid batches, the SAME first error with
+    the SAME message."""
+    from shockwave_tpu.runtime.protobuf import fastwire
+
+    rng = np.random.default_rng(13)
+    for trial in range(6):
+        n = int(rng.integers(1, 40))
+        specs = []
+        for i in range(n):
+            specs.append(
+                {
+                    "job_type": f"ResNet-{int(rng.integers(1, 60))} "
+                    f"(batch size {int(rng.integers(1, 256))})",
+                    "command": "python3 main.py" if i % 2 else "",
+                    "num_steps_arg": "" if i % 3 else "-e",
+                    "total_steps": int(rng.integers(1, 5000)),
+                    "scale_factor": int(rng.integers(0, 4)),
+                    "mode": "" if i % 4 else "dynamic",
+                    "priority_weight": float(rng.choice([0.0, 2.0])),
+                    "slo": float(rng.choice([0.0, 4.5])),
+                    "duration": float(rng.choice([0.0, 600.0])),
+                    "needs_data_dir": bool(i % 3 == 0),
+                    "tenant": f"t{i % 2}" if i % 2 else "",
+                }
+            )
+        cols = fastwire.decode_columnar_block(
+            fastwire.encode_columnar_block(specs)
+        )
+        want = [admission.job_from_spec_dict(s) for s in specs]
+        assert admission.jobs_from_columns(cols) == want
+
+
+@pytest.mark.parametrize(
+    "poison",
+    [
+        {"job_type": "garbage with no batch size"},
+        {"total_steps": 0},
+        {"scale_factor": -2},
+        # All three wrong at once: the scalar path reports job_type
+        # first — the columns must agree on the precedence.
+        {
+            "job_type": "garbage",
+            "total_steps": -1,
+            "scale_factor": -1,
+        },
+    ],
+)
+def test_jobs_from_columns_error_parity(poison):
+    from shockwave_tpu.runtime.protobuf import fastwire
+
+    specs = [
+        {
+            "job_type": "ResNet-18 (batch size 32)",
+            "command": "c",
+            "total_steps": 10,
+            "scale_factor": 1,
+            "mode": "static",
+        }
+        for _ in range(5)
+    ]
+    specs[3] = {**specs[3], **poison}
+    with pytest.raises(ValueError) as scalar_err:
+        [admission.job_from_spec_dict(s) for s in specs]
+    cols = fastwire.decode_columnar_block(
+        fastwire.encode_columnar_block(specs)
+    )
+    with pytest.raises(ValueError) as columnar_err:
+        admission.jobs_from_columns(cols)
+    assert str(columnar_err.value) == str(scalar_err.value)
+
+
 def test_submit_many_quota_knockout_frees_backpressure_room():
     """A quota-rejected batch must not count toward the depth the
     batches BEHIND it see — exactly what the sequential walk does."""

@@ -902,3 +902,74 @@ def test_ingest_mid_round_arrivals_replay_exactly(tmp_path, monkeypatch):
         r["round"] for r in results if r["diff"]
     ]
     obs.reset()
+
+
+def test_heartbeat_coalesces_metrics_push_with_liveness():
+    """The combined heartbeat+metrics RPC: a beat carrying
+    ``metrics_text`` must (1) keep the PR-7 liveness contract — the
+    heartbeat callback fires exactly as for a thin beat, clock fields
+    intact — and (2) deliver the dump to the fleet plane so the next
+    poll tick SKIPS that target; a thin beat must leave the fleet
+    store untouched. Legacy workers (no metrics_text) therefore keep
+    the pull path."""
+    from shockwave_tpu.obs.fleet import FleetTelemetry
+    from shockwave_tpu.runtime.rpc import scheduler_server
+    from shockwave_tpu.runtime.rpc.worker_client import WorkerRpcClient
+
+    fleet = FleetTelemetry(scrape_interval_s=30.0)
+    pulls = []
+
+    def pull():
+        pulls.append(time.monotonic())
+        return "# HELP pulled_series help\npulled_series 1.0\n"
+
+    fleet.add_target("0", pull)
+    beats = []
+
+    def heartbeat(worker_id, est_offset_s=0.0, est_rtt_s=0.0):
+        beats.append((int(worker_id), est_offset_s, est_rtt_s))
+
+    def worker_metrics(worker_id, text):
+        # The scheduler maps worker_id -> fleet label; this test's map
+        # is the identity.
+        fleet.accept_push(str(int(worker_id)), text)
+
+    port = free_port()
+    server = scheduler_server.serve(
+        port,
+        {
+            "heartbeat": heartbeat,
+            "worker_metrics": worker_metrics,
+            "sched_epoch": lambda: 7,
+        },
+    )
+    try:
+        client = WorkerRpcClient("127.0.0.1", port)
+        # Thin beat: liveness only, fleet store untouched.
+        sample, epoch = client.send_heartbeat(
+            0, est_offset_s=0.01, est_rtt_s=0.002
+        )
+        assert epoch == 7 and sample is not None
+        assert beats == [(0, 0.01, 0.002)]
+        assert fleet.poll_once() == 1  # nothing fresh: pull happens
+        assert len(pulls) == 1
+
+        # Fat beat: same liveness callback + the dump lands in the
+        # fleet store under the worker's label.
+        text = "# HELP pushed_series help\npushed_series 2.0\n"
+        sample, epoch = client.send_heartbeat(
+            0, est_offset_s=0.01, est_rtt_s=0.002, metrics_text=text
+        )
+        assert epoch == 7 and sample is not None
+        assert len(beats) == 2 and beats[1] == beats[0]
+        assert "pushed_series" in fleet.render()
+        # The push is fresher than the poll interval: the next tick
+        # must NOT pull this target again (the coalesced RPC already
+        # carried its data) yet still counts it as answered.
+        assert fleet.poll_once() == 1
+        assert len(pulls) == 1
+        # A push for an unknown label is dropped, not resurrected.
+        assert not fleet.accept_push("99", "ghost 1.0\n")
+        assert "ghost" not in fleet.render()
+    finally:
+        server.stop(0)
