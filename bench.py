@@ -254,6 +254,103 @@ def ingest_phase():
     }
 
 
+def obs_scale_phase():
+    """Telemetry-at-scale cost, the two numbers the PR-19 scale plane
+    stakes (both gated by check_bench_regression.py):
+
+    ``obs_overhead_pct`` (lower is better): the ingest-phase workload
+    (REAL admission work — vectorized submit_many + drain, which
+    observes histograms, bumps counters, sets gauges, and offers
+    exemplars on every drain) run as ALTERNATING metrics-off /
+    metrics-on rep pairs, median of per-pair overhead ratios — pairing
+    cancels the host drift that made independent min-of-N arms swing
+    tens of points on this shared-core box. The delta is the whole
+    price of the instrumented hot path: sketch feeds, governor
+    admission checks, reservoir offers. The 100k-job campaign artifact
+    (scripts/microbenchmarks/bench_obs_scale.py) owns the end-to-end
+    ≤2% number; this phase isolates the per-call cost so a regression
+    points at obs/metrics.py, not the campaign shape.
+
+    ``metrics_render_ms`` (lower is better): one ``render_text`` of a
+    governor-saturated registry — every family at its series budget
+    after a 5k-label flood plus sketch-backed histograms — i.e. the
+    worst /metrics scrape the budget permits. Render cost is bounded
+    by the budget, not the campaign size; that bound is what the gate
+    holds."""
+    from shockwave_tpu import obs
+    from shockwave_tpu.core.job import Job
+    from shockwave_tpu.runtime.admission import AdmissionQueue
+
+    calls_per_rep, reqs_per_call, jobs_per_req = 8, 32, 8
+    jobs_per_rep = calls_per_rep * reqs_per_call * jobs_per_req
+    job = Job(
+        job_type="ResNet-18 (batch size 32)",
+        command="python3 main.py",
+        total_steps=200,
+        scale_factor=1,
+        mode="static",
+    )
+    seq = [0]
+
+    def rep(queue):
+        t0 = time.time()
+        for _ in range(calls_per_rep):
+            reqs = []
+            for _ in range(reqs_per_call):
+                reqs.append(
+                    (f"obsbench-{seq[0]:06d}", [job] * jobs_per_req)
+                )
+                seq[0] += 1
+            results = queue.submit_many(reqs)
+            assert all(r[0] == "ACCEPTED" for r in results)
+        drained = queue.drain()
+        assert len(drained) == jobs_per_rep
+        return time.time() - t0
+
+    def make_queue():
+        return AdmissionQueue(
+            capacity=2 * jobs_per_rep, group_commit=True,
+            clock=time.monotonic,
+        )
+
+    obs.reset()
+    q_off = make_queue()
+    obs.configure(metrics=True)
+    q_on = make_queue()
+    ratios = []
+    for pair in range(13):  # pair 0 warms both arms, outside the set
+        obs.configure(metrics=False)
+        t_off = rep(q_off)
+        obs.configure(metrics=True)
+        t_on = rep(q_on)
+        if pair:
+            ratios.append(t_on / t_off)
+    ratios.sort()
+    overhead_pct = 100.0 * (ratios[len(ratios) // 2] - 1.0)
+
+    # Saturate the governor, then time the worst permitted render.
+    flood = obs.gauge(
+        "bench_job_progress", "per-job flood to saturate the budget"
+    )
+    for i in range(5_000):
+        flood.set(float(i % 13), job_id=str(i))
+        if i % 500 == 0:
+            obs.scale_tick(float(i))
+    t0 = time.time()
+    text = obs.get_registry().render_text()
+    render_ms = 1000.0 * (time.time() - t0)
+    assert text
+    obs.reset()
+    return {
+        "obs_overhead_pct": round(overhead_pct, 2),
+        "metrics_render_ms": round(render_ms, 3),
+        "obs_scale_config": (
+            f"{calls_per_rep}x{reqs_per_call}x{jobs_per_req} jobs/rep "
+            "admission A/B, 5k-label flood render"
+        ),
+    }
+
+
 def wire_phase():
     """Wire-path line rate, the two layers the fastwire codec owns.
 
@@ -731,6 +828,10 @@ def main():
         # (wire_decode_jobs_per_s and wire_submits_per_s gated by
         # check_bench_regression.py).
         **wire_phase(),
+        # Telemetry at scale: instrumented-hot-path overhead A/B and
+        # the budget-saturated /metrics render (obs_overhead_pct and
+        # metrics_render_ms gated by check_bench_regression.py).
+        **obs_scale_phase(),
         "config": "1000 jobs x 256 gpus x 50 rounds",
     }
 

@@ -33,6 +33,8 @@ from scripts.analysis.report_run import (  # noqa: E402
     _fmt,
     calibration_fleet,
     calibration_rows,
+    exemplar_rows,
+    history_stats,
     ingest_stats,
     load_json_input,
     load_metrics,
@@ -75,6 +77,10 @@ def collect(metrics_path, trace_path=None, decisions_path=None) -> dict:
         "ingest": ingest_stats(m),
         "market": market_stats(m),
         "market_trail": [],
+        # PR-19 scale planes: worst-offender exemplar reservoirs and
+        # ring-buffer campaign time series ([]/{} on older dumps).
+        "worst_offenders": exemplar_rows(m),
+        "history": history_stats(m),
     }
     if trace_path:
         trace = load_json_input(trace_path, "trace")
@@ -217,6 +223,27 @@ def render_text(data: dict) -> str:
                     f"bias {_fmt(bias, 1):>10} s  "
                     f"coverage {_fmt(cov)}  ({_fmt(n)} forecasts)"
                 )
+    offenders = data.get("worst_offenders") or []
+    if offenders:
+        lines.append("")
+        lines.append("Worst offenders (exemplar reservoirs):")
+        for family, entry_id, score, detail in offenders:
+            lines.append(
+                f"  {family:<24} {str(entry_id):<12} "
+                f"score {_fmt(score):<10} {detail}"
+            )
+    history = data.get("history") or {}
+    if history:
+        lines.append("")
+        lines.append("Campaign time series (ring-buffer history):")
+        for name, s in history.items():
+            lines.append(
+                f"  {name:<34} {str(s.get('mode')):<6} "
+                f"samples {_fmt(s.get('samples')):<8} "
+                f"last {_fmt(s.get('last')):<10} "
+                f"min {_fmt(s.get('min')):<10} "
+                f"max {_fmt(s.get('max'))}"
+            )
     d = data["decisions"]
     if d:
         lines.append("")
@@ -343,6 +370,32 @@ def render_html(data: dict) -> str:
             table(
                 ["job", "forecasts", "bias s", "MAPE", "coverage"],
                 data["calibration_jobs"],
+            )
+        )
+    if data.get("worst_offenders"):
+        parts.append("<h2>Worst offenders</h2>")
+        parts.append(
+            table(
+                ["family", "id", "score", "detail"],
+                data["worst_offenders"],
+            )
+        )
+    if data.get("history"):
+        parts.append("<h2>Campaign time series</h2>")
+        parts.append(
+            table(
+                ["series", "mode", "samples", "last", "min", "max"],
+                [
+                    (
+                        name,
+                        s.get("mode"),
+                        s.get("samples"),
+                        s.get("last"),
+                        s.get("min"),
+                        s.get("max"),
+                    )
+                    for name, s in data["history"].items()
+                ],
             )
         )
     d = data["decisions"]

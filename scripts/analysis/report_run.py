@@ -29,7 +29,8 @@ sys.path.insert(
 
 from shockwave_tpu.obs.metrics import (  # noqa: E402
     SCHEMA,
-    quantile_from_buckets,
+    merged_histogram_quantile,
+    series_quantile,
 )
 
 
@@ -85,6 +86,10 @@ class Metrics:
                 f"not a {SCHEMA} dump: schema={snapshot.get('schema')!r}"
             )
         self.metrics = snapshot["metrics"]
+        # PR-19 scale planes (absent in older dumps): worst-offender
+        # exemplar reservoirs and ring-buffer time series.
+        self.exemplars = snapshot.get("exemplars") or {}
+        self.history = snapshot.get("history") or {}
 
     def labeled_values(self, name, label_key):
         """{label value -> series value} for a gauge/counter family."""
@@ -190,22 +195,11 @@ def _counter_total(m: Metrics, name):
 
 
 def _histogram_quantile(m: Metrics, name, q):
-    """Quantile over every label series' merged cumulative buckets
-    (None when the metric is absent or bucket-less)."""
-    merged, observed_max, count = {}, None, 0
-    for series in m.series(name):
-        count += series["count"]
-        if series.get("max") is not None:
-            observed_max = (
-                series["max"]
-                if observed_max is None
-                else max(observed_max, series["max"])
-            )
-        for le, cum in (series.get("buckets") or {}).items():
-            merged[le] = merged.get(le, 0) + cum
-    if count <= 0 or not merged:
-        return None
-    value, _ = quantile_from_buckets(merged, q, observed_max)
+    """Quantile over every label series of a histogram family: exact
+    sketch merge when the dump carries sketches (quantiles then have
+    the pinned SHOCKWAVE_SKETCH_ALPHA relative-error bound), summed
+    cumulative buckets for pre-sketch dumps. None when absent."""
+    value, _count = merged_histogram_quantile(m.metrics.get(name), q)
     return value
 
 
@@ -350,12 +344,10 @@ def market_section(m: Metrics, decision_log=None):
 
 
 def _series_p99(series):
-    """p99 from a snapshot series' cumulative buckets (the shared
-    obs.metrics.quantile_from_buckets math; None pre-PR-4 dumps had no
-    buckets)."""
-    value, _ = quantile_from_buckets(
-        series.get("buckets") or {}, 0.99, series.get("max")
-    )
+    """p99 of one snapshot series: sketch when the dump carries one
+    (guaranteed relative error), bucket interpolation for pre-sketch
+    dumps (shared obs.metrics math)."""
+    value, _ = series_quantile(series, 0.99)
     return value
 
 
@@ -396,6 +388,108 @@ def histogram_summary_rows(m: Metrics, names):
                 )
             )
     return rows
+
+
+def exemplar_rows(m: Metrics):
+    """(family, id, score, detail) rows from the snapshot's exemplars
+    block — the identities the rollups deliberately forgot (worst
+    calibration MAPE jobs, longest admission waits, top tenant
+    spenders), capped at k per family by the reservoirs."""
+    rows = []
+    for family in sorted(m.exemplars):
+        block = m.exemplars[family]
+        for entry in block.get("entries") or []:
+            detail = ", ".join(
+                f"{k}={_fmt(v)}"
+                for k, v in sorted(entry.items())
+                if k not in ("id", "score")
+            )
+            rows.append((family, entry.get("id"), entry.get("score"), detail))
+    return rows
+
+
+def exemplar_section(m: Metrics):
+    lines = ["## Worst offenders (exemplar reservoirs)", ""]
+    rows = exemplar_rows(m)
+    if not rows:
+        lines.append(
+            "_No exemplar reservoirs in this dump (run predates the "
+            "scale plane, or nothing was offered)._"
+        )
+        return "\n".join(lines)
+    lines.append(
+        "Per-entity identities the per-job/per-tenant rollups dropped: "
+        "each family keeps only its k worst offenders "
+        "(SHOCKWAVE_OBS_EXEMPLARS)."
+    )
+    lines.append("")
+    lines.append(_table(["family", "id", "score", "detail"], rows))
+    return "\n".join(lines)
+
+
+def history_stats(m: Metrics):
+    """{family: summary} from the snapshot's ring-buffer history:
+    samples appended over the whole campaign, the window the fixed
+    rings still hold, and last/min/max/mean over that window."""
+    out = {}
+    for name in sorted(m.history):
+        block = m.history[name]
+        raw = block.get("raw") or []
+        coarse = block.get("coarse") or []
+        values = [v for _t, v in raw]
+        for row in coarse:
+            values.extend((row[1], row[2]))
+        times = [t for t, _v in raw] + [row[0] for row in coarse]
+        summary = {
+            "mode": block.get("mode"),
+            "samples": block.get("samples"),
+            "window_points": len(raw) + len(coarse),
+        }
+        if values:
+            summary["last"] = raw[-1][1] if raw else None
+            summary["min"] = min(values)
+            summary["max"] = max(values)
+        if len(times) >= 2:
+            summary["window_s"] = max(times) - min(times)
+        out[name] = summary
+    return out
+
+
+def history_section(m: Metrics):
+    lines = ["## Campaign time series (ring-buffer history)", ""]
+    stats = history_stats(m)
+    if not stats:
+        lines.append(
+            "_No ring-buffer history in this dump (run predates the "
+            "scale plane, or scale_tick never ran)._"
+        )
+        return "\n".join(lines)
+    lines.append(
+        "Fixed-memory rings sampled once per round (raw tail + "
+        "min/max/mean coarse ring behind it); `samples` counts every "
+        "append over the campaign, `window` what the rings still hold."
+    )
+    lines.append("")
+    lines.append(
+        _table(
+            ["series", "mode", "samples", "window pts", "window s",
+             "last", "min", "max"],
+            [
+                (
+                    name,
+                    s.get("mode"),
+                    s.get("samples"),
+                    s.get("window_points"),
+                    s.get("window_s"),
+                    s.get("last"),
+                    s.get("min"),
+                    s.get("max"),
+                )
+                for name, s in stats.items()
+            ],
+        )
+    )
+    return "\n".join(lines)
 
 
 def trace_sections(trace: dict):
@@ -589,6 +683,10 @@ def build_report(metrics_path, trace_path=None, decision_log=None):
                 calibration,
             )
         )
+    if m.exemplars:
+        out += ["", exemplar_section(m)]
+    if m.history:
+        out += ["", history_section(m)]
 
     if trace_path:
         trace = load_json_input(trace_path, "trace")
@@ -718,6 +816,10 @@ def build_json(metrics_path, trace_path=None, decision_log=None) -> dict:
                 for row in calibration_rows(m)
             ],
         },
+        # --json parity with the markdown's worst-offender and
+        # campaign time-series sections.
+        "worst_offenders": m.exemplars,
+        "history": history_stats(m),
     }
     if trace_path:
         trace = load_json_input(trace_path, "trace")

@@ -1,0 +1,364 @@
+#!/usr/bin/env python3
+"""CI smoke gate for telemetry at scale (the PR-19 scale plane).
+
+Four phases, ~1 min on CPU, all against REAL subsystems (registry,
+calibration tracker, cell planner, fleet store — no mocks):
+
+1. **Cardinality governor under a 5k-job label flood.** A per-job
+   labeled family is hammered with 5,000 distinct ``job_id`` labels
+   against the default series budget. Asserts: every family stays at
+   or under ``SHOCKWAVE_METRICS_MAX_SERIES``; the flood lands in the
+   ``overflow="true"`` aggregate (no observation silently vanishes);
+   the drop is LOUD (``metrics_series_dropped_total`` counts every
+   routed observation); per-job calibration gauges hold only the
+   reservoir's k worst offenders while the fleet aggregates score
+   every forecast exactly.
+2. **Sketch accuracy.** The round-duration histogram's sketch p99/p50
+   against exact numpy percentiles of the same observations — must be
+   within the pinned relative-error bound (SHOCKWAVE_SKETCH_ALPHA,
+   with bin-quantization slack).
+3. **Disabled parity at the 8-cell shape.** A 512-job, 8-cell
+   CellPlanner campaign (cold solve + churn rounds) run with obs fully
+   OFF and again with metrics ON must produce BIT-IDENTICAL schedules
+   and prices: observability changes no decision.
+4. **Fleet merge == offline merge.** Four worker registries encode
+   binary sketch frames (the Heartbeat.metrics_frame wire); a
+   FleetTelemetry store accepts them and its merged snapshot's
+   histogram quantiles must EQUAL the offline
+   ``metrics.merge_snapshots`` of the same snapshots — merging over
+   the wire loses nothing. A malformed frame and a frame from an
+   unknown (retired) label must both be rejected.
+
+Writes ``results/obs_scale/obs_scale_smoke.json`` (the gate verdict).
+Exits non-zero on any violated invariant. Wired into the verify skill
+next to the other smokes.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+OUT = os.path.join(REPO, "results", "obs_scale")
+
+JOBS = 5_000
+
+
+def governor_phase(failures):
+    from shockwave_tpu import obs
+    from shockwave_tpu.obs.metrics import DROPPED_FAMILY
+
+    obs.reset()
+    obs.configure(metrics=True)
+    registry = obs.get_registry()
+    budget = registry.series_budget()
+
+    rng = np.random.default_rng(7)
+    gauge = obs.gauge(
+        "smoke_job_progress", "per-job label flood for the governor"
+    )
+    hist = obs.histogram(
+        "scheduler_round_duration_seconds", "round wall time"
+    )
+    durations = rng.lognormal(mean=1.0, sigma=0.8, size=JOBS)
+    calibration = obs.get_calibration()
+    calibration.enabled = True
+    t0 = time.time()
+    for j in range(JOBS):
+        gauge.set(float(j % 17), job_id=str(j))
+        hist.observe(float(durations[j]))
+        calibration.record_forecast(j, 0.0, 100.0 + float(j % 50))
+        calibration.record_outcome(j, 100.0)
+        if j % 100 == 0:
+            obs.scale_tick(float(j))
+    ingest_s = time.time() - t0
+
+    t0 = time.time()
+    snap = registry.snapshot()
+    text = registry.render_text()
+    render_ms = (time.time() - t0) * 1e3
+
+    total_series = 0
+    for name, family in snap["metrics"].items():
+        n = len(family["series"])
+        total_series += n
+        if n > budget:
+            failures.append(
+                f"family {name} holds {n} series, budget is {budget}"
+            )
+    flood = snap["metrics"].get("smoke_job_progress", {"series": []})
+    overflow = [
+        s for s in flood["series"]
+        if s["labels"].get("overflow") == "true"
+    ]
+    if not overflow:
+        failures.append(
+            "label flood produced no overflow='true' aggregate series"
+        )
+    dropped_family = snap["metrics"].get(DROPPED_FAMILY)
+    dropped = sum(
+        s["value"] for s in (dropped_family or {"series": []})["series"]
+    )
+    # The governor may re-admit ids as ticks fold idle series, so the
+    # exact count depends on tick cadence — but a 5k-label flood at a
+    # 256-series budget MUST drop loudly, and the flood family's drops
+    # must be attributed to it by name.
+    if dropped <= 0:
+        failures.append(
+            f"drop counter is quiet for a {JOBS}-label flood at "
+            f"budget {budget}"
+        )
+    if dropped_family is not None and not any(
+        s["labels"].get("metric") == "smoke_job_progress"
+        for s in dropped_family["series"]
+    ):
+        failures.append(
+            "metrics_series_dropped_total does not attribute drops to "
+            "the flooded family"
+        )
+    if 'overflow="true"' not in text:
+        failures.append("render_text does not expose the overflow series")
+
+    cal = calibration.snapshot()
+    fleet = cal.get("fleet") or {}
+    if fleet.get("forecasts") != JOBS:
+        failures.append(
+            f"fleet calibration aggregates scored "
+            f"{fleet.get('forecasts')} forecasts, expected {JOBS} "
+            "(rollup must stay exact)"
+        )
+    job_gauges = snap["metrics"].get("predictor_job_mape", {"series": []})
+    k = len(cal["jobs"])
+    if len(job_gauges["series"]) > k or k > int(
+        os.environ.get("SHOCKWAVE_OBS_EXEMPLARS", 10)
+    ):
+        failures.append(
+            f"per-job calibration gauges leaked past the reservoir: "
+            f"{len(job_gauges['series'])} series for k={k}"
+        )
+    return {
+        "jobs": JOBS,
+        "budget": budget,
+        "total_series": total_series,
+        "dropped_routings": dropped,
+        "ingest_s": round(ingest_s, 3),
+        "metrics_render_ms": round(render_ms, 3),
+        "calibration_scored": fleet.get("forecasts"),
+        "calibration_job_series": len(job_gauges["series"]),
+    }
+
+
+def sketch_phase(failures):
+    """Sketch quantiles vs exact percentiles on the SAME observations
+    (the registry built in governor_phase is still live)."""
+    from shockwave_tpu import obs
+    from shockwave_tpu.obs.metrics import merged_histogram_quantile
+
+    rng = np.random.default_rng(7)
+    durations = rng.lognormal(mean=1.0, sigma=0.8, size=JOBS)
+    alpha = obs.get_registry().sketch_alpha
+    # Bin quantization adds up to ~alpha on top of the rank error.
+    bound = 2.5 * alpha
+    metric = obs.get_registry().snapshot()["metrics"][
+        "scheduler_round_duration_seconds"
+    ]
+    report = {}
+    for q in (0.5, 0.99):
+        est, count = merged_histogram_quantile(metric, q)
+        exact = float(np.quantile(durations, q))
+        rel = abs(est - exact) / exact
+        report[f"p{int(q * 100)}"] = {
+            "sketch": round(est, 6),
+            "exact": round(exact, 6),
+            "rel_err": round(rel, 6),
+        }
+        if rel > bound:
+            failures.append(
+                f"sketch q={q} off by {rel:.4f} relative "
+                f"(bound {bound:.4f}): {est} vs exact {exact}"
+            )
+        if count != JOBS:
+            failures.append(
+                f"sketch count {count} != {JOBS} observations"
+            )
+    report["alpha"] = alpha
+    return report
+
+
+def parity_phase(failures):
+    """8-cell planner campaign, obs off vs metrics on: bit-identical."""
+    from shockwave_tpu import obs
+    from shockwave_tpu.cells.planner import CellPlanner
+
+    def campaign(metrics_on):
+        obs.reset()
+        if metrics_on:
+            obs.configure(metrics=True)
+        rng = np.random.default_rng(3)
+        planner = CellPlanner(
+            {
+                "num_gpus": 256,
+                "time_per_iteration": 120.0,
+                "future_rounds": 12,
+                "lambda": 5.0,
+                "k": 10.0,
+                "cells": 8,
+            },
+            backend="cells",
+        )
+        for j in range(512):
+            planner.add_job(
+                j,
+                {
+                    "num_epochs": 4,
+                    "num_samples_per_epoch": 64,
+                    "scale_factor": 1,
+                    "bs_every_epoch": [32] * 4,
+                    "duration_every_epoch": [
+                        float(rng.uniform(60.0, 2000.0))
+                    ] * 4,
+                },
+                120.0,
+                1,
+            )
+        schedules = [sorted(map(str, planner.current_round_schedule()))]
+        next_id = 512
+        for r in range(3):
+            planner.increment_round()
+            victims = [
+                int(v) for v in rng.choice(512 + r * 4, size=4,
+                                           replace=False)
+                if int(v) in planner.job_cell
+            ]
+            for v in victims:
+                planner.remove_job(v)
+            for _ in range(4):
+                planner.add_job(
+                    next_id,
+                    {
+                        "num_epochs": 4,
+                        "num_samples_per_epoch": 64,
+                        "scale_factor": 1,
+                        "bs_every_epoch": [32] * 4,
+                        "duration_every_epoch": [900.0] * 4,
+                    },
+                    120.0,
+                    1,
+                )
+                next_id += 1
+            planner.set_recompute_flag()
+            schedules.append(
+                sorted(map(str, planner.current_round_schedule()))
+            )
+        prices = dict(planner.prices)
+        obs.reset()
+        return schedules, prices
+
+    t0 = time.time()
+    sched_off, prices_off = campaign(False)
+    sched_on, prices_on = campaign(True)
+    wall_s = time.time() - t0
+    identical = sched_off == sched_on and prices_off == prices_on
+    if not identical:
+        failures.append(
+            "disabled parity broken at the 8-cell shape: metrics-on "
+            "campaign diverged from obs-off (schedules or prices)"
+        )
+    return {
+        "cells": 8,
+        "jobs": 512,
+        "rounds": len(sched_off),
+        "bit_identical": identical,
+        "wall_s": round(wall_s, 2),
+    }
+
+
+def merge_phase(failures):
+    """Fleet store's frame merge vs the offline merge of the same
+    snapshots: identical quantiles, loud rejection of bad frames."""
+    from shockwave_tpu.obs.fleet import FleetTelemetry
+    from shockwave_tpu.obs.metrics import (
+        MetricsRegistry,
+        merge_snapshots,
+        merged_histogram_quantile,
+    )
+    from shockwave_tpu.obs.sketch import encode_snapshot_frame
+
+    rng = np.random.default_rng(11)
+    snapshots, frames = [], []
+    for w in range(4):
+        reg = MetricsRegistry(enabled=True)
+        hist = reg.histogram("worker_job_seconds", "job wall time")
+        hist.observe_many(rng.lognormal(2.0, 1.0, size=2_000))
+        snap = reg.snapshot()
+        snapshots.append(snap)
+        frames.append(encode_snapshot_frame(snap))
+
+    fleet = FleetTelemetry()
+    for w, frame in enumerate(frames):
+        fleet.add_target(f"w{w}", lambda: "")
+        if not fleet.accept_frame(f"w{w}", frame):
+            failures.append(f"fleet rejected a valid frame from w{w}")
+    if fleet.accept_frame("retired-worker", frames[0]):
+        failures.append(
+            "fleet accepted a frame from an unknown (retired) label"
+        )
+    if fleet.accept_frame("w0", b"not a frame"):
+        failures.append("fleet accepted a malformed frame")
+
+    offline = merge_snapshots(snapshots)
+    # merged_snapshot folds in this process's (empty) registry too,
+    # which adds no series — quantiles must match exactly.
+    via_fleet = fleet.merged_snapshot()
+    report = {"workers": 4, "observations": 8_000}
+    for q in (0.5, 0.9, 0.99):
+        a, ca = merged_histogram_quantile(
+            offline["metrics"].get("worker_job_seconds"), q
+        )
+        b, cb = merged_histogram_quantile(
+            via_fleet["metrics"].get("worker_job_seconds"), q
+        )
+        report[f"p{int(q * 100)}"] = round(b, 6) if b else None
+        if a != b or ca != cb:
+            failures.append(
+                f"fleet merge != offline merge at q={q}: "
+                f"{b} (n={cb}) vs {a} (n={ca})"
+            )
+    if report["p99"] is None:
+        failures.append("merged fleet histogram answered no p99")
+    return report
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    from shockwave_tpu import obs
+    from shockwave_tpu.utils.fileio import atomic_write_json
+
+    failures = []
+    result = {"governor": governor_phase(failures)}
+    result["sketch"] = sketch_phase(failures)
+    obs.reset()
+    result["parity"] = parity_phase(failures)
+    result["merge"] = merge_phase(failures)
+    result["failures"] = failures
+    result["ok"] = not failures
+    atomic_write_json(os.path.join(OUT, "obs_scale_smoke.json"), result)
+    print(json.dumps(result, indent=1))
+    if failures:
+        print("\nOBS SCALE SMOKE: FAIL", file=sys.stderr)
+        for failure in failures:
+            print(f"  - {failure}", file=sys.stderr)
+        return 1
+    print("\nOBS SCALE SMOKE: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,460 @@
+#!/usr/bin/env python3
+"""Telemetry at scale: the committed 100k-job campaign artifact.
+
+The PR-19 scale plane claims a campaign's telemetry cost is bounded by
+CONFIGURATION (series budget, sketch bins, reservoir k, ring lengths),
+not by how many jobs pass through. This bench stakes that claim on a
+real 100k-job campaign and commits the numbers
+(``results/obs_scale/obs_scale_campaign.json``):
+
+* **The campaign.** 100k jobs pushed through a real group-commit
+  AdmissionQueue (the instrumented front door: queue-latency
+  histograms, counters, worst-wait exemplars), admitted into a
+  16-cell :class:`CellPlanner` (per-cell gauges/histograms, market
+  attribution), cold coordinated solve + churned replan rounds with
+  per-round ``scale_tick`` housekeeping, predictor-calibration
+  forecasts scored for a 10k-job sample (fleet rollup + worst-MAPE
+  reservoir), and — outside the overhead window, on its own
+  wall-clock line — a deliberate 100k-label per-job gauge flood
+  standing in for the legacy per-job producer the governor exists to
+  absorb.
+* **Phase-interleaved A/B with ABBA solves.** Two identically-seeded
+  arms (metrics OFF vs ON) advance through the campaign TOGETHER:
+  each phase runs off-arm then on-arm back to back, and every
+  ~20 s solve (the cold solve and each churned replan) runs FOUR
+  times in ABBA order (off, on, on, off — flipped on alternating
+  rounds), each arm billed the mean of its two forced re-solves.
+  Sequential whole-arm A/B is hopeless on the shared 2-core bench
+  host — whole-2-minute-arm ratios measured 0.94 / 1.17 / 1.24
+  across three pairs of the SAME code, pure host drift — and even
+  adjacent per-round pairing leaves +-1.5-3 s of residual swing on a
+  ~20 s solve (five alternating identical rounds measured deltas
+  +0.59/+1.85/+1.24/-3.32/+0.47 s); ABBA cancels the drift's linear
+  component inside each solve window, which is where nearly all the
+  wall time lives. The OFF arm runs with the registry's ``enabled``
+  flag down, i.e. the real disabled fast path at every call site. A
+  full OFF-only warmup campaign runs first so the solver's XLA
+  compile is billed to neither arm.
+
+Checks recorded (and asserted by scripts/ci/obs_scale_smoke.py's
+sibling gate at the 5k shape):
+
+* obs overhead: on-arm vs off-arm summed phase wall, target <= 2%;
+* cardinality: every family at or under the series budget after 100k
+  jobs; the flood's drops loud in ``metrics_series_dropped_total``;
+* sketch accuracy: histogram p50/p99 vs exact numpy quantiles of the
+  same 100k observations, within the pinned relative-error bound;
+* disabled parity: off-arm and on-arm schedules and prices
+  bit-identical;
+* render cost: one /metrics render of the saturated registry, ms and
+  bytes (plus gzipped bytes — what the scrape endpoint actually
+  serves a gzip-accepting Prometheus).
+
+Runtime is solve-dominated: ~10 min on the 2-core CPU bench host
+(warmup campaign + both interleaved arms; each 100k solve is ~20 s
+and ABBA runs every solve twice per arm).
+"""
+
+import argparse
+import gzip
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+import numpy as np  # noqa: E402
+
+from shockwave_tpu.utils.fileio import atomic_write_json  # noqa: E402
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+OUT = os.path.join(REPO, "results", "obs_scale")
+
+
+def _profile(rng, epochs=4):
+    return {
+        "num_epochs": epochs,
+        "num_samples_per_epoch": 64,
+        "scale_factor": 1,
+        "bs_every_epoch": [32] * epochs,
+        "duration_every_epoch": [
+            float(rng.uniform(60.0, 2000.0))
+        ] * epochs,
+    }
+
+
+def interleaved_campaign(jobs, num_cells, churn_rounds, durations):
+    """Run the OFF and ON arms through the campaign phase-by-phase.
+
+    Returns ``(arms, flood_s)`` where ``arms[on]`` carries ``phases``
+    (phase -> seconds), ``wall`` (summed phases), ``schedules`` and
+    ``prices`` (the parity fingerprint).
+    """
+    from shockwave_tpu import obs
+    from shockwave_tpu.cells.planner import CellPlanner
+    from shockwave_tpu.core.job import Job
+    from shockwave_tpu.runtime.admission import AdmissionQueue
+
+    obs.reset()
+    obs.configure(metrics=True)
+    registry = obs.get_registry()
+    calibration = obs.get_calibration()
+    calibration.enabled = True
+
+    def activate(on):
+        # Every instrumented call site checks registry.enabled (or
+        # calibration.enabled) per call, so flipping the flags swaps
+        # between the true disabled fast path and live recording
+        # without touching the ON arm's accumulated state.
+        registry.enabled = on
+        calibration.enabled = on
+
+    arms = {
+        on: {
+            "rng": np.random.default_rng(0),
+            "phases": {},
+            "wall": 0.0,
+            "schedules": [],
+            "prices": None,
+        }
+        for on in (False, True)
+    }
+
+    def timed(name, fn, order=(False, True)):
+        for on in order:
+            activate(on)
+            arm = arms[on]
+            t0 = time.time()
+            fn(arm, on)
+            dt = time.time() - t0
+            arm["phases"][name] = arm["phases"].get(name, 0.0) + dt
+            arm["wall"] += dt
+
+    def timed_abba(name, fn, flip=False):
+        # Run fn twice per arm in ABBA order and bill each arm the
+        # MEAN of its two runs: linear host drift across the ~80 s
+        # window contributes equally to both arms and cancels.
+        order = (True, False, False, True) if flip else (
+            False, True, True, False
+        )
+        samples = {False: [], True: []}
+        for on in order:
+            activate(on)
+            t0 = time.time()
+            fn(arms[on], on)
+            samples[on].append(time.time() - t0)
+        for on in (False, True):
+            arm = arms[on]
+            dt = sum(samples[on]) / len(samples[on])
+            arm["phases"][name] = arm["phases"].get(name, 0.0) + dt
+            arm["wall"] += dt
+        return (
+            sum(samples[True]) / 2.0 - sum(samples[False]) / 2.0
+        )
+
+    # -- instrumented front door: all jobs through the real queue ----
+    job_proto = Job(
+        job_type="ResNet-18 (batch size 32)",
+        command="python3 main.py",
+        total_steps=200,
+        scale_factor=1,
+        mode="static",
+    )
+
+    def admission(arm, on):
+        queue = AdmissionQueue(
+            capacity=jobs, group_commit=True, clock=time.monotonic
+        )
+        seq = 0
+        batch = 256
+        for _ in range(0, jobs, batch * 8):
+            reqs = []
+            for _ in range(batch):
+                reqs.append((f"campaign-{seq:07d}", [job_proto] * 8))
+                seq += 1
+            queue.submit_many(reqs)
+            queue.drain()
+
+    timed("admission", admission)
+
+    # -- 16-cell planner campaign ------------------------------------
+    def add_jobs(arm, on):
+        planner = CellPlanner(
+            {
+                "num_gpus": jobs // 4,
+                "time_per_iteration": 120.0,
+                "future_rounds": 50,
+                "lambda": 5.0,
+                "k": 10.0,
+                "cells": num_cells,
+            },
+            backend="cells",
+        )
+        for j in range(jobs):
+            planner.add_job(j, _profile(arm["rng"]), 120.0, 1)
+        arm["planner"] = planner
+        arm["next_id"] = jobs
+
+    timed("add_jobs", add_jobs)
+
+    def solve(index):
+        # One forced full re-solve. Deterministic, so the 2nd ABBA
+        # pass reproduces the 1st; the parity fingerprint is taken
+        # from each arm's first pass only.
+        def run(arm, on):
+            planner = arm["planner"]
+            planner.set_recompute_flag()
+            sched = sorted(map(repr, planner.current_round_schedule()))
+            if len(arm["schedules"]) == index:
+                arm["schedules"].append(sched)
+
+        return run
+
+    round_deltas = [timed_abba("cold_solve", solve(0))]
+
+    def churn_mutations(r):
+        def run(arm, on):
+            planner = arm["planner"]
+            rng = arm["rng"]
+            planner.increment_round()
+            live = list(planner.job_cell)
+            victims = [
+                live[int(i)]
+                for i in rng.choice(len(live), size=20, replace=False)
+            ]
+            for v in victims:
+                # Score the retiring job's forecasts: the per-job
+                # plane the calibration rollup + reservoir replaces.
+                calibration.record_forecast(
+                    v, 0.0, 120.0 + float(v % 60)
+                )
+                calibration.record_outcome(v, 120.0)
+                planner.remove_job(v)
+            for _ in range(20):
+                planner.add_job(
+                    arm["next_id"], _profile(rng), 120.0, 1
+                )
+                arm["next_id"] += 1
+            obs.scale_tick(float(r))
+
+        return run
+
+    for r in range(churn_rounds):
+        # Alternate which arm goes first so a monotonic host-load
+        # trend cannot systematically bill one arm.
+        order = (False, True) if r % 2 == 0 else (True, False)
+        timed("churn_rounds", churn_mutations(r), order=order)
+        round_deltas.append(
+            timed_abba("churn_rounds", solve(r + 1), flip=r % 2 == 1)
+        )
+
+    # -- per-job planes at full campaign scale -----------------------
+    # 10k-job calibration sample (fleet aggregates stay exact, only k
+    # identities survive) + the whole campaign's durations into one
+    # sketch-backed histogram.
+    def calibration_and_hist(arm, on):
+        for j in range(10_000):
+            calibration.record_forecast(f"s{j}", 0.0, 100.0 + (j % 97))
+            calibration.record_outcome(f"s{j}", 100.0)
+        obs.histogram(
+            "worker_job_seconds", "per-job wall time"
+        ).observe_many(durations)
+        arm["prices"] = dict(arm["planner"].prices)
+
+    timed("calibration_and_hist", calibration_and_hist)
+
+    # Governor stress, OUTSIDE the overhead window: a deliberate
+    # one-label-per-job gauge flood standing in for the legacy per-job
+    # producer the budget exists to absorb. It is an adversarial
+    # worst case (every set routes through admit-or-overflow), not a
+    # plane any shipped producer still drives, so it gets its own
+    # wall-clock line instead of being billed to the 2% claim.
+    activate(True)
+    t_flood = time.time()
+    flood = obs.gauge(
+        "campaign_job_progress", "legacy-style per-job gauge flood"
+    )
+    for j in range(jobs):
+        flood.set(float(j % 29), job_id=str(j))
+        if j % 5_000 == 0:
+            obs.scale_tick(float(j))
+    flood_s = time.time() - t_flood
+    return arms, flood_s, round_deltas
+
+
+def warmup_campaign(jobs, num_cells, churn_rounds):
+    """OFF-only pass over the same shapes so XLA compiles are billed
+    to neither timed arm."""
+    from shockwave_tpu import obs
+    from shockwave_tpu.cells.planner import CellPlanner
+
+    obs.reset()
+    rng = np.random.default_rng(0)
+    planner = CellPlanner(
+        {
+            "num_gpus": jobs // 4,
+            "time_per_iteration": 120.0,
+            "future_rounds": 50,
+            "lambda": 5.0,
+            "k": 10.0,
+            "cells": num_cells,
+        },
+        backend="cells",
+    )
+    for j in range(jobs):
+        planner.add_job(j, _profile(rng), 120.0, 1)
+    planner.current_round_schedule()
+    next_id = jobs
+    # One churn round compiles the replan path; later rounds repeat
+    # the same shapes (20 removed, 20 added), so don't re-run them.
+    for _ in range(min(churn_rounds, 1)):
+        planner.increment_round()
+        live = list(planner.job_cell)
+        for v in (
+            live[int(i)]
+            for i in rng.choice(len(live), size=20, replace=False)
+        ):
+            planner.remove_job(v)
+        for _ in range(20):
+            planner.add_job(next_id, _profile(rng), 120.0, 1)
+            next_id += 1
+        planner.set_recompute_flag()
+        planner.current_round_schedule()
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--jobs", type=int, default=100_000)
+    parser.add_argument("--cells", type=int, default=16)
+    parser.add_argument("--churn-rounds", type=int, default=4)
+    args = parser.parse_args()
+
+    from shockwave_tpu import obs
+    from shockwave_tpu.obs.metrics import (
+        DROPPED_FAMILY,
+        merged_histogram_quantile,
+    )
+
+    rng = np.random.default_rng(42)
+    durations = rng.lognormal(mean=2.0, sigma=1.0, size=args.jobs)
+
+    print(f"warmup campaign ({args.jobs} jobs, compile)...", flush=True)
+    warmup_campaign(args.jobs, args.cells, args.churn_rounds)
+    print("interleaved off/on campaign...", flush=True)
+    arms, flood_s, round_deltas = interleaved_campaign(
+        args.jobs, args.cells, args.churn_rounds, durations
+    )
+    wall_off, wall_on = arms[False]["wall"], arms[True]["wall"]
+    overhead_pct = 100.0 * (wall_on - wall_off) / wall_off
+    print(
+        f"off={wall_off:.2f}s on={wall_on:.2f}s "
+        f"overhead={overhead_pct:.2f}%",
+        flush=True,
+    )
+
+    # The ON arm's registry is still live: audit it.
+    registry = obs.get_registry()
+    registry.enabled = True
+    budget = registry.series_budget()
+    t0 = time.time()
+    text = registry.render_text()
+    render_ms = 1000.0 * (time.time() - t0)
+    gz_bytes = len(gzip.compress(text.encode("utf-8"), 6))
+    snap = registry.snapshot()
+    family_sizes = {
+        name: len(fam["series"]) for name, fam in snap["metrics"].items()
+    }
+    max_family = max(family_sizes.values())
+    total_series = sum(family_sizes.values())
+    dropped = sum(
+        s["value"]
+        for s in snap["metrics"].get(
+            DROPPED_FAMILY, {"series": []}
+        )["series"]
+    )
+    sketch = {}
+    metric = snap["metrics"].get("worker_job_seconds")
+    alpha = registry.sketch_alpha
+    for q in (0.5, 0.99):
+        est, count = merged_histogram_quantile(metric, q)
+        exact = float(np.quantile(durations, q))
+        sketch[f"p{int(q * 100)}"] = {
+            "sketch": round(est, 6),
+            "exact": round(exact, 6),
+            "rel_err": round(abs(est - exact) / exact, 6),
+            "count": count,
+        }
+    parity = (
+        arms[False]["schedules"] == arms[True]["schedules"]
+        and arms[False]["prices"] == arms[True]["prices"]
+    )
+    cal = obs.get_calibration().snapshot()
+    obs.reset()
+
+    checks = {
+        "overhead_under_2pct": overhead_pct <= 2.0,
+        "budget_held": max_family <= budget,
+        "overflow_loud": dropped > 0,
+        "sketch_p99_within_bound": (
+            sketch["p99"]["rel_err"] <= 2.5 * alpha
+        ),
+        "sketch_counts_exact": (
+            sketch["p99"]["count"] == args.jobs
+        ),
+        "disabled_parity_bit_identical": parity,
+    }
+    result = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "config": (
+            f"{args.jobs} jobs x {args.cells} cells x "
+            f"{args.churn_rounds} churn rounds; budget {budget}; "
+            f"alpha {alpha}; phase-interleaved arms, ABBA solves"
+        ),
+        "jobs": args.jobs,
+        "wall_off_s": round(wall_off, 2),
+        "wall_on_s": round(wall_on, 2),
+        "obs_overhead_pct": round(overhead_pct, 3),
+        "governor_flood_s": round(flood_s, 2),
+        "governor_flood_us_per_set": round(
+            1e6 * flood_s / args.jobs, 2
+        ),
+        "solve_abba_deltas_s": [round(d, 3) for d in round_deltas],
+        "phases_off_s": {
+            k: round(v, 2) for k, v in arms[False]["phases"].items()
+        },
+        "phases_on_s": {
+            k: round(v, 2) for k, v in arms[True]["phases"].items()
+        },
+        "series_budget": budget,
+        "max_family_series": max_family,
+        "total_series": total_series,
+        "dropped_routings": dropped,
+        "metrics_render_ms": round(render_ms, 3),
+        "metrics_render_bytes": len(text),
+        "metrics_render_gzip_bytes": gz_bytes,
+        "sketch": sketch,
+        "calibration": {
+            "fleet_scored": (cal.get("fleet") or {}).get("forecasts"),
+            "surviving_job_rows": len(cal["jobs"]),
+        },
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    os.makedirs(OUT, exist_ok=True)
+    atomic_write_json(
+        os.path.join(OUT, "obs_scale_campaign.json"), result
+    )
+    print(json.dumps(result, indent=1))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -265,6 +265,10 @@ class PhysicalScheduler(Scheduler):
                 # agent's rendered registry lands here and pre-empts
                 # the fleet plane's next DumpMetrics poll for it.
                 "worker_metrics": self._worker_metrics_rpc,
+                # Binary successor: a compressed sketch-snapshot frame
+                # the fleet MERGES into exact fleet-wide quantiles
+                # instead of concatenating text.
+                "worker_metrics_frame": self._worker_metrics_frame_rpc,
                 "init_job": self._init_job_rpc,
                 "update_lease": self._update_lease_rpc,
                 "submit_jobs": self._submit_jobs_rpc,
@@ -568,6 +572,18 @@ class PhysicalScheduler(Scheduler):
         if fleet is None or entry is None:
             return
         fleet.accept_push(entry[0], text)
+
+    def _worker_metrics_frame_rpc(self, worker_id, frame: bytes) -> None:
+        """Binary sketch-frame push riding a heartbeat. Same label
+        discipline as the text path; a frame from a worker whose agent
+        has already been retired resolves to no fleet entry and is
+        dropped here — a dead worker cannot re-plant its series."""
+        with self._cv:
+            fleet = self._fleet
+            entry = self._fleet_agents.get(int(worker_id))
+        if fleet is None or entry is None:
+            return
+        fleet.accept_frame(entry[0], frame)
 
     def _explain_job_rpc(self, job_id):
         """ExplainJob handler: the job's decision narrative, derived
@@ -990,6 +1006,10 @@ class PhysicalScheduler(Scheduler):
             offset_gauge, rtt_gauge = _clock_gauges()
             offset_gauge.remove(worker=str(worker_id))
             rtt_gauge.remove(worker=str(worker_id))
+            # Sweep every remaining worker-labeled series — counters,
+            # histograms (sketch included), and exemplar details — so a
+            # retired worker serves nothing frozen from any family.
+            obs.remove_series(worker=str(worker_id))
         self._next_assignments = OrderedDict(
             (key, ids)
             for key, ids in self._next_assignments.items()

@@ -23,6 +23,7 @@ from __future__ import annotations
 import copy
 import heapq
 import math
+import os
 import random
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
@@ -1795,6 +1796,10 @@ class Scheduler:
             return
         if metrics_on:
             self._publish_tenant_spend()
+            # Scale housekeeping: sample tracked families into the
+            # ring-buffer history and run the cardinality governor's
+            # activity decay — one O(series) pass per round.
+            obs.scale_tick(self.get_current_timestamp())
         if not (recorder.enabled or calibration.enabled or watchdog.enabled):
             return
         now = self.get_current_timestamp()
@@ -1873,15 +1878,41 @@ class Scheduler:
             if tenant is None:
                 continue  # departed since the replan
             by_tenant[tenant] = by_tenant.get(tenant, 0.0) + spend
+        # Rollup + top-k: the labeled gauge keeps only the k biggest
+        # spenders (a 10k-tenant campaign must not mint 10k series);
+        # the fleet totals stay exact in two unlabeled rollups, and the
+        # top spenders also ride the exemplars block with real names.
+        k = max(1, int(os.environ.get("SHOCKWAVE_OBS_EXEMPLARS", 10)))
+        top = dict(
+            sorted(by_tenant.items(), key=lambda kv: -kv[1])[:k]
+        )
         gauge = obs.gauge(
             "market_tenant_spend",
-            "chip-rounds of the last committed plan per tenant",
+            "chip-rounds of the last committed plan per tenant "
+            "(top spenders only; see market_tenant_spend_total)",
         )
-        for tenant in self._tenant_spend_seen - set(by_tenant):
-            gauge.set(0.0, tenant=tenant)
-        for tenant, spend in by_tenant.items():
+        for tenant in self._tenant_spend_seen - set(top):
+            gauge.remove(tenant=tenant)
+        for tenant, spend in top.items():
             gauge.set(float(spend), tenant=tenant)
-        self._tenant_spend_seen = set(by_tenant)
+            obs.offer_exemplar(
+                "tenant_top_spend",
+                tenant,
+                float(spend),
+                help="tenants with the largest chip-round spend in the "
+                "last committed plan",
+                spend=round(float(spend), 6),
+            )
+        obs.gauge(
+            "market_tenant_spend_total",
+            "chip-rounds of the last committed plan summed over ALL "
+            "tenants (exact, unlabeled rollup)",
+        ).set(float(sum(by_tenant.values())))
+        obs.gauge(
+            "market_tenants",
+            "tenants with spend in the last committed plan",
+        ).set(len(by_tenant))
+        self._tenant_spend_seen = set(top)
 
     # ------------------------------------------------------------------
     # Plan-ahead pipelining (shockwave_tpu/policies/speculation.py).

@@ -69,7 +69,11 @@ from shockwave_tpu.obs.metrics import (  # noqa: F401 (re-exported API)
     Histogram,
     MetricsRegistry,
     SCHEMA,
+    merged_histogram_quantile,
+    render_snapshot_text,
+    series_quantile,
 )
+from shockwave_tpu.obs.sketch import QuantileSketch  # noqa: F401
 from shockwave_tpu.obs.recorder import FlightRecorder
 from shockwave_tpu.obs.trace import EventTracer
 from shockwave_tpu.obs.watchdog import Watchdog
@@ -99,6 +103,9 @@ class _NullInstrument:
         pass
 
     def remove(self, **labels):
+        pass
+
+    def offer(self, entry_id, score, **detail):
         pass
 
 
@@ -213,6 +220,31 @@ def histogram(name: str, help: str = ""):
     if not _registry.enabled:
         return _NULL
     return _registry.histogram(name, help)
+
+
+def offer_exemplar(name: str, entry_id, score, help: str = "", **detail):
+    """Offer one (id, score) to a named worst-offender reservoir; the
+    usual single-flag-check no-op while disabled."""
+    if not _registry.enabled:
+        return
+    _registry.offer_exemplar(name, entry_id, score, help=help, **detail)
+
+
+def scale_tick(now_s: float) -> None:
+    """Per-round telemetry maintenance (ring-buffer history sampling +
+    cardinality-governor decay); schedulers call it from their round
+    observability hook. No-op while metrics are disabled."""
+    if not _registry.enabled:
+        return
+    _registry.scale_tick(now_s)
+
+
+def remove_series(**labels) -> int:
+    """Drop every series matching the label subset across all families
+    (retired worker / completed cell cleanup)."""
+    if not _registry.enabled:
+        return 0
+    return _registry.remove_series(**labels)
 
 
 # -- tracing shortcuts --------------------------------------------------

@@ -179,6 +179,9 @@ class FleetTelemetry:
         self._interval_s = max(0.25, float(scrape_interval_s))
         self._targets: Dict[str, Callable[[], str]] = {}
         self._dumps: Dict[str, Tuple[str, float]] = {}
+        # label -> (decoded snapshot dict, ts) for binary sketch-frame
+        # pushes (the PR-19 wire: merged, not concatenated).
+        self._snaps: Dict[str, Tuple[dict, float]] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._http = None
@@ -194,6 +197,7 @@ class FleetTelemetry:
         with self._lock:
             self._targets.pop(str(label), None)
             self._dumps.pop(str(label), None)
+            self._snaps.pop(str(label), None)
 
     def num_targets(self) -> int:
         with self._lock:
@@ -220,6 +224,37 @@ class FleetTelemetry:
         ).inc(worker=label)
         return True
 
+    def accept_frame(self, label: str, frame: bytes) -> bool:
+        """Binary sketch-frame push: a worker's heartbeat carried its
+        registry snapshot as a compressed frame
+        (:func:`shockwave_tpu.obs.sketch.encode_snapshot_frame`). The
+        scheduler MERGES these snapshots (sketches add exactly) instead
+        of concatenating text, so the fleet scrape's cost is per label
+        set, not per worker. Same retirement guard as
+        :meth:`accept_push`: unknown labels are dropped — a push racing
+        the agent's retirement must not resurrect a dead worker's
+        series. Malformed frames are dropped and counted."""
+        from shockwave_tpu import obs
+        from shockwave_tpu.obs.sketch import decode_snapshot_frame
+
+        label = str(label)
+        snap = decode_snapshot_frame(frame)
+        if snap is None:
+            obs.counter(
+                "fleet_frame_decode_failures_total",
+                "sketch-frame pushes that failed to decode",
+            ).inc(worker=label)
+            return False
+        with self._lock:
+            if label not in self._targets:
+                return False
+            self._snaps[label] = (snap, time.time())
+        obs.counter(
+            "fleet_frame_pushes_total",
+            "binary sketch-frame snapshots coalesced onto heartbeats",
+        ).inc(worker=label)
+        return True
+
     # -- polling --------------------------------------------------------
     def poll_once(self) -> int:
         """Scrape every target now; returns how many answered (pushed
@@ -237,6 +272,11 @@ class FleetTelemetry:
             fresh = {
                 label
                 for label, (_, ts) in self._dumps.items()
+                if now - ts < self._interval_s
+            }
+            fresh |= {
+                label
+                for label, (_, ts) in self._snaps.items()
                 if now - ts < self._interval_s
             }
         answered = len(targets.keys() & fresh)
@@ -267,17 +307,70 @@ class FleetTelemetry:
 
     # -- rendering ------------------------------------------------------
     def render(self) -> str:
-        """The fleet ``/metrics`` payload: the scheduler's registry plus
-        every worker dump under its ``worker`` label."""
+        """The fleet ``/metrics`` payload: the scheduler's registry,
+        every legacy text dump under its ``worker`` label, and — for
+        workers that push binary sketch frames — per-worker
+        counter/gauge series plus fleet-MERGED histogram families
+        (``scope="fleet"``: counts/sums/buckets summed, sketches merged
+        exactly), so histogram scrape cost stays per label set however
+        many workers push."""
         from shockwave_tpu import obs
+        from shockwave_tpu.obs.metrics import (
+            merge_snapshots,
+            render_snapshot_text,
+        )
 
         with self._lock:
             dumps = dict(self._dumps)
+            snaps = dict(self._snaps)
         texts = [obs.render_prometheus()]
+        hist_snaps = []
+        for label in sorted(snaps):
+            snap, _ = snaps[label]
+            metrics = snap.get("metrics", {})
+            values = {
+                name: m
+                for name, m in metrics.items()
+                if m.get("type") != "histogram"
+            }
+            if values:
+                texts.append(
+                    render_snapshot_text(
+                        {"metrics": values}, extra_labels={"worker": label}
+                    )
+                )
+            hists = {
+                name: m
+                for name, m in metrics.items()
+                if m.get("type") == "histogram"
+            }
+            if hists:
+                hist_snaps.append({"metrics": hists})
+        if hist_snaps:
+            texts.append(
+                render_snapshot_text(
+                    merge_snapshots(hist_snaps),
+                    extra_labels={"scope": "fleet"},
+                )
+            )
         for label in sorted(dumps):
             text, _ = dumps[label]
             texts.append(relabel_prometheus_text(text, worker=label))
         return merge_prometheus_texts(texts)
+
+    def merged_snapshot(self) -> dict:
+        """ONE fleet-level metrics snapshot: the scheduler's registry
+        merged with every pushed worker snapshot (counters/gauges sum,
+        histogram sketches merge exactly). The first exact fleet-wide
+        quantiles — what :meth:`healthz` and the obs-scale gate read."""
+        from shockwave_tpu import obs
+        from shockwave_tpu.obs.metrics import merge_snapshots
+
+        with self._lock:
+            snaps = [snap for snap, _ in self._snaps.values()]
+        return merge_snapshots(
+            [obs.get_registry().snapshot()] + snaps
+        )
 
     def healthz(self) -> Tuple[int, dict]:
         """(HTTP status, JSON body) for ``/healthz``, backed by the
@@ -292,11 +385,15 @@ class FleetTelemetry:
             ages = [time.time() - ts for _, ts in self._dumps.values()]
         if ages:
             body["oldest_scrape_age_s"] = round(max(ages), 3)
+        with self._lock:
+            body["workers_pushing_frames"] = len(self._snaps)
         code = 200
         # Ingest latency percentiles (when the admission front door has
         # observed any queue latency): the live numbers an operator
-        # checks against SHOCKWAVE_INGEST_P99_BUDGET_S.
-        metrics_snapshot = obs.get_registry().snapshot()["metrics"]
+        # checks against SHOCKWAVE_INGEST_P99_BUDGET_S. Fleet-MERGED
+        # since PR 19: sketch frames pushed by workers combine exactly
+        # with the scheduler's own registry.
+        metrics_snapshot = self.merged_snapshot()["metrics"]
         ingest = metrics_snapshot.get("admission_queue_latency_seconds")
         if ingest and ingest.get("series"):
             from shockwave_tpu.obs.watchdog import Watchdog
@@ -367,8 +464,21 @@ class FleetTelemetry:
                     LOG.exception("scrape endpoint handler failed")
                     code, payload = 500, b"internal error\n"
                     ctype = "text/plain"
+                # gzip when the scraper advertises it: a large fleet's
+                # exposition text compresses ~10x, and the encode
+                # happens on the HTTP thread — never under the
+                # registry lock.
+                encoding = None
+                accept = self.headers.get("Accept-Encoding", "")
+                if code == 200 and "gzip" in accept.lower():
+                    import gzip as _gzip
+
+                    payload = _gzip.compress(payload, 6)
+                    encoding = "gzip"
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
+                if encoding:
+                    self.send_header("Content-Encoding", encoding)
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)

@@ -349,37 +349,21 @@ class Watchdog:
         else:
             self._rearm(rule)
 
-    @staticmethod
-    def _merged_buckets(metrics: dict, name: str):
-        """(total_count, {le_str: cumulative_count} summed over every
-        label series, overall_max) for a histogram metric."""
-        metric = metrics.get(name)
-        if not metric or not metric["series"]:
-            return 0, {}, None
-        merged: Dict[str, int] = {}
-        count = 0
-        maxes = []
-        for series in metric["series"]:
-            count += series["count"]
-            if series.get("max") is not None:
-                maxes.append(series["max"])
-            for le, cum in (series.get("buckets") or {}).items():
-                merged[le] = merged.get(le, 0) + cum
-        return count, merged, max(maxes) if maxes else None
-
     @classmethod
     def _histogram_quantile(cls, metrics, name, q):
-        """Upper-bound quantile estimate over every label series'
-        cumulative buckets (the shared
-        :func:`shockwave_tpu.obs.metrics.quantile_from_buckets` math;
-        the +Inf bucket resolves to the observed max). Returns
-        (value, count) or (None, count)."""
-        from shockwave_tpu.obs.metrics import quantile_from_buckets
+        """Quantile over every label series of a histogram family via
+        the shared
+        :func:`shockwave_tpu.obs.metrics.merged_histogram_quantile`:
+        when the series carry quantile sketches (every live registry
+        since PR 19) the merge is exact and the estimate sits within
+        the sketch's pinned relative error (``SHOCKWAVE_SKETCH_ALPHA``,
+        default 1%) — the replan_p99/ingest_p99 SLO rules gate on that
+        bound instead of bucket-table interpolation; pre-sketch dumps
+        fall back to the cumulative-bucket math. Returns (value, count)
+        or (None, count)."""
+        from shockwave_tpu.obs.metrics import merged_histogram_quantile
 
-        count, merged, observed_max = cls._merged_buckets(metrics, name)
-        if count <= 0 or not merged:
-            return None, count
-        return quantile_from_buckets(merged, q, observed_max)
+        return merged_histogram_quantile(metrics.get(name), q)
 
     def _check_admission_backlog(self, metrics, round_index, fired) -> None:
         """Caller holds the lock (check_round)."""

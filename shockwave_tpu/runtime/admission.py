@@ -1241,8 +1241,21 @@ class AdmissionQueue:
                 )
                 if released:
                     self._tenants.release(released)
-                latency.observe_many(
-                    [max(now - e[2], 0.0) for e in entries]
+                waits = [max(now - e[2], 0.0) for e in entries]
+                latency.observe_many(waits)
+                # Worst offender of the batch keeps its identity: the
+                # histogram above is a rollup, so without this a
+                # pathological straggler is invisible past its quantile.
+                # One offer per drain (not per job) keeps line-rate
+                # drains O(batch) with a single reservoir touch.
+                worst = max(range(len(waits)), key=waits.__getitem__)
+                obs.offer_exemplar(
+                    "admission_worst_wait",
+                    str(entries[worst][0]),
+                    waits[worst],
+                    help="submit tokens that waited longest in the "
+                    "admission queue",
+                    wait_s=round(waits[worst], 6),
                 )
             if out:
                 self.stats["admitted_jobs"] += len(out)

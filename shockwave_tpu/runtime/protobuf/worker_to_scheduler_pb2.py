@@ -215,14 +215,24 @@ class JobState:
 
 class Heartbeat:
     """message Heartbeat { worker_id, job_state, client_send_s,
-    est_offset_s, est_rtt_s, trace_context, metrics_text }
+    est_offset_s, est_rtt_s, trace_context, metrics_text,
+    metrics_frame }
 
     ``metrics_text`` (field 7) piggy-backs the agent's rendered
     Prometheus registry on a due heartbeat, coalescing the separate
     DumpMetrics poll into the RPC that already crosses the wire every
     interval. Empty (the default, and what legacy workers send) means
     "no dump attached" — the scheduler's pull path still covers that
-    peer, so both generations interoperate."""
+    peer, so both generations interoperate.
+
+    ``metrics_frame`` (field 8, bytes) is the PR-19 successor: a
+    compressed binary snapshot of the agent's registry (magic ``SKF1``;
+    :func:`shockwave_tpu.obs.sketch.encode_snapshot_frame`) whose
+    histogram sketches the scheduler MERGES into exact fleet-wide
+    quantiles instead of concatenating exposition text. A scheduler
+    that predates the field skips it (unknown-field rule), falling back
+    to its DumpMetrics pull; a worker that predates it simply never
+    sets it."""
 
     def __init__(
         self,
@@ -233,6 +243,7 @@ class Heartbeat:
         est_rtt_s: float = 0.0,
         trace_context: str = "",
         metrics_text: str = "",
+        metrics_frame: bytes = b"",
     ):
         self.worker_id = int(worker_id)
         self.job_state = list(job_state) if job_state else []
@@ -241,6 +252,7 @@ class Heartbeat:
         self.est_rtt_s = float(est_rtt_s)
         self.trace_context = trace_context
         self.metrics_text = metrics_text
+        self.metrics_frame = bytes(metrics_frame)
 
     def SerializeToString(self) -> bytes:  # noqa: N802
         out = bytearray()
@@ -252,6 +264,8 @@ class Heartbeat:
         put_double(out, 5, self.est_rtt_s)
         put_str(out, 6, self.trace_context)
         put_str(out, 7, self.metrics_text)
+        if self.metrics_frame:
+            put_msg(out, 8, self.metrics_frame)
         return bytes(out)
 
     @classmethod
@@ -272,6 +286,8 @@ class Heartbeat:
                 msg.trace_context = value.decode("utf-8")
             elif field == 7 and wire_type == 2:
                 msg.metrics_text = value.decode("utf-8")
+            elif field == 8 and wire_type == 2:
+                msg.metrics_frame = bytes(value)
         return msg
 
 

@@ -116,7 +116,15 @@ def _worker_to_scheduler_handlers(callbacks):
         # worker's due Prometheus dump feeds the fleet store directly,
         # replacing that cycle's DumpMetrics pull RPC. The liveness
         # callback above already ran — a fat beat is never less alive
-        # than a thin one.
+        # than a thin one. Binary sketch frames (field 8) take priority
+        # over legacy text dumps (field 7): the fleet merges frame
+        # histograms into exact fleet quantiles instead of
+        # concatenating exposition text.
+        frame = getattr(request, "metrics_frame", b"")
+        if frame:
+            frame_cb = callbacks.get("worker_metrics_frame")
+            if frame_cb is not None:
+                frame_cb(request.worker_id, frame)
         text = getattr(request, "metrics_text", "")
         if text:
             metrics_cb = callbacks.get("worker_metrics")

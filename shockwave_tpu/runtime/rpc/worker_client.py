@@ -137,6 +137,7 @@ class WorkerRpcClient:
         est_rtt_s: float = 0.0,
         trace_context: str = "",
         metrics_text: str = "",
+        metrics_frame: bytes = b"",
     ):
         """One liveness ping; doubles as a clock-offset exchange.
         Reports the worker's current best (offset, rtt) estimate to the
@@ -144,8 +145,10 @@ class WorkerRpcClient:
         ping's fresh (offset_s, rtt_s) sample (``None`` against a
         legacy scheduler) and the acking scheduler's fencing epoch
         (0 = HA off / legacy). ``metrics_text`` piggy-backs a rendered
-        metrics dump on the beat (one RPC instead of beat + poll); a
-        legacy scheduler skips the unknown field harmlessly."""
+        metrics dump on the beat (one RPC instead of beat + poll);
+        ``metrics_frame`` is its binary successor — a compressed sketch
+        snapshot the scheduler merges into fleet quantiles. A legacy
+        scheduler skips either unknown field harmlessly."""
         import time
 
         t0 = time.time()
@@ -159,6 +162,7 @@ class WorkerRpcClient:
                     est_rtt_s=est_rtt_s,
                     trace_context=trace_context,
                     metrics_text=metrics_text,
+                    metrics_frame=metrics_frame,
                 ),
                 timeout=timeout,
             ),

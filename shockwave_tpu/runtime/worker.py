@@ -154,8 +154,10 @@ class Worker:
             os.environ.get("SHOCKWAVE_HEARTBEAT_S", heartbeat_interval_s)
         )
         # Coalesced metrics push: when a dump is due, the next beat
-        # carries the rendered registry (Heartbeat.metrics_text), so
-        # the fleet plane's poll for this agent becomes a no-op — one
+        # carries the registry — a binary sketch frame
+        # (Heartbeat.metrics_frame) by default, rendered text
+        # (Heartbeat.metrics_text) under SHOCKWAVE_METRICS_FRAMES=0 —
+        # so the fleet plane's poll for this agent becomes a no-op: one
         # RPC where the wire used to carry beat + DumpMetrics. <= 0
         # disables (pull-only, the legacy shape).
         self._metrics_push_interval = float(
@@ -210,7 +212,7 @@ class Worker:
                 self._try_reattach()
             best = self._clock_sync.best()
             any_ok = False
-            push_text = self._render_metrics_push()
+            push_text, push_frame = self._render_metrics_push()
             for index, worker_id in enumerate(self._worker_ids):
                 try:
                     sample, epoch = self._rpc_client.send_heartbeat(
@@ -222,6 +224,7 @@ class Worker:
                         # the first id's beat (the fleet plane keys the
                         # whole agent on min(worker_ids)).
                         metrics_text=push_text if index == 0 else "",
+                        metrics_frame=push_frame if index == 0 else b"",
                     )
                 except Exception:
                     # Single-shot by policy: the next tick is the retry,
@@ -231,7 +234,7 @@ class Worker:
                     LOG.debug("heartbeat failed", exc_info=True)
                     continue
                 any_ok = True
-                if index == 0 and push_text:
+                if index == 0 and (push_text or push_frame):
                     # Delivered: a failed beat leaves the stamp alone,
                     # so the next tick re-attaches a fresh render.
                     self._last_metrics_push = time.monotonic()
@@ -248,20 +251,28 @@ class Worker:
             if obs.trace_enabled():
                 self._export_clock_meta()
 
-    def _render_metrics_push(self) -> str:
-        """Rendered Prometheus text when a coalesced push is due, else
-        "". Due = metrics enabled, pushing enabled, and at least
-        SHOCKWAVE_METRICS_PUSH_S since the last delivered push."""
+    def _render_metrics_push(self):
+        """``(text, frame)`` for the coalesced metrics push when one is
+        due, else ``("", b"")``. Due = metrics enabled, pushing enabled,
+        and at least SHOCKWAVE_METRICS_PUSH_S since the last delivered
+        push. By default the push is a binary sketch frame (the
+        scheduler merges its histograms into exact fleet quantiles);
+        SHOCKWAVE_METRICS_FRAMES=0 falls back to rendered Prometheus
+        text, the PR-18 shape a legacy scheduler still understands."""
         from shockwave_tpu import obs
 
         if self._metrics_push_interval <= 0 or not obs.metrics_enabled():
-            return ""
+            return "", b""
         if (
             time.monotonic() - self._last_metrics_push
             < self._metrics_push_interval
         ):
-            return ""
-        return obs.render_prometheus()
+            return "", b""
+        if os.environ.get("SHOCKWAVE_METRICS_FRAMES", "1") != "0":
+            from shockwave_tpu.obs.sketch import encode_snapshot_frame
+
+            return "", encode_snapshot_frame(obs.get_registry().snapshot())
+        return obs.render_prometheus(), b""
 
     def _try_reattach(self) -> bool:
         """Outage recovery: resolve the current leader from the HA

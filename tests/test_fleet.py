@@ -136,7 +136,11 @@ class TestQuantileFromBuckets:
         assert quantile_from_buckets(buckets, 0.99)[0] == 10.0
         assert quantile_from_buckets(buckets, 0.95)[0] == 1.0
 
-    def test_watchdog_and_helper_agree(self):
+    def test_watchdog_reads_sketch_not_bucket_interpolation(self):
+        """Since PR 19 the watchdog's quantile rules read the merged
+        quantile SKETCH (alpha relative error), not the bucket-table
+        upper bound: p99 of {0.02 x4, 40.0} is ~40.0, where the old
+        interpolation answered 60.0 (the next le boundary)."""
         from shockwave_tpu.obs.watchdog import Watchdog
 
         obs.configure(metrics=True)
@@ -147,11 +151,19 @@ class TestQuantileFromBuckets:
         value, count = Watchdog._histogram_quantile(
             metrics, "q_test", 0.99
         )
+        assert count == 5
+        assert abs(value - 40.0) / 40.0 <= 0.01
+        # The bucket fallback (pre-sketch dumps) still answers the old
+        # upper bound through quantile_from_buckets.
         series = metrics["q_test"]["series"][0]
-        direct = quantile_from_buckets(
+        assert quantile_from_buckets(
             series["buckets"], 0.99, series["max"]
-        )
-        assert (value, count) == direct
+        ) == (60.0, 5)
+        # Stripping the sketches reproduces the fallback path.
+        for s in metrics["q_test"]["series"]:
+            s.pop("sketch", None)
+        fallback, _ = Watchdog._histogram_quantile(metrics, "q_test", 0.99)
+        assert fallback == 60.0
 
 
 # ----------------------------------------------------------------------

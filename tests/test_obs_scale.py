@@ -1,0 +1,376 @@
+"""Scale-proof telemetry primitives (PR 19): sketch quantiles,
+cardinality governor, exemplar reservoirs, ring-buffer history, and the
+binary sketch-frame fleet-merge path.
+
+Every bound claimed in docs/USAGE.md "Telemetry at scale" is asserted
+here: the sketch's relative-error guarantee on adversarial
+distributions, exact merges, the per-family series budget with loud
+overflow, remove() sweeping sketch/rollup families, and fleet merge
+over SKF1 frames agreeing with an offline merge of the same snapshots.
+"""
+
+import gzip
+import zlib
+
+import numpy as np
+import pytest
+
+from shockwave_tpu import obs
+from shockwave_tpu.obs.fleet import FleetTelemetry
+from shockwave_tpu.obs.history import ExemplarReservoir, RingHistory
+from shockwave_tpu.obs.metrics import (
+    DROPPED_FAMILY,
+    MetricsRegistry,
+    merge_snapshots,
+    merged_histogram_quantile,
+    render_snapshot_text,
+    series_quantile,
+)
+from shockwave_tpu.obs.sketch import (
+    FRAME_MAGIC,
+    QuantileSketch,
+    decode_snapshot_frame,
+    encode_snapshot_frame,
+    merge_sketch_dicts,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_obs():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# ----------------------------------------------------------------------
+# QuantileSketch: the alpha relative-error contract.
+# ----------------------------------------------------------------------
+class TestQuantileSketch:
+    # Adversarial shapes: heavy tail, uniform, bimodal with a 6-decade
+    # spread, and near-constant (every value in one log bin).
+    DISTRIBUTIONS = {
+        "lognormal_heavy_tail": lambda rng: rng.lognormal(2.0, 1.5, 20_000),
+        "uniform": lambda rng: rng.uniform(0.5, 500.0, 20_000),
+        "bimodal_wide": lambda rng: np.concatenate(
+            [rng.uniform(1e-3, 2e-3, 10_000), rng.uniform(1e3, 2e3, 10_000)]
+        ),
+        "near_constant": lambda rng: 42.0 + rng.uniform(0, 1e-6, 20_000),
+    }
+
+    @pytest.mark.parametrize("dist", sorted(DISTRIBUTIONS))
+    @pytest.mark.parametrize("q", [0.5, 0.9, 0.99, 0.999])
+    def test_relative_error_bound(self, dist, q):
+        values = self.DISTRIBUTIONS[dist](np.random.default_rng(7))
+        sk = QuantileSketch(alpha=0.01)
+        sk.add_many(values)
+        # The sketch guarantee is RANK-based (the value at the
+        # ceil(q*n)-th order statistic), so compare against the
+        # non-interpolating quantile — linear interpolation between
+        # order statistics is meaningless across a bimodal gap.
+        exact = float(np.quantile(values, q, method="inverted_cdf"))
+        est = sk.quantile(q)
+        # 2*alpha/(1-alpha) ~ the worst-case bound; 2.5*alpha is the
+        # round number the smoke gate and docs pin.
+        assert abs(est - exact) / abs(exact) <= 2.5 * sk.alpha, (
+            dist, q, est, exact,
+        )
+
+    def test_add_many_matches_scalar_adds(self):
+        values = np.random.default_rng(3).lognormal(1.0, 1.0, 5_000)
+        batch, scalar = QuantileSketch(), QuantileSketch()
+        batch.add_many(values)
+        for v in values:
+            scalar.add(float(v))
+        got, want = batch.to_dict(), scalar.to_dict()
+        # numpy's pairwise summation differs from sequential adds in
+        # the last ulp; everything discrete must match exactly.
+        assert got.pop("sum") == pytest.approx(want.pop("sum"))
+        assert got == want
+
+    def test_negative_zero_and_mixed_sign(self):
+        # The calibration plane's signed forecast error crosses zero.
+        sk = QuantileSketch(alpha=0.01)
+        values = [-100.0, -1.0, 0.0, 0.0, 1.0, 100.0]
+        for v in values:
+            sk.add(v)
+        assert sk.count == 6
+        assert sk.zero_count == 2
+        assert sk.quantile(0.0) == -100.0
+        assert sk.quantile(1.0) == 100.0
+        med = sk.quantile(0.5)
+        assert -1.0 <= med <= 0.0
+
+    def test_empty_sketch_quantile_is_none(self):
+        assert QuantileSketch().quantile(0.99) is None
+
+    def test_merge_is_exact(self):
+        # The fleet-merge guarantee: merging two sketches is
+        # bit-identical to one sketch having seen both streams.
+        rng = np.random.default_rng(11)
+        a_vals = rng.lognormal(2.0, 1.0, 4_000)
+        b_vals = rng.uniform(0.1, 50.0, 4_000)
+        a, b, one = QuantileSketch(), QuantileSketch(), QuantileSketch()
+        a.add_many(a_vals)
+        b.add_many(b_vals)
+        one.add_many(np.concatenate([a_vals, b_vals]))
+        assert a.merge(b).to_dict() == one.to_dict()
+
+    def test_merge_alpha_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            QuantileSketch(alpha=0.01).merge(QuantileSketch(alpha=0.02))
+
+    def test_memory_bound_collapses_cheap_end_only(self):
+        # lognormal(0, 2) spans ~e^-8..e^8: ~780 natural bins at
+        # alpha=0.01, so a 256-bin cap forces collapsing — but only of
+        # the LOWEST bins, all below the p99 bin, so the tail keeps
+        # its alpha guarantee.
+        sk = QuantileSketch(alpha=0.01, max_bins=256)
+        values = np.random.default_rng(5).lognormal(0.0, 2.0, 50_000)
+        sk.add_many(values)
+        assert len(sk._pos) <= 256
+        exact = float(np.quantile(values, 0.99, method="inverted_cdf"))
+        assert abs(sk.quantile(0.99) - exact) / exact <= 2.5 * sk.alpha
+
+    def test_dict_roundtrip(self):
+        sk = QuantileSketch()
+        sk.add_many([-3.0, 0.0, 1.0, 2.5, 1e6])
+        assert QuantileSketch.from_dict(sk.to_dict()).to_dict() == sk.to_dict()
+
+    def test_merge_sketch_dicts_skips_empties(self):
+        a = QuantileSketch()
+        a.add(5.0)
+        merged = merge_sketch_dicts([None, {}, a.to_dict()])
+        assert merged.count == 1
+        assert merge_sketch_dicts([None, {}]) is None
+
+
+# ----------------------------------------------------------------------
+# SKF1 snapshot frames.
+# ----------------------------------------------------------------------
+class TestSnapshotFrames:
+    def test_roundtrip(self):
+        snap = {"schema": "x", "metrics": {"a": {"series": []}}, "extra": 1}
+        frame = encode_snapshot_frame(snap)
+        assert frame.startswith(FRAME_MAGIC)
+        assert decode_snapshot_frame(frame) == snap
+
+    @pytest.mark.parametrize(
+        "junk",
+        [
+            b"",
+            b"not a frame",
+            FRAME_MAGIC + b"garbage-not-zlib",
+            FRAME_MAGIC + zlib.compress(b"[1, 2, 3]"),  # JSON, not a dict
+            encode_snapshot_frame({"ok": True})[:-3],  # truncated push
+        ],
+    )
+    def test_malformed_frames_decode_to_none(self, junk):
+        assert decode_snapshot_frame(junk) is None
+
+
+# ----------------------------------------------------------------------
+# Cardinality governor.
+# ----------------------------------------------------------------------
+class TestCardinalityGovernor:
+    def test_budget_held_and_overflow_loud(self):
+        reg = MetricsRegistry(enabled=True, max_series=16)
+        g = reg.gauge("job_progress", "per-job flood")
+        for j in range(1_000):
+            g.set(float(j), job_id=str(j))
+        snap = reg.snapshot()["metrics"]
+        fam = snap["job_progress"]["series"]
+        assert len(fam) <= 16
+        overflow = [
+            s for s in fam if s["labels"].get("overflow") == "true"
+        ]
+        assert overflow, "over-budget traffic must fold into overflow"
+        dropped = snap[DROPPED_FAMILY]["series"]
+        assert dropped and dropped[0]["labels"]["metric"] == "job_progress"
+        assert dropped[0]["value"] > 0
+        assert 'overflow="true"' in reg.render_text()
+
+    def test_env_budget_knob(self, monkeypatch):
+        monkeypatch.setenv("SHOCKWAVE_METRICS_MAX_SERIES", "9")
+        assert MetricsRegistry(enabled=True).series_budget() == 9
+
+    def test_governor_decay_readmits_after_idle_fold(self):
+        reg = MetricsRegistry(enabled=True, max_series=8)
+        g = reg.gauge("g", "")
+        for j in range(8):
+            g.set(1.0, job_id=str(j))
+        # Budget full: a cold tick folds idle series, opening slots.
+        for _ in range(4):
+            reg.scale_tick(0.0)
+        g.set(1.0, job_id="fresh")
+        series = reg.snapshot()["metrics"]["g"]["series"]
+        labels = [s["labels"] for s in series]
+        assert {"job_id": "fresh"} in labels
+        assert len(series) <= 8
+
+    def test_overflow_histogram_keeps_observing(self):
+        reg = MetricsRegistry(enabled=True, max_series=4)
+        h = reg.histogram("h", "")
+        for j in range(64):
+            h.observe(float(j + 1), job_id=str(j))
+        metric = reg.snapshot()["metrics"]["h"]
+        total = sum(s["count"] for s in metric["series"])
+        assert total == 64, "dropped ROUTINGS must still be counted"
+
+    def test_remove_sweeps_sketch_and_rollup_families(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.gauge("worker_clock", "").set(1.0, worker="w0")
+        reg.gauge("worker_clock", "").set(1.0, worker="w1")
+        reg.histogram("worker_lat", "").observe(0.5, worker="w0")
+        removed = reg.remove_series(worker="w0")
+        assert removed == 2
+        text = reg.render_text()
+        assert 'worker="w0"' not in text
+        assert 'worker="w1"' in text
+
+
+# ----------------------------------------------------------------------
+# RingHistory / ExemplarReservoir.
+# ----------------------------------------------------------------------
+class TestRingHistory:
+    def test_fixed_memory_and_coarse_rollup(self):
+        ring = RingHistory(raw_len=8, coarse_len=4, per_coarse=4)
+        for i in range(100):
+            ring.append(float(i), float(i % 10))
+        snap = ring.snapshot()
+        assert snap["samples"] == 100
+        assert len(snap["raw"]) == 8
+        assert len(snap["coarse"]) == 4
+        # Raw keeps the newest window, oldest-first.
+        assert [t for t, _ in snap["raw"]] == [float(i) for i in range(92, 100)]
+        for t_last, lo, hi, mean in snap["coarse"]:
+            assert lo <= mean <= hi
+
+    def test_coarse_point_aggregates_per_coarse_raw(self):
+        ring = RingHistory(raw_len=16, coarse_len=8, per_coarse=4)
+        for i, v in enumerate([1.0, 9.0, 5.0, 5.0]):
+            ring.append(float(i), v)
+        (point,) = ring.snapshot()["coarse"]
+        assert point == [3.0, 1.0, 9.0, 5.0]
+
+
+class TestExemplarReservoir:
+    def test_keeps_top_k_by_score_with_identity(self):
+        res = ExemplarReservoir(k=3)
+        for j in range(100):
+            res.offer(f"job-{j}", float(j), cell="c0")
+        top = res.entries()
+        assert [e[0] for e in top] == ["job-99", "job-98", "job-97"]
+        assert res.offered == 100
+        assert len(res) == 3
+        assert res.snapshot()["entries"][0] == {
+            "id": "job-99", "score": 99.0, "cell": "c0",
+        }
+
+    def test_refresh_and_remove(self):
+        res = ExemplarReservoir(k=2)
+        res.offer("a", 10.0)
+        res.offer("b", 20.0)
+        assert not res.offer("c", 5.0)
+        assert res.offer("a", 1.0), "existing id refreshes, newest wins"
+        assert res.evicted_by("d", 30.0) == "a"
+        res.remove("b")
+        assert "b" not in res
+        assert len(res) == 1
+
+
+# ----------------------------------------------------------------------
+# Sketch-backed registry quantiles + fleet merge.
+# ----------------------------------------------------------------------
+class TestSketchQuantiles:
+    def test_series_quantile_prefers_sketch_over_buckets(self):
+        reg = MetricsRegistry(enabled=True)
+        values = np.random.default_rng(2).lognormal(2.0, 1.0, 10_000)
+        reg.histogram("h", "").observe_many(values)
+        (series,) = reg.snapshot()["metrics"]["h"]["series"]
+        est, count = series_quantile(series, 0.99)
+        exact = float(np.quantile(values, 0.99))
+        assert count == 10_000
+        assert abs(est - exact) / exact <= 2.5 * reg.sketch_alpha
+
+    def test_merged_quantile_without_sketch_falls_back_to_buckets(self):
+        # A legacy snapshot (no "sketch" key) must still yield a
+        # bucket-interpolated answer, not a crash.
+        reg = MetricsRegistry(enabled=True)
+        reg.histogram("h", "").observe_many([0.1, 0.2, 0.4, 0.8])
+        metric = reg.snapshot()["metrics"]["h"]
+        for series in metric["series"]:
+            series.pop("sketch", None)
+        est, count = merged_histogram_quantile(metric, 0.5)
+        assert count == 4
+        assert est is not None and est > 0
+
+    def test_fleet_frame_merge_equals_offline_merge(self):
+        rng = np.random.default_rng(9)
+        regs = []
+        for _ in range(4):
+            reg = MetricsRegistry(enabled=True)
+            reg.histogram("worker_job_seconds", "").observe_many(
+                rng.lognormal(2.0, 1.0, 2_000)
+            )
+            regs.append(reg)
+
+        fleet = FleetTelemetry()
+        for i, reg in enumerate(regs):
+            label = f"worker-{i}"
+            fleet.add_target(label, lambda: "")
+            assert fleet.accept_frame(
+                label, encode_snapshot_frame(reg.snapshot())
+            )
+        offline = merge_snapshots([r.snapshot() for r in regs])
+        via_fleet = fleet.merged_snapshot()
+        for q in (0.5, 0.9, 0.99):
+            a, na = merged_histogram_quantile(
+                offline["metrics"]["worker_job_seconds"], q
+            )
+            b, nb = merged_histogram_quantile(
+                via_fleet["metrics"]["worker_job_seconds"], q
+            )
+            assert na == nb == 8_000
+            assert a == pytest.approx(b)
+
+    def test_fleet_rejects_unknown_label_and_malformed_frame(self):
+        fleet = FleetTelemetry()
+        fleet.add_target("w0", lambda: "")
+        frame = encode_snapshot_frame(
+            MetricsRegistry(enabled=True).snapshot()
+        )
+        assert not fleet.accept_frame("retired-worker", frame)
+        assert not fleet.accept_frame("w0", b"not a frame")
+        # Retirement drops the label's buffered snapshot too.
+        assert fleet.accept_frame("w0", frame)
+        fleet.remove_target("w0")
+        assert not fleet.accept_frame("w0", frame)
+
+    def test_render_snapshot_text_gzips_cleanly(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.counter("c", "help").inc(5.0, cell="c0")
+        reg.histogram("h", "help").observe_many([0.5, 1.5])
+        text = render_snapshot_text(reg.snapshot())
+        assert "# TYPE c counter" in text
+        assert 'h_bucket{le="+Inf"} 2' in text
+        blob = gzip.compress(text.encode("utf-8"), 6)
+        assert gzip.decompress(blob).decode("utf-8") == text
+
+
+# ----------------------------------------------------------------------
+# Calibration rollup + worst-offender eviction.
+# ----------------------------------------------------------------------
+class TestCalibrationEviction:
+    def test_per_job_stats_survive_only_for_reservoir_members(self):
+        obs.configure(metrics=True)
+        cal = obs.get_calibration()
+        cal.enabled = True
+        for j in range(200):
+            # MAPE grows with j: the last k jobs are the worst.
+            cal.record_forecast(f"j{j}", 0.0, 100.0 + j)
+            cal.record_outcome(f"j{j}", 100.0)
+        snap = cal.snapshot()
+        assert snap["fleet"]["forecasts"] == 200
+        assert 0 < len(snap["jobs"]) <= 10
+        assert "j199" in snap["jobs"]
+        assert "j0" not in snap["jobs"]

@@ -147,6 +147,24 @@ def test_old_reader_heartbeat_with_clock_and_context():
     assert old.worker_id == 3
 
 
+def test_heartbeat_metrics_frame_roundtrip_and_legacy_skip():
+    # Field 8: the coalesced binary metrics frame (PR 19). Omitted
+    # from the wire when empty, byte-preserving on roundtrip, and a
+    # legacy scheduler skips it as an unknown field.
+    frame = b"SKF1" + bytes(range(40))
+    new = w2s_new.Heartbeat(worker_id=3, metrics_frame=frame)
+    data = new.SerializeToString()
+    parsed = w2s_new.Heartbeat.FromString(data)
+    assert parsed.metrics_frame == frame and parsed.worker_id == 3
+    old = w2s_old.Heartbeat.FromString(data)
+    assert old.worker_id == 3
+    # Empty frame leaves the wire byte-identical to the pre-frame
+    # schema (proto3 default omission).
+    without = w2s_new.Heartbeat(worker_id=3).SerializeToString()
+    assert b"SKF1" not in without
+    assert w2s_new.Heartbeat.FromString(without).metrics_frame == b""
+
+
 def test_old_reader_done_with_contexts():
     new = w2s_new.DoneRequest(
         worker_id=1, job_id=[4, 5], num_steps=[10, 20],
