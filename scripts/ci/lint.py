@@ -3,11 +3,13 @@
 
 Runs ``shockwave_tpu.analysis`` over the default enforcement scope
 (``shockwave_tpu/``, ``scripts/``, ``bench.py``) against the committed
-baseline (``lint_baseline.json``) — six per-file rules plus the five
-interprocedural ones (lock-order-cycle, transitive-host-sync,
-swallowed-exception, shared-state-race, snapshot-escape) sharing one
-project build — and exits non-zero when either direction of the
-ratchet is violated, or when the gate itself is broken:
+baseline (``lint_baseline.json``) — ten per-file rules (including the
+four wire-contract conformance rules over the hand-rolled protobuf
+codecs) plus the five interprocedural ones (lock-order-cycle,
+transitive-host-sync, swallowed-exception, shared-state-race,
+snapshot-escape) sharing one project build — and exits non-zero when
+either direction of the ratchet is violated, or when the gate itself
+is broken:
 
   exit 1  NEW findings — code introduced a violation the baseline does
           not accept. Fix it, or suppress the line with a justified
@@ -34,6 +36,12 @@ This is the same check tier-1 enforces via
 ``tests/test_analysis.py::test_repo_is_clean_against_baseline``; the
 script form exists for CI pipelines and pre-push hooks that want the
 finding list on stdout without a pytest run.
+
+The wire contract has its own deeper gate —
+``scripts/ci/wire_smoke.py`` adds the schema-evolution ratchet
+(``wire_registry.json``), protoc descriptor conformance, and the
+seeded differential wire fuzzer on top of the conformance rules this
+gate already runs.
 """
 
 import argparse

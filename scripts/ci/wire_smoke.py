@@ -1,0 +1,181 @@
+#!/usr/bin/env python3
+"""Wire-contract smoke gate: conformance rules, ratchet, differential fuzz.
+
+Four layers, all of which must be green — unlike ``lint.py`` there is
+NO baseline here: the wire contract is either exactly right or the
+build is wrong, so every finding fails the gate immediately:
+
+  1. **conformance rules** — the four wirecheck rules
+     (proto-codec-drift, field-number-collision,
+     canonical-default-omission, decoder-unknown-field-tolerance)
+     over ``shockwave_tpu/runtime/protobuf/``;
+  2. **schema-evolution ratchet** — the live ``.proto`` schema diffed
+     against the committed ``wire_registry.json`` (renumbering,
+     retyping, or deleting a registered field fails; a missing
+     registry is a BROKEN gate, exit 2);
+  3. **descriptor conformance** — the protoc-generated modules'
+     runtime descriptors must match the schema exactly, and the frozen
+     ``legacy/`` modules must be a consistent subset (skipped with a
+     notice when google.protobuf is unavailable);
+  4. **differential fuzz** — ``shockwave_tpu.analysis.wirefuzz``:
+     seeded random instances per message family, byte-identity against
+     a dynamically generated protoc mirror and the frozen legacy
+     goldens, unknown-field/truncation tolerance, columnar
+     round-trips. Deterministic in ``--seed``; a CI failure replays
+     locally with the same number.
+
+  exit 1  violations in any layer
+  exit 2  BROKEN gate (missing/unparseable wire_registry.json)
+
+Usage (see docs/USAGE.md "Static analysis"):
+  python scripts/ci/wire_smoke.py [--cases N] [--seed N] [--github]
+
+Default is 1000 cases per family (~24k total) in a few seconds;
+``--cases 50`` is plenty for a pre-commit hook. ``--github`` (implied
+by the ``GITHUB_ACTIONS`` env var) emits ``::error`` workflow
+annotations so violations land inline on the PR diff.
+"""
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+sys.path.insert(0, REPO_ROOT)
+
+from shockwave_tpu.analysis import wirefuzz, wireregistry  # noqa: E402
+from shockwave_tpu.analysis.core import active, run_paths  # noqa: E402
+from shockwave_tpu.analysis.protospec import load_repo_schema  # noqa: E402
+from shockwave_tpu.analysis.rules.wirecheck import (  # noqa: E402
+    CanonicalDefaultOmission,
+    DecoderUnknownFieldTolerance,
+    FieldNumberCollision,
+    ProtoCodecDrift,
+)
+
+PROTO_SCOPE = os.path.join(REPO_ROOT, "shockwave_tpu", "runtime", "protobuf")
+
+
+def _github_escape(text: str) -> str:
+    return (
+        text.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+    )
+
+
+def _emit(problem: str, github: bool, file: str = "", line: int = 0) -> None:
+    if github:
+        location = f" file={file},line={line}," if file else " "
+        print(
+            f"::error{location}title=wire-smoke::{_github_escape(problem)}"
+        )
+    else:
+        print(f"wire-smoke: {problem}", file=sys.stderr)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="wire-contract smoke gate (conformance + ratchet + fuzz)"
+    )
+    parser.add_argument(
+        "--cases",
+        type=int,
+        default=1000,
+        help="fuzz cases per message family (default 1000)",
+    )
+    parser.add_argument(
+        "--seed", type=int, default=wirefuzz.DEFAULT_SEED
+    )
+    parser.add_argument(
+        "--github",
+        action="store_true",
+        help="emit GitHub Actions ::error annotations (implied when "
+        "GITHUB_ACTIONS is set)",
+    )
+    args = parser.parse_args()
+    github = args.github or bool(os.environ.get("GITHUB_ACTIONS"))
+    schema = load_repo_schema(REPO_ROOT)
+    violations = 0
+
+    # 1. Conformance rules — zero findings, no baseline.
+    rules = [
+        ProtoCodecDrift(schema),
+        FieldNumberCollision(schema),
+        CanonicalDefaultOmission(),
+        DecoderUnknownFieldTolerance(),
+    ]
+    findings = active(run_paths([PROTO_SCOPE], rules=rules))
+    for f in findings:
+        _emit(
+            f"[{f.rule}] {f.message}", github, file=f.path, line=f.line
+        )
+    violations += len(findings)
+    print(f"wire-smoke: conformance rules — {len(findings)} finding(s)")
+
+    # 2. Schema-evolution ratchet.
+    registry_path = wireregistry.default_registry_path(REPO_ROOT)
+    registry = wireregistry.load_registry(registry_path)
+    if registry is None:
+        _emit(
+            f"BROKEN gate: {registry_path} missing — regenerate with "
+            "`python -m shockwave_tpu.analysis --write-wire-registry` "
+            "and commit it",
+            github,
+        )
+        return 2
+    problems = wireregistry.diff_registry(schema, registry)
+    for p in problems:
+        _emit(p, github)
+    violations += len(problems)
+    print(
+        f"wire-smoke: registry ratchet — "
+        f"{len(registry.get('entries', []))} committed entries, "
+        f"{len(problems)} violation(s)"
+    )
+
+    # 3. Descriptor conformance (protoc-generated + legacy modules).
+    try:
+        desc_problems = wirefuzz.descriptor_conformance_problems(schema)
+    except ImportError:
+        print(
+            "wire-smoke: descriptor conformance SKIPPED "
+            "(google.protobuf unavailable)"
+        )
+    else:
+        for p in desc_problems:
+            _emit(p, github)
+        violations += len(desc_problems)
+        print(
+            f"wire-smoke: descriptor conformance — "
+            f"{len(desc_problems)} problem(s)"
+        )
+
+    # 4. Differential fuzz.
+    report = wirefuzz.fuzz_schema(
+        schema, cases=args.cases, seed=args.seed
+    )
+    for failure in report["failures"]:
+        _emit(failure, github)
+    for skip in report["skipped"]:
+        print(f"wire-smoke: fuzz skipped — {skip}")
+    violations += len(report["failures"])
+    total = sum(f["cases"] for f in report["families"].values())
+    print(
+        f"wire-smoke: fuzz — {total} cases across "
+        f"{len(report['families'])} families (seed {args.seed}), "
+        f"{len(report['failures'])} failure(s)"
+    )
+
+    if violations:
+        print(
+            f"wire smoke gate FAIL: {violations} violation(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print("wire smoke gate PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,14 @@ _EXPORTS = {
     "RULE_CLASSES": "rules",
     "default_rules": "rules",
     "rule_by_name": "rules",
+    "ProtoSchema": "protospec",
+    "load_repo_schema": "protospec",
+    "parse_proto_text": "protospec",
+    "default_registry_path": "wireregistry",
+    "diff_registry": "wireregistry",
+    "load_registry": "wireregistry",
+    "make_registry": "wireregistry",
+    "save_registry": "wireregistry",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -91,6 +91,26 @@ def _parser() -> argparse.ArgumentParser:
         "SHOCKWAVE_SANITIZE=threads) and exit",
     )
     p.add_argument(
+        "--wire-registry",
+        action="store_true",
+        help="print the wire-contract registry derived from the "
+        "current .proto schema as JSON and exit",
+    )
+    p.add_argument(
+        "--write-wire-registry",
+        action="store_true",
+        help="write <repo>/wire_registry.json from the current schema "
+        "(append new fields; the CI ratchet rejects renumbering, "
+        "retyping, or deleting committed entries)",
+    )
+    p.add_argument(
+        "--check-wire-registry",
+        action="store_true",
+        help="diff the current .proto schema against the committed "
+        "wire_registry.json ratchet and exit (0 green, 1 violations, "
+        "2 missing registry)",
+    )
+    p.add_argument(
         "--baseline",
         default=None,
         help="baseline file (default: <repo>/lint_baseline.json)",
@@ -185,6 +205,56 @@ def _run_fix(args) -> int:
     return 0
 
 
+def _run_wire_registry(args) -> int:
+    from shockwave_tpu.analysis import protospec, wireregistry
+
+    schema = protospec.load_repo_schema()
+    path = wireregistry.default_registry_path()
+    if args.wire_registry:
+        print(json.dumps(wireregistry.make_registry(schema), indent=2))
+        return 0
+    if args.write_wire_registry:
+        registry = wireregistry.make_registry(schema)
+        committed = wireregistry.load_registry(path)
+        if committed is not None:
+            # Writing may only APPEND: refuse to paper over a ratchet
+            # violation by regenerating the ledger around it.
+            problems = [
+                p
+                for p in wireregistry.diff_registry(schema, committed)
+                if "is not in" not in p
+            ]
+            if problems:
+                for p in problems:
+                    print(f"wire-registry: {p}", file=sys.stderr)
+                print(
+                    "refusing to rewrite the registry over ratchet "
+                    "violations; fix the schema instead",
+                    file=sys.stderr,
+                )
+                return 1
+        wireregistry.save_registry(path, registry)
+        print(f"wrote {path} with {len(registry['entries'])} entries")
+        return 0
+    committed = wireregistry.load_registry(path)
+    if committed is None:
+        print(
+            f"wire-registry: {path} missing — the schema-evolution "
+            "ratchet is not in place (generate it with "
+            "--write-wire-registry and commit it)",
+            file=sys.stderr,
+        )
+        return 2
+    problems = wireregistry.diff_registry(schema, committed)
+    for p in problems:
+        print(f"wire-registry: {p}")
+    print(
+        f"wire-registry: {len(committed.get('entries', []))} committed "
+        f"entries, {len(problems)} violation(s)"
+    )
+    return 1 if problems else 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = _parser().parse_args(argv)
     fmt = args.format or ("json" if args.json else "text")
@@ -206,6 +276,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         print(json.dumps(thread_roots_dict(), indent=2))
         return 0
+
+    if args.wire_registry or args.write_wire_registry or args.check_wire_registry:
+        return _run_wire_registry(args)
 
     if args.fix:
         return _run_fix(args)

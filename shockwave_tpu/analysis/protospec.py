@@ -1,0 +1,577 @@
+"""Schema model for the repo's ``.proto`` files.
+
+The runtime speaks proto3 through hand-rolled codecs
+(``runtime/protobuf/*_pb2.py``), so the ``.proto`` files are the
+*contract*, not generated-from source — which is exactly why the
+analyzer needs a first-class parse of them.  This module turns the
+proto3 subset the repo uses (messages, scalar/message fields,
+``repeated``, ``reserved``, enums, services) into a small schema model
+that :mod:`shockwave_tpu.analysis.rules.wirecheck`,
+:mod:`shockwave_tpu.analysis.wireregistry`, and
+:mod:`shockwave_tpu.analysis.wirefuzz` all consume.
+
+No dependency on ``google.protobuf`` — the parser is a few hundred
+lines of tokenizer + recursive descent so the lint gate runs on any
+box.  Wire-type resolution follows the proto3 encoding spec:
+
+========  =======================================  =========
+wire type  scalar types                            kind
+========  =======================================  =========
+0 varint  int32 int64 uint32 uint64 sint32
+          sint64 bool enum                         varint
+1 64-bit  double fixed64 sfixed64                  fixed64
+5 32-bit  float fixed32 sfixed32                   fixed32
+2 len     string bytes embedded-message            len
+========  =======================================  =========
+
+``repeated`` numeric scalars are PACKED in proto3 (wire type 2 with
+the element type recoverable via :attr:`FieldSpec.element_wire_type`).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field as dc_field
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from shockwave_tpu.analysis.core import repo_root
+
+#: scalar proto3 type name -> wire kind
+_VARINT_TYPES = frozenset(
+    {"int32", "int64", "uint32", "uint64", "sint32", "sint64", "bool"}
+)
+_FIXED64_TYPES = frozenset({"double", "fixed64", "sfixed64"})
+_FIXED32_TYPES = frozenset({"float", "fixed32", "sfixed32"})
+_LEN_TYPES = frozenset({"string", "bytes"})
+_SCALAR_TYPES = _VARINT_TYPES | _FIXED64_TYPES | _FIXED32_TYPES | _LEN_TYPES
+
+#: proto reserves this tag range for its own wire format extensions.
+IMPLEMENTATION_RESERVED = (19000, 19999)
+
+WIRE_VARINT = 0
+WIRE_FIXED64 = 1
+WIRE_LEN = 2
+WIRE_FIXED32 = 5
+
+
+class ProtoParseError(ValueError):
+    """Raised when a .proto file does not parse under the supported subset."""
+
+    def __init__(self, message: str, filename: str = "<proto>", line: int = 0):
+        super().__init__(f"{filename}:{line}: {message}")
+        self.filename = filename
+        self.line = line
+
+
+@dataclass
+class FieldSpec:
+    """One field declaration inside a message."""
+
+    name: str
+    number: int
+    type: str  # declared type name as written (scalar keyword or message/enum name)
+    repeated: bool = False
+    line: int = 0
+    # Resolved by ProtoSchema.resolve():
+    kind: str = ""  # varint | fixed64 | fixed32 | string | bytes | message | enum
+    wire_type: int = -1  # wire type this field serializes with (packed => 2)
+    element_wire_type: int = -1  # element wire type (unpacked repeated scalar)
+    packed: bool = False
+
+    @property
+    def is_scalar(self) -> bool:
+        return self.type in _SCALAR_TYPES or self.kind == "enum"
+
+
+@dataclass
+class MessageSpec:
+    name: str
+    proto: str  # relative proto filename, e.g. "admission.proto"
+    line: int = 0
+    fields: List[FieldSpec] = dc_field(default_factory=list)
+    reserved_ranges: List[Tuple[int, int]] = dc_field(default_factory=list)
+    reserved_names: List[str] = dc_field(default_factory=list)
+
+    @property
+    def by_number(self) -> Dict[int, FieldSpec]:
+        return {f.number: f for f in self.fields}
+
+    @property
+    def by_name(self) -> Dict[str, FieldSpec]:
+        return {f.name: f for f in self.fields}
+
+    def reserved_hit(self, number: int) -> Optional[Tuple[int, int]]:
+        """Return the reserved range containing ``number``, if any
+        (declared ranges plus the 19000-19999 implementation range)."""
+        for lo, hi in list(self.reserved_ranges) + [IMPLEMENTATION_RESERVED]:
+            if lo <= number <= hi:
+                return (lo, hi)
+        return None
+
+
+@dataclass
+class EnumValueSpec:
+    name: str
+    number: int
+    line: int = 0
+
+
+@dataclass
+class EnumSpec:
+    name: str
+    proto: str
+    line: int = 0
+    values: List[EnumValueSpec] = dc_field(default_factory=list)
+
+
+@dataclass
+class MethodSpec:
+    name: str
+    request: str
+    response: str
+    line: int = 0
+
+
+@dataclass
+class ServiceSpec:
+    name: str
+    proto: str
+    line: int = 0
+    methods: List[MethodSpec] = dc_field(default_factory=list)
+
+
+@dataclass
+class ProtoFile:
+    name: str  # relative filename, e.g. "admission.proto"
+    package: str = ""
+    imports: List[str] = dc_field(default_factory=list)
+    messages: List[MessageSpec] = dc_field(default_factory=list)
+    enums: List[EnumSpec] = dc_field(default_factory=list)
+    services: List[ServiceSpec] = dc_field(default_factory=list)
+
+
+class ProtoSchema:
+    """All parsed proto files of a package, with cross-file lookups."""
+
+    def __init__(self, files: Dict[str, ProtoFile]):
+        self.files = files
+        self._messages: Dict[str, MessageSpec] = {}
+        self._enums: Dict[str, EnumSpec] = {}
+        for pf in files.values():
+            for msg in pf.messages:
+                self._messages[msg.name] = msg
+            for enum in pf.enums:
+                self._enums[enum.name] = enum
+        self._resolve()
+
+    # -- lookups -------------------------------------------------------
+    def message(self, name: str) -> Optional[MessageSpec]:
+        return self._messages.get(name)
+
+    def enum(self, name: str) -> Optional[EnumSpec]:
+        return self._enums.get(name)
+
+    @property
+    def messages(self) -> List[MessageSpec]:
+        return [m for pf in self.files.values() for m in pf.messages]
+
+    @property
+    def enums(self) -> List[EnumSpec]:
+        return [e for pf in self.files.values() for e in pf.enums]
+
+    @property
+    def services(self) -> List[ServiceSpec]:
+        return [s for pf in self.files.values() for s in pf.services]
+
+    def iter_fields(self) -> Iterator[Tuple[MessageSpec, FieldSpec]]:
+        for msg in self.messages:
+            for fld in msg.fields:
+                yield msg, fld
+
+    # -- wire-type resolution -----------------------------------------
+    def _resolve(self) -> None:
+        for msg in self._messages.values():
+            for fld in msg.fields:
+                self._resolve_field(fld)
+
+    def _resolve_field(self, fld: FieldSpec) -> None:
+        t = fld.type
+        if t in _VARINT_TYPES or t in self._enums:
+            fld.kind = "enum" if t in self._enums else "varint"
+            element = WIRE_VARINT
+        elif t in _FIXED64_TYPES:
+            fld.kind = "fixed64"
+            element = WIRE_FIXED64
+        elif t in _FIXED32_TYPES:
+            fld.kind = "fixed32"
+            element = WIRE_FIXED32
+        elif t in _LEN_TYPES:
+            fld.kind = t  # "string" | "bytes"
+            element = WIRE_LEN
+        elif t in self._messages:
+            fld.kind = "message"
+            element = WIRE_LEN
+        else:
+            # Unknown type name: treat as message-like (imported from a
+            # file outside the parsed set). wirecheck reports unknowns
+            # through its own finding rather than a parse failure.
+            fld.kind = "message"
+            element = WIRE_LEN
+        fld.element_wire_type = element
+        if fld.repeated and element in (WIRE_VARINT, WIRE_FIXED64, WIRE_FIXED32):
+            fld.packed = True
+            fld.wire_type = WIRE_LEN
+        else:
+            fld.packed = False
+            fld.wire_type = element
+
+    # -- constructors --------------------------------------------------
+    @classmethod
+    def from_sources(cls, sources: Dict[str, str]) -> "ProtoSchema":
+        return cls({name: parse_proto_text(text, name) for name, text in sources.items()})
+
+    @classmethod
+    def from_dir(cls, proto_dir: Path) -> "ProtoSchema":
+        sources = {
+            path.name: path.read_text(encoding="utf-8")
+            for path in sorted(proto_dir.glob("*.proto"))
+        }
+        return cls.from_sources(sources)
+
+
+# ---------------------------------------------------------------------------
+# Tokenizer + parser
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<comment>//[^\n]*|/\*.*?\*/)
+  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<number>-?\d+)
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_.]*)
+  | (?P<punct>[{}()\[\]=;,<>])
+  | (?P<ws>\s+)
+  | (?P<bad>.)
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+
+def _tokenize(text: str, filename: str) -> List[Tuple[str, str, int]]:
+    tokens: List[Tuple[str, str, int]] = []
+    line = 1
+    for match in _TOKEN_RE.finditer(text):
+        kind = match.lastgroup
+        value = match.group()
+        if kind == "bad":
+            raise ProtoParseError(f"unexpected character {value!r}", filename, line)
+        if kind not in ("ws", "comment"):
+            tokens.append((kind, value, line))
+        line += value.count("\n")
+    return tokens
+
+
+class _Parser:
+    def __init__(self, tokens: List[Tuple[str, str, int]], filename: str):
+        self.tokens = tokens
+        self.pos = 0
+        self.filename = filename
+
+    # -- token plumbing ------------------------------------------------
+    def _peek(self) -> Optional[Tuple[str, str, int]]:
+        return self.tokens[self.pos] if self.pos < len(self.tokens) else None
+
+    def _next(self) -> Tuple[str, str, int]:
+        tok = self._peek()
+        if tok is None:
+            last_line = self.tokens[-1][2] if self.tokens else 0
+            raise ProtoParseError("unexpected end of file", self.filename, last_line)
+        self.pos += 1
+        return tok
+
+    def _expect(self, value: str) -> Tuple[str, str, int]:
+        tok = self._next()
+        if tok[1] != value:
+            raise ProtoParseError(
+                f"expected {value!r}, got {tok[1]!r}", self.filename, tok[2]
+            )
+        return tok
+
+    def _expect_kind(self, kind: str) -> Tuple[str, str, int]:
+        tok = self._next()
+        if tok[0] != kind:
+            raise ProtoParseError(
+                f"expected {kind}, got {tok[1]!r}", self.filename, tok[2]
+            )
+        return tok
+
+    def _skip_statement(self) -> None:
+        """Consume through the next ';' (used for option/syntax lines)."""
+        while True:
+            tok = self._next()
+            if tok[1] == ";":
+                return
+
+    # -- grammar -------------------------------------------------------
+    def parse_file(self) -> ProtoFile:
+        pf = ProtoFile(name=self.filename)
+        while self._peek() is not None:
+            kind, value, line = self._peek()  # type: ignore[misc]
+            if value == "syntax" or value == "option":
+                self._skip_statement()
+            elif value == "package":
+                self._next()
+                pf.package = self._expect_kind("ident")[1]
+                self._expect(";")
+            elif value == "import":
+                self._next()
+                tok = self._next()
+                if tok[1] in ("public", "weak"):
+                    tok = self._next()
+                pf.imports.append(tok[1].strip('"'))
+                self._expect(";")
+            elif value == "message":
+                pf.messages.append(self.parse_message())
+            elif value == "enum":
+                pf.enums.append(self.parse_enum())
+            elif value == "service":
+                pf.services.append(self.parse_service())
+            elif value == ";":
+                self._next()
+            else:
+                raise ProtoParseError(
+                    f"unsupported top-level element {value!r}", self.filename, line
+                )
+        return pf
+
+    def parse_message(self) -> MessageSpec:
+        _, _, line = self._expect("message")
+        name = self._expect_kind("ident")[1]
+        msg = MessageSpec(name=name, proto=self.filename, line=line)
+        self._expect("{")
+        while True:
+            tok = self._peek()
+            if tok is None:
+                raise ProtoParseError("unterminated message", self.filename, line)
+            if tok[1] == "}":
+                self._next()
+                return msg
+            if tok[1] == "reserved":
+                self._parse_reserved(msg)
+            elif tok[1] == "option":
+                self._skip_statement()
+            elif tok[1] == ";":
+                self._next()
+            elif tok[1] == "message":
+                # Nested messages are flattened into the file's message
+                # list under their simple name (the repo does not nest,
+                # but fixtures may).
+                msg_nested = self.parse_message()
+                msg_nested.proto = self.filename
+                self._nested_messages.append(msg_nested)
+            elif tok[1] == "enum":
+                self._nested_enums.append(self.parse_enum())
+            else:
+                msg.fields.append(self._parse_field())
+
+    _nested_messages: List[MessageSpec]
+    _nested_enums: List[EnumSpec]
+
+    def _parse_field(self) -> FieldSpec:
+        repeated = False
+        tok = self._next()
+        line = tok[2]
+        if tok[1] in ("repeated", "optional", "required"):
+            repeated = tok[1] == "repeated"
+            tok = self._next()
+        if tok[0] != "ident":
+            raise ProtoParseError(
+                f"expected field type, got {tok[1]!r}", self.filename, tok[2]
+            )
+        ftype = tok[1]
+        if ftype == "map":
+            raise ProtoParseError("map fields are not supported", self.filename, line)
+        fname = self._expect_kind("ident")[1]
+        self._expect("=")
+        number = int(self._expect_kind("number")[1])
+        tok = self._next()
+        if tok[1] == "[":
+            # field options, e.g. [packed = false] — parsed and ignored;
+            # the repo's codecs only emit proto3 defaults.
+            while self._next()[1] != "]":
+                pass
+            tok = self._next()
+        if tok[1] != ";":
+            raise ProtoParseError(
+                f"expected ';' after field, got {tok[1]!r}", self.filename, tok[2]
+            )
+        return FieldSpec(name=fname, number=number, type=ftype, repeated=repeated, line=line)
+
+    def _parse_reserved(self, msg: MessageSpec) -> None:
+        self._expect("reserved")
+        while True:
+            tok = self._next()
+            if tok[0] == "number":
+                lo = int(tok[1])
+                peek = self._peek()
+                if peek is not None and peek[1] == "to":
+                    self._next()
+                    hi_tok = self._next()
+                    if hi_tok[1] == "max":
+                        hi = 536870911  # 2**29 - 1, proto3 field-number ceiling
+                    else:
+                        hi = int(hi_tok[1])
+                else:
+                    hi = lo
+                msg.reserved_ranges.append((lo, hi))
+            elif tok[0] == "string":
+                msg.reserved_names.append(tok[1].strip('"'))
+            else:
+                raise ProtoParseError(
+                    f"bad reserved entry {tok[1]!r}", self.filename, tok[2]
+                )
+            tok = self._next()
+            if tok[1] == ";":
+                return
+            if tok[1] != ",":
+                raise ProtoParseError(
+                    f"expected ',' or ';' in reserved, got {tok[1]!r}",
+                    self.filename,
+                    tok[2],
+                )
+
+    def parse_enum(self) -> EnumSpec:
+        _, _, line = self._expect("enum")
+        name = self._expect_kind("ident")[1]
+        enum = EnumSpec(name=name, proto=self.filename, line=line)
+        self._expect("{")
+        while True:
+            tok = self._next()
+            if tok[1] == "}":
+                return enum
+            if tok[1] == "option":
+                self._skip_statement()
+                continue
+            if tok[1] == ";":
+                continue
+            if tok[0] != "ident":
+                raise ProtoParseError(
+                    f"expected enum value name, got {tok[1]!r}", self.filename, tok[2]
+                )
+            vname, vline = tok[1], tok[2]
+            self._expect("=")
+            number = int(self._expect_kind("number")[1])
+            nxt = self._next()
+            if nxt[1] == "[":
+                while self._next()[1] != "]":
+                    pass
+                nxt = self._next()
+            if nxt[1] != ";":
+                raise ProtoParseError(
+                    f"expected ';' after enum value, got {nxt[1]!r}",
+                    self.filename,
+                    nxt[2],
+                )
+            enum.values.append(EnumValueSpec(name=vname, number=number, line=vline))
+
+    def parse_service(self) -> ServiceSpec:
+        _, _, line = self._expect("service")
+        name = self._expect_kind("ident")[1]
+        svc = ServiceSpec(name=name, proto=self.filename, line=line)
+        self._expect("{")
+        while True:
+            tok = self._next()
+            if tok[1] == "}":
+                return svc
+            if tok[1] == ";":
+                continue
+            if tok[1] == "option":
+                self._skip_statement()
+                continue
+            if tok[1] != "rpc":
+                raise ProtoParseError(
+                    f"expected 'rpc', got {tok[1]!r}", self.filename, tok[2]
+                )
+            mline = tok[2]
+            mname = self._expect_kind("ident")[1]
+            self._expect("(")
+            request = self._rpc_type()
+            self._expect(")")
+            self._expect_ident("returns")
+            self._expect("(")
+            response = self._rpc_type()
+            self._expect(")")
+            nxt = self._next()
+            if nxt[1] == "{":
+                depth = 1
+                while depth:
+                    inner = self._next()
+                    if inner[1] == "{":
+                        depth += 1
+                    elif inner[1] == "}":
+                        depth -= 1
+            elif nxt[1] != ";":
+                raise ProtoParseError(
+                    f"expected ';' or '{{' after rpc, got {nxt[1]!r}",
+                    self.filename,
+                    nxt[2],
+                )
+            svc.methods.append(
+                MethodSpec(name=mname, request=request, response=response, line=mline)
+            )
+
+    def _rpc_type(self) -> str:
+        tok = self._next()
+        if tok[1] == "stream":
+            tok = self._next()
+        return tok[1]
+
+    def _expect_ident(self, value: str) -> None:
+        tok = self._next()
+        if tok[1] != value:
+            raise ProtoParseError(
+                f"expected {value!r}, got {tok[1]!r}", self.filename, tok[2]
+            )
+
+
+def parse_proto_text(text: str, filename: str = "<proto>") -> ProtoFile:
+    """Parse one .proto source string into a :class:`ProtoFile`."""
+    parser = _Parser(_tokenize(text, filename), filename)
+    parser._nested_messages = []
+    parser._nested_enums = []
+    pf = parser.parse_file()
+    pf.messages.extend(parser._nested_messages)
+    pf.enums.extend(parser._nested_enums)
+    return pf
+
+
+# ---------------------------------------------------------------------------
+# Repo-level loading
+# ---------------------------------------------------------------------------
+
+PROTO_DIR = Path("shockwave_tpu") / "runtime" / "protobuf"
+
+_schema_cache: Dict[Tuple[Tuple[str, float], ...], ProtoSchema] = {}
+
+
+def proto_dir(root: Optional[Path] = None) -> Path:
+    return (root or repo_root()) / PROTO_DIR
+
+
+def load_repo_schema(root: Optional[Path] = None) -> ProtoSchema:
+    """Parse every .proto under ``runtime/protobuf`` (cached by mtime)."""
+    directory = proto_dir(root)
+    paths = sorted(directory.glob("*.proto"))
+    key = tuple((p.name, p.stat().st_mtime) for p in paths)
+    schema = _schema_cache.get(key)
+    if schema is None:
+        schema = ProtoSchema.from_dir(directory)
+        _schema_cache.clear()  # one live entry; old mtimes never recur
+        _schema_cache[key] = schema
+    return schema
+
+
+def schema_field_numbers(schema: ProtoSchema, message: str) -> Sequence[int]:
+    msg = schema.message(message)
+    return sorted(msg.by_number) if msg else ()

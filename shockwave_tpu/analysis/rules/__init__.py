@@ -22,6 +22,12 @@ from shockwave_tpu.analysis.rules.interproc import (
 from shockwave_tpu.analysis.rules.locks import LockDiscipline
 from shockwave_tpu.analysis.rules.races import SharedStateRace, SnapshotEscape
 from shockwave_tpu.analysis.rules.rng import RngKeyReuse
+from shockwave_tpu.analysis.rules.wirecheck import (
+    CanonicalDefaultOmission,
+    DecoderUnknownFieldTolerance,
+    FieldNumberCollision,
+    ProtoCodecDrift,
+)
 
 RULE_CLASSES = (
     DonationAfterUse,
@@ -35,6 +41,10 @@ RULE_CLASSES = (
     SwallowedException,
     SharedStateRace,
     SnapshotEscape,
+    ProtoCodecDrift,
+    FieldNumberCollision,
+    CanonicalDefaultOmission,
+    DecoderUnknownFieldTolerance,
 )
 
 
@@ -64,4 +74,8 @@ __all__ = [
     "SwallowedException",
     "SharedStateRace",
     "SnapshotEscape",
+    "ProtoCodecDrift",
+    "FieldNumberCollision",
+    "CanonicalDefaultOmission",
+    "DecoderUnknownFieldTolerance",
 ]

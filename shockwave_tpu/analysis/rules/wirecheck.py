@@ -1,0 +1,923 @@
+"""Rules: wire-contract conformance for the hand-rolled codecs.
+
+Every RPC rides hand-rolled wire-compatible codecs
+(``runtime/protobuf/*_pb2.py`` plus the fastwire columnar frames) whose
+field numbers, wire types, and canonical default-omission are
+maintained by hand against ``.proto`` files that are documentation,
+not source. These rules close that loop: :mod:`..protospec` parses the
+protos into a schema model and each codec module is AST-checked
+against it.
+
+* **proto-codec-drift** — every ``put_*`` serializer call and every
+  ``scan_fields`` decoder branch must agree with the ``.proto`` on
+  field number, wire type, and packedness; proto fields absent from
+  the encoder or decoder, and codec fields (or whole codec classes)
+  absent from the proto, are findings. The fastwire columnar path is
+  held to the same contract: the ``STR_FIELDS`` /
+  ``columns_from_jobspec_spans`` mapping must cover every ``JobSpec``
+  field (a new JobSpec field that skips the columnar frame is a silent
+  decode divergence, not a lint-free change) and the
+  ``encode_columnar_block``/``decode_columnar_block`` pair must agree
+  with ``ColumnarJobBlock``.
+* **field-number-collision** — duplicate field numbers inside a
+  message, reserved-range/name violations (declared ``reserved``
+  statements plus proto's own 19000–19999 range), duplicate enum
+  values.
+* **canonical-default-omission** — ``put_msg`` is the one helper in
+  :mod:`shockwave_tpu.runtime.protobuf.wire` that does NOT self-guard,
+  so every call must sit under an ``if``/loop guard; an unguarded call
+  emits a zero-length field for default values and breaks the
+  all-default-message-serializes-to-zero-bytes contract byte-identity
+  (and capability negotiation) rely on.
+* **decoder-unknown-field-tolerance** — scan-based decoders must skip
+  unknown tags, never raise on them: any ``raise`` inside a
+  ``for ... in scan_fields(...)`` loop, or a field-dispatch chain
+  whose terminal ``else`` raises, would turn a widened peer schema
+  into a hard parse failure (the forward-compat flag-day these codecs
+  exist to avoid).
+
+Findings anchor on the ``*_pb2.py`` module (the proto file is named in
+the message) so project-scoped runs and the baseline treat them like
+any other Python finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import posixpath
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from shockwave_tpu.analysis.core import FileContext, Finding, Rule
+
+_PB2_GLOB = "shockwave_tpu/runtime/protobuf/*_pb2.py"
+_LEGACY_PREFIX = "shockwave_tpu/runtime/protobuf/legacy/"
+_FASTWIRE_PATH = "shockwave_tpu/runtime/protobuf/fastwire.py"
+
+_PUT_HELPERS = frozenset(
+    {
+        "put_str",
+        "put_varint",
+        "put_double",
+        "put_msg",
+        "put_packed_varints",
+        "put_packed_doubles",
+    }
+)
+
+#: messages whose codec deliberately lives outside <proto>_pb2.py
+#: (the columnar frame is fastwire's encode/decode_columnar_block).
+_EXTERNAL_CODECS = frozenset({"ColumnarJobBlock"})
+
+
+def _is_pb2_module(relpath: str) -> bool:
+    return fnmatch.fnmatch(relpath, _PB2_GLOB) and not relpath.startswith(
+        _LEGACY_PREFIX
+    )
+
+
+def _module_proto_name(relpath: str) -> str:
+    base = posixpath.basename(relpath)
+    return base[: -len("_pb2.py")] + ".proto"
+
+
+def _is_protoc_generated(tree: ast.Module) -> bool:
+    """protoc output assigns the serialized FileDescriptorProto to a
+    module-level ``DESCRIPTOR`` — the runtime descriptor itself is the
+    conformance authority there (checked by scripts/ci/wire_smoke.py),
+    so the AST rules skip those modules."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id == "DESCRIPTOR":
+                    return True
+    return False
+
+
+def _call_name(node: ast.Call) -> str:
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _literal_int(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    return None
+
+
+def _self_attrs(node: ast.AST) -> Set[str]:
+    """Attribute names read off ``self`` anywhere inside ``node``."""
+    attrs: Set[str] = set()
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Attribute)
+            and isinstance(sub.value, ast.Name)
+            and sub.value.id == "self"
+        ):
+            attrs.add(sub.attr)
+    return attrs
+
+
+def _method(cls: ast.ClassDef, name: str) -> Optional[ast.FunctionDef]:
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name == name:
+                return node
+    return None
+
+
+def _function_calls(fn: ast.AST) -> List[ast.Call]:
+    calls = [n for n in ast.walk(fn) if isinstance(n, ast.Call)]
+    calls.sort(key=lambda c: (c.lineno, c.col_offset))
+    return calls
+
+
+class _WireRule(Rule):
+    """Shared schema plumbing: rules accept an injected schema for
+    fixture tests and lazily parse the repo's protos otherwise."""
+
+    def __init__(self, schema=None):
+        self._schema = schema
+
+    def _get_schema(self):
+        if self._schema is None:
+            from shockwave_tpu.analysis import protospec
+
+            self._schema = protospec.load_repo_schema()
+        return self._schema
+
+
+# ---------------------------------------------------------------------------
+# proto-codec-drift
+# ---------------------------------------------------------------------------
+
+class ProtoCodecDrift(_WireRule):
+    name = "proto-codec-drift"
+    description = (
+        "hand-rolled codec disagrees with its .proto on field number, "
+        "wire type, packedness, field coverage, or documents a message "
+        "no .proto declares"
+    )
+    rationale = (
+        "the .proto files are the wire contract but nothing generates "
+        "code from them — a codec edit that drifts (or a codec with no "
+        ".proto at all, like explain_pb2 pre-fix) silently breaks "
+        "byte-identity with every protoc peer"
+    )
+
+    def applies_to(self, relpath: str) -> bool:
+        return _is_pb2_module(relpath) or relpath.endswith("fastwire.py")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.relpath.endswith("fastwire.py"):
+            yield from self._check_fastwire(ctx)
+            return
+        if _is_protoc_generated(ctx.tree):
+            return
+        schema = self._get_schema()
+        proto_name = _module_proto_name(ctx.relpath)
+        proto_file = schema.files.get(proto_name)
+        codec_classes = [
+            node
+            for node in ctx.tree.body
+            if isinstance(node, ast.ClassDef)
+            and (
+                _method(node, "SerializeToString") is not None
+                or _method(node, "FromString") is not None
+            )
+        ]
+        implemented = {cls.name for cls in codec_classes}
+        if proto_file is not None:
+            for msg in proto_file.messages:
+                if msg.name in implemented or msg.name in _EXTERNAL_CODECS:
+                    continue
+                yield self.finding(
+                    ctx,
+                    1,
+                    f"message {msg.name} (declared in {proto_name}:"
+                    f"{msg.line}) has no codec class in this module — "
+                    "a peer encoding it gets silently dropped",
+                )
+        for cls in codec_classes:
+            spec = schema.message(cls.name)
+            if spec is None:
+                yield self.finding(
+                    ctx,
+                    cls,
+                    f"codec class {cls.name} is not declared by any "
+                    f".proto — author {proto_name} so the wire contract "
+                    "is documented, registered, and fuzzable",
+                )
+                continue
+            yield from self._check_encoder(ctx, cls, spec)
+            yield from self._check_decoder(ctx, cls, spec)
+
+    # -- encoder --------------------------------------------------------
+
+    def _helper_ok(self, helper: str, fld) -> bool:
+        if helper == "put_str":
+            return not fld.repeated and fld.kind == "string"
+        if helper == "put_varint":
+            return not fld.repeated and fld.kind in ("varint", "enum")
+        if helper == "put_double":
+            return not fld.repeated and fld.kind == "fixed64"
+        if helper == "put_packed_varints":
+            return fld.packed and fld.element_wire_type == 0
+        if helper == "put_packed_doubles":
+            return fld.packed and fld.element_wire_type == 1
+        if helper == "put_msg":
+            # Any length-delimited payload the caller pre-built: an
+            # embedded message, a bytes field, one element of a
+            # repeated string, or a pre-packed column. Singular strings
+            # must go through the self-guarding put_str.
+            if fld.wire_type != 2:
+                return False
+            return fld.repeated or fld.kind != "string"
+        return False
+
+    def _expected_helper(self, fld) -> str:
+        if fld.packed:
+            return (
+                "put_packed_varints"
+                if fld.element_wire_type == 0
+                else "put_packed_doubles"
+            )
+        if fld.repeated or fld.kind in ("message", "bytes"):
+            return "put_msg"
+        if fld.kind == "string":
+            return "put_str"
+        if fld.kind == "fixed64":
+            return "put_double"
+        return "put_varint"
+
+    def _encoder_attr(self, ctx: FileContext, call: ast.Call) -> Optional[str]:
+        """The self attribute a put_* call serializes, when it is
+        unambiguous: either exactly one ``self.x`` in the value
+        expression, or the ``self.x`` a wrapping ``for`` iterates."""
+        if len(call.args) < 3:
+            return None
+        attrs = _self_attrs(call.args[2])
+        if len(attrs) == 1:
+            return next(iter(attrs))
+        if attrs:
+            return None
+        for ancestor in ctx.ancestors(call):
+            if isinstance(ancestor, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return None
+            if isinstance(ancestor, (ast.For, ast.AsyncFor)):
+                iter_attrs = _self_attrs(ancestor.iter)
+                if len(iter_attrs) == 1:
+                    return next(iter(iter_attrs))
+                return None
+        return None
+
+    def _check_encoder(self, ctx: FileContext, cls: ast.ClassDef, spec):
+        fn = _method(cls, "SerializeToString")
+        if fn is None:
+            yield self.finding(
+                ctx, cls, f"codec class {cls.name} has no SerializeToString()"
+            )
+            return
+        written: Set[int] = set()
+        ordered: List[int] = []
+        for call in _function_calls(fn):
+            helper = _call_name(call)
+            if helper not in _PUT_HELPERS or len(call.args) < 2:
+                continue
+            number = _literal_int(call.args[1])
+            if number is None:
+                yield self.finding(
+                    ctx,
+                    call,
+                    f"{cls.name}: {helper}() field number must be a "
+                    "literal int so the contract is statically checkable",
+                )
+                continue
+            ordered.append(number)
+            fld = spec.by_number.get(number)
+            if fld is None:
+                yield self.finding(
+                    ctx,
+                    call,
+                    f"{cls.name} encoder writes field {number}, which "
+                    f"{spec.proto} does not declare for message "
+                    f"{spec.name}",
+                )
+                continue
+            written.add(number)
+            if not self._helper_ok(helper, fld):
+                yield self.finding(
+                    ctx,
+                    call,
+                    f"{cls.name} encoder writes field {number} "
+                    f"({fld.name}: "
+                    f"{'repeated ' if fld.repeated else ''}{fld.type}) "
+                    f"with {helper}() — wrong wire type/packedness; "
+                    f"expected {self._expected_helper(fld)}()",
+                )
+            attr = self._encoder_attr(ctx, call)
+            if attr is not None and attr != fld.name:
+                yield self.finding(
+                    ctx,
+                    call,
+                    f"{cls.name} encoder writes self.{attr} into field "
+                    f"{number}, which {spec.proto} names {fld.name!r} — "
+                    "swapped or renumbered field",
+                )
+        for prev, cur in zip(ordered, ordered[1:]):
+            if cur < prev:
+                yield self.finding(
+                    ctx,
+                    fn,
+                    f"{cls.name} encoder emits field {cur} after "
+                    f"{prev} — canonical proto3 writes fields in "
+                    "number order (byte-identity with protoc)",
+                )
+                break
+        for fld in spec.fields:
+            if fld.number not in written:
+                yield self.finding(
+                    ctx,
+                    fn,
+                    f"{cls.name} encoder never writes field "
+                    f"{fld.number} ({fld.name}), declared in "
+                    f"{spec.proto} — the field silently drops on send",
+                )
+
+    # -- decoder --------------------------------------------------------
+
+    def _scan_loops(self, fn: ast.AST) -> List[Tuple[ast.For, str, str]]:
+        loops = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.For):
+                continue
+            if not (
+                isinstance(node.iter, ast.Call)
+                and _call_name(node.iter) == "scan_fields"
+            ):
+                continue
+            field_var, wt_var = "field", "wire_type"
+            if isinstance(node.target, ast.Tuple) and len(node.target.elts) >= 2:
+                first, second = node.target.elts[0], node.target.elts[1]
+                if isinstance(first, ast.Name):
+                    field_var = first.id
+                if isinstance(second, ast.Name):
+                    wt_var = second.id
+            loops.append((node, field_var, wt_var))
+        return loops
+
+    def _branch_tests(
+        self, loop: ast.For, field_var: str, wt_var: str
+    ) -> List[Tuple[ast.If, int, Optional[int]]]:
+        """(if-node, field number, wire type or None) per dispatch branch."""
+        branches = []
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.If):
+                continue
+            field_num, wt = self._parse_test(node.test, field_var, wt_var)
+            if field_num is not None:
+                branches.append((node, field_num, wt))
+        return branches
+
+    def _parse_test(
+        self, test: ast.AST, field_var: str, wt_var: str
+    ) -> Tuple[Optional[int], Optional[int]]:
+        field_num: Optional[int] = None
+        wt: Optional[int] = None
+        comparisons: List[ast.Compare] = []
+        if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+            comparisons = [v for v in test.values if isinstance(v, ast.Compare)]
+        elif isinstance(test, ast.Compare):
+            comparisons = [test]
+        for cmp_node in comparisons:
+            if len(cmp_node.ops) != 1 or not isinstance(cmp_node.ops[0], ast.Eq):
+                continue
+            left, right = cmp_node.left, cmp_node.comparators[0]
+            value = _literal_int(right)
+            if not isinstance(left, ast.Name) or value is None:
+                continue
+            if left.id == field_var:
+                field_num = value
+            elif left.id == wt_var:
+                wt = value
+        return field_num, wt
+
+    def _branch_attr(self, branch: ast.If) -> Optional[str]:
+        """The instance attribute one dispatch branch assigns/appends —
+        unambiguous single-attr branches only."""
+        attrs: Set[str] = set()
+        for node in branch.body:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    for target in sub.targets:
+                        if isinstance(target, ast.Attribute) and isinstance(
+                            target.value, ast.Name
+                        ):
+                            attrs.add(target.attr)
+                elif isinstance(sub, ast.Call):
+                    func = sub.func
+                    if (
+                        isinstance(func, ast.Attribute)
+                        and func.attr in ("append", "extend")
+                        and isinstance(func.value, ast.Attribute)
+                        and isinstance(func.value.value, ast.Name)
+                    ):
+                        attrs.add(func.value.attr)
+        if len(attrs) == 1:
+            return next(iter(attrs))
+        return None
+
+    def _check_decoder(self, ctx: FileContext, cls: ast.ClassDef, spec):
+        fn = _method(cls, "FromString")
+        if fn is None:
+            yield self.finding(
+                ctx, cls, f"codec class {cls.name} has no FromString()"
+            )
+            return
+        loops = self._scan_loops(fn)
+        if not loops:
+            # A decoder not built on scan_fields (fastwire-style manual
+            # scan) is outside this rule's per-branch model.
+            return
+        handled: Set[int] = set()
+        for loop, field_var, wt_var in loops:
+            for branch, number, wt in self._branch_tests(loop, field_var, wt_var):
+                fld = spec.by_number.get(number)
+                if fld is None:
+                    yield self.finding(
+                        ctx,
+                        branch,
+                        f"{cls.name} decoder handles field {number}, "
+                        f"which {spec.proto} does not declare for "
+                        f"message {spec.name}",
+                    )
+                    continue
+                handled.add(number)
+                allowed = {fld.wire_type}
+                if fld.packed:
+                    # protoc parsers accept the unpacked encoding of a
+                    # packed field; these decoders keep that fallback.
+                    allowed.add(fld.element_wire_type)
+                if wt is not None and wt not in allowed:
+                    yield self.finding(
+                        ctx,
+                        branch,
+                        f"{cls.name} decoder reads field {number} "
+                        f"({fld.name}) at wire type {wt}; {spec.proto} "
+                        f"implies {sorted(allowed)}",
+                    )
+                attr = self._branch_attr(branch)
+                if attr is not None and attr != fld.name:
+                    yield self.finding(
+                        ctx,
+                        branch,
+                        f"{cls.name} decoder stores field {number} into "
+                        f".{attr}, which {spec.proto} names "
+                        f"{fld.name!r} — swapped or renumbered field",
+                    )
+        for fld in spec.fields:
+            if fld.number not in handled:
+                yield self.finding(
+                    ctx,
+                    fn,
+                    f"{cls.name} decoder never reads field "
+                    f"{fld.number} ({fld.name}), declared in "
+                    f"{spec.proto} — the field silently drops on receive",
+                )
+
+    # -- fastwire columnar path ----------------------------------------
+
+    def _check_fastwire(self, ctx: FileContext) -> Iterator[Finding]:
+        schema = self._get_schema()
+        jobspec = schema.message("JobSpec")
+        block = schema.message("ColumnarJobBlock")
+        if jobspec is not None:
+            yield from self._check_fastwire_jobspec(ctx, jobspec)
+        if block is not None:
+            yield from self._check_fastwire_block(ctx, block)
+
+    def _str_fields_assign(self, ctx: FileContext):
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if isinstance(target, ast.Name) and target.id == "STR_FIELDS":
+                        return node
+        return None
+
+    def _check_fastwire_jobspec(self, ctx: FileContext, jobspec):
+        """STR_FIELDS + the numeric dispatch in
+        columns_from_jobspec_spans must jointly cover JobSpec."""
+        str_map: Dict[int, str] = {}
+        assign = self._str_fields_assign(ctx)
+        if assign is None:
+            yield self.finding(
+                ctx,
+                1,
+                "fastwire no longer defines STR_FIELDS — the columnar "
+                "string-column mapping for JobSpec is gone",
+            )
+            return
+        if isinstance(assign.value, (ast.Tuple, ast.List)):
+            for elt in assign.value.elts:
+                if isinstance(elt, (ast.Tuple, ast.List)) and len(elt.elts) == 2:
+                    number = _literal_int(elt.elts[0])
+                    name_node = elt.elts[1]
+                    if number is not None and isinstance(name_node, ast.Constant):
+                        str_map[number] = str(name_node.value)
+        for number, name in sorted(str_map.items()):
+            fld = jobspec.by_number.get(number)
+            if fld is None or fld.name != name or fld.kind != "string":
+                yield self.finding(
+                    ctx,
+                    assign,
+                    f"STR_FIELDS maps column ({number}, {name!r}) but "
+                    f"JobSpec declares "
+                    f"{'no field ' + str(number) if fld is None else f'{number} as {fld.name} ({fld.type})'}",
+                )
+        numeric = self._jobspec_numeric_dispatch(ctx)
+        for number, wt in sorted(numeric.items()):
+            fld = jobspec.by_number.get(number)
+            if fld is None:
+                yield self.finding(
+                    ctx,
+                    1,
+                    f"columns_from_jobspec_spans dispatches JobSpec "
+                    f"field {number}, which admission.proto does not "
+                    "declare",
+                )
+            elif fld.wire_type != wt:
+                yield self.finding(
+                    ctx,
+                    1,
+                    f"columns_from_jobspec_spans reads JobSpec field "
+                    f"{number} ({fld.name}) at wire type {wt}; "
+                    f"admission.proto implies {fld.wire_type}",
+                )
+        covered = set(str_map) | set(numeric)
+        for fld in jobspec.fields:
+            if fld.number not in covered:
+                yield self.finding(
+                    ctx,
+                    1,
+                    f"JobSpec field {fld.number} ({fld.name}) is not "
+                    "mapped by the fastwire columnar decoder "
+                    "(STR_FIELDS / columns_from_jobspec_spans) — the "
+                    "field silently diverges between the scalar and "
+                    "columnar decode paths",
+                )
+
+    def _jobspec_numeric_dispatch(self, ctx: FileContext) -> Dict[int, int]:
+        """field number -> wire type for the numeric branches of
+        columns_from_jobspec_spans (``if wt == 0: ... if field == 5``)."""
+        fn = None
+        for node in ctx.tree.body:
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == "columns_from_jobspec_spans"
+            ):
+                fn = node
+                break
+        if fn is None:
+            return {}
+        dispatch: Dict[int, int] = {}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.If):
+                continue
+            field_num, _ = self._parse_test(node.test, "field", "wt")
+            if field_num is None:
+                continue
+            wt = self._enclosing_wt(ctx, node)
+            if wt in (0, 1):
+                dispatch[field_num] = wt
+        return dispatch
+
+    def _enclosing_wt(self, ctx: FileContext, node: ast.If) -> Optional[int]:
+        for ancestor in ctx.ancestors(node):
+            if isinstance(ancestor, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return None
+            if isinstance(ancestor, ast.If):
+                _, wt = self._parse_test(ancestor.test, "field", "wt")
+                if wt is None and isinstance(ancestor.test, ast.Compare):
+                    # `if wt == 0:` parses as the wt side only when the
+                    # name matches; _parse_test already handled it.
+                    pass
+                if wt is not None:
+                    return wt
+        return None
+
+    def _check_fastwire_block(self, ctx: FileContext, block):
+        """encode/decode_columnar_block field numbers must cover and
+        agree with ColumnarJobBlock."""
+        encode_fn = decode_fn = None
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name == "encode_columnar_block":
+                    encode_fn = node
+                elif node.name == "decode_columnar_block":
+                    decode_fn = node
+        if encode_fn is None or decode_fn is None:
+            yield self.finding(
+                ctx,
+                1,
+                "fastwire no longer defines encode_columnar_block/"
+                "decode_columnar_block — the ColumnarJobBlock contract "
+                "has no codec",
+            )
+            return
+        written: Set[int] = set()
+        for call in _function_calls(encode_fn):
+            helper = _call_name(call)
+            if helper not in _PUT_HELPERS or len(call.args) < 2:
+                continue
+            number = _literal_int(call.args[1])
+            if number is None:
+                continue
+            fld = block.by_number.get(number)
+            if fld is None:
+                yield self.finding(
+                    ctx,
+                    call,
+                    f"encode_columnar_block writes field {number}, "
+                    "which ColumnarJobBlock does not declare",
+                )
+                continue
+            written.add(number)
+            expected_wt = 0 if helper == "put_varint" else 2
+            if fld.wire_type != expected_wt:
+                yield self.finding(
+                    ctx,
+                    call,
+                    f"encode_columnar_block writes field {number} "
+                    f"({fld.name}) with {helper}() (wire type "
+                    f"{expected_wt}); ColumnarJobBlock implies "
+                    f"{fld.wire_type}",
+                )
+        for fld in block.fields:
+            if fld.number not in written:
+                yield self.finding(
+                    ctx,
+                    encode_fn,
+                    f"encode_columnar_block never writes field "
+                    f"{fld.number} ({fld.name}) of ColumnarJobBlock",
+                )
+        read = self._block_decode_fields(decode_fn)
+        for number in sorted(read):
+            if number not in block.by_number:
+                yield self.finding(
+                    ctx,
+                    decode_fn,
+                    f"decode_columnar_block reads field {number}, "
+                    "which ColumnarJobBlock does not declare",
+                )
+        for fld in block.fields:
+            if fld.number not in read:
+                yield self.finding(
+                    ctx,
+                    decode_fn,
+                    f"decode_columnar_block never reads field "
+                    f"{fld.number} ({fld.name}) of ColumnarJobBlock",
+                )
+
+    def _block_decode_fields(self, fn: ast.AST) -> Set[int]:
+        numbers: Set[int] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+                continue
+            if not (
+                isinstance(node.left, ast.Name) and node.left.id == "field"
+            ):
+                continue
+            comparator = node.comparators[0]
+            if isinstance(node.ops[0], ast.Eq):
+                value = _literal_int(comparator)
+                if value is not None:
+                    numbers.add(value)
+            elif isinstance(node.ops[0], ast.In) and isinstance(
+                comparator, (ast.Tuple, ast.List, ast.Set)
+            ):
+                for elt in comparator.elts:
+                    value = _literal_int(elt)
+                    if value is not None:
+                        numbers.add(value)
+        return numbers
+
+
+# ---------------------------------------------------------------------------
+# field-number-collision
+# ---------------------------------------------------------------------------
+
+class FieldNumberCollision(_WireRule):
+    name = "field-number-collision"
+    description = (
+        ".proto message reuses a field number, violates a reserved "
+        "range/name, or an enum aliases a value"
+    )
+    rationale = (
+        "a reused or reserved field number decodes old peers' bytes "
+        "into the wrong field with no error anywhere — the one wire "
+        "bug no amount of runtime testing against the same build "
+        "catches"
+    )
+
+    def applies_to(self, relpath: str) -> bool:
+        return _is_pb2_module(relpath)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        schema = self._get_schema()
+        proto_file = schema.files.get(_module_proto_name(ctx.relpath))
+        if proto_file is None:
+            return
+        for msg in proto_file.messages:
+            seen: Dict[int, str] = {}
+            for fld in msg.fields:
+                if fld.number in seen:
+                    yield self.finding(
+                        ctx,
+                        1,
+                        f"{proto_file.name}:{fld.line}: message "
+                        f"{msg.name} declares field number "
+                        f"{fld.number} twice ({seen[fld.number]} and "
+                        f"{fld.name})",
+                    )
+                seen[fld.number] = fld.name
+                hit = msg.reserved_hit(fld.number)
+                if hit is not None:
+                    yield self.finding(
+                        ctx,
+                        1,
+                        f"{proto_file.name}:{fld.line}: message "
+                        f"{msg.name} field {fld.name} = {fld.number} "
+                        f"falls in reserved range {hit[0]}-{hit[1]}",
+                    )
+                if fld.name in msg.reserved_names:
+                    yield self.finding(
+                        ctx,
+                        1,
+                        f"{proto_file.name}:{fld.line}: message "
+                        f"{msg.name} reuses reserved field name "
+                        f"{fld.name!r}",
+                    )
+        for enum in proto_file.enums:
+            seen_values: Dict[int, str] = {}
+            for value in enum.values:
+                if value.number in seen_values:
+                    yield self.finding(
+                        ctx,
+                        1,
+                        f"{proto_file.name}:{value.line}: enum "
+                        f"{enum.name} declares value {value.number} "
+                        f"twice ({seen_values[value.number]} and "
+                        f"{value.name})",
+                    )
+                seen_values[value.number] = value.name
+
+
+# ---------------------------------------------------------------------------
+# canonical-default-omission
+# ---------------------------------------------------------------------------
+
+class CanonicalDefaultOmission(Rule):
+    name = "canonical-default-omission"
+    description = (
+        "unguarded put_msg() call — a default-valued field would emit "
+        "a zero-length entry instead of being omitted"
+    )
+    rationale = (
+        "canonical proto3 omits default fields, which is what makes an "
+        "all-default message zero bytes and keeps hand-rolled output "
+        "byte-identical to protoc; put_msg is the one wire.py helper "
+        "that does not self-guard, so every call site needs an "
+        "if/for guard (early-return guards do not count: the guard "
+        "must be on the emptiness of THIS payload)"
+    )
+
+    def applies_to(self, relpath: str) -> bool:
+        return _is_pb2_module(relpath) or relpath.endswith("fastwire.py")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if _is_protoc_generated(ctx.tree):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or _call_name(node) != "put_msg":
+                continue
+            if self._guarded(ctx, node):
+                continue
+            yield self.finding(
+                ctx,
+                node,
+                "put_msg() without an if/for guard — an empty payload "
+                "emits a zero-length field, breaking canonical "
+                "default omission (and byte-identity with protoc)",
+            )
+
+    def _guarded(self, ctx: FileContext, node: ast.Call) -> bool:
+        for ancestor in ctx.ancestors(node):
+            if isinstance(
+                ancestor, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return False
+            if isinstance(
+                ancestor, (ast.If, ast.IfExp, ast.For, ast.AsyncFor, ast.While)
+            ):
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# decoder-unknown-field-tolerance
+# ---------------------------------------------------------------------------
+
+class DecoderUnknownFieldTolerance(Rule):
+    name = "decoder-unknown-field-tolerance"
+    description = (
+        "scan-based decoder raises inside its field loop or on an "
+        "unmatched field number — unknown tags must be skipped"
+    )
+    rationale = (
+        "proto3 forward compatibility IS unknown-field tolerance: a "
+        "decoder that raises on an unrecognized tag turns every "
+        "schema widening into a flag-day (the legacy-peer "
+        "interop every capability negotiation here depends on)"
+    )
+
+    def applies_to(self, relpath: str) -> bool:
+        return _is_pb2_module(relpath) or relpath.endswith("fastwire.py")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if _is_protoc_generated(ctx.tree):
+            return
+        reported: Set[ast.Raise] = set()
+        # (a) any raise inside a `for ... in scan_fields(...)` body —
+        # scan_fields already rejects malformed wire data before the
+        # loop body runs, so a raise here can only be value/field
+        # intolerance.
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.For):
+                continue
+            if not (
+                isinstance(node.iter, ast.Call)
+                and _call_name(node.iter) == "scan_fields"
+            ):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Raise) and sub not in reported:
+                    reported.add(sub)
+                    yield self.finding(
+                        ctx,
+                        sub,
+                        "raise inside a scan_fields() loop — unknown or "
+                        "unexpected fields must be skipped, not "
+                        "rejected (proto3 forward compatibility)",
+                    )
+        # (b) a field-dispatch chain whose terminal else raises (manual
+        # while-scanners dispatch on wire type too; only the FIELD
+        # chain must be tolerant — unknown wire types 3/4/6/7 are
+        # malformed data and may raise).
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.If):
+                continue
+            if not self._tests_field(node.test):
+                continue
+            for raise_node in self._terminal_else_raises(node):
+                if raise_node in reported:
+                    continue
+                reported.add(raise_node)
+                yield self.finding(
+                    ctx,
+                    raise_node,
+                    "field-dispatch chain raises on an unmatched field "
+                    "number — unknown fields must be skipped "
+                    "(proto3 forward compatibility)",
+                )
+
+    def _tests_field(self, test: ast.AST) -> bool:
+        for sub in ast.walk(test):
+            if (
+                isinstance(sub, ast.Compare)
+                and isinstance(sub.left, ast.Name)
+                and sub.left.id == "field"
+            ):
+                return True
+        return False
+
+    def _terminal_else_raises(self, node: ast.If) -> List[ast.Raise]:
+        while node.orelse:
+            if len(node.orelse) == 1 and isinstance(node.orelse[0], ast.If):
+                nxt = node.orelse[0]
+                if not self._tests_field(nxt.test):
+                    # The chain switches dispatch variable (e.g. back
+                    # to wire type) — stop at the field chain's end.
+                    return []
+                node = nxt
+                continue
+            return [
+                sub
+                for stmt in node.orelse
+                for sub in ast.walk(stmt)
+                if isinstance(sub, ast.Raise)
+            ]
+        return []

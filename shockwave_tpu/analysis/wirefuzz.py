@@ -1,0 +1,800 @@
+"""Schema-derived differential wire fuzzer.
+
+The wirecheck rules prove the codecs AGREE with the ``.proto`` files
+statically; this module proves the bytes agree at runtime. From the
+parsed schema (:mod:`.protospec`) it generates seeded random message
+instances and, per message family:
+
+* **protoc differential** — the same instance built through a
+  *dynamically generated* protoc message class (a
+  ``FileDescriptorProto`` synthesized from the schema model, so every
+  hand-rolled message gets a real protoc counterpart without protoc in
+  the build) must serialize byte-for-byte identically;
+* **round-trip** — ``FromString(SerializeToString(x))`` must
+  reproduce every field and re-serialize to the same bytes;
+* **unknown-field tolerance** — appending/prepending unknown fields
+  (wire types 0/1/2/5, numbers above the schema's) must parse cleanly
+  with the known fields intact (proto3 forward compatibility);
+* **truncation tolerance** — any byte-prefix must either parse or
+  raise ``ValueError`` — never an ``IndexError``/``struct.error``
+  escape (hostile-peer hygiene);
+* **legacy goldens** — instances restricted to the pre-extension
+  field set must be byte-identical to the frozen protoc modules under
+  ``runtime/protobuf/legacy/`` in both directions, and full
+  new-schema bytes must parse cleanly through the legacy parser (the
+  old-reader contract every rolling upgrade depends on);
+* **columnar differential** — ``encode_columnar_block`` /
+  ``decode_columnar_block`` round-trip spec dicts exactly, the frame
+  re-serializes canonically through the protoc mirror, and
+  ``FastSubmitRequest`` decodes the legacy encoding to the same
+  columns.
+
+Everything is deterministic in ``seed`` (per-case RNGs are keyed
+``seed:family:index``), so a CI failure replays locally with the same
+number. One deliberate, documented divergence is excluded by the
+generator: ``DoneRequest.trace_context`` omits an all-empty repeated
+string list entirely (legacy byte identity — see the codec comment),
+where protoc would serialize the empty elements, so non-empty
+generated lists always carry at least one non-empty element.
+
+Gate entry points: :func:`fuzz_schema` (report dict) and
+:func:`descriptor_conformance_problems` (the protoc-generated and
+legacy modules' runtime descriptors checked against the schema),
+both consumed by ``scripts/ci/wire_smoke.py``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib
+import random
+import struct
+from typing import Dict, List, Optional, Sequence
+
+from shockwave_tpu.analysis import protospec
+
+DEFAULT_SEED = 20260807
+DEFAULT_CASES = 50
+
+#: proto file -> hand-rolled codec module (import name under
+#: shockwave_tpu.runtime.protobuf).
+HANDROLLED_MODULES = {
+    "admission.proto": "admission_pb2",
+    "explain.proto": "explain_pb2",
+    "scheduler_to_worker.proto": "scheduler_to_worker_pb2",
+    "telemetry.proto": "telemetry_pb2",
+    "worker_to_scheduler.proto": "worker_to_scheduler_pb2",
+}
+
+#: proto file -> real protoc-generated module (descriptor-checked, not
+#: fuzzed — google.protobuf's own codec is the authority there).
+PROTOC_MODULES = {
+    "common.proto": "common_pb2",
+    "enums.proto": "enums_pb2",
+    "iterator_to_scheduler.proto": "iterator_to_scheduler_pb2",
+}
+
+#: frozen pre-extension protoc modules (the byte-identity goldens).
+LEGACY_MODULES = {
+    "worker_to_scheduler.proto": "legacy.worker_to_scheduler_pb2",
+    "scheduler_to_worker.proto": "legacy.scheduler_to_worker_pb2",
+}
+
+_RUNTIME_PKG = "shockwave_tpu.runtime.protobuf"
+
+_MAX_UINT32 = 2**32 - 1
+_MAX_UINT64 = 2**64 - 1
+
+_STRING_POOL = (
+    "",
+    "a",
+    "resnet50",
+    "Model (batch size 32)",
+    "accordion",
+    "tenant-α/β✓",
+    "x" * 40,
+)
+
+_DOUBLE_POOL = (0.0, 1.0, -2.5, 0.125, 3.5, 1e-300, 1e300, 17.25)
+
+
+def _import_runtime(modname: str):
+    return importlib.import_module(f"{_RUNTIME_PKG}.{modname}")
+
+
+def codec_index(schema) -> Dict[str, type]:
+    """message name -> hand-rolled codec class, across the hand-rolled
+    modules (JobState lives in worker_to_scheduler_pb2 though declared
+    in common.proto)."""
+    index: Dict[str, type] = {}
+    names = {msg.name for msg in schema.messages}
+    for modname in HANDROLLED_MODULES.values():
+        module = _import_runtime(modname)
+        for name in names:
+            cls = getattr(module, name, None)
+            if cls is not None and name not in index:
+                index[name] = cls
+    return index
+
+
+# ---------------------------------------------------------------------------
+# Dynamic protoc mirror
+# ---------------------------------------------------------------------------
+
+_SCALAR_TYPE_CODES = {
+    "double": 1,
+    "float": 2,
+    "int64": 3,
+    "uint64": 4,
+    "int32": 5,
+    "fixed64": 6,
+    "fixed32": 7,
+    "bool": 8,
+    "string": 9,
+    "bytes": 12,
+    "uint32": 13,
+    "sfixed32": 15,
+    "sfixed64": 16,
+    "sint32": 17,
+    "sint64": 18,
+}
+
+_MIRROR_PACKAGE = "shockwave_fuzz"
+
+
+def build_protoc_mirror(schema) -> Optional[Dict[str, type]]:
+    """message name -> dynamically generated protoc class mirroring the
+    schema, or None when google.protobuf is unavailable."""
+    try:
+        from google.protobuf import (
+            descriptor_pb2,
+            descriptor_pool,
+            message_factory,
+        )
+    except Exception:
+        return None
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = f"{_MIRROR_PACKAGE}/mirror.proto"
+    fdp.package = _MIRROR_PACKAGE
+    fdp.syntax = "proto3"
+    enum_names = {e.name for e in schema.enums}
+    for enum in schema.enums:
+        edp = fdp.enum_type.add()
+        edp.name = enum.name
+        for value in enum.values:
+            vdp = edp.value.add()
+            vdp.name = f"{enum.name}_{value.name}"  # avoid C++-scope clashes
+            vdp.number = value.number
+    for msg in schema.messages:
+        mdp = fdp.message_type.add()
+        mdp.name = msg.name
+        for fld in msg.fields:
+            fdp_field = mdp.field.add()
+            fdp_field.name = fld.name
+            fdp_field.number = fld.number
+            fdp_field.label = 3 if fld.repeated else 1
+            if fld.type in _SCALAR_TYPE_CODES:
+                fdp_field.type = _SCALAR_TYPE_CODES[fld.type]
+            elif fld.type in enum_names:
+                fdp_field.type = 14
+                fdp_field.type_name = f".{_MIRROR_PACKAGE}.{fld.type}"
+            else:
+                fdp_field.type = 11
+                fdp_field.type_name = f".{_MIRROR_PACKAGE}.{fld.type}"
+    pool = descriptor_pool.DescriptorPool()
+    file_desc = pool.Add(fdp)
+    return {
+        msg.name: message_factory.GetMessageClass(
+            file_desc.message_types_by_name[msg.name]
+        )
+        for msg in schema.messages
+    }
+
+
+def _fill_protoc(mirror_msg, schema, spec, values: dict) -> None:
+    for fld in spec.fields:
+        value = values.get(fld.name)
+        if value is None:
+            continue
+        if fld.repeated:
+            target = getattr(mirror_msg, fld.name)
+            if fld.kind == "message":
+                sub_spec = schema.message(fld.type)
+                for sub_values in value:
+                    _fill_protoc(target.add(), schema, sub_spec, sub_values)
+            else:
+                target.extend(value)
+        elif fld.kind == "message":
+            _fill_protoc(
+                getattr(mirror_msg, fld.name),
+                schema,
+                schema.message(fld.type),
+                value,
+            )
+        else:
+            setattr(mirror_msg, fld.name, value)
+
+
+# ---------------------------------------------------------------------------
+# Value generation
+# ---------------------------------------------------------------------------
+
+def _gen_scalar(rng: random.Random, schema, fld):
+    if fld.kind == "enum":
+        enum = schema.enum(fld.type)
+        return rng.choice([v.number for v in enum.values])
+    if fld.type == "bool":
+        return rng.random() < 0.5
+    if fld.kind == "varint":
+        cap = _MAX_UINT32 if fld.type == "uint32" else _MAX_UINT64
+        return rng.choice(
+            (0, 1, 7, 300, 65536, cap // 3, cap - 1, rng.randrange(cap))
+        )
+    if fld.kind == "fixed64":
+        return rng.choice(_DOUBLE_POOL + (rng.random() * 100.0,))
+    if fld.kind == "string":
+        return rng.choice(_STRING_POOL)
+    if fld.kind == "bytes":
+        return bytes(rng.getrandbits(8) for _ in range(rng.randrange(0, 16)))
+    raise AssertionError(f"unhandled scalar kind {fld.kind}")
+
+
+def _gen_field(rng: random.Random, schema, fld, depth: int, restrict=None):
+    if not fld.repeated:
+        if fld.kind == "message":
+            return _gen_message(
+                rng, schema, schema.message(fld.type), depth + 1, restrict
+            )
+        return _gen_scalar(rng, schema, fld)
+    count = rng.choice((0, 1, 2, 4)) if depth == 0 else rng.choice((0, 1, 2))
+    if fld.kind == "message":
+        sub_spec = schema.message(fld.type)
+        return [
+            _gen_message(rng, schema, sub_spec, depth + 1, restrict)
+            for _ in range(count)
+        ]
+    values = [_gen_scalar(rng, schema, fld) for _ in range(count)]
+    if fld.kind == "string" and values and not any(values):
+        # Deliberate divergence exclusion: hand-rolled codecs omit an
+        # all-empty repeated string list for legacy byte identity,
+        # where protoc serializes the empty elements.
+        values[rng.randrange(len(values))] = rng.choice(_STRING_POOL[1:])
+    return values
+
+
+def _gen_message(
+    rng: random.Random, schema, spec, depth: int = 0, restrict=None
+) -> dict:
+    """Generate a values dict for ``spec``. ``restrict`` is an optional
+    protoc Descriptor (the frozen legacy shape): only its field numbers
+    are populated, recursively — nested messages are restricted to the
+    legacy sub-descriptor too."""
+    values = {}
+    for fld in spec.fields:
+        sub_restrict = None
+        if restrict is not None:
+            legacy_fld = restrict.fields_by_number.get(fld.number)
+            if legacy_fld is None:
+                continue
+            if fld.kind == "message":
+                sub_restrict = legacy_fld.message_type
+        values[fld.name] = _gen_field(rng, schema, fld, depth, sub_restrict)
+    return values
+
+
+def _build_handrolled(index, schema, spec, values: dict):
+    kwargs = {}
+    for fld in spec.fields:
+        value = values.get(fld.name)
+        if value is None:
+            continue
+        if fld.kind == "message":
+            sub_spec = schema.message(fld.type)
+            if fld.repeated:
+                kwargs[fld.name] = [
+                    _build_handrolled(index, schema, sub_spec, sub)
+                    for sub in value
+                ]
+            else:
+                kwargs[fld.name] = _build_handrolled(
+                    index, schema, sub_spec, value
+                )
+        else:
+            kwargs[fld.name] = value
+    return index[spec.name](**kwargs)
+
+
+def _equals(schema, spec, obj, values: dict) -> bool:
+    for fld in spec.fields:
+        want = values.get(fld.name)
+        if want is None:
+            continue
+        got = getattr(obj, fld.name)
+        if fld.kind == "message":
+            sub_spec = schema.message(fld.type)
+            if fld.repeated:
+                if len(got) != len(want):
+                    return False
+                if not all(
+                    _equals(schema, sub_spec, g, w) for g, w in zip(got, want)
+                ):
+                    return False
+            elif not _equals(schema, sub_spec, got, want):
+                return False
+        elif fld.repeated:
+            if [_norm(fld, v) for v in got] != [_norm(fld, v) for v in want]:
+                return False
+        elif _norm(fld, got) != _norm(fld, want):
+            return False
+    return True
+
+
+def _norm(fld, value):
+    if fld.type == "bool":
+        return bool(value)
+    if fld.kind == "varint" or fld.kind == "enum":
+        return int(value)
+    if fld.kind == "fixed64":
+        return float(value)
+    return value
+
+
+# ---------------------------------------------------------------------------
+# Mutations
+# ---------------------------------------------------------------------------
+
+def _unknown_fields_blob(rng: random.Random, first_free: int) -> bytes:
+    from shockwave_tpu.runtime.protobuf.wire import encode_varint, tag
+
+    out = bytearray()
+    for _ in range(rng.randint(1, 3)):
+        number = rng.randint(first_free, first_free + 40)
+        wt = rng.choice((0, 1, 2, 5))
+        if wt == 0:
+            out += tag(number, 0) + encode_varint(rng.randrange(_MAX_UINT64))
+        elif wt == 1:
+            out += tag(number, 1) + struct.pack("<d", rng.random())
+        elif wt == 2:
+            blob = bytes(rng.getrandbits(8) for _ in range(rng.randrange(0, 9)))
+            out += tag(number, 2) + encode_varint(len(blob)) + blob
+        else:
+            out += tag(number, 5) + struct.pack("<f", 1.5)
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# The fuzz run
+# ---------------------------------------------------------------------------
+
+def fuzz_schema(
+    schema=None,
+    cases: int = DEFAULT_CASES,
+    seed: int = DEFAULT_SEED,
+    messages: Optional[Sequence[str]] = None,
+) -> dict:
+    """Run the differential fuzz over every hand-rolled message family
+    (plus legacy goldens and the columnar frame). Returns a report
+    dict; ``report["failures"]`` empty means the gate is green."""
+    schema = schema or protospec.load_repo_schema()
+    index = codec_index(schema)
+    mirror = build_protoc_mirror(schema)
+    report: dict = {
+        "seed": seed,
+        "cases_per_family": cases,
+        "families": {},
+        "failures": [],
+        "skipped": [],
+    }
+    if mirror is None:
+        report["skipped"].append(
+            "protoc-differential (google.protobuf unavailable)"
+        )
+    fuzzed = sorted(name for name in index if messages is None or name in messages)
+    for name in fuzzed:
+        _fuzz_family(report, schema, index, mirror, name, cases, seed)
+    if messages is None:
+        _fuzz_legacy(report, schema, index, cases, seed)
+        _fuzz_columnar(report, mirror, cases, seed)
+    return report
+
+
+def _family(report: dict, name: str) -> dict:
+    fam = report["families"].setdefault(
+        name, {"cases": 0, "digest": hashlib.sha256()}
+    )
+    return fam
+
+
+def _finish_digests(report: dict) -> dict:
+    for fam in report["families"].values():
+        if not isinstance(fam["digest"], str):
+            fam["digest"] = fam["digest"].hexdigest()[:16]
+    return report
+
+
+def _fail(report: dict, message: str) -> None:
+    if len(report["failures"]) < 50:
+        report["failures"].append(message)
+
+
+def _fuzz_family(report, schema, index, mirror, name, cases, seed) -> None:
+    spec = schema.message(name)
+    cls = index[name]
+    fam = _family(report, name)
+    max_number = max(spec.by_number, default=0)
+    for i in range(cases):
+        rng = random.Random(f"{seed}:{name}:{i}")
+        tagline = f"{name} case {i} (seed {seed})"
+        values = _gen_message(rng, schema, spec)
+        try:
+            obj = _build_handrolled(index, schema, spec, values)
+            data = obj.SerializeToString()
+        except Exception as e:
+            # A crash on schema-legal values is itself a codec/schema
+            # disagreement, not fuzzer infrastructure.
+            _fail(report, f"{tagline}: codec crashed on encode: {e!r}")
+            fam["cases"] += 1
+            continue
+        fam["cases"] += 1
+        fam["digest"].update(data)
+        if mirror is not None:
+            m = mirror[name]()
+            _fill_protoc(m, schema, spec, values)
+            protoc_bytes = m.SerializeToString()
+            if protoc_bytes != data:
+                _fail(
+                    report,
+                    f"{tagline}: hand-rolled bytes differ from protoc "
+                    f"({data.hex()} != {protoc_bytes.hex()})",
+                )
+            try:
+                m2 = mirror[name].FromString(data)
+            except Exception as e:  # pragma: no cover - defensive
+                _fail(report, f"{tagline}: protoc failed to parse: {e!r}")
+            else:
+                if m2.SerializeToString() != data:
+                    _fail(
+                        report,
+                        f"{tagline}: protoc re-serialization differs "
+                        "(non-canonical hand-rolled encoding)",
+                    )
+        try:
+            back = cls.FromString(data)
+            if not _equals(schema, spec, back, values):
+                _fail(report, f"{tagline}: round-trip changed field values")
+            if back.SerializeToString() != data:
+                _fail(
+                    report, f"{tagline}: round-trip re-serialization differs"
+                )
+        except Exception as e:
+            _fail(report, f"{tagline}: codec crashed on round-trip: {e!r}")
+        # Unknown-field tolerance: inject at field boundaries.
+        blob = _unknown_fields_blob(rng, max_number + 1)
+        mutated = blob + data if rng.random() < 0.5 else data + blob
+        try:
+            tolerant = cls.FromString(mutated)
+        except Exception as e:
+            _fail(
+                report,
+                f"{tagline}: decoder raised on unknown fields: {e!r}",
+            )
+        else:
+            if not _equals(schema, spec, tolerant, values):
+                _fail(
+                    report,
+                    f"{tagline}: unknown-field injection corrupted "
+                    "known fields",
+                )
+        # Truncation tolerance: ValueError or success, nothing else.
+        for _ in range(3):
+            if len(data) < 2:
+                break
+            cut = rng.randrange(1, len(data))
+            try:
+                cls.FromString(data[:cut])
+            except ValueError:
+                pass
+            except Exception as e:
+                _fail(
+                    report,
+                    f"{tagline}: truncation at {cut} escaped as "
+                    f"{type(e).__name__}: {e!r}",
+                )
+
+
+def _fuzz_legacy(report, schema, index, cases, seed) -> None:
+    for proto_name, legacy_modname in LEGACY_MODULES.items():
+        try:
+            legacy_mod = _import_runtime(legacy_modname)
+        except Exception:
+            report["skipped"].append(
+                f"legacy goldens for {proto_name} (google.protobuf "
+                "unavailable)"
+            )
+            continue
+        for msg_name in sorted(
+            legacy_mod.DESCRIPTOR.message_types_by_name
+        ):
+            ldesc = legacy_mod.DESCRIPTOR.message_types_by_name[msg_name]
+            spec = schema.message(msg_name)
+            if spec is None or msg_name not in index:
+                _fail(
+                    report,
+                    f"legacy golden {msg_name}: no live schema/codec "
+                    "counterpart",
+                )
+                continue
+            legacy_cls = getattr(legacy_mod, msg_name)
+            fam = _family(report, f"legacy:{msg_name}")
+            for i in range(cases):
+                rng = random.Random(f"{seed}:legacy:{msg_name}:{i}")
+                values = _gen_message(rng, schema, spec, restrict=ldesc)
+                obj = _build_handrolled(index, schema, spec, values)
+                data = obj.SerializeToString()
+                fam["cases"] += 1
+                fam["digest"].update(data)
+                tagline = f"legacy {msg_name} case {i} (seed {seed})"
+                golden = legacy_cls()
+                _fill_protoc(golden, schema, spec, values)
+                golden_bytes = golden.SerializeToString()
+                if golden_bytes != data:
+                    _fail(
+                        report,
+                        f"{tagline}: hand-rolled bytes differ from the "
+                        f"frozen protoc golden ({data.hex()} != "
+                        f"{golden_bytes.hex()})",
+                    )
+                back = index[msg_name].FromString(golden_bytes)
+                if not _equals(schema, spec, back, values):
+                    _fail(
+                        report,
+                        f"{tagline}: hand-rolled parse of golden bytes "
+                        "changed values",
+                    )
+                # Old-reader contract: a FULL new-schema instance must
+                # parse cleanly through the legacy parser.
+                full_rng = random.Random(f"{seed}:legacyfull:{msg_name}:{i}")
+                full_values = _gen_message(full_rng, schema, spec)
+                full_bytes = _build_handrolled(
+                    index, schema, spec, full_values
+                ).SerializeToString()
+                try:
+                    legacy_cls.FromString(full_bytes)
+                except Exception as e:
+                    _fail(
+                        report,
+                        f"{tagline}: legacy parser rejected new-schema "
+                        f"bytes: {e!r}",
+                    )
+
+
+def _random_spec_dict(rng: random.Random) -> dict:
+    return {
+        "job_type": rng.choice(_STRING_POOL),
+        "command": rng.choice(_STRING_POOL),
+        "working_directory": rng.choice(_STRING_POOL),
+        "num_steps_arg": rng.choice(_STRING_POOL),
+        "total_steps": rng.choice((0, 1, 500, 2**40)),
+        "scale_factor": rng.choice((0, 1, 8)),
+        "mode": rng.choice(("", "static", "accordion", "gns")),
+        "priority_weight": rng.choice((0.0, 1.0, 2.5)),
+        "slo": rng.choice((0.0, 3600.0)),
+        "duration": rng.choice((0.0, 120.5)),
+        "needs_data_dir": rng.random() < 0.5,
+        "tenant": rng.choice(_STRING_POOL),
+        "trace_context": rng.choice(_STRING_POOL),
+    }
+
+
+def _fuzz_columnar(report, mirror, cases, seed) -> None:
+    try:
+        from shockwave_tpu.runtime.protobuf import admission_pb2, fastwire
+    except Exception as e:  # pragma: no cover - numpy always present
+        report["skipped"].append(f"columnar (fastwire unavailable: {e!r})")
+        return
+    fam = _family(report, "columnar:ColumnarJobBlock")
+    mirror_cls = mirror.get("ColumnarJobBlock") if mirror else None
+    for i in range(cases):
+        rng = random.Random(f"{seed}:columnar:{i}")
+        specs = [_random_spec_dict(rng) for _ in range(rng.choice((0, 1, 2, 5)))]
+        block = fastwire.encode_columnar_block(specs)
+        fam["cases"] += 1
+        fam["digest"].update(block)
+        tagline = f"columnar case {i} (seed {seed})"
+        cols = fastwire.decode_columnar_block(block)
+        if cols.to_spec_dicts() != specs:
+            _fail(report, f"{tagline}: columnar round-trip changed specs")
+            continue
+        if mirror_cls is not None:
+            m = mirror_cls.FromString(block)
+            if m.SerializeToString() != block:
+                _fail(
+                    report,
+                    f"{tagline}: block is not canonical proto3 "
+                    "(protoc re-serialization differs)",
+                )
+            if int(m.num_jobs) != len(specs):
+                _fail(report, f"{tagline}: num_jobs mismatch via protoc")
+        # The legacy repeated-JobSpec encoding must decode to the SAME
+        # columns through FastSubmitRequest (decision identity).
+        request = admission_pb2.SubmitJobsRequest(
+            token="t",
+            jobs=[admission_pb2.JobSpec(**spec) for spec in specs],
+        )
+        fast = fastwire.FastSubmitRequest.FromString(
+            request.SerializeToString()
+        )
+        if fast.columns.to_spec_dicts() != specs:
+            _fail(
+                report,
+                f"{tagline}: FastSubmitRequest columns diverge from "
+                "the scalar decode",
+            )
+        # And the columnar frame carried inside a request decodes
+        # identically.
+        framed = admission_pb2.SubmitJobsRequest(
+            token="t", jobs_columnar=block, wire_caps=fastwire.CAP_COLUMNAR
+        )
+        fast2 = fastwire.FastSubmitRequest.FromString(
+            framed.SerializeToString()
+        )
+        if fast2.columns.to_spec_dicts() != specs:
+            _fail(
+                report,
+                f"{tagline}: framed columnar decode diverges from specs",
+            )
+
+
+# ---------------------------------------------------------------------------
+# Descriptor conformance (protoc-generated + legacy modules)
+# ---------------------------------------------------------------------------
+
+_DESCRIPTOR_KIND = {
+    1: "fixed64",  # double
+    2: "fixed32",  # float
+    3: "varint",  # int64
+    4: "varint",  # uint64
+    5: "varint",  # int32
+    6: "fixed64",
+    7: "fixed32",
+    8: "varint",  # bool
+    9: "string",
+    11: "message",
+    12: "bytes",
+    13: "varint",  # uint32
+    14: "enum",
+    15: "fixed32",
+    16: "fixed64",
+    17: "varint",
+    18: "varint",
+}
+
+
+def _descriptor_problems(schema, proto_name, module, subset: bool) -> List[str]:
+    problems: List[str] = []
+    for msg_name, desc in module.DESCRIPTOR.message_types_by_name.items():
+        spec = schema.message(msg_name)
+        if spec is None:
+            problems.append(
+                f"{module.__name__}: message {msg_name} has no live "
+                "schema counterpart"
+            )
+            continue
+        for fld in desc.fields:
+            live = spec.by_number.get(fld.number)
+            if live is None:
+                problems.append(
+                    f"{msg_name}.{fld.name} (= {fld.number}) exists in "
+                    f"{module.__name__} but not in the live schema"
+                )
+                continue
+            if live.name != fld.name:
+                problems.append(
+                    f"{msg_name} field {fld.number}: descriptor says "
+                    f"{fld.name}, schema says {live.name}"
+                )
+            desc_kind = _DESCRIPTOR_KIND.get(fld.type)
+            live_kind = live.kind
+            if desc_kind != live_kind:
+                problems.append(
+                    f"{msg_name}.{fld.name}: descriptor wire kind "
+                    f"{desc_kind}, schema {live_kind}"
+                )
+            is_rep = getattr(fld, "is_repeated", None)
+            desc_repeated = bool(
+                is_rep() if callable(is_rep) else is_rep
+            ) if is_rep is not None else fld.label == 3
+            if desc_repeated != live.repeated:
+                problems.append(
+                    f"{msg_name}.{fld.name}: descriptor "
+                    f"{'repeated' if desc_repeated else 'singular'}, "
+                    f"schema the opposite"
+                )
+        if not subset:
+            desc_numbers = {f.number for f in desc.fields}
+            for fld in spec.fields:
+                if fld.number not in desc_numbers:
+                    problems.append(
+                        f"{msg_name}.{fld.name} (= {fld.number}) in "
+                        f"{proto_name} is missing from "
+                        f"{module.__name__}'s descriptor — regenerate "
+                        "the protoc module"
+                    )
+    for enum_name, desc in getattr(
+        module.DESCRIPTOR, "enum_types_by_name", {}
+    ).items():
+        enum = schema.enum(enum_name)
+        if enum is None:
+            problems.append(
+                f"{module.__name__}: enum {enum_name} has no live "
+                "schema counterpart"
+            )
+            continue
+        live_values = {v.number: v.name for v in enum.values}
+        for value in desc.values:
+            if value.number not in live_values:
+                problems.append(
+                    f"enum {enum_name} value {value.name} = "
+                    f"{value.number} missing from the live schema"
+                )
+    return problems
+
+
+def descriptor_conformance_problems(schema=None) -> List[str]:
+    """Check every protoc-generated module's runtime descriptor (the
+    three live ones exactly; the legacy frozen ones as a subset — every
+    legacy field must still mean the same thing) against the schema.
+    Returns rendered problems; raises ImportError if google.protobuf
+    is unavailable (callers skip the check explicitly)."""
+    schema = schema or protospec.load_repo_schema()
+    problems: List[str] = []
+    for proto_name, modname in sorted(PROTOC_MODULES.items()):
+        module = _import_runtime(modname)
+        problems.extend(
+            _descriptor_problems(schema, proto_name, module, subset=False)
+        )
+    for proto_name, modname in sorted(LEGACY_MODULES.items()):
+        module = _import_runtime(modname)
+        problems.extend(
+            _descriptor_problems(schema, proto_name, module, subset=True)
+        )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(
+        prog="python -m shockwave_tpu.analysis.wirefuzz",
+        description="schema-derived differential wire fuzzer",
+    )
+    parser.add_argument("--cases", type=int, default=DEFAULT_CASES)
+    parser.add_argument("--seed", type=int, default=DEFAULT_SEED)
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+    report = _finish_digests(
+        fuzz_schema(cases=args.cases, seed=args.seed)
+    )
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        for name, fam in sorted(report["families"].items()):
+            print(f"{name}: {fam['cases']} cases, digest {fam['digest']}")
+        for skip in report["skipped"]:
+            print(f"skipped: {skip}")
+        for failure in report["failures"]:
+            print(f"FAIL: {failure}")
+        print(
+            f"wirefuzz: {sum(f['cases'] for f in report['families'].values())} "
+            f"cases, {len(report['failures'])} failure(s)"
+        )
+    return 1 if report["failures"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,159 @@
+"""The wire registry: a committed ledger of every
+``(message, field, number, type)`` the schema has ever declared.
+
+Like :mod:`.baseline` it makes evolution an explicit, reviewed diff —
+but where the lint baseline ratchets toward zero, the wire registry is
+**append-only**: wire history cannot be rewritten, because bytes
+already sent with an old tag are decoded by whatever the number means
+NOW. Enforced failure modes (``scripts/ci/wire_smoke.py`` and the
+``--check-wire-registry`` CLI gate):
+
+- **renumbered** — a registered field name moved to a different
+  number: old peers' bytes for the old number silently land in the
+  wrong (or no) field;
+- **retyped / repurposed** — a registered number changed name, type,
+  or packedness: the classic number-reuse bug, undetectable at
+  runtime between same-build peers;
+- **removed** — a registered field vanished from the schema without a
+  ``reserved`` tombstone: the number is now free to be reused by a
+  future edit against live traffic;
+- **unregistered** — a new schema field not yet in the registry:
+  append it (``--write-wire-registry``) so the diff is part of the PR.
+
+Removal with a ``reserved`` declaration for the retired number is the
+one legal deletion: the tombstone keeps the number unusable forever.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from shockwave_tpu.analysis.core import repo_root
+
+DEFAULT_REGISTRY_NAME = "wire_registry.json"
+
+
+def default_registry_path(root: Optional[str] = None) -> str:
+    return os.path.join(root or repo_root(), DEFAULT_REGISTRY_NAME)
+
+
+def registry_entries(schema) -> List[dict]:
+    """The schema flattened into sorted registry entries."""
+    entries = [
+        {
+            "message": msg.name,
+            "field": fld.name,
+            "number": fld.number,
+            "type": ("repeated " if fld.repeated else "") + fld.type,
+            "proto": msg.proto,
+        }
+        for msg, fld in schema.iter_fields()
+    ]
+    entries.sort(key=lambda e: (e["message"], e["number"]))
+    return entries
+
+
+def make_registry(schema) -> dict:
+    return {
+        "comment": (
+            "Wire-contract registry: every (message, field, number, "
+            "type) the schema has ever declared. APPEND-ONLY — "
+            "renumbering, retyping, or deleting an entry fails CI "
+            "(scripts/ci/wire_smoke.py); retire a field by reserving "
+            "its number in the .proto instead. Append new fields with "
+            "`python -m shockwave_tpu.analysis --write-wire-registry`."
+        ),
+        "entries": registry_entries(schema),
+    }
+
+
+def save_registry(path: str, registry: dict) -> None:
+    from shockwave_tpu.utils.fileio import atomic_write_text
+
+    atomic_write_text(path, json.dumps(registry, indent=2) + "\n")
+
+
+def load_registry(path: str) -> Optional[dict]:
+    """The committed registry, or None when the file is missing (a
+    broken gate, not a clean slate — callers must fail loudly)."""
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _reserved_numbers(schema, message: str) -> List[Tuple[int, int]]:
+    msg = schema.message(message)
+    return list(msg.reserved_ranges) if msg is not None else []
+
+
+def diff_registry(schema, registry: dict) -> List[str]:
+    """Ratchet violations between the live schema and the committed
+    registry, as rendered problem strings (empty = gate green)."""
+    problems: List[str] = []
+    current = registry_entries(schema)
+    cur_by_num: Dict[Tuple[str, int], dict] = {
+        (e["message"], e["number"]): e for e in current
+    }
+    cur_by_name: Dict[Tuple[str, str], dict] = {
+        (e["message"], e["field"]): e for e in current
+    }
+    reg_entries = registry.get("entries", [])
+    reg_by_num = {(e["message"], e["number"]): e for e in reg_entries}
+    for entry in reg_entries:
+        message, name = entry["message"], entry["field"]
+        number, ftype = entry["number"], entry["type"]
+        live = cur_by_num.get((message, number))
+        live_name = cur_by_name.get((message, name))
+        if live is not None and live["field"] == name and live["type"] == ftype:
+            continue  # intact
+        if live_name is not None and live_name["number"] != number:
+            problems.append(
+                f"{message}.{name} renumbered: registry says {number}, "
+                f"schema now says {live_name['number']} — peers built "
+                "against the registry encode the old tag; field "
+                "numbers are forever"
+            )
+            continue
+        if live is None:
+            if schema.message(message) is None:
+                problems.append(
+                    f"{message}: whole message removed from the schema "
+                    "but its registry entries remain — messages are "
+                    "wire history too; restore it or retire it "
+                    "explicitly with reserved tombstones in a kept "
+                    "message definition"
+                )
+                continue
+            reserved = any(
+                lo <= number <= hi
+                for lo, hi in _reserved_numbers(schema, message)
+            )
+            if not reserved:
+                problems.append(
+                    f"{message}.{name} (= {number}) removed from the "
+                    "schema without a reserved tombstone — the number "
+                    "is free to be reused against live traffic; add "
+                    f"`reserved {number};` to "
+                    f"{entry.get('proto', 'the .proto')}"
+                )
+            continue
+        problems.append(
+            f"{message} field {number} repurposed: registry says "
+            f"{name} ({ftype}), schema now says {live['field']} "
+            f"({live['type']}) — old peers' bytes for tag {number} "
+            "decode into the wrong field; pick a fresh number"
+        )
+    unregistered = [
+        e for e in current if (e["message"], e["number"]) not in reg_by_num
+    ]
+    for entry in unregistered:
+        problems.append(
+            f"{entry['message']}.{entry['field']} (= {entry['number']}, "
+            f"{entry['type']}) is not in {DEFAULT_REGISTRY_NAME} — "
+            "append it with --write-wire-registry so the schema "
+            "evolution is a reviewed diff"
+        )
+    return problems

@@ -9,22 +9,7 @@ canonical proto3 (a protoc-generated counterpart interoperates
 byte-for-byte; see the byte-identity tests in
 ``tests/test_wire_compat.py``). Unknown fields are skipped per proto3
 rules, keeping both parsers forward-compatible with a widened schema.
-
-.. code-block:: proto
-
-    syntax = "proto3";
-    package shockwave_tpu;
-
-    message ExplainJobRequest {
-      string job_id = 1;
-      string trace_context = 2;   // obs.propagate causal context
-    }
-
-    message ExplainJobResponse {
-      bool found = 1;
-      string narrative_json = 2;  // the decision narrative (JSON)
-      string error = 3;           // set when found is false
-    }
+Field numbers are documented in explain.proto.
 """
 
 from __future__ import annotations

@@ -456,8 +456,15 @@ def encode_columnar_block(specs: Sequence[dict]) -> bytes:
             chunks.append(raw)
             lens[k] = len(raw)
             k += 1
-    put_msg(out, 2, b"".join(chunks))
-    put_msg(out, 3, encode_varints(lens))
+    arena = b"".join(chunks)
+    if arena:
+        # An all-empty-strings batch omits the arena entirely (protoc
+        # omits an empty bytes field); str_lens still carries the 7*n
+        # zero lengths, so the decoder reconstructs the empty columns.
+        put_msg(out, 2, arena)
+    lens_payload = encode_varints(lens)
+    if lens_payload:
+        put_msg(out, 3, lens_payload)
     total_steps = np.asarray(
         [int(s.get("total_steps", 0)) for s in specs], dtype=np.int64
     )

@@ -1,0 +1,183 @@
+"""protospec: the hand-rolled .proto parser the wire-contract analyzer
+(wirecheck rules, wire registry, wirefuzz) is built on. Fixture-text
+units plus assertions over the real repo schema, so a parser regression
+cannot silently blind the whole analysis layer.
+"""
+
+import pytest
+
+from shockwave_tpu.analysis import parse_proto_text, repo_root
+from shockwave_tpu.analysis.protospec import (
+    IMPLEMENTATION_RESERVED,
+    WIRE_FIXED64,
+    WIRE_LEN,
+    WIRE_VARINT,
+    ProtoSchema,
+    load_repo_schema,
+)
+
+FIXTURE = """
+// A comment with message Decoy { uint64 nope = 9; } inside.
+syntax = "proto3";
+
+package fixture;
+
+/* block comment
+   string also_decoy = 3; */
+
+enum Color {
+  COLOR_UNSPECIFIED = 0;
+  RED = 1;
+  BLUE = 2;
+}
+
+message Inner {
+  string label = 1;  // trailing comment
+}
+
+message Outer {
+  reserved 5, 10 to 12;
+  reserved "old_name";
+  uint64 id = 1;
+  string name = 2;
+  repeated uint64 steps = 3;
+  repeated double weights = 4;
+  repeated string tags = 6;
+  Inner inner = 7;
+  repeated Inner inners = 8;
+  bool flag = 9;
+  Color color = 13;
+  bytes payload = 14;
+  double score = 15;
+}
+
+service FixtureService {
+  rpc GetOuter (Inner) returns (Outer);
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def schema():
+    return ProtoSchema({"fixture.proto": parse_proto_text(FIXTURE, "fixture.proto")})
+
+
+class TestParser:
+    def test_messages_enums_services(self, schema):
+        assert {m.name for m in schema.messages} == {"Inner", "Outer"}
+        assert [e.name for e in schema.enums] == ["Color"]
+        (svc,) = schema.services
+        assert svc.name == "FixtureService"
+        (method,) = svc.methods
+        assert (method.name, method.request, method.response) == (
+            "GetOuter",
+            "Inner",
+            "Outer",
+        )
+
+    def test_comments_do_not_declare_fields(self, schema):
+        assert schema.message("Decoy") is None
+        outer = schema.message("Outer")
+        assert "also_decoy" not in outer.by_name
+
+    def test_field_numbers_types_and_labels(self, schema):
+        outer = schema.message("Outer")
+        assert sorted(outer.by_number) == [1, 2, 3, 4, 6, 7, 8, 9, 13, 14, 15]
+        assert outer.by_name["id"].type == "uint64"
+        assert not outer.by_name["id"].repeated
+        assert outer.by_name["steps"].repeated
+        assert outer.by_name["inner"].type == "Inner"
+
+    def test_wire_kind_resolution(self, schema):
+        outer = schema.message("Outer")
+        by = outer.by_name
+        assert by["id"].kind == "varint"
+        assert by["id"].wire_type == WIRE_VARINT
+        assert by["name"].kind == "string"
+        assert by["name"].wire_type == WIRE_LEN
+        assert by["score"].kind == "fixed64"
+        assert by["score"].wire_type == WIRE_FIXED64
+        assert by["flag"].kind == "varint"
+        assert by["payload"].kind == "bytes"
+        assert by["inner"].kind == "message"
+        assert by["color"].kind == "enum"
+        assert by["color"].wire_type == WIRE_VARINT
+
+    def test_repeated_numeric_scalars_are_packed(self, schema):
+        outer = schema.message("Outer")
+        steps = outer.by_name["steps"]
+        assert steps.packed
+        assert steps.wire_type == WIRE_LEN
+        assert steps.element_wire_type == WIRE_VARINT
+        weights = outer.by_name["weights"]
+        assert weights.packed
+        assert weights.element_wire_type == WIRE_FIXED64
+        # Repeated strings/messages are NOT packed: one LEN field each.
+        assert not outer.by_name["tags"].packed
+        assert not outer.by_name["inners"].packed
+
+    def test_reserved(self, schema):
+        outer = schema.message("Outer")
+        assert outer.reserved_hit(5)
+        assert outer.reserved_hit(11)
+        assert not outer.reserved_hit(4)
+        assert "old_name" in outer.reserved_names
+        lo, hi = IMPLEMENTATION_RESERVED
+        assert outer.reserved_hit(lo) and outer.reserved_hit(hi)
+
+    def test_cross_file_enum_resolution(self):
+        a = parse_proto_text(
+            'syntax = "proto3"; package p;\n'
+            "enum Mood { OK = 0; BAD = 1; }",
+            "a.proto",
+        )
+        b = parse_proto_text(
+            'syntax = "proto3"; package p;\n'
+            "message M { Mood mood = 1; }",
+            "b.proto",
+        )
+        schema = ProtoSchema({"a.proto": a, "b.proto": b})
+        assert schema.message("M").by_name["mood"].kind == "enum"
+
+    def test_from_sources(self):
+        schema = ProtoSchema.from_sources(
+            {"x.proto": 'syntax = "proto3"; message X { uint32 n = 1; }'}
+        )
+        assert schema.message("X").by_name["n"].wire_type == WIRE_VARINT
+
+
+class TestRepoSchema:
+    """The real schema: the analyzer's view of the actual wire contract."""
+
+    def test_all_proto_files_parse(self):
+        schema = load_repo_schema(repo_root())
+        assert len(schema.files) == 8
+        names = set(schema.files)
+        assert "explain.proto" in names  # authored this PR
+        assert "common.proto" in names
+
+    def test_known_shapes(self):
+        schema = load_repo_schema(repo_root())
+        jobspec = schema.message("JobSpec")
+        assert len(jobspec.fields) == 13
+        assert jobspec.by_name["needs_data_dir"].type == "bool"
+        heartbeat = schema.message("Heartbeat")
+        assert heartbeat.by_name["job_state"].kind == "message"
+        assert heartbeat.by_name["job_state"].repeated
+        done = schema.message("DoneRequest")
+        assert done.by_name["num_steps"].packed
+        assert done.by_name["execution_time"].packed
+        # Cross-file: JobState.status is an enum declared in enums.proto.
+        assert schema.message("JobState").by_name["status"].kind == "enum"
+
+    def test_services_present(self):
+        schema = load_repo_schema(repo_root())
+        assert {s.name for s in schema.services} >= {
+            "SchedulerToWorker",
+            "WorkerToScheduler",
+            "SchedulerExplain",
+        }
+
+    def test_schema_cache_returns_same_object(self):
+        root = repo_root()
+        assert load_repo_schema(root) is load_repo_schema(root)

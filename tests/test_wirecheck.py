@@ -1,0 +1,520 @@
+"""Wire-contract conformance rules + the schema-evolution ratchet:
+fixture corpus per rule (positive / negative / suppressed) against
+injected fixture schemas, registry round-trip + mutation cases, and
+the tier-1 repo-wide assertions (the real codecs are clean, the
+committed registry is ratchet-green, the pre/post fix artifacts match
+what the analyzer actually found).
+"""
+
+import json
+import os
+
+from shockwave_tpu.analysis import check_source, repo_root, run_paths
+from shockwave_tpu.analysis.protospec import ProtoSchema, load_repo_schema
+from shockwave_tpu.analysis.rules.wirecheck import (
+    CanonicalDefaultOmission,
+    DecoderUnknownFieldTolerance,
+    FieldNumberCollision,
+    ProtoCodecDrift,
+)
+from shockwave_tpu.analysis.wireregistry import (
+    default_registry_path,
+    diff_registry,
+    load_registry,
+    make_registry,
+    registry_entries,
+)
+
+PB2_RELPATH = "shockwave_tpu/runtime/protobuf/ping_pb2.py"
+
+PING_PROTO = """
+syntax = "proto3";
+package fixture;
+
+message Ping {
+  uint64 id = 1;
+  string name = 2;
+  repeated uint64 steps = 3;
+  double score = 4;
+}
+"""
+
+
+def ping_schema(proto_text=PING_PROTO):
+    return ProtoSchema.from_sources({"ping.proto": proto_text})
+
+
+def drift(source, proto_text=PING_PROTO, relpath=PB2_RELPATH):
+    return check_source(source, relpath, [ProtoCodecDrift(ping_schema(proto_text))])
+
+
+def active(findings):
+    return [f for f in findings if not f.suppressed]
+
+
+CLEAN_CODEC = """
+from shockwave_tpu.runtime.protobuf.wire import (
+    put_double, put_packed_varints, put_str, put_varint, scan_fields,
+    unpack_packed_varints,
+)
+
+
+class Ping:
+    def __init__(self, id=0, name="", steps=None, score=0.0):
+        self.id = int(id)
+        self.name = str(name)
+        self.steps = list(steps or [])
+        self.score = float(score)
+
+    def SerializeToString(self):
+        out = bytearray()
+        put_varint(out, 1, self.id)
+        put_str(out, 2, self.name)
+        put_packed_varints(out, 3, self.steps)
+        put_double(out, 4, self.score)
+        return bytes(out)
+
+    @classmethod
+    def FromString(cls, data):
+        msg = cls()
+        for field, wire_type, value in scan_fields(memoryview(data)):
+            if field == 1 and wire_type == 0:
+                msg.id = value
+            elif field == 2 and wire_type == 2:
+                msg.name = bytes(value).decode("utf-8")
+            elif field == 3 and wire_type == 2:
+                msg.steps = list(unpack_packed_varints(value))
+            elif field == 3 and wire_type == 0:
+                msg.steps.append(value)
+            elif field == 4 and wire_type == 1:
+                msg.score = value
+        return msg
+"""
+
+
+class TestProtoCodecDrift:
+    def test_negative_conformant_codec(self):
+        assert active(drift(CLEAN_CODEC)) == []
+
+    def test_wrong_helper_wire_type(self):
+        bad = CLEAN_CODEC.replace(
+            "put_varint(out, 1, self.id)", "put_str(out, 1, self.id)"
+        )
+        (f,) = [x for x in active(drift(bad)) if "wrong wire type" in x.message]
+        assert "expected put_varint()" in f.message
+
+    def test_undeclared_field_number(self):
+        bad = CLEAN_CODEC.replace(
+            "put_varint(out, 1, self.id)", "put_varint(out, 9, self.id)"
+        )
+        msgs = [f.message for f in active(drift(bad))]
+        assert any("writes field 9" in m and "does not declare" in m for m in msgs)
+        # ...and field 1 is now missing from the encoder.
+        assert any("never writes field 1" in m for m in msgs)
+
+    def test_swapped_attribute(self):
+        bad = CLEAN_CODEC.replace(
+            "put_str(out, 2, self.name)", "put_str(out, 2, self.label)"
+        )
+        msgs = [f.message for f in active(drift(bad))]
+        assert any("swapped or renumbered" in m for m in msgs)
+
+    def test_field_order_violation(self):
+        bad = CLEAN_CODEC.replace(
+            "put_varint(out, 1, self.id)\n        put_str(out, 2, self.name)",
+            "put_str(out, 2, self.name)\n        put_varint(out, 1, self.id)",
+        )
+        msgs = [f.message for f in active(drift(bad))]
+        assert any("number order" in m for m in msgs)
+
+    def test_non_literal_field_number(self):
+        bad = CLEAN_CODEC.replace(
+            "put_varint(out, 1, self.id)", "put_varint(out, ID_FIELD, self.id)"
+        )
+        msgs = [f.message for f in active(drift(bad))]
+        assert any("literal int" in m for m in msgs)
+
+    def test_decoder_wrong_wire_type(self):
+        bad = CLEAN_CODEC.replace(
+            "if field == 1 and wire_type == 0:",
+            "if field == 1 and wire_type == 2:",
+        )
+        msgs = [f.message for f in active(drift(bad))]
+        assert any("wire type 2" in m and "implies [0]" in m for m in msgs)
+
+    def test_decoder_unpacked_fallback_is_allowed(self):
+        # field == 3 at wt 0 (the unpacked element form) is legal for a
+        # packed repeated field — protoc parsers accept both.
+        assert active(drift(CLEAN_CODEC)) == []
+
+    def test_decoder_missing_field(self):
+        bad = CLEAN_CODEC.replace(
+            "            elif field == 4 and wire_type == 1:\n"
+            "                msg.score = value\n",
+            "",
+        )
+        msgs = [f.message for f in active(drift(bad))]
+        assert any("never reads field 4" in m for m in msgs)
+
+    def test_codec_class_without_proto(self):
+        # Pong is declared by NO .proto in the schema — an undocumented
+        # wire contract (the explain_pb2 pre-fix finding this PR
+        # captured in results/lint/wire_pre.json).
+        msgs = [
+            f.message
+            for f in active(
+                drift(
+                    CLEAN_CODEC.replace("class Ping:", "class Pong:"),
+                    relpath="shockwave_tpu/runtime/protobuf/pong_pb2.py",
+                )
+            )
+        ]
+        assert any("not declared by any .proto" in m for m in msgs)
+
+    def test_message_without_codec_class(self):
+        two = PING_PROTO.replace(
+            "message Ping {",
+            "message Extra { uint64 x = 1; }\n\nmessage Ping {",
+        )
+        msgs = [f.message for f in active(drift(CLEAN_CODEC, proto_text=two))]
+        assert any("message Extra" in m and "no codec class" in m for m in msgs)
+
+    def test_suppressed(self):
+        bad = CLEAN_CODEC.replace(
+            "put_varint(out, 1, self.id)",
+            "put_str(out, 1, self.id)  # shockwave-lint: disable=proto-codec-drift",
+        )
+        findings = drift(bad)
+        assert any("wrong wire type" in f.message for f in findings)
+        assert not any("wrong wire type" in f.message for f in active(findings))
+
+    def test_legacy_modules_exempt(self):
+        bad = CLEAN_CODEC.replace(
+            "put_varint(out, 1, self.id)", "put_varint(out, 9, self.id)"
+        )
+        findings = drift(
+            bad,
+            relpath="shockwave_tpu/runtime/protobuf/legacy/ping_pb2.py",
+        )
+        assert findings == []
+
+    def test_protoc_generated_modules_exempt(self):
+        source = "DESCRIPTOR = None\n" + CLEAN_CODEC.replace(
+            "put_varint(out, 1, self.id)", "put_varint(out, 9, self.id)"
+        )
+        assert drift(source) == []
+
+
+COLLIDE_RELPATH = "shockwave_tpu/runtime/protobuf/bad_pb2.py"
+
+
+def collisions(proto_text, relpath=COLLIDE_RELPATH, source="# codec stub\n"):
+    schema = ProtoSchema.from_sources({"bad.proto": proto_text})
+    return check_source(source, relpath, [FieldNumberCollision(schema)])
+
+
+class TestFieldNumberCollision:
+    def test_duplicate_number(self):
+        (f,) = active(
+            collisions(
+                'syntax = "proto3";\n'
+                "message Bad { uint64 a = 1; string b = 1; }"
+            )
+        )
+        assert "field number 1 twice" in f.message
+
+    def test_reserved_range_violation(self):
+        (f,) = active(
+            collisions(
+                'syntax = "proto3";\n'
+                "message Bad { reserved 5 to 8; uint64 a = 6; }"
+            )
+        )
+        assert "reserved range 5-8" in f.message
+
+    def test_implementation_reserved_range(self):
+        (f,) = active(
+            collisions(
+                'syntax = "proto3";\nmessage Bad { uint64 a = 19500; }'
+            )
+        )
+        assert "19000-19999" in f.message
+
+    def test_reserved_name_reuse(self):
+        (f,) = active(
+            collisions(
+                'syntax = "proto3";\n'
+                'message Bad { reserved "old"; uint64 old = 1; }'
+            )
+        )
+        assert "reserved field name 'old'" in f.message
+
+    def test_duplicate_enum_value(self):
+        (f,) = active(
+            collisions(
+                'syntax = "proto3";\nenum E { A = 0; B = 1; C = 1; }'
+            )
+        )
+        assert "value 1 twice" in f.message
+
+    def test_negative_clean_proto(self):
+        assert active(collisions(PING_PROTO.replace("fixture", "bad"))) == []
+
+    def test_suppressed(self):
+        findings = collisions(
+            'syntax = "proto3";\nmessage Bad { uint64 a = 1; string b = 1; }',
+            source="# shockwave-lint: disable=field-number-collision\n",
+        )
+        assert findings and all(f.suppressed for f in findings)
+
+
+def omission(source, relpath=PB2_RELPATH):
+    return check_source(source, relpath, [CanonicalDefaultOmission()])
+
+
+class TestCanonicalDefaultOmission:
+    POSITIVE = """
+def SerializeToString(self):
+    out = bytearray()
+    put_msg(out, 2, self.payload)
+    return bytes(out)
+"""
+
+    def test_positive_unguarded(self):
+        (f,) = active(omission(self.POSITIVE))
+        assert "zero-length field" in f.message
+
+    def test_negative_if_guard(self):
+        guarded = self.POSITIVE.replace(
+            "    put_msg(out, 2, self.payload)",
+            "    if self.payload:\n        put_msg(out, 2, self.payload)",
+        )
+        assert active(omission(guarded)) == []
+
+    def test_negative_for_guard(self):
+        looped = self.POSITIVE.replace(
+            "    put_msg(out, 2, self.payload)",
+            "    for item in self.items:\n        put_msg(out, 2, item)",
+        )
+        assert active(omission(looped)) == []
+
+    def test_early_return_guard_does_not_count(self):
+        # The guard must be lexical on THIS call: an early return for
+        # the all-empty case still leaves a per-field empty payload
+        # unguarded (the fastwire.encode_columnar_block bug this PR
+        # fixed was exactly this shape).
+        early = self.POSITIVE.replace(
+            "    out = bytearray()",
+            "    out = bytearray()\n    if not self.payload:\n        return b''",
+        )
+        assert len(active(omission(early))) == 1
+
+    def test_protoc_generated_exempt(self):
+        assert omission("DESCRIPTOR = None\n" + self.POSITIVE) == []
+
+    def test_suppressed(self):
+        suppressed = self.POSITIVE.replace(
+            "put_msg(out, 2, self.payload)",
+            "put_msg(out, 2, self.payload)  "
+            "# shockwave-lint: disable=canonical-default-omission",
+        )
+        findings = omission(suppressed)
+        assert findings and all(f.suppressed for f in findings)
+
+
+def tolerance(source, relpath=PB2_RELPATH):
+    return check_source(source, relpath, [DecoderUnknownFieldTolerance()])
+
+
+class TestDecoderUnknownFieldTolerance:
+    def test_raise_inside_scan_loop(self):
+        source = """
+def FromString(data):
+    for field, wt, value in scan_fields(memoryview(data)):
+        if field == 1:
+            pass
+        else:
+            raise ValueError("unknown field")
+"""
+        (f,) = active(tolerance(source))
+        assert "scan_fields() loop" in f.message
+
+    def test_field_dispatch_else_raise(self):
+        source = """
+def decode(data, field, pos):
+    if field == 1:
+        pos += 2
+    elif field == 2:
+        pos += 3
+    else:
+        raise ValueError("unknown field")
+"""
+        (f,) = active(tolerance(source))
+        assert "unmatched field number" in f.message
+
+    def test_wire_type_chain_may_raise(self):
+        # After the chain switches from field dispatch to wire-type
+        # dispatch, a terminal raise is legitimate: unknown wire types
+        # 3/4/6/7 are malformed data, not schema evolution (this is
+        # fastwire's manual-scanner shape).
+        source = """
+def decode(data, field, wt, pos):
+    if field == 1:
+        pos += 2
+    elif wt == 5:
+        pos += 4
+    else:
+        raise ValueError("bad wire type")
+"""
+        assert active(tolerance(source)) == []
+
+    def test_negative_silent_skip(self):
+        source = """
+def FromString(data):
+    msg = {}
+    for field, wt, value in scan_fields(memoryview(data)):
+        if field == 1:
+            msg["id"] = value
+    return msg
+"""
+        assert active(tolerance(source)) == []
+
+    def test_suppressed(self):
+        source = """
+def FromString(data):
+    for field, wt, value in scan_fields(memoryview(data)):
+        if field == 1:
+            pass
+        else:
+            raise ValueError("x")  # shockwave-lint: disable=decoder-unknown-field-tolerance
+"""
+        findings = tolerance(source)
+        assert findings and all(f.suppressed for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# Wire registry: round-trip + every mutation class must be caught.
+# ---------------------------------------------------------------------------
+
+BASE_PROTO = """
+syntax = "proto3";
+message M {
+  uint64 a = 1;
+  string b = 2;
+  repeated double c = 3;
+}
+"""
+
+
+def schema_of(text):
+    return ProtoSchema.from_sources({"m.proto": text})
+
+
+class TestWireRegistry:
+    def test_round_trip_clean(self):
+        schema = schema_of(BASE_PROTO)
+        registry = make_registry(schema)
+        assert diff_registry(schema, registry) == []
+        entries = registry["entries"]
+        assert [(e["field"], e["number"]) for e in entries] == [
+            ("a", 1),
+            ("b", 2),
+            ("c", 3),
+        ]
+        assert entries[2]["type"] == "repeated double"
+
+    def test_renumbered_field_fails(self):
+        registry = make_registry(schema_of(BASE_PROTO))
+        mutated = schema_of(BASE_PROTO.replace("uint64 a = 1;", "uint64 a = 4;"))
+        problems = diff_registry(mutated, registry)
+        assert any("M.a renumbered" in p for p in problems)
+
+    def test_repurposed_number_fails(self):
+        registry = make_registry(schema_of(BASE_PROTO))
+        mutated = schema_of(BASE_PROTO.replace("uint64 a = 1;", "uint64 z = 1;"))
+        problems = diff_registry(mutated, registry)
+        assert any("field 1 repurposed" in p for p in problems)
+
+    def test_retyped_number_fails(self):
+        registry = make_registry(schema_of(BASE_PROTO))
+        mutated = schema_of(BASE_PROTO.replace("uint64 a = 1;", "string a = 1;"))
+        problems = diff_registry(mutated, registry)
+        assert any("repurposed" in p and "string" in p for p in problems)
+
+    def test_dropped_field_without_tombstone_fails(self):
+        registry = make_registry(schema_of(BASE_PROTO))
+        mutated = schema_of(BASE_PROTO.replace("uint64 a = 1;", ""))
+        problems = diff_registry(mutated, registry)
+        assert any("without a reserved tombstone" in p for p in problems)
+
+    def test_dropped_field_with_tombstone_is_legal(self):
+        registry = make_registry(schema_of(BASE_PROTO))
+        mutated = schema_of(BASE_PROTO.replace("uint64 a = 1;", "reserved 1;"))
+        assert diff_registry(mutated, registry) == []
+
+    def test_dropped_message_fails(self):
+        registry = make_registry(schema_of(BASE_PROTO))
+        mutated = schema_of(
+            'syntax = "proto3"; message Other { uint64 x = 1; }'
+        )
+        problems = diff_registry(mutated, registry)
+        assert any("whole message removed" in p for p in problems)
+
+    def test_appended_field_is_flagged_until_registered(self):
+        registry = make_registry(schema_of(BASE_PROTO))
+        grown = schema_of(BASE_PROTO.replace("}", "  bool d = 4;\n}"))
+        problems = diff_registry(grown, registry)
+        assert problems == [p for p in problems if "is not in" in p]
+        assert len(problems) == 1
+        # Regenerating (the --write-wire-registry append) goes green.
+        assert diff_registry(grown, make_registry(grown)) == []
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 repo-wide gate: the real codecs, registry, and artifacts.
+# ---------------------------------------------------------------------------
+
+class TestRepoIsClean:
+    def test_real_codecs_have_no_findings(self):
+        root = repo_root()
+        schema = load_repo_schema(root)
+        rules = [
+            ProtoCodecDrift(schema),
+            FieldNumberCollision(schema),
+            CanonicalDefaultOmission(),
+            DecoderUnknownFieldTolerance(),
+        ]
+        findings = active(
+            run_paths(
+                [os.path.join(root, "shockwave_tpu", "runtime", "protobuf")],
+                rules=rules,
+            )
+        )
+        assert findings == [], [f.render() for f in findings]
+
+    def test_committed_registry_is_ratchet_green(self):
+        root = repo_root()
+        registry = load_registry(default_registry_path(root))
+        assert registry is not None, "wire_registry.json missing"
+        schema = load_repo_schema(root)
+        assert diff_registry(schema, registry) == []
+        # Byte-stable: regenerating produces the identical entry list.
+        assert registry["entries"] == registry_entries(schema)
+
+    def test_prefix_artifacts(self):
+        root = repo_root()
+        with open(
+            os.path.join(root, "results", "lint", "wire_pre.json"),
+            encoding="utf-8",
+        ) as f:
+            pre = json.load(f)
+        msgs = [x["message"] for x in pre["findings"]]
+        assert any("explain.proto" in m for m in msgs)
+        assert pre["total_findings"] == len(pre["findings"]) > 0
+        with open(
+            os.path.join(root, "results", "lint", "wire_post.json"),
+            encoding="utf-8",
+        ) as f:
+            post = json.load(f)
+        assert post["total_findings"] == 0
+        assert post["findings"] == []
